@@ -1,0 +1,9 @@
+"""Driver benchmark entry: prints ONE JSON line {metric, value, unit,
+vs_baseline}. See corro_sim/benchmarks.py for the scenario definition."""
+
+import sys
+
+from corro_sim.benchmarks import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""corro-sim-jax: a TPU-native simulator of Corrosion's replication protocols.
+
+Corrosion (the reference, valyentdev/corrosion) is a Rust distributed system
+replicating SQLite state across clusters via:
+
+- CR-SQLite per-column LWW CRDTs (reference ``doc/crdts.md:13-16``),
+- SWIM membership via the ``foca`` crate
+  (``crates/corro-agent/src/broadcast/mod.rs:120-375``),
+- QUIC gossip broadcast with ring-0 eager paths and bounded retransmission
+  (``broadcast/mod.rs:489-597``),
+- periodic anti-entropy sync computing version-range "needs"
+  (``crates/corro-types/src/sync.rs:127-249``).
+
+This package re-expresses those protocols as batched array programs so that a
+whole cluster advances in one ``lax.scan`` step on TPU:
+
+- every node's CR-SQLite row state is a node-sharded tensor
+  (:mod:`corro_sim.core.crdt`),
+- LWW merge is a lexicographic scatter-max over
+  ``(col_version, value_rank, site_id)`` keys,
+- version bookkeeping (``BookedVersions``, reference
+  ``corro-types/src/agent.rs:1310-1496``) is a per-(node, actor) contiguous
+  head plus a 32-bit out-of-order window (:mod:`corro_sim.core.bookkeeping`),
+- broadcast and sync become sparse scatter/gather along sampled peer
+  adjacency (:mod:`corro_sim.gossip`, :mod:`corro_sim.sync`),
+- foca's SWIM automaton runs vmapped per node (:mod:`corro_sim.membership`).
+
+Nothing here imports from or links against the reference; the architecture is
+array-first, not a port of the Rust task/channel graph.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,104 @@
+"""Headline benchmark: 10k-node gossip/CRDT cluster simulation on TPU.
+
+Scenario = BASELINE.md config 4: 10k nodes, SWIM membership enabled, a
+network partition during the run, gossip broadcast + anti-entropy sync.
+Metric: CRDT changes applied across the cluster per wall-clock second
+(local writes + fresh broadcast merges + sync repairs), steady-state,
+excluding compile.
+
+Baseline: the reference publishes no benchmarks (BASELINE.md); its only
+numeric datum is an incidental sync-throughput log line of 156.04
+changes/s on a dev machine (``doc/quick-start.md:121``). vs_baseline is
+measured against that number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_CHANGES_PER_SEC = 156.04  # doc/quick-start.md:121
+
+
+def run_headline_bench(
+    n: int | None = None,
+    chunk: int | None = None,
+    measured_chunks: int | None = None,
+) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule, _chunk_runner
+    from corro_sim.engine.state import init_state
+
+    n = n or int(os.environ.get("CORRO_BENCH_NODES", "10000"))
+    chunk = chunk or int(os.environ.get("CORRO_BENCH_CHUNK", "8"))
+    measured_chunks = measured_chunks or int(
+        os.environ.get("CORRO_BENCH_CHUNKS", "4")
+    )
+
+    cfg = SimConfig(
+        num_nodes=n,
+        num_rows=256,
+        num_cols=4,
+        log_capacity=512,
+        write_rate=0.5,
+        zipf_alpha=0.8,
+        swim_enabled=True,
+        swim_suspect_rounds=6,
+        sync_interval=8,
+        sync_actor_topk=32,
+        sync_cap_per_actor=8,
+    )
+    state = init_state(cfg, seed=0)
+    runner = _chunk_runner(cfg)
+
+    def part_fn(r, num):
+        p = np.zeros(num, np.int32)
+        if 16 <= r < 32:  # partition window mid-run
+            p[num // 2:] = 1
+        return p
+
+    schedule = Schedule(write_rounds=10**9, part_fn=part_fn)
+    root = jax.random.PRNGKey(0)
+
+    def run_chunk(state, ci, start_round):
+        alive, part, we = schedule.slice(start_round, chunk, cfg.num_nodes)
+        keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
+        return runner(
+            state, keys, jnp.asarray(alive), jnp.asarray(part), jnp.asarray(we)
+        )
+
+    # warm-up / compile
+    s, m = run_chunk(state, 0, 0)
+    jax.block_until_ready(m)
+    state = s
+
+    t0 = time.perf_counter()
+    applied = 0
+    rounds = 0
+    for ci in range(1, 1 + measured_chunks):
+        state, m = run_chunk(state, ci, rounds + chunk)
+        m = jax.tree.map(np.asarray, m)
+        applied += int(m["writes"].sum()) + int(m["fresh"].sum()) + int(
+            m["sync_versions"].sum()
+        )
+        rounds += chunk
+    wall = time.perf_counter() - t0
+
+    changes_per_sec = applied / wall
+    return {
+        "metric": f"crdt_changes_applied_per_sec_{n}_node_sim",
+        "value": round(changes_per_sec, 2),
+        "unit": "changes/s",
+        "vs_baseline": round(changes_per_sec / REFERENCE_CHANGES_PER_SEC, 2),
+    }
+
+
+def main() -> int:
+    print(json.dumps(run_headline_bench()))
+    return 0

@@ -1,0 +1,114 @@
+"""`corro-sim` command line — the analog of the reference's `corrosion` CLI.
+
+The reference binary exposes Agent/Backup/Restore/Cluster/Query/Exec/Sync/…
+subcommands (``crates/corrosion/src/main.rs:626-801``). The simulator's
+command surface grows toward that inventory; current subcommands:
+
+  run     — run a simulation config to convergence, print a report
+  bench   — the headline benchmark (same as bench.py)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+_FLAG_TO_FIELD = {
+    "nodes": "num_nodes",
+    "rows": "num_rows",
+    "cols": "num_cols",
+    "log_capacity": "log_capacity",
+    "write_rate": "write_rate",
+    "zipf": "zipf_alpha",
+    "swim": "swim_enabled",
+    "sync_interval": "sync_interval",
+}
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    import dataclasses
+
+    import numpy as np
+
+    from corro_sim.engine import init_state, run_sim
+    from corro_sim.engine.driver import Schedule
+    from corro_sim.io.config_file import load_config
+
+    # --config (+ CORRO_SIM__* env) provides the base; explicit CLI flags
+    # win — the reference's TOML < env < CLI precedence
+    # (corro-types/src/config.rs:284-291, corrosion/src/main.rs:558-624).
+    cfg = load_config(args.config)
+    overrides = {
+        field: getattr(args, flag)
+        for flag, field in _FLAG_TO_FIELD.items()
+        if getattr(args, flag) is not None
+    }
+    cfg = dataclasses.replace(cfg, **overrides).validate()
+    res = run_sim(
+        cfg,
+        init_state(cfg, seed=args.seed),
+        Schedule(write_rounds=args.write_rounds),
+        max_rounds=args.max_rounds,
+        chunk=args.chunk,
+        seed=args.seed,
+    )
+    report = {
+        "nodes": cfg.num_nodes,
+        "converged_round": res.converged_round,
+        "rounds_run": res.rounds,
+        "writes": int(res.metrics["writes"].sum()),
+        "changes_applied": int(res.metrics["fresh"].sum())
+        + int(res.metrics["sync_versions"].sum()),
+        "dropped_window": int(res.metrics["dropped_window"].sum()),
+        "wall_per_round_ms": round(res.wall_per_round_ms, 3),
+        "compile_seconds": round(res.compile_seconds, 2),
+        "sim_seconds_per_round": cfg.round_ms / 1000.0,
+        "final_gap": float(np.asarray(res.metrics["gap"])[-1]),
+    }
+    print(json.dumps(report, indent=2))
+    return 0 if res.converged_round is not None else 3
+
+
+def _cmd_bench(_args: argparse.Namespace) -> int:
+    from corro_sim.benchmarks import main as bench_main
+
+    return bench_main() or 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="corro-sim",
+        description="TPU-native simulator of Corrosion's replication protocols",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    pr = sub.add_parser("run", help="run a simulation to convergence")
+    pr.add_argument("--config", help="TOML config file ([sim] table)")
+    pr.add_argument("--nodes", type=int)
+    pr.add_argument("--rows", type=int)
+    pr.add_argument("--cols", type=int)
+    pr.add_argument("--log-capacity", type=int)
+    pr.add_argument("--write-rate", type=float)
+    pr.add_argument("--zipf", type=float)
+    pr.add_argument("--swim", action="store_const", const=True)
+    pr.add_argument("--sync-interval", type=int)
+    pr.add_argument("--write-rounds", type=int, default=32)
+    pr.add_argument("--max-rounds", type=int, default=4096)
+    pr.add_argument("--chunk", type=int, default=16)
+    pr.add_argument("--seed", type=int, default=0)
+    pr.set_defaults(fn=_cmd_run)
+
+    pb = sub.add_parser("bench", help="run the headline benchmark")
+    pb.set_defaults(fn=_cmd_bench)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Simulation configuration — the analog of the reference's typed `Config`.
+
+The reference loads a TOML ``Config{db, api, gossip, perf, ...}`` with
+env-var overrides (``corro-types/src/config.rs:44-62,284-291``) whose
+``PerfConfig`` exposes every channel capacity and queue threshold
+(``config.rs:168-215``). Here the same role is played by :class:`SimConfig`:
+every buffer size, fanout, cadence and cap is a static field (XLA needs
+static shapes — cluster size, fanout and buffer caps are compile-time per
+run, churn changes membership *state*, not shapes).
+
+TOML loading + ``CORRO_SIM__``-prefixed env overrides live in
+:mod:`corro_sim.io.config_file`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    # --- cluster shape ---
+    num_nodes: int = 64
+    num_rows: int = 256  # table row slots (pk universe)
+    num_cols: int = 4  # columns per row
+    log_capacity: int = 1024  # max versions per actor per run (ring)
+
+    # --- workload ---
+    write_rate: float = 0.5  # P(node writes) per round while writes enabled
+    delete_rate: float = 0.0  # P(write is a DELETE)
+    zipf_alpha: float = 0.0  # 0 = uniform rows; >0 = Zipf hot-row contention
+    value_universe: int = 1 << 20  # interned value id space
+
+    # --- gossip (reference broadcast/mod.rs) ---
+    pend_slots: int = 16  # pending-broadcast ring per node
+    fanout: int = 3  # random members per dissemination round
+    max_transmissions: int = 4  # re-send budget (foca-style)
+    rebroadcast_transmissions: int = 2  # budget for relayed changes
+    ring0_size: int = 4  # eager low-latency peer set size
+
+    # --- anti-entropy sync (reference api/peer.rs, agent/handlers.rs) ---
+    sync_interval: int = 8  # rounds between sync sweeps (1-15 s backoff analog)
+    sync_candidates: int = 10  # RANDOM_NODES_CHOICES (agent/mod.rs:38)
+    sync_server_cap: int = 3  # inbound sync semaphore (corro-types/agent.rs:132)
+    sync_actor_topk: int = 32  # actors repaired per sync round
+    sync_cap_per_actor: int = 8  # versions per actor per sync round
+    sync_need_sample: int = 256  # actors sampled for need estimation
+
+    # --- SWIM membership (foca analog) ---
+    swim_enabled: bool = False
+    swim_indirect_probes: int = 3  # num_indirect_probes
+    swim_suspect_rounds: int = 6  # suspicion timeout, in rounds
+    swim_gossip_peers: int = 3  # view-exchange peers per round
+    swim_announce_interval: int = 4  # belief-independent announce cadence
+    # (ANNOUNCE_INTERVAL analog, agent/mod.rs:32 — heals mutual-down splits)
+
+    # --- timing model ---
+    round_ms: float = 200.0  # simulated wall-clock per round (broadcast
+    # flush cadence is 500 ms in the reference, broadcast/mod.rs:378; one
+    # sim round ≈ one flush+delivery hop)
+
+    @property
+    def num_actors(self) -> int:
+        return self.num_nodes
+
+    def validate(self) -> "SimConfig":
+        assert self.num_nodes >= 2
+        assert self.fanout >= 1 and self.pend_slots >= 1
+        assert self.log_capacity >= 1
+        assert self.sync_candidates >= 1
+        return self

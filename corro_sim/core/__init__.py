@@ -1,0 +1,15 @@
+from corro_sim.core.crdt import TableState, apply_cell_changes, make_table_state
+from corro_sim.core.bookkeeping import Bookkeeping, deliver_versions, make_bookkeeping
+from corro_sim.core.changelog import ChangeLog, make_changelog, append_writes
+
+__all__ = [
+    "TableState",
+    "apply_cell_changes",
+    "make_table_state",
+    "Bookkeeping",
+    "deliver_versions",
+    "make_bookkeeping",
+    "ChangeLog",
+    "make_changelog",
+    "append_writes",
+]

@@ -1,0 +1,129 @@
+"""Version bookkeeping: who has applied which versions of which actor.
+
+The reference keeps, per (node, actor), a ``BookedVersions``: the set of
+applied changeset versions, the ``needed`` gap set (``RangeInclusiveSet``),
+partial-seq buffers, and the max seen version
+(``corro-types/src/agent.rs:1310-1496``). Gap ranges are collapsed
+transactionally by ``compute_gaps_change`` (``agent.rs:1220-1285``).
+
+A ragged range-set per (node, actor) cannot live on a TPU. Instead:
+
+- ``head[N, A] int32`` — the contiguously-applied prefix: every version of
+  actor ``a`` up to ``head[n, a]`` has been applied at node ``n``.
+- ``win[N, A] uint32`` — a 32-slot out-of-order window: bit ``k`` set means
+  version ``head + 1 + k`` was applied ahead of a gap.
+
+A delivery inside the window sets its bit; the contiguous prefix is then
+absorbed (count-trailing-ones + shift, :mod:`corro_sim.utils.bits`). A
+delivery *beyond* the window is dropped — deliberately. That is the
+reference's own escape hatch: ``handle_changes`` drops when its queue
+overflows and anti-entropy sync repairs the loss
+(``corro-agent/src/agent/handlers.rs:866-884``). Here "window overflow"
+plays the role of queue overflow, and :mod:`corro_sim.sync` repairs it.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from corro_sim.utils.bits import WINDOW_BITS, absorb, window_shift_right
+from corro_sim.utils.slots import dedupe_sorted_mask
+
+
+@flax.struct.dataclass
+class Bookkeeping:
+    head: jnp.ndarray  # (N, A) int32
+    win: jnp.ndarray  # (N, A) uint32
+
+
+def make_bookkeeping(num_nodes: int, num_actors: int) -> Bookkeeping:
+    return Bookkeeping(
+        head=jnp.zeros((num_nodes, num_actors), jnp.int32),
+        win=jnp.zeros((num_nodes, num_actors), jnp.uint32),
+    )
+
+
+def deliver_versions(
+    book: Bookkeeping,
+    dst: jnp.ndarray,
+    actor: jnp.ndarray,
+    ver: jnp.ndarray,
+    valid: jnp.ndarray,
+):
+    """Record a flat batch of (dst, actor, version) deliveries.
+
+    Returns ``(new_book, fresh, dropped)`` where ``fresh[m]`` is True iff
+    message ``m`` was the first in this batch to deliver a not-yet-applied
+    version (these are the changes worth merging and re-broadcasting — the
+    reference's seen-cache + ``booked.contains_all`` check,
+    ``handlers.rs:886-934``), and ``dropped[m]`` marks beyond-window drops
+    for metrics (``corro.agent.changes.dropped`` analog).
+
+    Within-batch duplicates are removed by sorting on (dst, actor, ver); the
+    window bits are then applied with a plain scatter-add of ``1 << offset``
+    (safe once unique).
+
+    Batch semantics: window offsets are computed against the head *before*
+    the batch — a batch models one round's concurrent deliveries, so a
+    version more than WINDOW_BITS ahead of the pre-round head is dropped
+    even if the same batch also fills the gap. (Sequential processing would
+    accept it; the batched rule drops slightly more aggressively, which is
+    safe — drops are exactly what anti-entropy repairs.)
+    """
+    m = dst.shape[0]
+    n, a = book.head.shape
+
+    # Sort by (dst, actor, ver); invalid lanes sort to the end via huge dst.
+    big = jnp.int32(n + 1)
+    sdst = jnp.where(valid, dst, big)
+    order = jnp.lexsort((ver, actor, sdst))
+    s_dst = sdst[order]
+    s_actor = actor[order]
+    s_ver = ver[order]
+    s_valid = valid[order]
+
+    first = dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
+
+    pair_idx = (jnp.where(s_valid, s_dst, -1), s_actor)
+    head_g = book.head[pair_idx]
+    win_g = book.win[pair_idx]
+    off = s_ver - head_g - 1  # window bit offset; <0 = already applied
+    in_window = (off >= 0) & (off < WINDOW_BITS)
+    already = (off >= 0) & (off < WINDOW_BITS) & (
+        (win_g >> off.clip(0, WINDOW_BITS - 1).astype(jnp.uint32)) & jnp.uint32(1)
+    ).astype(bool)
+    fresh_sorted = first & in_window & ~already
+    dropped_sorted = first & (off >= WINDOW_BITS)
+
+    bit = jnp.where(
+        fresh_sorted,
+        jnp.left_shift(
+            jnp.uint32(1), off.clip(0, WINDOW_BITS - 1).astype(jnp.uint32)
+        ),
+        jnp.uint32(0),
+    )
+    new_win = book.win.at[pair_idx].add(bit, mode="drop")
+    new_head, new_win = absorb(book.head, new_win)
+
+    # Un-sort the masks back to caller order.
+    inv = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+    return (
+        Bookkeeping(head=new_head, win=new_win),
+        fresh_sorted[inv],
+        dropped_sorted[inv],
+    )
+
+
+def advance_heads(book: Bookkeeping, new_floor: jnp.ndarray) -> Bookkeeping:
+    """Raise heads to at least ``new_floor`` (N, A) — the sync fast-path.
+
+    After an anti-entropy transfer the contiguous prefix extends to the
+    synced range's end; any window bits now below the head are re-absorbed.
+    Window bits are *about* offsets from the old head, so shift them by the
+    head delta before absorbing.
+    """
+    floor = jnp.maximum(book.head, new_floor)
+    delta = (floor - book.head).astype(jnp.uint32)
+    head, win = absorb(floor, window_shift_right(book.win, delta))
+    return Bookkeeping(head=head, win=win)

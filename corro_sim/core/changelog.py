@@ -1,0 +1,99 @@
+"""The global change log: every actor's write history as dense tensors.
+
+In the reference each agent's writes live in its SQLite ``crsql_changes``
+virtual table, keyed by (version, seq) and re-read at broadcast and sync
+time (``corro-types/src/broadcast.rs:480-544``,
+``corro-agent/src/api/peer.rs:351-762``). In the simulator the whole
+cluster shares one address space, so the authoritative write history is a
+single replicated structure-of-arrays indexed by (actor, version % L):
+
+    log_row[A, L]   row slot written
+    log_col[A, L]   column index
+    log_vr[A, L]    interned value rank
+    log_cv[A, L]    col_version assigned at write time
+    log_cl[A, L]    causal length assigned at write time
+
+``L`` caps versions per actor per run (static shape); the ring wraps, which
+is safe as long as no node lags more than ``L`` versions — the same flavor
+of bound as the reference's bounded queues. What stays *per node* is only
+the bookkeeping of which (actor, version) pairs have been applied
+(:mod:`corro_sim.core.bookkeeping`) — delivery state, not data.
+
+One version == one cell change here (the reference batches a transaction
+into one version with many seqs, ``corro-api-types/src/lib.rs:235-245``;
+multi-cell changesets are modeled by emitting consecutive versions).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+
+@flax.struct.dataclass
+class ChangeLog:
+    row: jnp.ndarray  # (A, L) int32
+    col: jnp.ndarray  # (A, L) int32
+    vr: jnp.ndarray  # (A, L) int32
+    cv: jnp.ndarray  # (A, L) int32
+    cl: jnp.ndarray  # (A, L) int32
+    head: jnp.ndarray  # (A,) int32 — number of versions each actor has written
+
+    @property
+    def capacity(self) -> int:
+        return self.row.shape[1]
+
+
+def make_changelog(num_actors: int, capacity: int) -> ChangeLog:
+    # Distinct buffers per field — sharing one zeros array across fields
+    # makes buffer donation reject the state ("same buffer donated twice").
+    shape = (num_actors, capacity)
+    return ChangeLog(
+        row=jnp.zeros(shape, jnp.int32),
+        col=jnp.zeros(shape, jnp.int32),
+        vr=jnp.zeros(shape, jnp.int32),
+        cv=jnp.zeros(shape, jnp.int32),
+        cl=jnp.zeros(shape, jnp.int32),
+        head=jnp.zeros((num_actors,), jnp.int32),
+    )
+
+
+def append_writes(
+    log: ChangeLog,
+    actor: jnp.ndarray,
+    row: jnp.ndarray,
+    col: jnp.ndarray,
+    vr: jnp.ndarray,
+    cv: jnp.ndarray,
+    cl: jnp.ndarray,
+    valid: jnp.ndarray,
+):
+    """Append one write per listed actor; returns (log, version) per lane.
+
+    Each lane is a distinct actor (a node writes at most one changeset per
+    round — the reference serializes local writes through a single write
+    connection + ``Semaphore(1)``, ``corro-types/src/agent.rs:500-731``, so
+    per-round-per-actor writes are naturally ordered).
+    """
+    aidx = jnp.where(valid, actor, -1)
+    ver = log.head[aidx] + 1  # versions are 1-based (Version(u64) newtype)
+    slot = (ver - 1) % log.capacity
+    idx = (aidx, slot)
+    return (
+        ChangeLog(
+            row=log.row.at[idx].set(row, mode="drop"),
+            col=log.col.at[idx].set(col, mode="drop"),
+            vr=log.vr.at[idx].set(vr, mode="drop"),
+            cv=log.cv.at[idx].set(cv, mode="drop"),
+            cl=log.cl.at[idx].set(cl, mode="drop"),
+            head=log.head.at[aidx].add(jnp.where(valid, 1, 0), mode="drop"),
+        ),
+        ver.astype(jnp.int32),
+    )
+
+
+def gather_changes(log: ChangeLog, actor: jnp.ndarray, ver: jnp.ndarray):
+    """Fetch the (row, col, vr, cv, cl) tuple for (actor, version) lanes."""
+    slot = (ver - 1) % log.capacity
+    idx = (actor, slot)
+    return log.row[idx], log.col[idx], log.vr[idx], log.cv[idx], log.cl[idx]

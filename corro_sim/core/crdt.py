@@ -1,0 +1,156 @@
+"""CR-SQLite's LWW CRDT merge as batched TPU array ops.
+
+Reference semantics (``doc/crdts.md:15-17,237``, enforced by the CR-SQLite
+extension the reference bundles at ``crates/corro-types/crsqlite-*.so``):
+
+  For an existing (row, column) cell, an incoming change wins iff its
+  ``(col_version, value, site_id)`` triple is lexicographically larger than
+  the stored one. ("Biggest ``col_version`` wins; tie → biggest value";
+  final tie broken on site_id — ``doc/crdts.md:237``.)
+
+Per-row *causal length* ``cl`` (delete/resurrect counter) merges by max
+(cl CRDT, ``doc/crdts.md:13``; odd = live row, even = deleted).
+
+TPU design
+----------
+Node-local SQLite B-trees become one structure-of-arrays *TableState*: three
+int32 planes of shape (nodes, rows, cols) holding ``col_version``,
+``value_rank`` (values interned to a total order preserving SQLite value
+comparison, see :mod:`corro_sim.io.values`) and ``site``. Merging a batch of
+changes is then a *lexicographic scatter-max*. XLA has no lexicographic
+scatter combinator and we avoid 64-bit packed keys (int64 is emulated on
+TPU), so the merge runs as three masked int32 scatter-max passes:
+
+1. scatter-max ``col_version``;
+2. among changes whose col_version equals the post-merge winner, scatter-max
+   ``value_rank`` (existing value participates in the tie only if the stored
+   col_version survived);
+3. among changes matching both, scatter-max ``site``.
+
+All passes are dense, batched over every node at once — the per-node merge
+loop of ``process_multiple_changes`` (reference
+``corro-agent/src/agent/util.rs:721-1062``) vanishes into three scatters.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+NEG = jnp.int32(-(2**31))
+
+
+@flax.struct.dataclass
+class TableState:
+    """Per-node CRDT cell state; every field shape (N, R, C) except cl (N, R)."""
+
+    cv: jnp.ndarray  # col_version, int32, starts at 0 (= never written)
+    vr: jnp.ndarray  # value rank, int32, NEG when never written
+    site: jnp.ndarray  # writer site ordinal, int32, -1 when never written
+    cl: jnp.ndarray  # causal length per row, int32, 0 = never existed
+
+
+def make_table_state(num_nodes: int, num_rows: int, num_cols: int) -> TableState:
+    shape = (num_nodes, num_rows, num_cols)
+    return TableState(
+        cv=jnp.zeros(shape, jnp.int32),
+        vr=jnp.full(shape, NEG, jnp.int32),
+        site=jnp.full(shape, -1, jnp.int32),
+        cl=jnp.zeros((num_nodes, num_rows), jnp.int32),
+    )
+
+
+def apply_cell_changes(
+    state: TableState,
+    dst: jnp.ndarray,
+    row: jnp.ndarray,
+    col: jnp.ndarray,
+    ch_cv: jnp.ndarray,
+    ch_vr: jnp.ndarray,
+    ch_site: jnp.ndarray,
+    ch_cl: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> TableState:
+    """Merge a flat batch of cell changes into the cluster's table state.
+
+    Args are parallel (M,) arrays: destination node, row slot, column, and the
+    change triple. ``valid`` masks out padding lanes (ragged batches are the
+    norm: every round produces a different number of deliveries, but shapes
+    must be static under jit).
+
+    This is the TPU analog of the reference's per-change
+    ``INSERT INTO crsql_changes`` loop in ``process_complete_version``
+    (``corro-agent/src/agent/util.rs:1193-1307``) — except it applies every
+    change for every node in one shot.
+    """
+    # Invalid lanes scatter out of bounds and get dropped.
+    dst = jnp.where(valid, dst, -1)
+
+    cv0, vr0, site0 = state.cv, state.vr, state.site
+    idx = (dst, row, col)
+
+    # Pass 1: col_version.
+    cv1 = cv0.at[idx].max(jnp.where(valid, ch_cv, NEG), mode="drop")
+
+    # Pass 2: value rank. The stored value only competes if the stored
+    # col_version is still the winner; otherwise the cell was superseded and
+    # its value is reset before the tie-break.
+    vr_base = jnp.where(cv1 > cv0, NEG, vr0)
+    win1 = valid & (ch_cv == cv1[idx])
+    vr1 = vr_base.at[idx].max(jnp.where(win1, ch_vr, NEG), mode="drop")
+
+    # Pass 3: site. Stored site survives only if (cv, vr) both survived.
+    site_base = jnp.where((cv1 != cv0) | (vr1 != vr0), NEG, site0)
+    win2 = win1 & (ch_vr == vr1[idx])
+    site1 = site_base.at[idx].max(jnp.where(win2, ch_site, NEG), mode="drop")
+
+    # Causal length: per-row max (cl CRDT).
+    cl1 = state.cl.at[dst, row].max(jnp.where(valid, ch_cl, NEG), mode="drop")
+
+    return TableState(cv=cv1, vr=vr1, site=site1, cl=cl1)
+
+
+def local_write(
+    state: TableState,
+    writer: jnp.ndarray,
+    row: jnp.ndarray,
+    col: jnp.ndarray,
+    vr: jnp.ndarray,
+    site: jnp.ndarray,
+    is_delete: jnp.ndarray,
+    valid: jnp.ndarray,
+):
+    """Apply node-local writes and return the resulting change records.
+
+    A local UPDATE bumps the cell's col_version to (stored + 1) — exactly what
+    the CR-SQLite triggers do on a tracked table (``doc/crdts.md:82``). A
+    DELETE instead bumps the row's causal length to the next even number and
+    a fresh INSERT after a delete bumps it to the next odd number
+    (causal-length CRDT).
+
+    Returns ``(new_state, ch_cv, ch_cl)`` where ``ch_cv``/``ch_cl`` are the
+    per-write col_version / causal length to record in the change log and
+    gossip out.
+    """
+    widx = jnp.where(valid, writer, -1)
+    cur_cv = state.cv[widx, row, col]
+    cur_cl = state.cl[widx, row]
+
+    # Next causal length: resurrect (or first insert) → odd; delete → even.
+    alive = (cur_cl % 2) == 1
+    ch_cl = jnp.where(
+        is_delete,
+        jnp.where(alive, cur_cl + 1, cur_cl),
+        jnp.where(alive, cur_cl, cur_cl + 1),
+    ).astype(jnp.int32)
+    ch_cv = jnp.where(is_delete, cur_cv, cur_cv + 1).astype(jnp.int32)
+    # A DELETE only bumps the causal length — it must not touch column
+    # values (CR-SQLite deletes never produce value changes, only clock
+    # rows). Neutralize the value/site lanes so the merge is a cl-only op.
+    ch_vr = jnp.where(is_delete, NEG, vr).astype(jnp.int32)
+    ch_site = jnp.where(is_delete, NEG, site).astype(jnp.int32)
+
+    new_state = apply_cell_changes(
+        state, writer, row, col, ch_cv, ch_vr, ch_site, ch_cl, valid
+    )
+    return new_state, ch_cv, ch_cl, ch_vr

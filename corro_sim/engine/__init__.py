@@ -1,0 +1,5 @@
+from corro_sim.engine.state import SimState, init_state
+from corro_sim.engine.step import sim_step
+from corro_sim.engine.driver import run_sim, RunResult
+
+__all__ = ["SimState", "init_state", "sim_step", "run_sim", "RunResult"]

@@ -1,0 +1,164 @@
+"""Run loop: chunked `lax.scan` over rounds with host-side convergence exit.
+
+The reference has no "run until converged" mode — convergence is emergent
+from its always-on loops. The simulator's contract (BASELINE.md) is
+*rounds-to-convergence*: drive rounds until every live node has applied
+every written version (``gap == 0``) after the write phase ends.
+
+``lax.scan`` cannot early-exit, so rounds run in device-resident chunks;
+between chunks the host reads one scalar (the last gap) and decides whether
+to continue — one small transfer per chunk, not per round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.state import SimState
+from corro_sim.engine.step import sim_step
+
+
+@dataclasses.dataclass
+class Schedule:
+    """Per-round ground truth: who is up, partition ids, write phase.
+
+    The default models the happy path: everybody up, one partition, writes
+    enabled for ``write_rounds`` rounds then quiesce (the measurement phase).
+    Churn/partition scenarios override the callables.
+    """
+
+    write_rounds: int = 16
+    alive_fn: Callable[[int, int], np.ndarray] | None = None  # (round, n) -> (n,) bool
+    part_fn: Callable[[int, int], np.ndarray] | None = None  # (round, n) -> (n,) int32
+
+    def slice(self, start: int, length: int, n: int):
+        alive = np.ones((length, n), bool)
+        part = np.zeros((length, n), np.int32)
+        we = np.zeros((length,), bool)
+        for t in range(length):
+            r = start + t
+            if self.alive_fn is not None:
+                alive[t] = self.alive_fn(r, n)
+            if self.part_fn is not None:
+                part[t] = self.part_fn(r, n)
+            we[t] = r < self.write_rounds
+        return alive, part, we
+
+
+@dataclasses.dataclass
+class RunResult:
+    state: SimState
+    metrics: dict  # name -> (rounds,) np.ndarray
+    rounds: int
+    converged_round: int | None
+    wall_seconds: float  # steady-state only (first chunk excluded)
+    compile_seconds: float  # first chunk: compile + execute
+    timed_rounds: int = 0
+
+    @property
+    def wall_per_round_ms(self) -> float:
+        return 1000.0 * self.wall_seconds / max(self.timed_rounds, 1)
+
+
+def _chunk_runner(cfg: SimConfig, donate: bool = False):
+    def body(state, inp):
+        key, alive, part, we = inp
+        return sim_step(cfg, state, key, alive, part, we)
+
+    # Buffer donation halves peak memory (state in+out aliased) but the
+    # axon TPU-tunnel platform currently miscompiles donated calls; keep it
+    # opt-in for real multi-chip runs.
+    kwargs = {"donate_argnums": 0} if donate else {}
+
+    @functools.partial(jax.jit, **kwargs)
+    def run_chunk(state, keys, alive, part, we):
+        return jax.lax.scan(body, state, (keys, alive, part, we))
+
+    return run_chunk
+
+
+def run_sim(
+    cfg: SimConfig,
+    state: SimState,
+    schedule: Schedule | None = None,
+    max_rounds: int = 4096,
+    chunk: int = 16,
+    seed: int = 0,
+    stop_on_convergence: bool = True,
+    donate: bool = False,
+    min_rounds: int | None = None,
+) -> RunResult:
+    """``min_rounds``: don't test convergence before this round — needed when
+    the schedule brings nodes back later (a cluster can be momentarily
+    "converged among the living" while an outage victim still has to catch
+    up). Defaults to the write phase length."""
+    schedule = schedule or Schedule()
+    if min_rounds is None:
+        min_rounds = schedule.write_rounds
+    runner = _chunk_runner(cfg, donate=donate)
+    root = jax.random.PRNGKey(seed)
+
+    metrics_chunks = []
+    converged_round = None
+    rounds = 0
+    timed_rounds = 0
+    compile_seconds = 0.0
+    wall = 0.0
+
+    # The first chunk both compiles and executes — its elapsed time is
+    # recorded as compile_seconds and excluded from the steady-state wall
+    # clock, but its rounds/metrics are real (with donation enabled the
+    # warm-up consumes the input buffers, so it cannot be a throwaway).
+    ci = 0
+    while rounds < max_rounds:
+        alive, part, we = schedule.slice(rounds, chunk, cfg.num_nodes)
+        keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
+        t0 = time.perf_counter()
+        state, m = runner(
+            state, keys, jnp.asarray(alive), jnp.asarray(part), jnp.asarray(we)
+        )
+        m = jax.tree.map(np.asarray, m)  # forces device sync
+        elapsed = time.perf_counter() - t0
+        if ci == 0:
+            compile_seconds = elapsed
+        else:
+            wall += elapsed
+            timed_rounds += chunk
+        metrics_chunks.append(m)
+        rounds += chunk
+        ci += 1
+        # Strictly greater: at rounds == min_rounds the round numbered
+        # min_rounds (e.g. a scheduled rejoin) has not executed yet.
+        if stop_on_convergence and rounds > min_rounds:
+            gaps = m["gap"]
+            if gaps[-1] == 0.0:
+                # Only rounds strictly past min_rounds are convergence
+                # candidates — a transient zero during the write phase (all
+                # deliveries momentarily caught up) is not convergence.
+                base = rounds - chunk  # chunk covers rounds base+1 … rounds
+                idx = np.arange(1, chunk + 1) + base
+                eligible = (gaps == 0.0) & (idx > min_rounds)
+                converged_round = int(idx[np.argmax(eligible)])
+                break
+
+    metrics = {
+        k: np.concatenate([c[k] for c in metrics_chunks])
+        for k in metrics_chunks[0]
+    }
+    return RunResult(
+        state=state,
+        metrics=metrics,
+        rounds=rounds,
+        converged_round=converged_round,
+        wall_seconds=wall,
+        compile_seconds=compile_seconds,
+        timed_rounds=timed_rounds,
+    )

@@ -1,0 +1,81 @@
+"""The whole cluster as one pytree.
+
+The reference's per-agent ``Agent(Arc<AgentInner>)`` god-handle
+(``corro-types/src/agent.rs:50-247``) holds pools, clocks, members, booked
+versions and channels for *one* node. Here the entire cluster's state is a
+single structure-of-arrays pytree whose leading axis is the node dimension —
+that axis is what gets sharded over the TPU mesh.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.core.bookkeeping import Bookkeeping, make_bookkeeping
+from corro_sim.core.changelog import ChangeLog, make_changelog
+from corro_sim.core.crdt import TableState, make_table_state
+from corro_sim.gossip.broadcast import GossipState, make_gossip_state
+from corro_sim.membership.swim import SwimState, make_swim_state
+
+
+@flax.struct.dataclass
+class SimState:
+    table: TableState
+    book: Bookkeeping
+    log: ChangeLog
+    gossip: GossipState
+    swim: SwimState
+    ring0: jnp.ndarray  # (N, ring0_size) int32 static eager-peer table
+    row_cdf: jnp.ndarray  # (R,) float32 cumulative row-sampling distribution
+    round: jnp.ndarray  # () int32
+    hlc: jnp.ndarray  # (N,) int32 — per-node HLC tick (uhlc analog)
+
+
+def _row_cdf(cfg: SimConfig) -> np.ndarray:
+    r = cfg.num_rows
+    if cfg.zipf_alpha <= 0.0:
+        w = np.ones(r, np.float64)
+    else:
+        w = 1.0 / np.power(np.arange(1, r + 1, dtype=np.float64), cfg.zipf_alpha)
+    cdf = np.cumsum(w / w.sum())
+    cdf[-1] = 1.0
+    return cdf.astype(np.float32)
+
+
+def _ring0(cfg: SimConfig, seed: int) -> np.ndarray:
+    """Static low-latency neighbor table.
+
+    The reference derives ring-0 from measured RTTs bucketed at
+    {0-6,6-15,…,200-300} ms (``corro-types/src/members.rs:40,140-188``). The
+    simulator's latency structure is positional: nodes adjacent in id space
+    are "close" (think same-rack), so ring0 = the nearest ids plus a couple
+    of random long links, fixed for the run.
+    """
+    rng = np.random.default_rng(seed)
+    n, k = cfg.num_nodes, cfg.ring0_size
+    near = ((np.arange(n)[:, None] + np.arange(1, k + 1)[None, :]) % n).astype(
+        np.int32
+    )
+    if k >= 2:
+        near[:, -1] = rng.integers(0, n, size=n)  # one random long link
+    return near
+
+
+def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
+    cfg.validate()
+    n = cfg.num_nodes
+    return SimState(
+        table=make_table_state(n, cfg.num_rows, cfg.num_cols),
+        book=make_bookkeeping(n, cfg.num_actors),
+        log=make_changelog(cfg.num_actors, cfg.log_capacity),
+        gossip=make_gossip_state(n, cfg.pend_slots),
+        swim=make_swim_state(n, enabled=cfg.swim_enabled),
+        ring0=jnp.asarray(_ring0(cfg, seed)),
+        row_cdf=jnp.asarray(_row_cdf(cfg)),
+        round=jnp.zeros((), jnp.int32),
+        hlc=jnp.zeros((n,), jnp.int32),
+    )

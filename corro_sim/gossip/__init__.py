@@ -1,0 +1,13 @@
+from corro_sim.gossip.broadcast import (
+    GossipState,
+    broadcast_step,
+    enqueue_broadcasts,
+    make_gossip_state,
+)
+
+__all__ = [
+    "GossipState",
+    "broadcast_step",
+    "enqueue_broadcasts",
+    "make_gossip_state",
+]

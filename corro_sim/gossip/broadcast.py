@@ -1,0 +1,148 @@
+"""Gossip broadcast dissemination as sparse scatter over sampled adjacency.
+
+Reference behavior being modeled (``corro-agent/src/broadcast/mod.rs``):
+
+- local changes go *eagerly* to every ring-0 (lowest-RTT) peer
+  (``broadcast/mod.rs:489-499``);
+- everything else is batched and sent to a random sample of members, then
+  re-queued until ``max_transmissions`` is exhausted
+  (``broadcast/mod.rs:532-597``);
+- receivers re-broadcast fresh changes (``handlers.rs:950-960``), so a
+  change radiates epidemically;
+- queues are bounded and overflow drops (``handlers.rs:866-884``) — sync
+  repairs.
+
+TPU shape: each node owns a fixed ring buffer of pending broadcast ids
+(actor, version, transmissions-left). One round = every node samples
+``fanout`` random targets per live slot and the resulting flat message
+batch is scattered into the cluster-wide delivery pipeline. There is no
+wire protocol — "sending" is building (dst, actor, ver) index arrays.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from corro_sim.utils.slots import group_counts, ranks_within_group
+
+
+@flax.struct.dataclass
+class GossipState:
+    pend_actor: jnp.ndarray  # (N, P) int32
+    pend_ver: jnp.ndarray  # (N, P) int32
+    pend_tx: jnp.ndarray  # (N, P) int32, 0 = free slot
+    cursor: jnp.ndarray  # (N,) int32 ring-buffer write cursor
+    overflow: jnp.ndarray  # () int32 — live slots overwritten (drop metric)
+
+
+def make_gossip_state(num_nodes: int, pend_slots: int) -> GossipState:
+    shape = (num_nodes, pend_slots)
+    return GossipState(
+        pend_actor=jnp.zeros(shape, jnp.int32),
+        pend_ver=jnp.zeros(shape, jnp.int32),
+        pend_tx=jnp.zeros(shape, jnp.int32),
+        cursor=jnp.zeros((num_nodes,), jnp.int32),
+        overflow=jnp.zeros((), jnp.int32),
+    )
+
+
+def enqueue_broadcasts(
+    gossip: GossipState,
+    dst: jnp.ndarray,
+    actor: jnp.ndarray,
+    ver: jnp.ndarray,
+    valid: jnp.ndarray,
+    transmissions: int,
+) -> GossipState:
+    """Append (actor, ver) to each dst's pending ring buffer.
+
+    Slot allocation for a variable number of appends per node is one sort:
+    order by dst, rank within group, slot = (cursor + rank) % P. Overwriting
+    a still-live slot is counted as overflow (the bounded-queue drop of
+    ``handlers.rs:866-884``).
+    """
+    n, p = gossip.pend_tx.shape
+    big = jnp.int32(n + 1)
+    key = jnp.where(valid, dst, big)
+    order = jnp.argsort(key)
+    s_dst = key[order]
+    s_actor = actor[order]
+    s_ver = ver[order]
+    s_valid = valid[order]
+
+    rank = ranks_within_group(s_dst)
+    # More than P appends to one node in a single round: lanes past the ring
+    # capacity are dropped outright (counted as overflow) — wrapping them
+    # would make later lanes clobber earlier ones *within this batch* with a
+    # nondeterministic scatter winner.
+    over_capacity = s_valid & (rank >= p)
+    s_valid = s_valid & (rank < p)
+    slot = (gossip.cursor[jnp.where(s_valid, s_dst, -1)] + rank) % p
+    idx = (jnp.where(s_valid, s_dst, -1), slot)
+
+    clobbered = ((gossip.pend_tx[idx] > 0) & s_valid) | over_capacity
+    counts = group_counts(jnp.where(s_valid, s_dst, big), n)
+
+    return GossipState(
+        pend_actor=gossip.pend_actor.at[idx].set(s_actor, mode="drop"),
+        pend_ver=gossip.pend_ver.at[idx].set(s_ver, mode="drop"),
+        pend_tx=gossip.pend_tx.at[idx].set(
+            jnp.where(s_valid, transmissions, 0), mode="drop"
+        ),
+        cursor=(gossip.cursor + counts) % p,
+        overflow=gossip.overflow + clobbered.sum(dtype=jnp.int32),
+    )
+
+
+def broadcast_step(
+    gossip: GossipState,
+    key: jax.Array,
+    sender_alive: jnp.ndarray,  # (N,) bool — node is actually up
+    target_alive_view: jnp.ndarray,  # (N, N) bool or (N,1)-broadcastable: sender's belief
+    fanout: int,
+):
+    """Emit one round of gossip messages; decrement transmission budgets.
+
+    Every live pending slot is sent to ``fanout`` uniformly sampled members
+    the *sender believes* are alive (membership is the sender's SWIM view,
+    not ground truth — a node will happily gossip at a dead peer until SWIM
+    says otherwise, exactly like the reference sending into QUIC connections
+    that have not yet errored).
+
+    Returns ``(gossip, dst, src, actor, ver, valid)`` flat message arrays of
+    length N * P * fanout.
+    """
+    n, p = gossip.pend_tx.shape
+    live = (gossip.pend_tx > 0) & sender_alive[:, None]  # (N, P)
+
+    tkey = jax.random.fold_in(key, 7)
+    targets = jax.random.randint(
+        tkey, (n, p, fanout), 0, n, dtype=jnp.int32
+    )
+    src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None, None], targets.shape)
+    # Sender's belief about the target (gather per (src, target)). A shared
+    # (1, N) view means "everyone believes the same thing" (no-SWIM configs)
+    # and avoids materializing an (N, N) belief matrix.
+    if target_alive_view.shape[0] == 1:
+        believed_up = target_alive_view[0][targets]
+    else:
+        believed_up = target_alive_view[src, targets]
+    ok = live[:, :, None] & believed_up & (targets != src)
+
+    dst = targets.reshape(-1)
+    valid = ok.reshape(-1)
+    actor = jnp.broadcast_to(gossip.pend_actor[:, :, None], targets.shape).reshape(-1)
+    ver = jnp.broadcast_to(gossip.pend_ver[:, :, None], targets.shape).reshape(-1)
+    src_flat = src.reshape(-1)
+
+    new_tx = jnp.where(live, gossip.pend_tx - 1, gossip.pend_tx)
+    return (
+        gossip.replace(pend_tx=new_tx),
+        dst,
+        src_flat,
+        actor,
+        ver,
+        valid,
+    )

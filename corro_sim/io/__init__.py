@@ -1,0 +1,4 @@
+from corro_sim.io.config_file import load_config
+from corro_sim.io.values import ValueInterner, sqlite_sort_key
+
+__all__ = ["load_config", "ValueInterner", "sqlite_sort_key"]

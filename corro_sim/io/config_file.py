@@ -1,0 +1,62 @@
+"""TOML config loading with env-var overrides.
+
+The reference loads a TOML ``Config`` and applies ``__``-separated env-var
+overrides via the ``config`` crate (``corro-types/src/config.rs:284-291``),
+e.g. ``CORROSION__GOSSIP__BIND_ADDR``. Here the file is a flat ``[sim]``
+table whose keys are :class:`corro_sim.config.SimConfig` fields, and the
+override prefix is ``CORRO_SIM__``::
+
+    [sim]
+    num_nodes = 1000
+    write_rate = 0.3
+    swim_enabled = true
+
+    CORRO_SIM__NUM_NODES=5000 corro-sim run --config cluster.toml
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+
+from corro_sim.config import SimConfig
+
+ENV_PREFIX = "CORRO_SIM__"
+
+
+def _coerce(field: dataclasses.Field, raw: str):
+    if field.type in ("int", int):
+        return int(raw)
+    if field.type in ("float", float):
+        return float(raw)
+    if field.type in ("bool", bool):
+        if raw.lower() in ("1", "true", "yes", "on"):
+            return True
+        if raw.lower() in ("0", "false", "no", "off"):
+            return False
+        raise ValueError(f"invalid bool for {field.name}: {raw!r}")
+    return raw
+
+
+def load_config(path: str | None = None, env=None) -> SimConfig:
+    """Build a SimConfig from an optional TOML file + env overrides."""
+    env = os.environ if env is None else env
+    fields = {f.name: f for f in dataclasses.fields(SimConfig)}
+    values: dict = {}
+
+    if path is not None:
+        with open(path, "rb") as fh:
+            doc = tomllib.load(fh)
+        table = doc.get("sim", doc)
+        for k, v in table.items():
+            if k not in fields:
+                raise KeyError(f"unknown config key in {path}: {k!r}")
+            values[k] = v
+
+    for k, field in fields.items():
+        env_key = ENV_PREFIX + k.upper()
+        if env_key in env:
+            values[k] = _coerce(field, env[env_key])
+
+    return SimConfig(**values).validate()

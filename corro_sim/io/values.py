@@ -1,0 +1,65 @@
+"""Value interning: SQLite values → dense int32 ranks, order-preserving.
+
+CR-SQLite's LWW tie-break compares raw SQLite values with SQL ``max()``
+semantics (``doc/crdts.md:237-248``): the storage-class order is
+NULL < (INTEGER|REAL, compared numerically) < TEXT (binary collation) <
+BLOB (memcmp). The simulator's merge kernel compares int32 *value ranks*
+(:mod:`corro_sim.core.crdt`), so trace ingestion must map every observed
+value to a rank such that rank order == SQLite value order. The wire shape
+being interned is the reference's ``SqliteValue`` tagged union
+(``corro-api-types/src/lib.rs:455-715``).
+"""
+
+from __future__ import annotations
+
+
+def sqlite_sort_key(value):
+    """Total-order sort key matching SQLite's cross-type value comparison."""
+    if value is None:
+        return (0,)
+    if isinstance(value, bool):  # JSON true/false arrive as ints in SQLite
+        return (1, float(int(value)))
+    if isinstance(value, (int, float)):
+        return (1, float(value))
+    if isinstance(value, str):
+        return (2, value.encode("utf-8"))
+    if isinstance(value, (bytes, bytearray)):
+        return (3, bytes(value))
+    raise TypeError(f"not a SQLite value: {type(value)!r}")
+
+
+class ValueInterner:
+    """Assigns order-preserving dense ranks to a closed set of values.
+
+    Two-phase by design: collect every value appearing in a trace, then
+    ``freeze()`` to get ranks. (An online order-preserving assignment can't
+    be dense; traces are replayed from files, so the closed-world phase is
+    free.)
+    """
+
+    def __init__(self):
+        self._values = set()
+        self._ranks: dict | None = None
+
+    def add(self, value) -> None:
+        if self._ranks is not None:
+            raise RuntimeError("interner is frozen")
+        self._values.add(_hashable(value))
+
+    def freeze(self) -> None:
+        ordered = sorted(self._values, key=sqlite_sort_key)
+        self._ranks = {v: i for i, v in enumerate(ordered)}
+
+    def rank(self, value) -> int:
+        if self._ranks is None:
+            raise RuntimeError("freeze() the interner before ranking")
+        return self._ranks[_hashable(value)]
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+
+def _hashable(value):
+    if isinstance(value, bytearray):
+        return bytes(value)
+    return value

@@ -1,0 +1,19 @@
+from corro_sim.membership.swim import (
+    SwimState,
+    make_swim_state,
+    swim_step,
+    view_alive,
+    ALIVE,
+    SUSPECT,
+    DOWN,
+)
+
+__all__ = [
+    "SwimState",
+    "make_swim_state",
+    "swim_step",
+    "view_alive",
+    "ALIVE",
+    "SUSPECT",
+    "DOWN",
+]

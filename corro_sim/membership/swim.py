@@ -1,0 +1,249 @@
+"""SWIM failure detection as a vmapped per-node automaton.
+
+The reference embeds the ``foca`` SWIM implementation in a dedicated
+single-threaded loop (``corro-agent/src/broadcast/mod.rs:120-375``) and
+consumes its MemberUp/MemberDown notifications to drive the members map
+(``agent/handlers.rs:267-373``). The protocol surface reproduced here:
+
+- each round a node *pings* one random member it believes is up; on no ack
+  it launches ``num_indirect_probes`` indirect probes through random
+  intermediaries (SWIM's ping-req);
+- no ack at all → the member is marked **suspect** with its current
+  incarnation; a suspect not refuted within the timeout becomes **down**;
+- a node that learns it is suspected/declared-down *refutes* by bumping its
+  incarnation — the reference's identity ``renew()`` auto-rejoin
+  (``corro-types/src/actor.rs:199-210``);
+- membership knowledge disseminates epidemically. foca piggybacks updates
+  on gossip datagrams (≤1178 B, ``broadcast/mod.rs:743``); the simulator
+  exchanges full view rows with ``swim_gossip_peers`` random peers per
+  round and merges by ``(incarnation, status-severity)`` — same fixed
+  point, bounded per-round traffic.
+
+State is three (N, N) planes — node i's belief about member j — sharded
+over the observer axis. The whole cluster's SWIM tick is elementwise +
+gathers: no per-node control flow survives.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from corro_sim.config import SimConfig
+
+ALIVE = jnp.int8(0)
+SUSPECT = jnp.int8(1)
+DOWN = jnp.int8(2)
+
+
+@flax.struct.dataclass
+class SwimState:
+    status: jnp.ndarray  # (N, N) int8 — i's belief about j
+    inc: jnp.ndarray  # (N, N) int32 — incarnation i knows for j
+    since: jnp.ndarray  # (N, N) int32 — round suspicion started (else 0)
+
+
+def make_swim_state(num_nodes: int, enabled: bool = True) -> SwimState:
+    n = num_nodes if enabled else 1
+    return SwimState(
+        status=jnp.zeros((n, n), jnp.int8),
+        inc=jnp.zeros((n, n), jnp.int32),
+        since=jnp.zeros((n, n), jnp.int32),
+    )
+
+
+def view_alive(swim: SwimState) -> jnp.ndarray:
+    """(N, N) bool: who each node would still gossip/sync with.
+
+    Suspects remain targets (SWIM keeps talking to suspects — that is how
+    they get the chance to refute); only DOWN members are excluded, matching
+    the reference's members map dropping on MemberDown
+    (``handlers.rs:280-330``).
+    """
+    return swim.status < DOWN
+
+
+def _merge_views(status_a, inc_a, since_a, status_b, inc_b, since_b):
+    """Pointwise foca update-precedence merge.
+
+    Higher incarnation always wins; at equal incarnation the more severe
+    status wins (down > suspect > alive) — i.e. an alive claim only refutes
+    suspicion when it carries a *newer* incarnation.
+    """
+    better = (inc_b > inc_a) | ((inc_b == inc_a) & (status_b > status_a))
+    return (
+        jnp.where(better, status_b, status_a),
+        jnp.where(better, inc_b, inc_a),
+        jnp.where(better, since_b, since_a),
+    )
+
+
+def swim_step(
+    cfg: SimConfig,
+    swim: SwimState,
+    key: jax.Array,
+    alive: jnp.ndarray,  # (N,) ground-truth up mask
+    reachable,  # callable (src, dst) -> bool mask, ground truth links
+    round_idx: jnp.ndarray,
+):
+    """One SWIM protocol round for every node at once."""
+    n = swim.status.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    k_tgt, k_ind, k_ex = jax.random.split(key, 3)
+
+    # --- probe: one random target each -------------------------------------
+    tgt = jax.random.randint(k_tgt, (n,), 0, n, dtype=jnp.int32)
+    probing = alive & (tgt != rows) & (swim.status[rows, tgt] < DOWN)
+
+    direct_ack = probing & alive[tgt] & reachable(rows, tgt)
+
+    inter = jax.random.randint(
+        k_ind, (n, cfg.swim_indirect_probes), 0, n, dtype=jnp.int32
+    )
+    ind_ok = (
+        alive[inter]
+        & alive[tgt][:, None]
+        & reachable(rows[:, None], inter)
+        & reachable(inter, tgt[:, None])
+    ).any(axis=1)
+    acked = direct_ack | (probing & ind_ok)
+    failed = probing & ~acked
+
+    # --- apply probe outcome to the prober's row ---------------------------
+    cur_inc = swim.inc[rows, tgt]
+    cur_status = swim.status[rows, tgt]
+    new_status = jnp.where(
+        failed & (cur_status == ALIVE), SUSPECT, cur_status
+    )
+    # an ack refutes only our own suspicion at the same incarnation
+    new_status = jnp.where(acked & (cur_status == SUSPECT), ALIVE, new_status)
+    new_since = jnp.where(
+        failed & (cur_status == ALIVE), round_idx, swim.since[rows, tgt]
+    )
+    status = swim.status.at[rows, tgt].set(
+        jnp.where(probing, new_status, cur_status)
+    )
+    since = swim.since.at[rows, tgt].set(
+        jnp.where(probing, new_since, swim.since[rows, tgt])
+    )
+    swim = swim.replace(status=status, since=since)
+
+    # --- suspicion timeout → down -----------------------------------------
+    timed_out = (
+        (swim.status == SUSPECT)
+        & (round_idx - swim.since >= cfg.swim_suspect_rounds)
+        & alive[:, None]
+    )
+    swim = swim.replace(status=jnp.where(timed_out, DOWN, swim.status))
+
+    # --- epidemic view exchange -------------------------------------------
+    # Two directions per sub-round:
+    #  * pull — i merges a random peer's view, but only contacts peers it
+    #    believes are up;
+    #  * push — every node pushes its view to one target (a random
+    #    permutation, so each target receives exactly one push and the
+    #    scatter-merge degenerates into a gather). The *pusher's* belief
+    #    gates the contact, which is what lets a refuted/rejoined node
+    #    re-enter views that had written it off — the reference's SWIM
+    #    announcer + identity renew path (handlers.rs:188-232,
+    #    actor.rs:199-210). Pull alone deadlocks: nobody polls a member
+    #    they believe is DOWN.
+    for g in range(cfg.swim_gossip_peers):
+        kg_pull, kg_push = jax.random.split(jax.random.fold_in(k_ex, g))
+        peer = jax.random.randint(kg_pull, (n,), 0, n, dtype=jnp.int32)
+        can = (
+            alive
+            & alive[peer]
+            & reachable(rows, peer)
+            & (peer != rows)
+            & (swim.status[rows, peer] < DOWN)
+        )[:, None]
+        ps, pi, pse = swim.status[peer], swim.inc[peer], swim.since[peer]
+        ms, mi, mse = _merge_views(
+            swim.status, swim.inc, swim.since, ps, pi, pse
+        )
+        swim = swim.replace(
+            status=jnp.where(can, ms, swim.status),
+            inc=jnp.where(can, mi, swim.inc),
+            since=jnp.where(can, mse, swim.since),
+        )
+
+        pusher = jax.random.permutation(kg_push, n).astype(jnp.int32)
+        can_push = (
+            alive[pusher]
+            & alive
+            & reachable(pusher, rows)
+            & (pusher != rows)
+            & (swim.status[pusher, rows] < DOWN)  # pusher believes us up
+        )[:, None]
+        ps, pi, pse = swim.status[pusher], swim.inc[pusher], swim.since[pusher]
+        ms, mi, mse = _merge_views(
+            swim.status, swim.inc, swim.since, ps, pi, pse
+        )
+        swim = swim.replace(
+            status=jnp.where(can_push, ms, swim.status),
+            inc=jnp.where(can_push, mi, swim.inc),
+            since=jnp.where(can_push, mse, swim.since),
+        )
+
+    # --- periodic announce (belief-independent) ----------------------------
+    # After a partition both sides can hold each other DOWN; neither pulls
+    # nor pushes across (all contact is gated on believed-up). The reference
+    # escapes via its periodic SWIM announcer, which dials bootstrap/member
+    # addresses regardless of member state (handlers.rs:188-232,
+    # ANNOUNCE_INTERVAL agent/mod.rs:32). Model: every k rounds each node
+    # exchanges views with one uniformly random member, gated only on the
+    # ground-truth link. The down-side node then sees itself DOWN in the
+    # merged view and refutes with a higher incarnation (below), which wins
+    # subsequent merges — the standard SWIM heal dance.
+    def do_announce(swim):
+        ka = jax.random.fold_in(k_ex, 997)
+        p = jax.random.permutation(ka, n).astype(jnp.int32)
+        inv = jnp.argsort(p).astype(jnp.int32)
+        for partner in (p, inv):
+            can = (
+                alive & alive[partner] & reachable(rows, partner)
+                & (partner != rows)
+            )[:, None]
+            ms, mi, mse = _merge_views(
+                swim.status, swim.inc, swim.since,
+                swim.status[partner], swim.inc[partner], swim.since[partner],
+            )
+            swim = swim.replace(
+                status=jnp.where(can, ms, swim.status),
+                inc=jnp.where(can, mi, swim.inc),
+                since=jnp.where(can, mse, swim.since),
+            )
+        return swim
+
+    swim = jax.lax.cond(
+        (round_idx % cfg.swim_announce_interval) == 0,
+        do_announce,
+        lambda s: s,
+        swim,
+    )
+
+    # --- refutation / identity renew --------------------------------------
+    self_status = swim.status[rows, rows]
+    self_inc = swim.inc[rows, rows]
+    need_refute = alive & (self_status > ALIVE)
+    swim = swim.replace(
+        status=swim.status.at[rows, rows].set(
+            jnp.where(need_refute, ALIVE, self_status)
+        ),
+        inc=swim.inc.at[rows, rows].set(
+            jnp.where(need_refute, self_inc + 1, self_inc)
+        ),
+    )
+
+    metrics = {
+        "swim_suspects": (
+            (swim.status == SUSPECT) & alive[:, None]
+        ).sum(dtype=jnp.int32),
+        "swim_down": ((swim.status == DOWN) & alive[:, None]).sum(
+            dtype=jnp.int32
+        ),
+        "swim_probe_failures": failed.sum(dtype=jnp.int32),
+    }
+    return swim, metrics

@@ -1,0 +1,3 @@
+from corro_sim.sync.sync import sync_round
+
+__all__ = ["sync_round"]

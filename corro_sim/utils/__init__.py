@@ -1,0 +1,9 @@
+from corro_sim.utils.bits import trailing_ones_u32, window_shift_right
+from corro_sim.utils.slots import dedupe_sorted_mask, ranks_within_group
+
+__all__ = [
+    "trailing_ones_u32",
+    "window_shift_right",
+    "dedupe_sorted_mask",
+    "ranks_within_group",
+]

@@ -1,0 +1,59 @@
+"""Branch-free bit utilities for the out-of-order version window.
+
+The reference tracks per-actor applied-version gaps with a
+``RangeInclusiveSet`` (``corro-types/src/agent.rs:1310-1496``). On TPU that
+ragged structure becomes a fixed 32-bit window per (node, actor): bit ``k``
+means version ``head + 1 + k`` has been applied out of order. Absorbing the
+contiguous prefix after a delivery is "count trailing ones, shift right".
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+U32_ONE = jnp.uint32(1)
+WINDOW_BITS = 32
+
+
+def trailing_ones_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Count of consecutive set low bits of ``x`` (uint32), elementwise.
+
+    trailing_ones(x) == trailing_zeros(~x); computed via the classic
+    ``popcount((y & -y) - 1)`` ctz identity on ``y = ~x`` with an all-ones
+    fixup (``~x == 0`` means all 32 bits set).
+    """
+    x = x.astype(jnp.uint32)
+    y = ~x
+    # two's complement negate in uint32
+    neg_y = (~y) + U32_ONE
+    lowbit = y & neg_y
+    ctz = lax.population_count(lowbit - U32_ONE)
+    return jnp.where(y == 0, jnp.uint32(WINDOW_BITS), ctz.astype(jnp.uint32))
+
+
+def window_shift_right(win: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
+    """Logical right-shift of each uint32 window by per-element ``t`` bits.
+
+    ``t`` may be 32 (full absorb), which wraps around in XLA's shift, so
+    clamp-and-mask: shift >= 32 yields 0.
+    """
+    win = win.astype(jnp.uint32)
+    t32 = jnp.minimum(t.astype(jnp.uint32), jnp.uint32(WINDOW_BITS))
+    shifted = lax.shift_right_logical(win, jnp.minimum(t32, jnp.uint32(31)))
+    # if t in [1,31] we already shifted correctly; handle t == 32 → 0,
+    # and t == 31 path above is exact; for t==32 we shifted by 31, fix:
+    shifted = jnp.where(t32 >= jnp.uint32(WINDOW_BITS), jnp.uint32(0), shifted)
+    return shifted
+
+
+def absorb(head: jnp.ndarray, win: jnp.ndarray):
+    """Advance contiguous heads: head += trailing_ones(win); win >>= t.
+
+    Mirrors ``BookedVersions`` collapsing a gap range once the missing
+    versions arrive (reference ``corro-types/src/agent.rs:1220-1285``).
+    """
+    t = trailing_ones_u32(win)
+    new_head = head + t.astype(head.dtype)
+    new_win = window_shift_right(win, t)
+    return new_head, new_win

@@ -1,0 +1,48 @@
+"""Vectorized helpers for message dedupe and per-destination slot allocation.
+
+The broadcast path needs two primitives that tokio gives the reference for
+free (per-connection ordering + bounded mpsc channels,
+``corro-types/src/channel.rs``):
+
+- dedupe of identical (dst, actor, version) deliveries within a round (the
+  reference's seen-cache in ``handle_changes``,
+  ``corro-agent/src/agent/handlers.rs:886-934``), and
+- appending a variable number of accepted messages to each destination's
+  bounded pending-broadcast ring (``broadcast/mod.rs:446-455``).
+
+Both are built on one sort: order messages by destination key, then
+first-occurrence masks and within-group ranks are elementwise ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def dedupe_sorted_mask(*keys: jnp.ndarray) -> jnp.ndarray:
+    """Given already-sorted parallel key arrays, mask of first occurrences."""
+    first = jnp.ones(keys[0].shape, dtype=bool)
+    neq = jnp.zeros(keys[0].shape[:-1] + (keys[0].shape[-1] - 1,), dtype=bool)
+    for k in keys:
+        neq = neq | (k[..., 1:] != k[..., :-1])
+    return first.at[..., 1:].set(neq)
+
+
+def ranks_within_group(group_sorted: jnp.ndarray) -> jnp.ndarray:
+    """For a sorted group-id array, the rank of each element in its group.
+
+    e.g. [2,2,2,5,5,9] → [0,1,2,0,1,0]. Used to hand out ring-buffer slots:
+    slot = (cursor[group] + rank) % capacity.
+    """
+    n = group_sorted.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    starts = jnp.searchsorted(group_sorted, group_sorted, side="left")
+    return idx - starts.astype(jnp.int32)
+
+
+def group_counts(group_sorted: jnp.ndarray, num_groups: int) -> jnp.ndarray:
+    """Counts per group id for a sorted group array (drop-invalid ids)."""
+    ones = jnp.ones(group_sorted.shape, dtype=jnp.int32)
+    return jnp.zeros((num_groups,), jnp.int32).at[group_sorted].add(
+        ones, mode="drop"
+    )

@@ -1,0 +1,22 @@
+"""Force tests onto a virtual 8-device CPU mesh (no TPU needed in CI).
+
+Mirrors the reference's test posture: real protocol code, no mocks, tiny
+clusters (``crates/corro-tests/src/lib.rs:63-95`` launches full agents on
+loopback) — here the "loopback" is XLA's forced host platform.
+
+The environment's sitecustomize registers the single-chip TPU tunnel
+backend and pins ``jax_platforms`` programmatically, so an env var is not
+enough — re-pin the config before the first backend lookup.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

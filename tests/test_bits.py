@@ -1,0 +1,44 @@
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.utils.bits import absorb, trailing_ones_u32, window_shift_right
+
+
+def oracle_trailing_ones(x: int) -> int:
+    t = 0
+    while t < 32 and (x >> t) & 1:
+        t += 1
+    return t
+
+
+def test_trailing_ones_exhaustive_patterns():
+    cases = np.array(
+        [0, 1, 2, 3, 0b0111, 0b1011, 0xFFFFFFFF, 0x7FFFFFFF, 0xFFFFFFFE, 5, 13],
+        dtype=np.uint32,
+    )
+    got = np.asarray(trailing_ones_u32(jnp.asarray(cases)))
+    want = np.array([oracle_trailing_ones(int(c)) for c in cases], np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_trailing_ones_random():
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 2**32, size=1000, dtype=np.uint32)
+    got = np.asarray(trailing_ones_u32(jnp.asarray(xs)))
+    want = np.array([oracle_trailing_ones(int(x)) for x in xs], np.uint32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_window_shift_right_including_full():
+    win = jnp.asarray(np.array([0xFFFFFFFF, 0b1010, 0b1, 7], np.uint32))
+    t = jnp.asarray(np.array([32, 1, 1, 3], np.uint32))
+    got = np.asarray(window_shift_right(win, t))
+    np.testing.assert_array_equal(got, np.array([0, 0b101, 0, 0], np.uint32))
+
+
+def test_absorb():
+    head = jnp.asarray(np.array([5, 0, 9], np.int32))
+    win = jnp.asarray(np.array([0b0111, 0, 0xFFFFFFFF], np.uint32))
+    h, w = absorb(head, win)
+    np.testing.assert_array_equal(np.asarray(h), [8, 0, 41])
+    np.testing.assert_array_equal(np.asarray(w), [0, 0, 0])

@@ -1,0 +1,161 @@
+"""Bookkeeping window vs. a Python set-based oracle of BookedVersions."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.core.bookkeeping import (
+    advance_heads,
+    deliver_versions,
+    make_bookkeeping,
+)
+from corro_sim.utils.bits import WINDOW_BITS
+
+
+class OracleBook:
+    """Exact applied-version sets with the same bounded-window drop rule.
+
+    Matches the kernel's batch semantics: a whole batch is judged against
+    the heads as they stood *before* the batch (one round's deliveries are
+    concurrent), then heads advance.
+    """
+
+    def __init__(self, n, a):
+        self.applied = {}  # (node, actor) -> set of versions
+        self.n, self.a = n, a
+
+    def head(self, n, a):
+        s = self.applied.get((n, a), set())
+        h = 0
+        while (h + 1) in s:
+            h += 1
+        return h
+
+    def deliver_batch(self, triples):
+        """Returns a list of 'fresh' | 'dup' | 'dropped' per unique triple
+        (first occurrence wins; repeats report 'dup')."""
+        pre_heads = {}
+        results = []
+        seen = set()
+        staged = []
+        for n, a, v in triples:
+            if (n, a, v) in seen:
+                results.append("dup")
+                continue
+            seen.add((n, a, v))
+            h = pre_heads.setdefault((n, a), self.head(n, a))
+            s = self.applied.setdefault((n, a), set())
+            if v <= h or v in s:
+                results.append("dup")
+            elif v - h > WINDOW_BITS:
+                results.append("dropped")
+            else:
+                staged.append((n, a, v))
+                results.append("fresh")
+        for n, a, v in staged:
+            self.applied[(n, a)].add(v)
+        return results
+
+
+def to_np(book):
+    return np.asarray(book.head), np.asarray(book.win)
+
+
+def deliver_np(book, triples, valid=None):
+    arr = np.array(triples, np.int32).reshape(-1, 3)
+    if valid is None:
+        valid = np.ones(arr.shape[0], bool)
+    book, fresh, dropped = deliver_versions(
+        book,
+        jnp.asarray(arr[:, 0]),
+        jnp.asarray(arr[:, 1]),
+        jnp.asarray(arr[:, 2]),
+        jnp.asarray(valid),
+    )
+    return book, np.asarray(fresh), np.asarray(dropped)
+
+
+def test_in_order_delivery_advances_head():
+    book = make_bookkeeping(2, 2)
+    book, fresh, dropped = deliver_np(book, [(0, 1, 1), (0, 1, 2), (0, 1, 3)])
+    head, win = to_np(book)
+    assert head[0, 1] == 3 and win[0, 1] == 0
+    assert fresh.all() and not dropped.any()
+
+
+def test_gap_then_fill():
+    book = make_bookkeeping(1, 1)
+    book, fresh, _ = deliver_np(book, [(0, 0, 2), (0, 0, 3)])
+    head, win = to_np(book)
+    assert head[0, 0] == 0 and win[0, 0] == 0b110
+    assert fresh.all()
+    book, fresh, _ = deliver_np(book, [(0, 0, 1)])
+    head, win = to_np(book)
+    assert head[0, 0] == 3 and win[0, 0] == 0
+    assert fresh.all()
+
+
+def test_duplicate_within_batch_single_fresh():
+    book = make_bookkeeping(1, 1)
+    book, fresh, dropped = deliver_np(book, [(0, 0, 1), (0, 0, 1), (0, 0, 1)])
+    assert fresh.sum() == 1 and not dropped.any()
+    head, _ = to_np(book)
+    assert head[0, 0] == 1
+
+
+def test_redelivery_across_batches_is_dup():
+    book = make_bookkeeping(1, 1)
+    book, _, _ = deliver_np(book, [(0, 0, 1)])
+    book, fresh, dropped = deliver_np(book, [(0, 0, 1)])
+    assert not fresh.any() and not dropped.any()
+
+
+def test_beyond_window_dropped():
+    book = make_bookkeeping(1, 1)
+    book, fresh, dropped = deliver_np(book, [(0, 0, WINDOW_BITS + 2)])
+    assert dropped.all() and not fresh.any()
+    head, win = to_np(book)
+    assert head[0, 0] == 0 and win[0, 0] == 0
+
+
+def test_window_edge_exactly_32_ahead():
+    book = make_bookkeeping(1, 1)
+    book, fresh, dropped = deliver_np(book, [(0, 0, WINDOW_BITS)])
+    assert fresh.all() and not dropped.any()
+    _, win = to_np(book)
+    assert win[0, 0] == (1 << (WINDOW_BITS - 1))
+
+
+def test_fuzz_vs_oracle():
+    rng = np.random.default_rng(3)
+    n_nodes, n_actors = 3, 4
+    book = make_bookkeeping(n_nodes, n_actors)
+    oracle = OracleBook(n_nodes, n_actors)
+    # issue deliveries in randomized bursts, versions near the frontier
+    for _ in range(30):
+        triples = []
+        for _ in range(20):
+            n = int(rng.integers(0, n_nodes))
+            a = int(rng.integers(0, n_actors))
+            v = oracle.head(n, a) + int(rng.integers(1, 40))
+            triples.append((n, a, v))
+        book, fresh, dropped = deliver_np(book, triples)
+        results = oracle.deliver_batch(triples)
+        for i, ((n, a, v), res) in enumerate(zip(triples, results)):
+            assert fresh[i] == (res == "fresh"), (i, n, a, v, res)
+            assert dropped[i] == (res == "dropped"), (i, n, a, v, res)
+        head, _ = to_np(book)
+        for n in range(n_nodes):
+            for a in range(n_actors):
+                assert head[n, a] == oracle.head(n, a)
+
+
+def test_advance_heads_sync_fastpath():
+    book = make_bookkeeping(1, 2)
+    # window has bits at head+2, head+3 (versions 3,4)
+    book, _, _ = deliver_np(book, [(0, 0, 3), (0, 0, 4)])
+    floor = jnp.asarray(np.array([[2, 0]], np.int32))
+    book = advance_heads(book, floor)
+    head, win = to_np(book)
+    # head raised to 2, then absorbs 3 and 4 from the shifted window
+    assert head[0, 0] == 4 and win[0, 0] == 0
+    assert head[0, 1] == 0

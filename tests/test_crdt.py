@@ -1,0 +1,160 @@
+"""LWW merge kernel vs. a plain-Python oracle of CR-SQLite semantics.
+
+Oracle rule (reference ``doc/crdts.md:15-17,237``): incoming change wins iff
+(col_version, value, site_id) is lexicographically larger than stored.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.core.crdt import (
+    NEG,
+    apply_cell_changes,
+    local_write,
+    make_table_state,
+)
+
+
+def oracle_merge(cells, changes):
+    """cells: dict (n,r,c) -> (cv, vr, site); changes: list of tuples."""
+    for n, r, c, cv, vr, site in changes:
+        cur = cells.get((n, r, c), (0, int(NEG), -1))
+        if (cv, vr, site) > cur:
+            cells[(n, r, c)] = (cv, vr, site)
+    return cells
+
+
+def run_kernel(num_nodes, num_rows, num_cols, changes, valid=None):
+    st = make_table_state(num_nodes, num_rows, num_cols)
+    arr = np.array(changes, np.int32).reshape(-1, 6)
+    if valid is None:
+        valid = np.ones(arr.shape[0], bool)
+    st = apply_cell_changes(
+        st,
+        jnp.asarray(arr[:, 0]),
+        jnp.asarray(arr[:, 1]),
+        jnp.asarray(arr[:, 2]),
+        jnp.asarray(arr[:, 3]),
+        jnp.asarray(arr[:, 4]),
+        jnp.asarray(arr[:, 5]),
+        jnp.ones(arr.shape[0], jnp.int32),
+        jnp.asarray(valid),
+    )
+    return st
+
+
+def assert_matches_oracle(st, changes, num_nodes, num_rows, num_cols):
+    cells = oracle_merge({}, changes)
+    cv = np.asarray(st.cv)
+    vr = np.asarray(st.vr)
+    site = np.asarray(st.site)
+    for n in range(num_nodes):
+        for r in range(num_rows):
+            for c in range(num_cols):
+                want = cells.get((n, r, c), (0, int(NEG), -1))
+                got = (int(cv[n, r, c]), int(vr[n, r, c]), int(site[n, r, c]))
+                assert got == want, (n, r, c, got, want)
+
+
+def test_higher_col_version_wins():
+    changes = [(0, 0, 0, 1, 50, 3), (0, 0, 0, 2, 10, 1)]
+    st = run_kernel(2, 2, 2, changes)
+    assert_matches_oracle(st, changes, 2, 2, 2)
+    assert int(st.vr[0, 0, 0]) == 10  # lower value but higher col_version
+
+
+def test_value_breaks_col_version_tie():
+    # doc/crdts.md:239 — 'started' beats 'destroyed' at equal col_version.
+    changes = [(0, 0, 0, 2, 7, 0), (0, 0, 0, 2, 9, 1)]
+    st = run_kernel(1, 1, 1, changes)
+    assert int(st.vr[0, 0, 0]) == 9
+
+
+def test_site_breaks_full_tie():
+    changes = [(0, 0, 0, 2, 7, 5), (0, 0, 0, 2, 7, 3)]
+    st = run_kernel(1, 1, 1, changes)
+    assert int(st.site[0, 0, 0]) == 5
+
+
+def test_batch_order_independence():
+    rng = np.random.default_rng(42)
+    changes = [
+        (
+            int(rng.integers(0, 3)),
+            int(rng.integers(0, 4)),
+            int(rng.integers(0, 2)),
+            int(rng.integers(1, 5)),
+            int(rng.integers(0, 100)),
+            int(rng.integers(0, 8)),
+        )
+        for _ in range(200)
+    ]
+    st1 = run_kernel(3, 4, 2, changes)
+    perm = rng.permutation(200)
+    st2 = run_kernel(3, 4, 2, [changes[i] for i in perm])
+    for f in ("cv", "vr", "site"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(st1, f)), np.asarray(getattr(st2, f))
+        )
+    assert_matches_oracle(st1, changes, 3, 4, 2)
+
+
+def test_idempotent_redelivery():
+    changes = [(1, 2, 0, 3, 11, 2)]
+    st = run_kernel(2, 3, 1, changes * 5)
+    assert_matches_oracle(st, changes, 2, 3, 1)
+
+
+def test_invalid_lanes_dropped():
+    changes = [(0, 0, 0, 9, 99, 7), (0, 0, 0, 1, 1, 1)]
+    st = run_kernel(1, 1, 1, changes, valid=np.array([False, True]))
+    assert int(st.cv[0, 0, 0]) == 1
+    assert int(st.vr[0, 0, 0]) == 1
+
+
+def test_random_fuzz_vs_oracle():
+    rng = np.random.default_rng(7)
+    for trial in range(5):
+        changes = [
+            (
+                int(rng.integers(0, 4)),
+                int(rng.integers(0, 3)),
+                int(rng.integers(0, 3)),
+                int(rng.integers(1, 6)),
+                int(rng.integers(-5, 5)),
+                int(rng.integers(0, 10)),
+            )
+            for _ in range(300)
+        ]
+        st = run_kernel(4, 3, 3, changes)
+        assert_matches_oracle(st, changes, 4, 3, 3)
+
+
+def test_local_write_bumps_col_version():
+    st = make_table_state(2, 2, 2)
+    ones = jnp.ones((1,), jnp.int32)
+    f = jnp.zeros((1,), bool)
+    t = jnp.ones((1,), bool)
+    # first write: cv 0 -> 1, row born: cl 0 -> 1
+    st, cv, cl, _ = local_write(
+        st, ones * 0, ones * 1, ones * 0, ones * 42, ones * 0, f, t
+    )
+    assert int(cv[0]) == 1 and int(cl[0]) == 1
+    # second write to same cell: cv 1 -> 2, cl stays 1
+    st, cv, cl, _ = local_write(
+        st, ones * 0, ones * 1, ones * 0, ones * 43, ones * 0, f, t
+    )
+    assert int(cv[0]) == 2 and int(cl[0]) == 1
+    assert int(st.vr[0, 1, 0]) == 43
+    # delete: cl 1 -> 2 (even = dead), cv unchanged
+    st, cv, cl, dvr = local_write(
+        st, ones * 0, ones * 1, ones * 0, ones * 0, ones * 0, t, t
+    )
+    assert int(cl[0]) == 2 and int(st.cl[0, 1]) == 2
+    assert int(dvr[0]) < 0  # delete carries no value
+    assert int(st.vr[0, 1, 0]) == 43  # stored value untouched by delete
+    # resurrect: cl 2 -> 3
+    st, cv, cl, _ = local_write(
+        st, ones * 0, ones * 1, ones * 0, ones * 44, ones * 0, f, t
+    )
+    assert int(cl[0]) == 3

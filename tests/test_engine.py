@@ -1,0 +1,219 @@
+"""End-to-end simulator behavior on tiny clusters (the reference's
+multi-agent-on-loopback tests, corro-agent/src/agent/tests.rs, re-shaped:
+whole cluster in one process, convergence asserted instead of polling)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.driver import Schedule, run_sim
+from corro_sim.engine.state import init_state
+
+
+def assert_converged_state(cfg, result):
+    """All alive nodes agree: heads == writer log heads, value planes equal."""
+    st = result.state
+    head = np.asarray(st.book.head)
+    log_head = np.asarray(st.log.head)
+    np.testing.assert_array_equal(
+        head, np.broadcast_to(log_head, head.shape), strict=False
+    )
+    for plane in (st.table.cv, st.table.vr, st.table.site):
+        p = np.asarray(plane)
+        np.testing.assert_array_equal(
+            p, np.broadcast_to(p[:1], p.shape),
+            err_msg="table state diverged across nodes",
+        )
+
+
+def test_small_cluster_converges_broadcast_only():
+    # config-2 shape in miniature: no sync needed when nothing drops
+    cfg = SimConfig(
+        num_nodes=8,
+        num_rows=16,
+        num_cols=2,
+        log_capacity=64,
+        write_rate=0.5,
+        pend_slots=8,
+        fanout=3,
+        sync_interval=4,
+    )
+    state = init_state(cfg, seed=1)
+    res = run_sim(
+        cfg, state, Schedule(write_rounds=8), max_rounds=256, chunk=8, seed=1
+    )
+    assert res.converged_round is not None, (
+        f"no convergence; last gaps {res.metrics['gap'][-8:]}"
+    )
+    assert_converged_state(cfg, res)
+    assert res.metrics["writes"].sum() > 0
+
+
+def test_convergence_with_lossy_broadcast_needs_sync():
+    # Starve the gossip path (fanout 1, tiny queue, 1 transmission) so the
+    # anti-entropy path has to repair — mirrors the reference's drop→sync
+    # recovery model (handlers.rs:866-884).
+    cfg = SimConfig(
+        num_nodes=12,
+        num_rows=8,
+        num_cols=2,
+        log_capacity=128,
+        write_rate=0.9,
+        pend_slots=2,
+        fanout=1,
+        max_transmissions=1,
+        rebroadcast_transmissions=0,
+        ring0_size=1,
+        sync_interval=4,
+        sync_actor_topk=12,
+        sync_cap_per_actor=8,
+    )
+    state = init_state(cfg, seed=2)
+    res = run_sim(
+        cfg, state, Schedule(write_rounds=16), max_rounds=512, chunk=16, seed=2
+    )
+    assert res.converged_round is not None, (
+        f"no convergence; last gaps {res.metrics['gap'][-8:]}"
+    )
+    assert_converged_state(cfg, res)
+    assert res.metrics["sync_versions"].sum() > 0, "sync never transferred"
+
+
+def test_node_outage_catches_up_via_sync():
+    # One node sleeps through the write phase and must catch up afterwards —
+    # the config-5 scenario in miniature.
+    cfg = SimConfig(
+        num_nodes=8,
+        num_rows=8,
+        num_cols=2,
+        log_capacity=128,
+        write_rate=0.8,
+        sync_interval=4,
+        sync_actor_topk=8,
+    )
+
+    def alive_fn(r, n):
+        a = np.ones(n, bool)
+        if r < 24:
+            a[0] = False
+        return a
+
+    state = init_state(cfg, seed=3)
+    res = run_sim(
+        cfg,
+        state,
+        Schedule(write_rounds=16, alive_fn=alive_fn),
+        max_rounds=512,
+        chunk=8,
+        seed=3,
+        min_rounds=24,  # node 0 rejoins at round 24
+    )
+    assert res.converged_round is not None
+    assert_converged_state(cfg, res)
+    # the sleeper was repaired by anti-entropy, not broadcast
+    assert res.metrics["sync_versions"].sum() > 0
+
+
+def test_deterministic_given_seed():
+    cfg = SimConfig(num_nodes=6, num_rows=8, num_cols=2, log_capacity=64)
+    r1 = run_sim(cfg, init_state(cfg, seed=5), max_rounds=32, chunk=8, seed=5,
+                 stop_on_convergence=False)
+    r2 = run_sim(cfg, init_state(cfg, seed=5), max_rounds=32, chunk=8, seed=5,
+                 stop_on_convergence=False)
+    np.testing.assert_array_equal(r1.metrics["gap"], r2.metrics["gap"])
+    np.testing.assert_array_equal(
+        np.asarray(r1.state.table.vr), np.asarray(r2.state.table.vr)
+    )
+
+
+def test_sharded_run_matches_single_device():
+    from corro_sim.engine.sharding import make_mesh, shard_state
+
+    cfg = SimConfig(num_nodes=16, num_rows=8, num_cols=2, log_capacity=64)
+    assert len(jax.devices()) == 8, "conftest should force 8 CPU devices"
+    mesh = make_mesh()
+    s0 = init_state(cfg, seed=7)
+    r_plain = run_sim(cfg, s0, max_rounds=16, chunk=8, seed=7,
+                      stop_on_convergence=False)
+    s1 = shard_state(init_state(cfg, seed=7), mesh, cfg.num_nodes)
+    r_shard = run_sim(cfg, s1, max_rounds=16, chunk=8, seed=7,
+                      stop_on_convergence=False)
+    np.testing.assert_array_equal(r_plain.metrics["gap"], r_shard.metrics["gap"])
+    np.testing.assert_array_equal(
+        np.asarray(r_plain.state.table.vr), np.asarray(r_shard.state.table.vr)
+    )
+
+
+def test_partition_with_swim_converges_after_heal():
+    # config-4 in miniature: SWIM churn/partition + gossip + sync. During the
+    # split each side converges internally; after healing, announce-driven
+    # SWIM recovery plus anti-entropy closes the cross-side gap.
+    cfg = SimConfig(
+        num_nodes=12,
+        num_rows=16,
+        num_cols=2,
+        log_capacity=128,
+        write_rate=0.5,
+        swim_enabled=True,
+        swim_suspect_rounds=3,
+        sync_interval=4,
+        sync_actor_topk=12,
+    )
+
+    def part_fn(r, n):
+        p = np.zeros(n, np.int32)
+        if 8 <= r < 40:
+            p[n // 2:] = 1
+        return p
+
+    state = init_state(cfg, seed=11)
+    res = run_sim(
+        cfg,
+        state,
+        Schedule(write_rounds=32, part_fn=part_fn),
+        max_rounds=1024,
+        chunk=16,
+        seed=11,
+        min_rounds=48,
+    )
+    assert res.converged_round is not None, (
+        f"no convergence; last gaps {res.metrics['gap'][-8:]}"
+    )
+    assert_converged_state(cfg, res)
+    # the partition must actually have produced SWIM suspicion
+    assert res.metrics["swim_down"].max() > 0
+
+
+def test_deletes_converge_and_stay_value_neutral():
+    # DELETE changes are causal-length-only: they must not claim cell
+    # values/sites (CR-SQLite deletes emit clock rows, not value rows).
+    cfg = SimConfig(
+        num_nodes=8,
+        num_rows=8,
+        num_cols=2,
+        log_capacity=128,
+        write_rate=0.8,
+        delete_rate=0.4,
+        sync_interval=4,
+        sync_actor_topk=8,
+    )
+    res = run_sim(
+        cfg, init_state(cfg, seed=13), Schedule(write_rounds=16),
+        max_rounds=512, chunk=8, seed=13,
+    )
+    assert res.converged_round is not None
+    assert_converged_state(cfg, res)
+    st = res.state
+    cv = np.asarray(st.table.cv)
+    vr = np.asarray(st.table.vr)
+    site = np.asarray(st.table.site)
+    from corro_sim.core.crdt import NEG
+    # never-written cells keep their sentinel values even when their row saw
+    # deletes
+    untouched = cv == 0
+    assert (vr[untouched] == int(NEG)).all()
+    assert (site[untouched] == -1).all() or (site[untouched] == int(NEG)).all()
+    # some rows must actually have died (even causal length)
+    assert (np.asarray(st.table.cl) % 2 == 0).any()

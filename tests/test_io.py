@@ -1,0 +1,50 @@
+import pytest
+
+from corro_sim.io.config_file import load_config
+from corro_sim.io.values import ValueInterner, sqlite_sort_key
+
+
+def test_load_defaults_without_file():
+    cfg = load_config(None, env={})
+    assert cfg.num_nodes == 64
+
+
+def test_toml_plus_env_override(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text(
+        "[sim]\nnum_nodes = 100\nwrite_rate = 0.25\nswim_enabled = true\n"
+    )
+    cfg = load_config(str(p), env={})
+    assert cfg.num_nodes == 100 and cfg.write_rate == 0.25 and cfg.swim_enabled
+
+    cfg = load_config(
+        str(p),
+        env={"CORRO_SIM__NUM_NODES": "500", "CORRO_SIM__SWIM_ENABLED": "off"},
+    )
+    assert cfg.num_nodes == 500 and not cfg.swim_enabled
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "c.toml"
+    p.write_text("[sim]\nbogus = 1\n")
+    with pytest.raises(KeyError):
+        load_config(str(p), env={})
+
+
+def test_sqlite_value_ordering():
+    # NULL < numeric (int/real interleaved) < text < blob — SQLite storage
+    # class order, with 'destroyed' < 'started' (doc/crdts.md:239-248)
+    vals = ["started", None, 3, b"\x00", 2.5, "destroyed", b"zz", -7]
+    ordered = sorted(vals, key=sqlite_sort_key)
+    assert ordered == [None, -7, 2.5, 3, "destroyed", "started", b"\x00", b"zz"]
+
+
+def test_interner_order_preserving():
+    it = ValueInterner()
+    for v in ["b", 1, None, "a", 2.0, b"x"]:
+        it.add(v)
+    it.freeze()
+    assert it.rank(None) < it.rank(1) < it.rank(2.0) < it.rank("a")
+    assert it.rank("a") < it.rank("b") < it.rank(b"x")
+    with pytest.raises(RuntimeError):
+        it.add("late")

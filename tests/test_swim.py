@@ -1,0 +1,97 @@
+"""SWIM membership automaton behavior (foca notification surface analog)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.membership.swim import (
+    ALIVE,
+    DOWN,
+    SUSPECT,
+    make_swim_state,
+    swim_step,
+    view_alive,
+)
+
+
+def run_swim(cfg, swim, alive_np, part_np, rounds, seed=0, start_round=0):
+    alive = jnp.asarray(alive_np)
+    part = jnp.asarray(part_np)
+
+    def step(swim, inp):
+        k, r = inp
+
+        def reach(src, dst):
+            return alive[src] & alive[dst] & (part[src] == part[dst])
+
+        return swim_step(cfg, swim, k, alive, reach, r)
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), rounds)
+    rs = jnp.arange(start_round, start_round + rounds, dtype=jnp.int32)
+    swim, metrics = jax.jit(
+        lambda s: jax.lax.scan(step, s, (keys, rs))
+    )(swim)
+    return swim, jax.tree.map(lambda x: x[-1], metrics)
+
+
+def test_dead_node_gets_suspected_then_down():
+    cfg = SimConfig(num_nodes=8, swim_enabled=True, swim_suspect_rounds=3)
+    swim = make_swim_state(8)
+    alive = np.ones(8, bool)
+    alive[3] = False
+    swim, _ = run_swim(cfg, swim, alive, np.zeros(8, np.int32), rounds=40)
+    status = np.asarray(swim.status)
+    # every live node should have concluded node 3 is down
+    live = [i for i in range(8) if i != 3]
+    assert (status[live, 3] == int(DOWN)).all(), status[:, 3]
+    # and nobody down-ed a live node
+    for j in live:
+        assert (status[live, j] == int(ALIVE)).all(), (j, status[:, j])
+
+
+def test_rejoin_refutes_and_recovers():
+    cfg = SimConfig(num_nodes=8, swim_enabled=True, swim_suspect_rounds=3)
+    swim = make_swim_state(8)
+    alive = np.ones(8, bool)
+    alive[3] = False
+    part = np.zeros(8, np.int32)
+    swim, _ = run_swim(cfg, swim, alive, part, rounds=40)
+    # node 3 comes back: its incarnation bump must spread and revive it
+    alive[3] = True
+    swim, _ = run_swim(cfg, swim, alive, part, rounds=60, seed=1, start_round=40)
+    status = np.asarray(swim.status)
+    inc = np.asarray(swim.inc)
+    assert (status[:, 3] == int(ALIVE)).all(), status[:, 3]
+    assert inc[3, 3] >= 1  # renew() bumped the incarnation
+
+
+def test_partition_suspects_other_side():
+    cfg = SimConfig(num_nodes=10, swim_enabled=True, swim_suspect_rounds=3)
+    swim = make_swim_state(10)
+    alive = np.ones(10, bool)
+    part = np.zeros(10, np.int32)
+    part[5:] = 1
+    swim, _ = run_swim(cfg, swim, alive, part, rounds=50)
+    status = np.asarray(swim.status)
+    # each side declared the other side down, kept its own side alive
+    assert (status[:5, 5:] == int(DOWN)).all()
+    assert (status[5:, :5] == int(DOWN)).all()
+    assert (status[:5, :5] == int(ALIVE)).all()
+    assert (status[5:, 5:] == int(ALIVE)).all()
+    # heal: everyone refutes and recovers
+    part[:] = 0
+    swim, _ = run_swim(cfg, swim, alive, part, rounds=80, seed=2, start_round=50)
+    status = np.asarray(swim.status)
+    assert (status == int(ALIVE)).all(), status
+
+
+def test_view_alive_excludes_only_down():
+    swim = make_swim_state(3)
+    swim = swim.replace(
+        status=jnp.asarray(
+            np.array([[0, 1, 2], [0, 0, 0], [0, 0, 0]], np.int8)
+        )
+    )
+    v = np.asarray(view_alive(swim))
+    assert v[0, 0] and v[0, 1] and not v[0, 2]
