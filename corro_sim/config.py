@@ -24,6 +24,12 @@ class SimConfig:
     num_rows: int = 256  # table row slots (pk universe)
     num_cols: int = 4  # columns per row
     log_capacity: int = 1024  # max versions per actor per run (ring)
+    seqs_per_version: int = 1  # max cells per changeset (CrsqlSeq axis;
+    # one version = one transaction's changeset, corro-api-types/lib.rs:235-245)
+    chunks_per_version: int = 1  # gossip chunks per changeset — the
+    # ChunkedChanges ≤8 KiB split (corro-types/src/change.rs:16-122); a
+    # version applies only when all chunks arrived (partial buffering,
+    # agent/util.rs:1065-1190). Must divide 32 (window bits per version).
 
     # --- workload ---
     write_rate: float = 0.5  # P(node writes) per round while writes enabled
@@ -68,4 +74,8 @@ class SimConfig:
         assert self.fanout >= 1 and self.pend_slots >= 1
         assert self.log_capacity >= 1
         assert self.sync_candidates >= 1
+        assert self.seqs_per_version >= 1
+        assert self.chunks_per_version in (1, 2, 4, 8, 16, 32), (
+            "chunks_per_version must divide the 32-bit version window"
+        )
         return self

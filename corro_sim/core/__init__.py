@@ -1,6 +1,6 @@
 from corro_sim.core.crdt import TableState, apply_cell_changes, make_table_state
 from corro_sim.core.bookkeeping import Bookkeeping, deliver_versions, make_bookkeeping
-from corro_sim.core.changelog import ChangeLog, make_changelog, append_writes
+from corro_sim.core.changelog import ChangeLog, append_changesets, make_changelog
 
 __all__ = [
     "TableState",
@@ -11,5 +11,5 @@ __all__ = [
     "make_bookkeeping",
     "ChangeLog",
     "make_changelog",
-    "append_writes",
+    "append_changesets",
 ]

@@ -10,16 +10,23 @@ A ragged range-set per (node, actor) cannot live on a TPU. Instead:
 
 - ``head[N, A] int32`` — the contiguously-applied prefix: every version of
   actor ``a`` up to ``head[n, a]`` has been applied at node ``n``.
-- ``win[N, A] uint32`` — a 32-slot out-of-order window: bit ``k`` set means
-  version ``head + 1 + k`` was applied ahead of a gap.
+- ``win[N, A] uint32`` — an out-of-order window over the next
+  ``32 // bits_per_version`` versions. Each version owns a group of
+  ``bits_per_version`` adjacent bits, one per changeset *chunk*: bit
+  ``v * bpv + c`` set means chunk ``c`` of version ``head + 1 + v`` has
+  arrived. A version is *applied* only once its whole group is set — a
+  partially-set group is a buffered partial version, the dense analog of
+  ``__corro_buffered_changes`` + ``__corro_seq_bookkeeping``
+  (``agent/util.rs:1065-1190``).
 
-A delivery inside the window sets its bit; the contiguous prefix is then
-absorbed (count-trailing-ones + shift, :mod:`corro_sim.utils.bits`). A
-delivery *beyond* the window is dropped — deliberately. That is the
-reference's own escape hatch: ``handle_changes`` drops when its queue
-overflows and anti-entropy sync repairs the loss
-(``corro-agent/src/agent/handlers.rs:866-884``). Here "window overflow"
-plays the role of queue overflow, and :mod:`corro_sim.sync` repairs it.
+A delivery inside the window sets its bit; the contiguous prefix of
+*complete* versions is then absorbed (count-trailing-ones rounded down to a
+whole group + shift, :mod:`corro_sim.utils.bits`). A delivery *beyond* the
+window is dropped — deliberately. That is the reference's own escape hatch:
+``handle_changes`` drops when its queue overflows and anti-entropy sync
+repairs the loss (``corro-agent/src/agent/handlers.rs:866-884``). Here
+"window overflow" plays the role of queue overflow, and
+:mod:`corro_sim.sync` repairs it.
 """
 
 from __future__ import annotations
@@ -44,78 +51,130 @@ def make_bookkeeping(num_nodes: int, num_actors: int) -> Bookkeeping:
     )
 
 
+def version_window(bits_per_version: int) -> int:
+    """How many versions ahead of the head the window can buffer."""
+    return WINDOW_BITS // bits_per_version
+
+
 def deliver_versions(
     book: Bookkeeping,
     dst: jnp.ndarray,
     actor: jnp.ndarray,
     ver: jnp.ndarray,
     valid: jnp.ndarray,
+    chunk: jnp.ndarray | None = None,
+    bits_per_version: int = 1,
 ):
-    """Record a flat batch of (dst, actor, version) deliveries.
+    """Record a flat batch of (dst, actor, version[, chunk]) deliveries.
 
-    Returns ``(new_book, fresh, dropped)`` where ``fresh[m]`` is True iff
-    message ``m`` was the first in this batch to deliver a not-yet-applied
-    version (these are the changes worth merging and re-broadcasting — the
-    reference's seen-cache + ``booked.contains_all`` check,
-    ``handlers.rs:886-934``), and ``dropped[m]`` marks beyond-window drops
-    for metrics (``corro.agent.changes.dropped`` analog).
+    Returns ``(new_book, fresh_chunk, complete, dropped)``:
 
-    Within-batch duplicates are removed by sorting on (dst, actor, ver); the
-    window bits are then applied with a plain scatter-add of ``1 << offset``
-    (safe once unique).
+    - ``fresh_chunk[m]`` — message ``m`` was the first in this batch to
+      deliver a not-yet-seen chunk (worth re-broadcasting — the reference's
+      seen-cache + ``booked.contains_all`` check, ``handlers.rs:886-934``);
+    - ``complete[m]`` — message ``m`` completed its version: every chunk of
+      that version is now present and it was not complete before. These are
+      the lanes whose changesets get merged into table state (the reference
+      applies a version only once seq-complete, ``util.rs:458-501``); the
+      mask is set on exactly one lane per completed (dst, actor, ver);
+    - ``dropped[m]`` — beyond-window drops for metrics
+      (``corro.agent.changes.dropped`` analog).
+
+    Within-batch duplicates are removed by sorting on (dst, actor, ver,
+    chunk); the window bits are then applied with a plain scatter-add of
+    ``1 << offset`` (safe once unique).
 
     Batch semantics: window offsets are computed against the head *before*
     the batch — a batch models one round's concurrent deliveries, so a
-    version more than WINDOW_BITS ahead of the pre-round head is dropped
+    version more than ``window`` ahead of the pre-round head is dropped
     even if the same batch also fills the gap. (Sequential processing would
     accept it; the batched rule drops slightly more aggressively, which is
     safe — drops are exactly what anti-entropy repairs.)
     """
     m = dst.shape[0]
     n, a = book.head.shape
+    bpv = bits_per_version
+    vwin = WINDOW_BITS // bpv
+    if chunk is None:
+        chunk = jnp.zeros((m,), jnp.int32)
 
-    # Sort by (dst, actor, ver); invalid lanes sort to the end via huge dst.
+    # Sort by (dst, actor, ver, chunk); invalid lanes sort to the end.
     big = jnp.int32(n + 1)
     sdst = jnp.where(valid, dst, big)
-    order = jnp.lexsort((ver, actor, sdst))
+    order = jnp.lexsort((chunk, ver, actor, sdst))
     s_dst = sdst[order]
     s_actor = actor[order]
     s_ver = ver[order]
+    s_chunk = chunk[order]
     s_valid = valid[order]
 
-    first = dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
+    first_chunk = dedupe_sorted_mask(s_dst, s_actor, s_ver, s_chunk) & s_valid
+    first_ver = dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
 
     pair_idx = (jnp.where(s_valid, s_dst, -1), s_actor)
     head_g = book.head[pair_idx]
     win_g = book.win[pair_idx]
-    off = s_ver - head_g - 1  # window bit offset; <0 = already applied
-    in_window = (off >= 0) & (off < WINDOW_BITS)
-    already = (off >= 0) & (off < WINDOW_BITS) & (
-        (win_g >> off.clip(0, WINDOW_BITS - 1).astype(jnp.uint32)) & jnp.uint32(1)
-    ).astype(bool)
-    fresh_sorted = first & in_window & ~already
-    dropped_sorted = first & (off >= WINDOW_BITS)
+    voff = s_ver - head_g - 1  # version offset in window; <0 = absorbed
+    in_window = (voff >= 0) & (voff < vwin)
+    off = (voff * bpv + s_chunk).clip(0, WINDOW_BITS - 1).astype(jnp.uint32)
+    already = in_window & ((win_g >> off) & jnp.uint32(1)).astype(bool)
+    fresh_sorted = first_chunk & in_window & ~already
+    dropped_sorted = first_chunk & (voff >= vwin)
 
-    bit = jnp.where(
-        fresh_sorted,
-        jnp.left_shift(
-            jnp.uint32(1), off.clip(0, WINDOW_BITS - 1).astype(jnp.uint32)
-        ),
-        jnp.uint32(0),
-    )
+    bit = jnp.where(fresh_sorted, jnp.left_shift(jnp.uint32(1), off), jnp.uint32(0))
     new_win = book.win.at[pair_idx].add(bit, mode="drop")
-    new_head, new_win = absorb(book.head, new_win)
+
+    # Version completion: all bpv bits of the version's group set *now* and
+    # not all set before this batch. Reported once per (dst, actor, ver).
+    if bpv == 1:
+        complete_sorted = fresh_sorted
+    else:
+        group_mask = jnp.uint32((1 << bpv) - 1)
+        gshift = (voff.clip(0, vwin - 1) * bpv).astype(jnp.uint32)
+        vmask = jnp.left_shift(group_mask, gshift)
+        now_g = new_win[pair_idx]
+        complete_sorted = (
+            first_ver
+            & in_window
+            & ((now_g & vmask) == vmask)
+            & ((win_g & vmask) != vmask)
+        )
+
+    new_head, new_win = absorb(book.head, new_win, bpv)
 
     # Un-sort the masks back to caller order.
     inv = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
     return (
         Bookkeeping(head=new_head, win=new_win),
         fresh_sorted[inv],
+        complete_sorted[inv],
         dropped_sorted[inv],
     )
 
 
-def advance_heads(book: Bookkeeping, new_floor: jnp.ndarray) -> Bookkeeping:
+def partial_versions(book: Bookkeeping, bits_per_version: int) -> jnp.ndarray:
+    """() int32 — count of buffered partial versions across the cluster.
+
+    The gauge analog of the reference's ``__corro_buffered_changes`` row
+    count (``agent/metrics.rs:47-60``): window groups with some but not all
+    chunk bits set.
+    """
+    bpv = bits_per_version
+    if bpv == 1:
+        return jnp.int32(0)  # single-chunk versions are never partial
+    vwin = WINDOW_BITS // bpv
+    group_mask = jnp.uint32((1 << bpv) - 1)
+    total = jnp.int32(0)
+    win = book.win
+    for v in range(vwin):
+        g = (win >> jnp.uint32(v * bpv)) & group_mask
+        total = total + ((g != 0) & (g != group_mask)).sum(dtype=jnp.int32)
+    return total
+
+
+def advance_heads(
+    book: Bookkeeping, new_floor: jnp.ndarray, bits_per_version: int = 1
+) -> Bookkeeping:
     """Raise heads to at least ``new_floor`` (N, A) — the sync fast-path.
 
     After an anti-entropy transfer the contiguous prefix extends to the
@@ -124,6 +183,6 @@ def advance_heads(book: Bookkeeping, new_floor: jnp.ndarray) -> Bookkeeping:
     head delta before absorbing.
     """
     floor = jnp.maximum(book.head, new_floor)
-    delta = (floor - book.head).astype(jnp.uint32)
-    head, win = absorb(floor, window_shift_right(book.win, delta))
+    delta = (floor - book.head).astype(jnp.uint32) * jnp.uint32(bits_per_version)
+    head, win = absorb(floor, window_shift_right(book.win, delta), bits_per_version)
     return Bookkeeping(head=head, win=win)

@@ -5,23 +5,23 @@ virtual table, keyed by (version, seq) and re-read at broadcast and sync
 time (``corro-types/src/broadcast.rs:480-544``,
 ``corro-agent/src/api/peer.rs:351-762``). In the simulator the whole
 cluster shares one address space, so the authoritative write history is a
-single replicated structure-of-arrays indexed by (actor, version % L):
+single replicated structure-of-arrays indexed by (actor, version % L, seq):
 
-    log_row[A, L]   row slot written
-    log_col[A, L]   column index
-    log_vr[A, L]    interned value rank
-    log_cv[A, L]    col_version assigned at write time
-    log_cl[A, L]    causal length assigned at write time
+    log_row[A, L, S]   row slot written by each cell
+    log_col[A, L, S]   column index
+    log_vr[A, L, S]    interned value rank
+    log_cv[A, L, S]    col_version assigned at write time
+    log_cl[A, L, S]    causal length assigned at write time
+    ncells[A, L]       cells actually used (last_seq + 1 analog,
+                       ``corro-api-types/src/lib.rs:235-245``)
 
 ``L`` caps versions per actor per run (static shape); the ring wraps, which
 is safe as long as no node lags more than ``L`` versions — the same flavor
-of bound as the reference's bounded queues. What stays *per node* is only
-the bookkeeping of which (actor, version) pairs have been applied
+of bound as the reference's bounded queues. ``S`` caps cells per changeset
+(one version == one transaction's changeset; its cells are the reference's
+seq-numbered ``Change`` rows). What stays *per node* is only the
+bookkeeping of which (actor, version, chunk) triples have been applied
 (:mod:`corro_sim.core.bookkeeping`) — delivery state, not data.
-
-One version == one cell change here (the reference batches a transaction
-into one version with many seqs, ``corro-api-types/src/lib.rs:235-245``;
-multi-cell changesets are modeled by emitting consecutive versions).
 """
 
 from __future__ import annotations
@@ -32,43 +32,50 @@ import jax.numpy as jnp
 
 @flax.struct.dataclass
 class ChangeLog:
-    row: jnp.ndarray  # (A, L) int32
-    col: jnp.ndarray  # (A, L) int32
-    vr: jnp.ndarray  # (A, L) int32
-    cv: jnp.ndarray  # (A, L) int32
-    cl: jnp.ndarray  # (A, L) int32
+    row: jnp.ndarray  # (A, L, S) int32
+    col: jnp.ndarray  # (A, L, S) int32
+    vr: jnp.ndarray  # (A, L, S) int32
+    cv: jnp.ndarray  # (A, L, S) int32
+    cl: jnp.ndarray  # (A, L, S) int32
+    ncells: jnp.ndarray  # (A, L) int32
     head: jnp.ndarray  # (A,) int32 — number of versions each actor has written
 
     @property
     def capacity(self) -> int:
         return self.row.shape[1]
 
+    @property
+    def seqs(self) -> int:
+        return self.row.shape[2]
 
-def make_changelog(num_actors: int, capacity: int) -> ChangeLog:
+
+def make_changelog(num_actors: int, capacity: int, seqs: int = 1) -> ChangeLog:
     # Distinct buffers per field — sharing one zeros array across fields
     # makes buffer donation reject the state ("same buffer donated twice").
-    shape = (num_actors, capacity)
+    shape = (num_actors, capacity, seqs)
     return ChangeLog(
         row=jnp.zeros(shape, jnp.int32),
         col=jnp.zeros(shape, jnp.int32),
         vr=jnp.zeros(shape, jnp.int32),
         cv=jnp.zeros(shape, jnp.int32),
         cl=jnp.zeros(shape, jnp.int32),
+        ncells=jnp.zeros((num_actors, capacity), jnp.int32),
         head=jnp.zeros((num_actors,), jnp.int32),
     )
 
 
-def append_writes(
+def append_changesets(
     log: ChangeLog,
-    actor: jnp.ndarray,
-    row: jnp.ndarray,
-    col: jnp.ndarray,
-    vr: jnp.ndarray,
-    cv: jnp.ndarray,
-    cl: jnp.ndarray,
-    valid: jnp.ndarray,
+    actor: jnp.ndarray,  # (n,) int32
+    row: jnp.ndarray,  # (n, S) int32
+    col: jnp.ndarray,  # (n, S) int32
+    vr: jnp.ndarray,  # (n, S) int32
+    cv: jnp.ndarray,  # (n, S) int32
+    cl: jnp.ndarray,  # (n, S) int32
+    ncells: jnp.ndarray,  # (n,) int32
+    valid: jnp.ndarray,  # (n,) bool
 ):
-    """Append one write per listed actor; returns (log, version) per lane.
+    """Append one changeset per listed actor; returns (log, version) per lane.
 
     Each lane is a distinct actor (a node writes at most one changeset per
     round — the reference serializes local writes through a single write
@@ -86,14 +93,28 @@ def append_writes(
             vr=log.vr.at[idx].set(vr, mode="drop"),
             cv=log.cv.at[idx].set(cv, mode="drop"),
             cl=log.cl.at[idx].set(cl, mode="drop"),
+            ncells=log.ncells.at[idx].set(ncells, mode="drop"),
             head=log.head.at[aidx].add(jnp.where(valid, 1, 0), mode="drop"),
         ),
         ver.astype(jnp.int32),
     )
 
 
-def gather_changes(log: ChangeLog, actor: jnp.ndarray, ver: jnp.ndarray):
-    """Fetch the (row, col, vr, cv, cl) tuple for (actor, version) lanes."""
+def gather_changesets(log: ChangeLog, actor: jnp.ndarray, ver: jnp.ndarray):
+    """Fetch the full cell arrays for (actor, version) lanes.
+
+    Returns ``(row, col, vr, cv, cl, ncells)`` where the cell planes have
+    shape ``lanes + (S,)`` and ``ncells`` has the lane shape — the analog of
+    re-reading ``crsql_changes WHERE db_version = ? ORDER BY seq``
+    (``corro-types/src/broadcast.rs:492-500``).
+    """
     slot = (ver - 1) % log.capacity
     idx = (actor, slot)
-    return log.row[idx], log.col[idx], log.vr[idx], log.cv[idx], log.cl[idx]
+    return (
+        log.row[idx],
+        log.col[idx],
+        log.vr[idx],
+        log.cv[idx],
+        log.cl[idx],
+        log.ncells[idx],
+    )

@@ -112,45 +112,66 @@ def apply_cell_changes(
 
 def local_write(
     state: TableState,
-    writer: jnp.ndarray,
-    row: jnp.ndarray,
-    col: jnp.ndarray,
-    vr: jnp.ndarray,
-    site: jnp.ndarray,
-    is_delete: jnp.ndarray,
-    valid: jnp.ndarray,
+    writer: jnp.ndarray,  # (n,) int32
+    row: jnp.ndarray,  # (n, S) int32 — row slot per cell
+    col: jnp.ndarray,  # (n, S) int32 — column per cell
+    vr: jnp.ndarray,  # (n, S) int32 — new value rank per cell
+    is_delete: jnp.ndarray,  # (n,) bool — changeset is a row DELETE
+    ncells: jnp.ndarray,  # (n,) int32 — live cells per changeset
+    valid: jnp.ndarray,  # (n,) bool
 ):
-    """Apply node-local writes and return the resulting change records.
+    """Apply one multi-cell changeset per writer; return its change records.
 
-    A local UPDATE bumps the cell's col_version to (stored + 1) — exactly what
-    the CR-SQLite triggers do on a tracked table (``doc/crdts.md:82``). A
-    DELETE instead bumps the row's causal length to the next even number and
-    a fresh INSERT after a delete bumps it to the next odd number
-    (causal-length CRDT).
+    A changeset is one transaction's worth of cell writes (up to S cells,
+    each a seq-numbered ``Change`` row in the reference,
+    ``corro-api-types/src/lib.rs:235-245``). A local UPDATE bumps each
+    touched cell's col_version to (stored + 1) — exactly what the CR-SQLite
+    triggers do on a tracked table (``doc/crdts.md:82``). A DELETE instead
+    bumps the row's causal length to the next even number and a fresh
+    INSERT after a delete bumps it to the next odd number (causal-length
+    CRDT). Cells within one changeset must target distinct (row, col)
+    pairs — the same invariant SQLite gives the reference, where a tx's
+    changes coalesce per cell before extraction.
 
-    Returns ``(new_state, ch_cv, ch_cl)`` where ``ch_cv``/``ch_cl`` are the
-    per-write col_version / causal length to record in the change log and
-    gossip out.
+    Returns ``(new_state, ch_cv, ch_cl, ch_vr)``, each (n, S) — the
+    per-cell col_version / causal length / value rank to record in the
+    change log and gossip out.
     """
-    widx = jnp.where(valid, writer, -1)
+    n, s = row.shape
+    cell_live = (
+        valid[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None])
+    )
+    widx = jnp.where(valid, writer, -1)[:, None]
     cur_cv = state.cv[widx, row, col]
     cur_cl = state.cl[widx, row]
 
     # Next causal length: resurrect (or first insert) → odd; delete → even.
     alive = (cur_cl % 2) == 1
+    del_b = is_delete[:, None]
     ch_cl = jnp.where(
-        is_delete,
+        del_b,
         jnp.where(alive, cur_cl + 1, cur_cl),
         jnp.where(alive, cur_cl, cur_cl + 1),
     ).astype(jnp.int32)
-    ch_cv = jnp.where(is_delete, cur_cv, cur_cv + 1).astype(jnp.int32)
+    ch_cv = jnp.where(del_b, cur_cv, cur_cv + 1).astype(jnp.int32)
     # A DELETE only bumps the causal length — it must not touch column
     # values (CR-SQLite deletes never produce value changes, only clock
     # rows). Neutralize the value/site lanes so the merge is a cl-only op.
-    ch_vr = jnp.where(is_delete, NEG, vr).astype(jnp.int32)
-    ch_site = jnp.where(is_delete, NEG, site).astype(jnp.int32)
+    ch_vr = jnp.where(del_b, NEG, vr).astype(jnp.int32)
+    ch_site = jnp.where(
+        del_b, NEG, jnp.broadcast_to(writer[:, None], (n, s))
+    ).astype(jnp.int32)
 
     new_state = apply_cell_changes(
-        state, writer, row, col, ch_cv, ch_vr, ch_site, ch_cl, valid
+        state,
+        jnp.broadcast_to(writer[:, None], (n, s)).reshape(-1),
+        row.reshape(-1),
+        col.reshape(-1),
+        ch_cv.reshape(-1),
+        ch_vr.reshape(-1),
+        ch_site.reshape(-1),
+        ch_cl.reshape(-1),
+        cell_live.reshape(-1),
     )
     return new_state, ch_cv, ch_cl, ch_vr

@@ -71,7 +71,9 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
     return SimState(
         table=make_table_state(n, cfg.num_rows, cfg.num_cols),
         book=make_bookkeeping(n, cfg.num_actors),
-        log=make_changelog(cfg.num_actors, cfg.log_capacity),
+        log=make_changelog(
+            cfg.num_actors, cfg.log_capacity, cfg.seqs_per_version
+        ),
         gossip=make_gossip_state(n, cfg.pend_slots),
         swim=make_swim_state(n, enabled=cfg.swim_enabled),
         ring0=jnp.asarray(_ring0(cfg, seed)),

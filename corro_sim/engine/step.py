@@ -3,12 +3,18 @@
 Round structure (mirrors the reference's data plane, SURVEY §1):
 
   local writes → eager ring-0 broadcast → gossip dissemination →
-  delivery + bookkeeping + CRDT merge → rebroadcast of fresh changes →
+  delivery + bookkeeping + CRDT merge → rebroadcast of fresh chunks →
   SWIM tick → (every ``sync_interval`` rounds) anti-entropy sync.
 
 Every stage is a batched array op over all nodes; there is no per-node
 control flow, so the step jits to one XLA program that `lax.scan` can
 iterate on-device.
+
+Changesets are seq-structured like the reference's: one version = one
+transaction's multi-cell changeset (``corro-api-types/src/lib.rs:235-245``),
+gossiped as ``chunks_per_version`` chunks (the ≤8 KiB ``ChunkedChanges``
+split, ``corro-types/src/change.rs:16-122``); a receiver buffers partial
+versions and merges only once seq-complete (``agent/util.rs:458-501``).
 """
 
 from __future__ import annotations
@@ -17,8 +23,8 @@ import jax
 import jax.numpy as jnp
 
 from corro_sim.config import SimConfig
-from corro_sim.core.bookkeeping import deliver_versions
-from corro_sim.core.changelog import append_writes, gather_changes
+from corro_sim.core.bookkeeping import deliver_versions, partial_versions
+from corro_sim.core.changelog import append_changesets, gather_changesets
 from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
@@ -35,6 +41,14 @@ def _reachable_fn(alive: jnp.ndarray, part: jnp.ndarray):
     return reach
 
 
+def _tile_chunks(cpv: int, *arrays):
+    """Repeat each lane cpv times, appending a chunk index array."""
+    out = [jnp.repeat(a, cpv) for a in arrays]
+    n = arrays[0].shape[0]
+    chunk = jnp.tile(jnp.arange(cpv, dtype=jnp.int32), n)
+    return (*out, chunk)
+
+
 def sim_step(
     cfg: SimConfig,
     state: SimState,
@@ -44,9 +58,11 @@ def sim_step(
     write_enable: jnp.ndarray,  # () bool — workload phase switch
 ):
     n = cfg.num_nodes
+    s = cfg.seqs_per_version
+    cpv = cfg.chunks_per_version
     rows_idx = jnp.arange(n, dtype=jnp.int32)
-    (k_write, k_row, k_col, k_val, k_del, k_bcast, k_swim, k_sync) = (
-        jax.random.split(key, 8)
+    (k_write, k_row, k_col, k_val, k_del, k_ncell, k_bcast, k_swim, k_sync) = (
+        jax.random.split(key, 9)
     )
     reach = _reachable_fn(alive, part)
 
@@ -57,7 +73,7 @@ def sim_step(
         view = jnp.ones((1, n), bool)
 
     # ---------------------------------------------------------- local writes
-    # One write per node per round max — the reference serializes local
+    # One changeset per node per round max — the reference serializes local
     # writes through one write conn + Semaphore(1) (agent.rs:500-731).
     writers = (
         (jax.random.uniform(k_write, (n,)) < cfg.write_rate)
@@ -68,19 +84,30 @@ def sim_step(
     w_row = jnp.searchsorted(state.row_cdf, u).astype(jnp.int32).clip(
         0, cfg.num_rows - 1
     )
-    w_col = jax.random.randint(k_col, (n,), 0, cfg.num_cols, dtype=jnp.int32)
+    w_del = (jax.random.uniform(k_del, (n,)) < cfg.delete_rate) & writers
+
+    # Cells: 1..S distinct columns of the written row (a transaction touching
+    # several columns — each cell is a seq-numbered Change).
+    if s > 1:
+        w_ncells = jax.random.randint(k_ncell, (n,), 1, s + 1, dtype=jnp.int32)
+        w_col = jnp.argsort(
+            jax.random.uniform(k_col, (n, cfg.num_cols)), axis=1
+        ).astype(jnp.int32)[:, :s]
+    else:
+        w_ncells = jnp.ones((n,), jnp.int32)
+        w_col = jax.random.randint(k_col, (n, 1), 0, cfg.num_cols, jnp.int32)
+    w_ncells = jnp.where(w_del, 1, w_ncells)  # DELETE = one cl-only change
     w_val = jax.random.randint(
-        k_val, (n,), 0, cfg.value_universe, dtype=jnp.int32
+        k_val, (n, s), 0, cfg.value_universe, dtype=jnp.int32
     )
-    w_del = (
-        jax.random.uniform(k_del, (n,)) < cfg.delete_rate
-    ) & writers
+    w_row_s = jnp.broadcast_to(w_row[:, None], (n, s))
 
     table, ch_cv, ch_cl, ch_vr = local_write(
-        state.table, rows_idx, w_row, w_col, w_val, rows_idx, w_del, writers
+        state.table, rows_idx, w_row_s, w_col, w_val, w_del, w_ncells, writers
     )
-    log, w_ver = append_writes(
-        state.log, rows_idx, w_row, w_col, ch_vr, ch_cv, ch_cl, writers
+    log, w_ver = append_changesets(
+        state.log, rows_idx, w_row_s, w_col, ch_vr, ch_cv, ch_cl, w_ncells,
+        writers,
     )
     # Self-bookkeeping: a node's own writes are trivially in-order.
     book = state.book.replace(
@@ -90,15 +117,20 @@ def sim_step(
     )
 
     # ------------------------------------------------- eager ring-0 messages
+    # Every chunk of a fresh local changeset goes to every ring-0 peer
+    # (broadcast/mod.rs:489-499).
     r0 = state.ring0.shape[1]
-    e_dst = state.ring0.reshape(-1)
-    e_src = jnp.repeat(rows_idx, r0)
+    e_dst, e_src, e_ver, e_valid, e_chunk = _tile_chunks(
+        cpv,
+        state.ring0.reshape(-1),
+        jnp.repeat(rows_idx, r0),
+        jnp.repeat(w_ver, r0),
+        jnp.repeat(writers, r0),
+    )
     e_actor = e_src
-    e_ver = jnp.repeat(w_ver, r0)
-    e_valid = jnp.repeat(writers, r0)
 
     # ------------------------------------------------- gossip dissemination
-    gossip, g_dst, g_src, g_actor, g_ver, g_valid = broadcast_step(
+    gossip, g_dst, g_src, g_actor, g_ver, g_chunk, g_valid = broadcast_step(
         state.gossip, k_bcast, alive, view, cfg.fanout
     )
 
@@ -106,32 +138,52 @@ def sim_step(
     src = jnp.concatenate([e_src, g_src])
     actor = jnp.concatenate([e_actor, g_actor])
     ver = jnp.concatenate([e_ver, g_ver])
+    chunk = jnp.concatenate([e_chunk, g_chunk])
     valid = jnp.concatenate([e_valid, g_valid])
 
     # Ground truth: the packet only lands if the link is actually up.
     delivered = valid & reach(src, dst)
 
     # ------------------------------------- delivery: bookkeeping + merge
-    book, fresh, dropped = deliver_versions(book, dst, actor, ver, delivered)
-    c_row, c_col, c_vr, c_cv, c_cl = gather_changes(
-        log, jnp.where(fresh, actor, 0), jnp.maximum(ver, 1)
+    book, fresh_chunk, complete, dropped = deliver_versions(
+        book, dst, actor, ver, delivered, chunk=chunk, bits_per_version=cpv
+    )
+    c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
+        log, jnp.where(complete, actor, 0), jnp.maximum(ver, 1)
+    )
+    m = dst.shape[0]
+    cell_live = (
+        complete[:, None] & (jnp.arange(s, dtype=jnp.int32)[None, :] < c_n[:, None])
     )
     # The writing site is the actor — except for DELETE entries (logged with
     # vr == NEG), which are cl-only and must not claim the site slot either.
-    c_site = jnp.where(c_vr == NEG, NEG, actor)
+    c_site = jnp.where(c_vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (m, s)))
     table = apply_cell_changes(
-        table, dst, c_row, c_col, c_cv, c_vr, c_site, c_cl, fresh
+        table,
+        jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
+        c_row.reshape(-1),
+        c_col.reshape(-1),
+        c_cv.reshape(-1),
+        c_vr.reshape(-1),
+        c_site.reshape(-1),
+        c_cl.reshape(-1),
+        cell_live.reshape(-1),
     )
 
     # ------------------------------------------------- rebroadcast + enqueue
-    # Fresh foreign changes re-enter the destination's pending ring
-    # (handlers.rs:950-960); a node's own fresh writes enter its own ring
+    # Fresh foreign chunks re-enter the destination's pending ring
+    # (handlers.rs:950-960); a node's own fresh chunks enter its own ring
     # for random dissemination (the eager ring-0 send already happened).
-    gossip = enqueue_broadcasts(
-        gossip, rows_idx, rows_idx, w_ver, writers, cfg.max_transmissions
+    wq_dst, wq_actor, wq_ver, wq_valid, wq_chunk = _tile_chunks(
+        cpv, rows_idx, rows_idx, w_ver, writers
     )
     gossip = enqueue_broadcasts(
-        gossip, dst, actor, ver, fresh, cfg.rebroadcast_transmissions
+        gossip, wq_dst, wq_actor, wq_ver, wq_chunk, wq_valid,
+        cfg.max_transmissions,
+    )
+    gossip = enqueue_broadcasts(
+        gossip, dst, actor, ver, chunk, fresh_chunk,
+        cfg.rebroadcast_transmissions,
     )
 
     # ----------------------------------------------------------------- SWIM
@@ -178,9 +230,12 @@ def sim_step(
     ).sum()
     metrics = {
         "writes": writers.sum(dtype=jnp.int32),
+        "cells_written": jnp.where(writers, w_ncells, 0).sum(dtype=jnp.int32),
         "msgs_sent": valid.sum(dtype=jnp.int32),
         "delivered": delivered.sum(dtype=jnp.int32),
-        "fresh": fresh.sum(dtype=jnp.int32),
+        "fresh": complete.sum(dtype=jnp.int32),
+        "fresh_chunks": fresh_chunk.sum(dtype=jnp.int32),
+        "buffered_partials": partial_versions(book, cpv),
         "dropped_window": dropped.sum(dtype=jnp.int32),
         "queue_overflow": gossip.overflow,
         "gap": gap,
@@ -201,13 +256,5 @@ def sim_step(
 
 
 def _pairwise_mask(alive: jnp.ndarray, part: jnp.ndarray):
-    """(1|N, N) reachability for sync peer choice without an (N,N) alloc.
-
-    When partitions are trivial (all part ids equal is unknowable statically)
-    we still need per-pair checks; sync gathers per chosen peer, so hand it a
-    small closure-materialized matrix only for the pairs it checks. Here we
-    return the (N, N) boolean lazily only if partitions are in play would
-    require dynamic shapes — so return the full mask; N×N bool is bit-packed
-    by XLA and sharded over nodes.
-    """
+    """(N, N) ground-truth reachability for sync peer choice."""
     return alive[:, None] & alive[None, :] & (part[:, None] == part[None, :])

@@ -32,6 +32,7 @@ from corro_sim.utils.slots import group_counts, ranks_within_group
 class GossipState:
     pend_actor: jnp.ndarray  # (N, P) int32
     pend_ver: jnp.ndarray  # (N, P) int32
+    pend_chunk: jnp.ndarray  # (N, P) int32 — changeset chunk index
     pend_tx: jnp.ndarray  # (N, P) int32, 0 = free slot
     cursor: jnp.ndarray  # (N,) int32 ring-buffer write cursor
     overflow: jnp.ndarray  # () int32 — live slots overwritten (drop metric)
@@ -42,6 +43,7 @@ def make_gossip_state(num_nodes: int, pend_slots: int) -> GossipState:
     return GossipState(
         pend_actor=jnp.zeros(shape, jnp.int32),
         pend_ver=jnp.zeros(shape, jnp.int32),
+        pend_chunk=jnp.zeros(shape, jnp.int32),
         pend_tx=jnp.zeros(shape, jnp.int32),
         cursor=jnp.zeros((num_nodes,), jnp.int32),
         overflow=jnp.zeros((), jnp.int32),
@@ -53,10 +55,11 @@ def enqueue_broadcasts(
     dst: jnp.ndarray,
     actor: jnp.ndarray,
     ver: jnp.ndarray,
+    chunk: jnp.ndarray,
     valid: jnp.ndarray,
     transmissions: int,
 ) -> GossipState:
-    """Append (actor, ver) to each dst's pending ring buffer.
+    """Append (actor, ver, chunk) to each dst's pending ring buffer.
 
     Slot allocation for a variable number of appends per node is one sort:
     order by dst, rank within group, slot = (cursor + rank) % P. Overwriting
@@ -70,6 +73,7 @@ def enqueue_broadcasts(
     s_dst = key[order]
     s_actor = actor[order]
     s_ver = ver[order]
+    s_chunk = chunk[order]
     s_valid = valid[order]
 
     rank = ranks_within_group(s_dst)
@@ -88,6 +92,7 @@ def enqueue_broadcasts(
     return GossipState(
         pend_actor=gossip.pend_actor.at[idx].set(s_actor, mode="drop"),
         pend_ver=gossip.pend_ver.at[idx].set(s_ver, mode="drop"),
+        pend_chunk=gossip.pend_chunk.at[idx].set(s_chunk, mode="drop"),
         pend_tx=gossip.pend_tx.at[idx].set(
             jnp.where(s_valid, transmissions, 0), mode="drop"
         ),
@@ -111,8 +116,8 @@ def broadcast_step(
     says otherwise, exactly like the reference sending into QUIC connections
     that have not yet errored).
 
-    Returns ``(gossip, dst, src, actor, ver, valid)`` flat message arrays of
-    length N * P * fanout.
+    Returns ``(gossip, dst, src, actor, ver, chunk, valid)`` flat message
+    arrays of length N * P * fanout.
     """
     n, p = gossip.pend_tx.shape
     live = (gossip.pend_tx > 0) & sender_alive[:, None]  # (N, P)
@@ -135,6 +140,9 @@ def broadcast_step(
     valid = ok.reshape(-1)
     actor = jnp.broadcast_to(gossip.pend_actor[:, :, None], targets.shape).reshape(-1)
     ver = jnp.broadcast_to(gossip.pend_ver[:, :, None], targets.shape).reshape(-1)
+    chunk = jnp.broadcast_to(
+        gossip.pend_chunk[:, :, None], targets.shape
+    ).reshape(-1)
     src_flat = src.reshape(-1)
 
     new_tx = jnp.where(live, gossip.pend_tx - 1, gossip.pend_tx)
@@ -144,5 +152,6 @@ def broadcast_step(
         src_flat,
         actor,
         ver,
+        chunk,
         valid,
     )

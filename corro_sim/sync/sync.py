@@ -29,8 +29,9 @@ import jax.numpy as jnp
 
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping, advance_heads
-from corro_sim.core.changelog import ChangeLog, gather_changes
+from corro_sim.core.changelog import ChangeLog, gather_changesets
 from corro_sim.core.crdt import NEG, TableState, apply_cell_changes
+from corro_sim.utils.bits import WINDOW_BITS
 from corro_sim.utils.slots import ranks_within_group
 
 
@@ -133,13 +134,28 @@ def sync_round(
         jnp.arange(n, dtype=jnp.int32)[:, None, None], ver.shape
     ).reshape(-1)
 
-    row, col, vr, cv, cl = gather_changes(
+    row, col, vr, cv, cl, ncells = gather_changesets(
         log, jnp.where(valid_l, actor_l, 0), jnp.maximum(ver_l, 1)
     )
+    s = log.seqs
+    m = dst_l.shape[0]
+    cell_live = valid_l[:, None] & (
+        jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None]
+    )
     # DELETE log entries (vr == NEG) are cl-only: no site claim.
-    site_l = jnp.where(vr == NEG, NEG, actor_l)
+    site_l = jnp.where(
+        vr == NEG, NEG, jnp.broadcast_to(actor_l[:, None], (m, s))
+    )
     table = apply_cell_changes(
-        table, dst_l, row, col, cv, vr, site_l, cl, valid_l
+        table,
+        jnp.broadcast_to(dst_l[:, None], (m, s)).reshape(-1),
+        row.reshape(-1),
+        col.reshape(-1),
+        cv.reshape(-1),
+        vr.reshape(-1),
+        site_l.reshape(-1),
+        cl.reshape(-1),
+        cell_live.reshape(-1),
     )
 
     # Raise heads: floor[i, topa] = head + take, absorb window bits above.
@@ -147,20 +163,20 @@ def sync_round(
         jnp.arange(n, dtype=jnp.int32)[:, None], topa
     ].max(base + take)
 
-    # Newly-applied count: versions in head+1..head+take whose window bit
-    # was already set arrived earlier via gossip and were counted then —
-    # don't count the re-transfer again.
+    # Newly-applied count: versions in head+1..head+take that were already
+    # seq-complete in the window arrived earlier via gossip and were counted
+    # then — don't count the re-transfer again.
+    bpv = cfg.chunks_per_version
+    vwin = WINDOW_BITS // bpv
     win_g = book.win[jnp.arange(n, dtype=jnp.int32)[:, None], topa]
-    tmask = jnp.where(
-        take >= 32,
-        jnp.uint32(0xFFFFFFFF),
-        (jnp.uint32(1) << jnp.minimum(take, 31).astype(jnp.uint32))
-        - jnp.uint32(1),
-    )
-    already = jax.lax.population_count(win_g & tmask).astype(jnp.int32)
+    group_mask = jnp.uint32((1 << bpv) - 1)
+    already = jnp.zeros(take.shape, jnp.int32)
+    for o in range(min(cap, vwin)):
+        g = (win_g >> jnp.uint32(o * bpv)) & group_mask
+        already = already + ((g == group_mask) & (o < take)).astype(jnp.int32)
     new_versions = (take - already).sum(dtype=jnp.int32)
 
-    book = advance_heads(book, floor)
+    book = advance_heads(book, floor, bpv)
 
     metrics = {
         "sync_pairs": granted.sum(dtype=jnp.int32),

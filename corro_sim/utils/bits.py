@@ -47,13 +47,22 @@ def window_shift_right(win: jnp.ndarray, t: jnp.ndarray) -> jnp.ndarray:
     return shifted
 
 
-def absorb(head: jnp.ndarray, win: jnp.ndarray):
-    """Advance contiguous heads: head += trailing_ones(win); win >>= t.
+def absorb(head: jnp.ndarray, win: jnp.ndarray, bits_per_version: int = 1):
+    """Advance contiguous heads: head += trailing-complete versions.
 
     Mirrors ``BookedVersions`` collapsing a gap range once the missing
     versions arrive (reference ``corro-types/src/agent.rs:1220-1285``).
+
+    With ``bits_per_version > 1`` each version owns a group of adjacent
+    window bits — one per changeset *chunk* (the reference splits a
+    changeset into ≤8 KiB seq-range chunks, ``corro-types/src/change.rs:
+    16-122``, and buffers partial versions until every seq arrived,
+    ``agent/util.rs:1065-1190``). Only fully-set groups are absorbed; a
+    partially-set group is exactly a buffered partial version.
     """
     t = trailing_ones_u32(win)
-    new_head = head + t.astype(head.dtype)
+    if bits_per_version > 1:
+        t = (t // jnp.uint32(bits_per_version)) * jnp.uint32(bits_per_version)
+    new_head = head + (t // jnp.uint32(bits_per_version)).astype(head.dtype)
     new_win = window_shift_right(win, t)
     return new_head, new_win

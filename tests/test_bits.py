@@ -42,3 +42,18 @@ def test_absorb():
     h, w = absorb(head, win)
     np.testing.assert_array_equal(np.asarray(h), [8, 0, 41])
     np.testing.assert_array_equal(np.asarray(w), [0, 0, 0])
+
+
+def test_absorb_grouped_bits_per_version():
+    # bits_per_version=2: only fully-set pairs absorb (partial versions stay)
+    head = jnp.asarray(np.array([0, 0, 0, 4], np.int32))
+    win = jnp.asarray(
+        np.array([0b11, 0b01, 0b1111, 0b110111], np.uint32)
+    )
+    h, w = absorb(head, win, bits_per_version=2)
+    # 0b11 -> one complete version; 0b01 -> partial, nothing absorbs;
+    # 0b1111 -> two versions; 0b110111 -> one version (next group 0b01 partial)
+    np.testing.assert_array_equal(np.asarray(h), [1, 0, 2, 5])
+    np.testing.assert_array_equal(
+        np.asarray(w), [0, 0b01, 0, 0b1101]
+    )

@@ -64,13 +64,15 @@ def deliver_np(book, triples, valid=None):
     arr = np.array(triples, np.int32).reshape(-1, 3)
     if valid is None:
         valid = np.ones(arr.shape[0], bool)
-    book, fresh, dropped = deliver_versions(
+    book, fresh, complete, dropped = deliver_versions(
         book,
         jnp.asarray(arr[:, 0]),
         jnp.asarray(arr[:, 1]),
         jnp.asarray(arr[:, 2]),
         jnp.asarray(valid),
     )
+    # single-chunk versions: fresh == complete
+    np.testing.assert_array_equal(np.asarray(fresh), np.asarray(complete))
     return book, np.asarray(fresh), np.asarray(dropped)
 
 
@@ -159,3 +161,93 @@ def test_advance_heads_sync_fastpath():
     # head raised to 2, then absorbs 3 and 4 from the shifted window
     assert head[0, 0] == 4 and win[0, 0] == 0
     assert head[0, 1] == 0
+
+
+# ---------------------------------------------------------------- chunked
+def deliver_chunks(book, quads, bpv, valid=None):
+    arr = np.array(quads, np.int32).reshape(-1, 4)
+    if valid is None:
+        valid = np.ones(arr.shape[0], bool)
+    book, fresh, complete, dropped = deliver_versions(
+        book,
+        jnp.asarray(arr[:, 0]),
+        jnp.asarray(arr[:, 1]),
+        jnp.asarray(arr[:, 2]),
+        jnp.asarray(valid),
+        chunk=jnp.asarray(arr[:, 3]),
+        bits_per_version=bpv,
+    )
+    return book, np.asarray(fresh), np.asarray(complete), np.asarray(dropped)
+
+
+def test_partial_version_not_complete_until_all_chunks():
+    book = make_bookkeeping(1, 1)
+    # version 1 has 2 chunks; deliver chunk 0 only
+    book, fresh, complete, _ = deliver_chunks(book, [(0, 0, 1, 0)], bpv=2)
+    assert fresh.all() and not complete.any()
+    head, win = to_np(book)
+    assert head[0, 0] == 0 and win[0, 0] == 0b01
+    # second chunk completes and absorbs the version
+    book, fresh, complete, _ = deliver_chunks(book, [(0, 0, 1, 1)], bpv=2)
+    assert fresh.all() and complete.all()
+    head, win = to_np(book)
+    assert head[0, 0] == 1 and win[0, 0] == 0
+
+
+def test_both_chunks_in_one_batch_single_complete():
+    book = make_bookkeeping(1, 1)
+    book, fresh, complete, _ = deliver_chunks(
+        book, [(0, 0, 1, 0), (0, 0, 1, 1), (0, 0, 1, 1)], bpv=2
+    )
+    assert fresh.sum() == 2  # two distinct chunks
+    assert complete.sum() == 1  # version completes exactly once
+    head, _ = to_np(book)
+    assert head[0, 0] == 1
+
+
+def test_chunk_redelivery_is_dup():
+    book = make_bookkeeping(1, 1)
+    book, _, _, _ = deliver_chunks(book, [(0, 0, 1, 0)], bpv=2)
+    book, fresh, complete, _ = deliver_chunks(book, [(0, 0, 1, 0)], bpv=2)
+    assert not fresh.any() and not complete.any()
+
+
+def test_chunked_window_is_narrower():
+    # bpv=4 -> only 8 versions of lookahead; version 9 ahead drops
+    book = make_bookkeeping(1, 1)
+    book, fresh, complete, dropped = deliver_chunks(
+        book, [(0, 0, 9, 0)], bpv=4
+    )
+    assert dropped.all() and not fresh.any()
+    book, fresh, complete, dropped = deliver_chunks(
+        book, [(0, 0, 8, 3)], bpv=4
+    )
+    assert fresh.all() and not dropped.any()
+
+
+def test_out_of_order_chunked_versions_absorb_together():
+    book = make_bookkeeping(1, 1)
+    # complete version 2 first (both chunks), then version 1
+    book, _, complete, _ = deliver_chunks(
+        book, [(0, 0, 2, 0), (0, 0, 2, 1)], bpv=2
+    )
+    assert complete.sum() == 1
+    head, win = to_np(book)
+    assert head[0, 0] == 0 and win[0, 0] == 0b1100
+    book, _, complete, _ = deliver_chunks(
+        book, [(0, 0, 1, 1), (0, 0, 1, 0)], bpv=2
+    )
+    assert complete.sum() == 1
+    head, win = to_np(book)
+    assert head[0, 0] == 2 and win[0, 0] == 0
+
+
+def test_partial_versions_gauge():
+    from corro_sim.core.bookkeeping import partial_versions
+
+    book = make_bookkeeping(2, 2)
+    book, _, _, _ = deliver_chunks(
+        book, [(0, 0, 1, 0), (1, 1, 3, 1), (1, 1, 1, 0), (1, 1, 1, 1)], bpv=2
+    )
+    # (0,0) v1 partial; (1,1) v3 partial; (1,1) v1 completed+absorbed
+    assert int(np.asarray(partial_versions(book, 2))) == 2

@@ -130,31 +130,56 @@ def test_random_fuzz_vs_oracle():
         assert_matches_oracle(st, changes, 4, 3, 3)
 
 
+def _write1(st, writer, row, col, val, is_delete):
+    """One single-cell changeset through the multi-cell local_write."""
+    one = jnp.ones((1,), jnp.int32)
+    st, cv, cl, vr = local_write(
+        st,
+        one * writer,
+        (one * row)[:, None],
+        (one * col)[:, None],
+        (one * val)[:, None],
+        jnp.full((1,), is_delete, bool),
+        one,  # ncells
+        jnp.ones((1,), bool),
+    )
+    return st, cv[0, 0], cl[0, 0], vr[0, 0]
+
+
 def test_local_write_bumps_col_version():
     st = make_table_state(2, 2, 2)
-    ones = jnp.ones((1,), jnp.int32)
-    f = jnp.zeros((1,), bool)
-    t = jnp.ones((1,), bool)
     # first write: cv 0 -> 1, row born: cl 0 -> 1
-    st, cv, cl, _ = local_write(
-        st, ones * 0, ones * 1, ones * 0, ones * 42, ones * 0, f, t
-    )
-    assert int(cv[0]) == 1 and int(cl[0]) == 1
+    st, cv, cl, _ = _write1(st, 0, 1, 0, 42, False)
+    assert int(cv) == 1 and int(cl) == 1
     # second write to same cell: cv 1 -> 2, cl stays 1
-    st, cv, cl, _ = local_write(
-        st, ones * 0, ones * 1, ones * 0, ones * 43, ones * 0, f, t
-    )
-    assert int(cv[0]) == 2 and int(cl[0]) == 1
+    st, cv, cl, _ = _write1(st, 0, 1, 0, 43, False)
+    assert int(cv) == 2 and int(cl) == 1
     assert int(st.vr[0, 1, 0]) == 43
     # delete: cl 1 -> 2 (even = dead), cv unchanged
-    st, cv, cl, dvr = local_write(
-        st, ones * 0, ones * 1, ones * 0, ones * 0, ones * 0, t, t
-    )
-    assert int(cl[0]) == 2 and int(st.cl[0, 1]) == 2
-    assert int(dvr[0]) < 0  # delete carries no value
+    st, cv, cl, dvr = _write1(st, 0, 1, 0, 0, True)
+    assert int(cl) == 2 and int(st.cl[0, 1]) == 2
+    assert int(dvr) < 0  # delete carries no value
     assert int(st.vr[0, 1, 0]) == 43  # stored value untouched by delete
     # resurrect: cl 2 -> 3
-    st, cv, cl, _ = local_write(
-        st, ones * 0, ones * 1, ones * 0, ones * 44, ones * 0, f, t
+    st, cv, cl, _ = _write1(st, 0, 1, 0, 44, False)
+    assert int(cl) == 3
+
+
+def test_local_write_multi_cell_changeset():
+    """A 3-cell transaction bumps each touched cell's cv independently."""
+    st = make_table_state(1, 2, 4)
+    writer = jnp.zeros((1,), jnp.int32)
+    row = jnp.zeros((1, 3), jnp.int32)
+    col = jnp.asarray([[0, 2, 3]], jnp.int32)
+    val = jnp.asarray([[10, 20, 30]], jnp.int32)
+    st, cv, cl, vr = local_write(
+        st, writer, row, col, val,
+        jnp.zeros((1,), bool), jnp.full((1,), 2, jnp.int32),
+        jnp.ones((1,), bool),
     )
-    assert int(cl[0]) == 3
+    # ncells=2: only the first two cells land
+    assert int(st.vr[0, 0, 0]) == 10
+    assert int(st.vr[0, 0, 2]) == 20
+    assert int(st.cv[0, 0, 3]) == 0  # third cell masked out
+    assert int(st.cl[0, 0]) == 1
+    np.testing.assert_array_equal(np.asarray(cv[0, :2]), [1, 1])

@@ -217,3 +217,32 @@ def test_deletes_converge_and_stay_value_neutral():
     assert (site[untouched] == -1).all() or (site[untouched] == int(NEG)).all()
     # some rows must actually have died (even causal length)
     assert (np.asarray(st.table.cl) % 2 == 0).any()
+
+
+def test_multicell_chunked_changesets_converge():
+    # Seq-structured changesets: up to 3 cells per version, gossiped as 2
+    # chunks; receivers must buffer partial versions until seq-complete
+    # (the __corro_buffered_changes path) and still converge.
+    cfg = SimConfig(
+        num_nodes=10,
+        num_rows=8,
+        num_cols=4,
+        log_capacity=128,
+        write_rate=0.7,
+        seqs_per_version=3,
+        chunks_per_version=2,
+        sync_interval=4,
+        sync_actor_topk=10,
+        sync_cap_per_actor=8,
+    )
+    state = init_state(cfg, seed=13)
+    res = run_sim(
+        cfg, state, Schedule(write_rounds=12), max_rounds=512, chunk=8, seed=13
+    )
+    assert res.converged_round is not None, (
+        f"no convergence; last gaps {res.metrics['gap'][-8:]}"
+    )
+    assert_converged_state(cfg, res)
+    # chunking must actually have produced buffered partials at some point
+    assert res.metrics["buffered_partials"].max() > 0
+    assert res.metrics["cells_written"].sum() > res.metrics["writes"].sum()
