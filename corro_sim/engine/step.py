@@ -230,6 +230,7 @@ def sim_step(
     ).sum()
     metrics = {
         "writes": writers.sum(dtype=jnp.int32),
+        "deletes": w_del.sum(dtype=jnp.int32),
         "cells_written": jnp.where(writers, w_ncells, 0).sum(dtype=jnp.int32),
         "msgs_sent": valid.sum(dtype=jnp.int32),
         "delivered": delivered.sum(dtype=jnp.int32),
