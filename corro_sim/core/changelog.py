@@ -38,6 +38,8 @@ class ChangeLog:
     cv: jnp.ndarray  # (A, L, S) int32
     cl: jnp.ndarray  # (A, L, S) int32
     ncells: jnp.ndarray  # (A, L) int32
+    live: jnp.ndarray  # (A, L) int32 — cells still globally winning
+    cleared: jnp.ndarray  # (A, L) bool — fully superseded (empty changeset)
     head: jnp.ndarray  # (A,) int32 — number of versions each actor has written
 
     @property
@@ -60,6 +62,8 @@ def make_changelog(num_actors: int, capacity: int, seqs: int = 1) -> ChangeLog:
         cv=jnp.zeros(shape, jnp.int32),
         cl=jnp.zeros(shape, jnp.int32),
         ncells=jnp.zeros((num_actors, capacity), jnp.int32),
+        live=jnp.zeros((num_actors, capacity), jnp.int32),
+        cleared=jnp.zeros((num_actors, capacity), bool),
         head=jnp.zeros((num_actors,), jnp.int32),
     )
 
@@ -82,8 +86,10 @@ def append_changesets(
     connection + ``Semaphore(1)``, ``corro-types/src/agent.rs:500-731``, so
     per-round-per-actor writes are naturally ordered).
     """
-    aidx = jnp.where(valid, actor, -1)
-    ver = log.head[aidx] + 1  # versions are 1-based (Version(u64) newtype)
+    # OOB-positive sentinel: JAX scatter mode="drop" drops indices >= size,
+    # but a -1 wraps to the last actor and corrupts it.
+    aidx = jnp.where(valid, actor, log.head.shape[0])
+    ver = log.head[jnp.where(valid, actor, 0)] + 1  # 1-based (Version newtype)
     slot = (ver - 1) % log.capacity
     idx = (aidx, slot)
     return (
@@ -94,6 +100,8 @@ def append_changesets(
             cv=log.cv.at[idx].set(cv, mode="drop"),
             cl=log.cl.at[idx].set(cl, mode="drop"),
             ncells=log.ncells.at[idx].set(ncells, mode="drop"),
+            live=log.live.at[idx].set(ncells, mode="drop"),
+            cleared=log.cleared.at[idx].set(False, mode="drop"),
             head=log.head.at[aidx].add(jnp.where(valid, 1, 0), mode="drop"),
         ),
         ver.astype(jnp.int32),

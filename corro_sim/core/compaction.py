@@ -1,0 +1,228 @@
+"""Overwritten-version clearing — the reference's changeset compaction.
+
+In the reference, clock-table triggers record which (actor, version) pairs
+lost rows to a new transaction (``__corro_versions_impacted``,
+``corro-types/src/agent.rs:265-279,554-596``); after commit,
+``find_overwritten_versions`` drains that table and ``store_empty_changeset``
+replaces fully-superseded versions with *cleared ranges*
+(``agent.rs:1662-1721``, ``change.rs:267-389``). Cleared versions carry no
+data: anti-entropy serves them as ``SyncNeedV1::Empty`` → ``EmptySet``
+messages, and peers fast-forward their bookkeeping without any row transfer
+(``api/peer.rs:716-758``, ``handlers.rs:524-719``).
+
+TPU model. The authoritative write history is the global change log, so
+supersession is global too:
+
+- ``CellOwnership`` tracks, per table cell, the currently-winning change's
+  ``(col_version, value_rank, site)`` triple and the (actor, version) that
+  produced it — the dense analog of the ``<tbl>__crsql_clock`` tables
+  (``doc/crdts.md:9-40``). Per-row planes do the same for the causal
+  length (delete-tombstone ownership).
+- The change log keeps ``live[A, L]`` — how many of a version's cells are
+  still a winner — and ``cleared[A, L]``. When a round's writes steal a
+  cell from its previous owner (or a generation change wipes a whole row),
+  the owner's ``live`` decrements; at zero the version is cleared.
+
+Cleared versions still occupy their slot in version order (bookkeeping
+heads must pass through them) but deliver no cells: both the gossip-apply
+and the sync-transfer paths mask cell application with ``cleared`` — the
+moral equivalent of a sync peer answering "that range is empty now".
+
+Semantics mirror :func:`corro_sim.core.crdt.apply_cell_changes` exactly
+(causal-generation merge): row cl merges first; a generation bump wipes the
+row's value cells and their ownership; value lanes contest only at the
+row's current generation.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+
+from corro_sim.core.changelog import ChangeLog
+from corro_sim.core.crdt import NEG
+from corro_sim.utils.slots import dedupe_sorted_mask
+
+
+@flax.struct.dataclass
+class CellOwnership:
+    # per-cell winning change (R, C)
+    cv: jnp.ndarray  # int32 col_version
+    vr: jnp.ndarray  # int32 value rank
+    site: jnp.ndarray  # int32 writer site
+    actor: jnp.ndarray  # int32 owning actor, -1 = none
+    ver: jnp.ndarray  # int32 owning version, 0 = none
+    # per-row causal-length state (R,)
+    rcl: jnp.ndarray  # int32 causal length (global max)
+    ractor: jnp.ndarray  # int32 tombstone-owning DELETE actor, -1 = none
+    rver: jnp.ndarray  # int32 tombstone-owning DELETE version, 0 = none
+    rsite: jnp.ndarray  # int32 tombstone tie-break site
+
+
+def make_ownership(num_rows: int, num_cols: int) -> CellOwnership:
+    shape = (num_rows, num_cols)
+    return CellOwnership(
+        cv=jnp.zeros(shape, jnp.int32),
+        vr=jnp.full(shape, NEG, jnp.int32),
+        site=jnp.full(shape, -1, jnp.int32),
+        actor=jnp.full(shape, -1, jnp.int32),
+        ver=jnp.zeros(shape, jnp.int32),
+        rcl=jnp.zeros((num_rows,), jnp.int32),
+        ractor=jnp.full((num_rows,), -1, jnp.int32),
+        rver=jnp.zeros((num_rows,), jnp.int32),
+        rsite=jnp.full((num_rows,), -1, jnp.int32),
+    )
+
+
+def _decrement_live(log: ChangeLog, actor, ver, valid):
+    """live[actor, ver] -= 1 where valid; set cleared at zero.
+
+    Guards the log ring: a version older than capacity has been overwritten
+    by the ring wrap and must not be touched.
+    """
+    in_ring = valid & (
+        ver > log.head[jnp.where(valid, actor, 0)] - log.capacity
+    )
+    aidx = jnp.where(in_ring, actor, log.head.shape[0])
+    slot = (jnp.maximum(ver, 1) - 1) % log.capacity
+    live = log.live.at[aidx, slot].add(jnp.where(in_ring, -1, 0), mode="drop")
+    cleared = log.cleared | ((live <= 0) & (log.ncells > 0))
+    return log.replace(live=live, cleared=cleared)
+
+
+def _first_per_key(key: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """Mask of the first valid lane per key value (in caller order)."""
+    k = jnp.where(valid, key, jnp.int32(2**30))
+    order = jnp.argsort(k)
+    inv = jnp.zeros(order.shape, jnp.int32).at[order].set(
+        jnp.arange(order.shape[0], dtype=jnp.int32)
+    )
+    return (dedupe_sorted_mask(k[order]) & valid[order])[inv]
+
+
+def update_ownership(
+    own: CellOwnership,
+    log: ChangeLog,
+    actor: jnp.ndarray,  # (M,) int32 — writing actor per cell lane
+    ver: jnp.ndarray,  # (M,) int32 — version per cell lane
+    row: jnp.ndarray,  # (M,) int32
+    col: jnp.ndarray,  # (M,) int32
+    cv: jnp.ndarray,  # (M,) int32
+    vr: jnp.ndarray,  # (M,) int32 (NEG for cl-only DELETE lanes)
+    site: jnp.ndarray,  # (M,) int32 (NEG for cl-only lanes)
+    cl: jnp.ndarray,  # (M,) int32
+    valid: jnp.ndarray,  # (M,) bool — live cell lanes
+    is_delete: jnp.ndarray,  # (M,) bool — lane belongs to a DELETE changeset
+):
+    """Fold one round of freshly-written cells into global ownership.
+
+    Every losing side of each contested cell — the previous owner, any
+    same-round lane beaten at scatter time, and every value cell of a row
+    that changed generation — has its version's ``live`` count
+    decremented; versions at zero live cells become ``cleared``.
+
+    Lanes must be unique per (row, col) among value lanes and unique per
+    row among DELETE lanes (one changeset writes a cell at most once — the
+    same invariant SQLite's per-tx coalescing gives the reference).
+    """
+    num_rows, num_cols = own.cv.shape
+    rowm = jnp.where(valid, row, num_rows)  # OOB-positive: -1 wraps
+
+    # --- 1) row causal length: merge from every lane ----------------------
+    rcl0 = own.rcl
+    rcl1 = rcl0.at[rowm].max(jnp.where(valid, cl, NEG), mode="drop")
+    bumped = rcl1 > rcl0  # (R,) generation changed
+
+    # --- 2) generation wipe: bumped rows lose cells + their owners --------
+    wipe = bumped[:, None] & (own.actor >= 0)  # (R, C)
+    log = _decrement_live(
+        log, own.actor.reshape(-1), own.ver.reshape(-1), wipe.reshape(-1)
+    )
+    bump2 = bumped[:, None]
+    cv0 = jnp.where(bump2, 0, own.cv)
+    vr0 = jnp.where(bump2, NEG, own.vr)
+    site0 = jnp.where(bump2, -1, own.site)
+    oactor = jnp.where(bump2, -1, own.actor)
+    over = jnp.where(bump2, 0, own.ver)
+
+    # --- 3) tombstone ownership ------------------------------------------
+    # Old tombstone superseded by any generation bump (resurrect or newer
+    # delete). At an unchanged even generation, a concurrent delete with a
+    # higher site outbids the owner (deterministic tie-break).
+    old_tomb_lost = bumped & (own.ractor >= 0)
+    log = _decrement_live(log, own.ractor, own.rver, old_tomb_lost)
+    ractor0 = jnp.where(bumped, -1, own.ractor)
+    rver0 = jnp.where(bumped, 0, own.rver)
+    rsite0 = jnp.where(bumped, -1, own.rsite)
+
+    del_lane = valid & is_delete & (cl == rcl1[jnp.where(valid, row, 0)])
+    drow = jnp.where(del_lane, row, num_rows)
+    rsite1 = rsite0.at[drow].max(jnp.where(del_lane, site_of(actor), NEG),
+                                 mode="drop")
+    dwin = del_lane & (site_of(actor) == rsite1[jnp.where(del_lane, row, 0)])
+    # Only winning lanes may scatter ownership — a losing lane must drop,
+    # not write a sentinel (two lanes on one row race the scatter winner).
+    dwrow = jnp.where(dwin, row, num_rows)
+    tomb_changed = rsite1 != rsite0
+    ractor1 = ractor0.at[dwrow].set(actor, mode="drop")
+    rver1 = rver0.at[dwrow].set(ver, mode="drop")
+    # outbid previous same-generation tombstone owner
+    drow_g = jnp.where(del_lane, row, 0)  # clamped gather index
+    outbid = (
+        _first_per_key(drow, del_lane)
+        & ~bumped[drow_g]
+        & (ractor0[drow_g] >= 0)
+        & tomb_changed[drow_g]
+    )
+    log = _decrement_live(log, ractor0[drow_g], rver0[drow_g], outbid)
+    # delete lanes beaten at scatter time (stale generation or lower site)
+    dself_lost = valid & is_delete & ~dwin
+    log = _decrement_live(log, actor, ver, dself_lost)
+
+    # --- 4) value cells: contest at the current generation ----------------
+    val = valid & (vr != NEG) & (cl == rcl1[jnp.where(valid, row, 0)])
+    r_idx = jnp.where(val, row, num_rows)
+    idx = (r_idx, col)
+    gidx = (jnp.where(val, row, 0), col)  # clamped gather twin of idx
+    cv1 = cv0.at[idx].max(jnp.where(val, cv, NEG), mode="drop")
+    vr_base = jnp.where(cv1 > cv0, NEG, vr0)
+    w1 = val & (cv == cv1[idx])
+    vr1 = vr_base.at[idx].max(jnp.where(w1, vr, NEG), mode="drop")
+    site_base = jnp.where((cv1 != cv0) | (vr1 != vr0), NEG, site0)
+    w2 = w1 & (vr == vr1[idx])
+    site1 = site_base.at[idx].max(jnp.where(w2, site, NEG), mode="drop")
+    winner = w2 & (site == site1[idx])
+
+    changed = (cv1 != cv0) | (vr1 != vr0) | (site1 != site0)
+    # Only winning lanes scatter ownership (losers drop — see tombstone).
+    widx = (jnp.where(winner, row, num_rows), col)
+    actor1 = oactor.at[widx].set(actor, mode="drop")
+    ver1 = over.at[widx].set(ver, mode="drop")
+
+    # previous owner superseded → one decrement per unique contested cell
+    cell_key = jnp.where(val, row * num_cols + col, jnp.int32(2**30))
+    first_cell = _first_per_key(cell_key, val)
+    old_lost = first_cell & (oactor[idx] >= 0) & changed[idx]
+    log = _decrement_live(log, oactor[idx], over[idx], old_lost)
+    # same-round losers and stale-generation value lanes die at birth
+    self_lost = valid & (vr != NEG) & ~winner
+    log = _decrement_live(log, actor, ver, self_lost)
+
+    own = CellOwnership(
+        cv=cv1,
+        vr=vr1,
+        site=site1,
+        actor=actor1,
+        ver=ver1,
+        rcl=rcl1,
+        ractor=ractor1,
+        rver=rver1,
+        rsite=rsite1,
+    )
+    return own, log
+
+
+def site_of(actor: jnp.ndarray) -> jnp.ndarray:
+    """Site ordinal of an actor — identical in the simulator (ActorId is
+    the crsql site id, ``corro-types/src/actor.rs:26``)."""
+    return actor

@@ -82,30 +82,44 @@ def apply_cell_changes(
     ``INSERT INTO crsql_changes`` loop in ``process_complete_version``
     (``corro-agent/src/agent/util.rs:1193-1307``) — except it applies every
     change for every node in one shot.
+
+    Causal-generation semantics (CR-SQLite's causal-length CRDT,
+    ``doc/crdts.md:13``): the row's causal length merges first; a row whose
+    cl *increases* changes generation and physically loses its cells (a
+    DELETE drops the row and its clock rows in CR-SQLite — values don't
+    survive the tombstone; a resurrect starts a fresh generation). Value
+    changes then apply only if their cl matches the row's post-merge
+    generation — a stale-generation update loses to a concurrent delete.
     """
     # Invalid lanes scatter out of bounds and get dropped.
     dst = jnp.where(valid, dst, -1)
 
-    cv0, vr0, site0 = state.cv, state.vr, state.site
+    # Pass 0: causal length — per-row max (cl CRDT), then generation wipe.
+    cl0 = state.cl
+    cl1 = cl0.at[dst, row].max(jnp.where(valid, ch_cl, NEG), mode="drop")
+    bumped = (cl1 > cl0)[:, :, None]  # (N, R, 1) — generation changed
+    cv0 = jnp.where(bumped, 0, state.cv)
+    vr0 = jnp.where(bumped, NEG, state.vr)
+    site0 = jnp.where(bumped, -1, state.site)
+
     idx = (dst, row, col)
+    # A value lane participates only at the row's current generation.
+    val = valid & (ch_vr != NEG) & (ch_cl == cl1[dst, row])
 
     # Pass 1: col_version.
-    cv1 = cv0.at[idx].max(jnp.where(valid, ch_cv, NEG), mode="drop")
+    cv1 = cv0.at[idx].max(jnp.where(val, ch_cv, NEG), mode="drop")
 
     # Pass 2: value rank. The stored value only competes if the stored
     # col_version is still the winner; otherwise the cell was superseded and
     # its value is reset before the tie-break.
     vr_base = jnp.where(cv1 > cv0, NEG, vr0)
-    win1 = valid & (ch_cv == cv1[idx])
+    win1 = val & (ch_cv == cv1[idx])
     vr1 = vr_base.at[idx].max(jnp.where(win1, ch_vr, NEG), mode="drop")
 
     # Pass 3: site. Stored site survives only if (cv, vr) both survived.
     site_base = jnp.where((cv1 != cv0) | (vr1 != vr0), NEG, site0)
     win2 = win1 & (ch_vr == vr1[idx])
     site1 = site_base.at[idx].max(jnp.where(win2, ch_site, NEG), mode="drop")
-
-    # Causal length: per-row max (cl CRDT).
-    cl1 = state.cl.at[dst, row].max(jnp.where(valid, ch_cl, NEG), mode="drop")
 
     return TableState(cv=cv1, vr=vr1, site=site1, cl=cl1)
 

@@ -53,12 +53,14 @@ def state_shardings(state: SimState, mesh: Mesh, num_nodes: int):
         table=node_major(state.table),
         book=node_major(state.book),
         log=repl(state.log),
+        own=repl(state.own),  # global (R, C) ownership — replicated like log
         gossip=node_major(state.gossip),
         swim=node_major(state.swim),
         ring0=node_sharded,
         row_cdf=replicated,
         round=replicated,
         hlc=node_sharded,
+        last_cleared=node_sharded,
     )
 
 

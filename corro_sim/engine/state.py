@@ -17,6 +17,7 @@ import numpy as np
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping, make_bookkeeping
 from corro_sim.core.changelog import ChangeLog, make_changelog
+from corro_sim.core.compaction import CellOwnership, make_ownership
 from corro_sim.core.crdt import TableState, make_table_state
 from corro_sim.gossip.broadcast import GossipState, make_gossip_state
 from corro_sim.membership.swim import SwimState, make_swim_state
@@ -27,12 +28,15 @@ class SimState:
     table: TableState
     book: Bookkeeping
     log: ChangeLog
+    own: CellOwnership  # global cell ownership → overwritten-version clearing
     gossip: GossipState
     swim: SwimState
     ring0: jnp.ndarray  # (N, ring0_size) int32 static eager-peer table
     row_cdf: jnp.ndarray  # (R,) float32 cumulative row-sampling distribution
     round: jnp.ndarray  # () int32
     hlc: jnp.ndarray  # (N,) int32 — per-node HLC tick (uhlc analog)
+    last_cleared: jnp.ndarray  # (N,) int32 — round of last emptyset applied
+    # (last_cleared_ts analog, corro-types/src/sync.rs:80-87)
 
 
 def _row_cdf(cfg: SimConfig) -> np.ndarray:
@@ -74,10 +78,12 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
         log=make_changelog(
             cfg.num_actors, cfg.log_capacity, cfg.seqs_per_version
         ),
+        own=make_ownership(cfg.num_rows, cfg.num_cols),
         gossip=make_gossip_state(n, cfg.pend_slots),
         swim=make_swim_state(n, enabled=cfg.swim_enabled),
         ring0=jnp.asarray(_ring0(cfg, seed)),
         row_cdf=jnp.asarray(_row_cdf(cfg)),
         round=jnp.zeros((), jnp.int32),
         hlc=jnp.zeros((n,), jnp.int32),
+        last_cleared=jnp.full((n,), -1, jnp.int32),
     )

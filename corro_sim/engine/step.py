@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import deliver_versions, partial_versions
 from corro_sim.core.changelog import append_changesets, gather_changesets
+from corro_sim.core.compaction import update_ownership
 from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
@@ -116,6 +117,29 @@ def sim_step(
         )
     )
 
+    # Global ownership fold: which versions lost cells to this round's
+    # writes (find_overwritten_versions → store_empty_changeset).
+    w_cell_live = (
+        writers[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < w_ncells[:, None])
+    )
+    own, log = update_ownership(
+        state.own,
+        log,
+        jnp.broadcast_to(rows_idx[:, None], (n, s)).reshape(-1),
+        jnp.broadcast_to(w_ver[:, None], (n, s)).reshape(-1),
+        w_row_s.reshape(-1),
+        w_col.reshape(-1),
+        ch_cv.reshape(-1),
+        ch_vr.reshape(-1),
+        jnp.where(
+            w_del[:, None], NEG, jnp.broadcast_to(rows_idx[:, None], (n, s))
+        ).reshape(-1),
+        ch_cl.reshape(-1),
+        w_cell_live.reshape(-1),
+        jnp.broadcast_to(w_del[:, None], (n, s)).reshape(-1),
+    )
+
     # ------------------------------------------------- eager ring-0 messages
     # Every chunk of a fresh local changeset goes to every ring-0 peer
     # (broadcast/mod.rs:489-499).
@@ -152,8 +176,16 @@ def sim_step(
         log, jnp.where(complete, actor, 0), jnp.maximum(ver, 1)
     )
     m = dst.shape[0]
+    # Cleared versions deliver no cells — the receiver of an emptied
+    # changeset just fast-forwards bookkeeping (handle_emptyset analog).
+    c_cleared = log.cleared[
+        jnp.where(complete, actor, 0),
+        (jnp.maximum(ver, 1) - 1) % log.capacity,
+    ]
     cell_live = (
-        complete[:, None] & (jnp.arange(s, dtype=jnp.int32)[None, :] < c_n[:, None])
+        complete[:, None]
+        & ~c_cleared[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < c_n[:, None])
     )
     # The writing site is the actor — except for DELETE entries (logged with
     # vr == NEG), which are cl-only and must not claim the site slot either.
@@ -215,11 +247,23 @@ def sim_step(
     def no_sync(args):
         book, table = args
         zero = jnp.int32(0)
-        return book, table, {"sync_pairs": zero, "sync_versions": zero}
+        return book, table, {
+            "sync_pairs": zero,
+            "sync_versions": zero,
+            "sync_empties": zero,
+        }
 
     book, table, sync_metrics = jax.lax.cond(
         is_sync, do_sync, no_sync, (book, table)
     )
+
+    # last_cleared_ts analog: the round a node last applied an emptied
+    # version (gossip-delivered here; sync empties update it via the
+    # sync_empties path next sweep — observability, not correctness).
+    applied_empty = jnp.zeros((n,), bool).at[
+        jnp.where(complete & c_cleared, dst, n)
+    ].set(True, mode="drop")
+    last_cleared = jnp.where(applied_empty, state.round, state.last_cleared)
 
     # -------------------------------------------------------------- metrics
     # float32 sum: magnitudes can exceed int32 at 10k×10k scale, and the
@@ -239,6 +283,7 @@ def sim_step(
         "buffered_partials": partial_versions(book, cpv),
         "dropped_window": dropped.sum(dtype=jnp.int32),
         "queue_overflow": gossip.overflow,
+        "cleared_versions": log.cleared.sum(dtype=jnp.int32),
         "gap": gap,
         **swim_metrics,
         **sync_metrics,
@@ -248,10 +293,12 @@ def sim_step(
         table=table,
         book=book,
         log=log,
+        own=own,
         gossip=gossip,
         swim=swim,
         round=state.round + 1,
         hlc=jnp.where(alive, jnp.maximum(state.hlc, state.round) + 1, state.hlc),
+        last_cleared=last_cleared,
     )
     return new_state, metrics
 

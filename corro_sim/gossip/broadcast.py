@@ -83,8 +83,9 @@ def enqueue_broadcasts(
     # nondeterministic scatter winner.
     over_capacity = s_valid & (rank >= p)
     s_valid = s_valid & (rank < p)
-    slot = (gossip.cursor[jnp.where(s_valid, s_dst, -1)] + rank) % p
-    idx = (jnp.where(s_valid, s_dst, -1), slot)
+    slot = (gossip.cursor[jnp.where(s_valid, s_dst, 0)] + rank) % p
+    # OOB-positive sentinel: -1 would wrap and clobber the last node's ring
+    idx = (jnp.where(s_valid, s_dst, n), slot)
 
     clobbered = ((gossip.pend_tx[idx] > 0) & s_valid) | over_capacity
     counts = group_counts(jnp.where(s_valid, s_dst, big), n)

@@ -139,8 +139,17 @@ def sync_round(
     )
     s = log.seqs
     m = dst_l.shape[0]
-    cell_live = valid_l[:, None] & (
-        jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None]
+    # Cleared versions are served as empties: bookkeeping fast-forwards but
+    # no rows transfer (handle_need cleared → SyncMessage Empty/EmptySet,
+    # api/peer.rs:716-758).
+    cleared_l = log.cleared[
+        jnp.where(valid_l, actor_l, 0),
+        (jnp.maximum(ver_l, 1) - 1) % log.capacity,
+    ]
+    cell_live = (
+        valid_l[:, None]
+        & ~cleared_l[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None])
     )
     # DELETE log entries (vr == NEG) are cl-only: no site claim.
     site_l = jnp.where(
@@ -181,5 +190,6 @@ def sync_round(
     metrics = {
         "sync_pairs": granted.sum(dtype=jnp.int32),
         "sync_versions": new_versions,
+        "sync_empties": (valid_l & cleared_l).sum(dtype=jnp.int32),
     }
     return book, table, metrics

@@ -1,0 +1,206 @@
+"""Overwritten-version clearing vs. the reference's compaction semantics.
+
+Models: find_overwritten_versions (corro-types/src/agent.rs:1662-1721),
+store_empty_changeset (change.rs:267-389), EmptySet sync serving
+(api/peer.rs:716-758).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.core.changelog import append_changesets, make_changelog
+from corro_sim.core.compaction import make_ownership, update_ownership
+from corro_sim.core.crdt import NEG
+
+
+def _log_with_versions(num_actors, capacity, seqs, writes):
+    """writes: list of (actor, [(row, col, cv, vr, cl, is_del)]) appended in
+    order; returns (log, versions list)."""
+    log = make_changelog(num_actors, capacity, seqs)
+    vers = []
+    for actor, cells in writes:
+        s = len(cells)
+        pad = seqs - s
+        arr = np.array(cells, np.int32).reshape(-1, 6)
+        row = np.pad(arr[:, 0], (0, pad))[None]
+        col = np.pad(arr[:, 1], (0, pad))[None]
+        cv = np.pad(arr[:, 2], (0, pad))[None]
+        vr = np.pad(arr[:, 3], (0, pad))[None]
+        cl = np.pad(arr[:, 4], (0, pad))[None]
+        log, ver = append_changesets(
+            log,
+            jnp.asarray([actor], jnp.int32),
+            jnp.asarray(row), jnp.asarray(col), jnp.asarray(vr),
+            jnp.asarray(cv), jnp.asarray(cl),
+            jnp.asarray([s], jnp.int32),
+            jnp.ones((1,), bool),
+        )
+        vers.append(int(ver[0]))
+    return log, vers
+
+
+def _fold(own, log, lanes):
+    """lanes: list of (actor, ver, row, col, cv, vr, site, cl, valid, is_del)."""
+    arr = np.array([l[:8] for l in lanes], np.int32).reshape(-1, 8)
+    valid = np.array([l[8] for l in lanes], bool)
+    is_del = np.array([l[9] for l in lanes], bool)
+    return update_ownership(
+        own, log,
+        jnp.asarray(arr[:, 0]), jnp.asarray(arr[:, 1]), jnp.asarray(arr[:, 2]),
+        jnp.asarray(arr[:, 3]), jnp.asarray(arr[:, 4]), jnp.asarray(arr[:, 5]),
+        jnp.asarray(arr[:, 6]), jnp.asarray(arr[:, 7]),
+        jnp.asarray(valid), jnp.asarray(is_del),
+    )
+
+
+def test_full_supersession_clears_version():
+    # actor 0 v1 writes cell (0,0); actor 1 v1 overwrites it -> v1@0 cleared
+    log, _ = _log_with_versions(
+        2, 8, 1,
+        [(0, [(0, 0, 1, 10, 1, 0)]), (1, [(0, 0, 2, 20, 1, 0)])],
+    )
+    own = make_ownership(4, 2)
+    own, log = _fold(own, log, [(0, 1, 0, 0, 1, 10, 0, 1, True, False)])
+    assert not bool(np.asarray(log.cleared).any())
+    own, log = _fold(own, log, [(1, 1, 0, 0, 2, 20, 1, 1, True, False)])
+    cleared = np.asarray(log.cleared)
+    assert cleared[0, 0]  # actor 0 v1 fully superseded
+    assert not cleared[1, 0]
+    assert int(np.asarray(own.actor)[0, 0]) == 1
+    assert int(np.asarray(own.ver)[0, 0]) == 1
+
+
+def test_partial_supersession_keeps_version_live():
+    # v1 of actor 0 writes two cells; only one is overwritten
+    log, _ = _log_with_versions(
+        2, 8, 2,
+        [
+            (0, [(0, 0, 1, 10, 1, 0), (0, 1, 1, 11, 1, 0)]),
+            (1, [(0, 0, 2, 20, 1, 0)]),
+        ],
+    )
+    own = make_ownership(4, 2)
+    own, log = _fold(own, log, [
+        (0, 1, 0, 0, 1, 10, 0, 1, True, False),
+        (0, 1, 0, 1, 1, 11, 0, 1, True, False),
+    ])
+    own, log = _fold(own, log, [(1, 1, 0, 0, 2, 20, 1, 1, True, False)])
+    assert not np.asarray(log.cleared)[0, 0]
+    assert int(np.asarray(log.live)[0, 0]) == 1
+
+
+def test_same_round_loser_cleared_at_birth():
+    # two single-cell writes to the same cell in one round: loser clears
+    log, _ = _log_with_versions(
+        2, 8, 1,
+        [(0, [(0, 0, 1, 10, 1, 0)]), (1, [(0, 0, 1, 20, 1, 0)])],
+    )
+    own = make_ownership(4, 2)
+    own, log = _fold(own, log, [
+        (0, 1, 0, 0, 1, 10, 0, 1, True, False),
+        (1, 1, 0, 0, 1, 20, 1, 1, True, False),  # wins value tie
+    ])
+    cleared = np.asarray(log.cleared)
+    assert cleared[0, 0] and not cleared[1, 0]
+
+
+def test_delete_wipes_row_and_clears_owners():
+    # actor 0 v1 writes both cells of row 0; actor 1 deletes row 0 (cl 2):
+    # the insert version clears, the delete owns the tombstone
+    log, _ = _log_with_versions(
+        2, 8, 2,
+        [
+            (0, [(0, 0, 1, 10, 1, 0), (0, 1, 1, 11, 1, 0)]),
+            (1, [(0, 0, 0, int(NEG), 2, 1)]),
+        ],
+    )
+    own = make_ownership(4, 2)
+    own, log = _fold(own, log, [
+        (0, 1, 0, 0, 1, 10, 0, 1, True, False),
+        (0, 1, 0, 1, 1, 11, 0, 1, True, False),
+    ])
+    own, log = _fold(own, log, [
+        (1, 1, 0, 0, 0, int(NEG), int(NEG), 2, True, True),
+    ])
+    cleared = np.asarray(log.cleared)
+    assert cleared[0, 0], "insert version should clear on row delete"
+    assert not cleared[1, 0], "tombstone is live content"
+    assert int(np.asarray(own.ractor)[0]) == 1
+    assert int(np.asarray(own.rcl)[0]) == 2
+    assert int(np.asarray(own.actor)[0, 0]) == -1  # value owners wiped
+
+
+def test_resurrect_clears_tombstone():
+    log, _ = _log_with_versions(
+        2, 8, 1,
+        [(0, [(0, 0, 0, int(NEG), 2, 1)]), (1, [(0, 0, 1, 30, 3, 0)])],
+    )
+    own = make_ownership(4, 2)
+    own, log = _fold(own, log, [
+        (0, 1, 0, 0, 0, int(NEG), int(NEG), 2, True, True),
+    ])
+    assert int(np.asarray(own.ractor)[0]) == 0
+    own, log = _fold(own, log, [(1, 1, 0, 0, 1, 30, 1, 3, True, False)])
+    cleared = np.asarray(log.cleared)
+    assert cleared[0, 0], "tombstone cleared by resurrect"
+    assert int(np.asarray(own.ractor)[0]) == -1
+    assert int(np.asarray(own.rcl)[0]) == 3
+    assert int(np.asarray(own.actor)[0, 0]) == 1
+
+
+def test_live_counts_never_negative():
+    rng = np.random.default_rng(0)
+    log = make_changelog(4, 32, 2)
+    own = make_ownership(8, 2)
+    heads = [0, 0, 0, 0]
+    for _ in range(40):
+        lanes = []
+        appends = []
+        for a in range(4):
+            if rng.random() < 0.7:
+                is_del = rng.random() < 0.3
+                r = int(rng.integers(0, 8))
+                heads[a] += 1
+                if is_del:
+                    cells = [(r, 0, 0, int(NEG), 2 * heads[a], 1)]
+                    lanes.append(
+                        (a, heads[a], r, 0, 0, int(NEG), int(NEG),
+                         2 * heads[a], True, True)
+                    )
+                else:
+                    c = int(rng.integers(0, 2))
+                    cv = heads[a]
+                    vrv = int(rng.integers(0, 100))
+                    cells = [(r, c, cv, vrv, 2 * heads[a] - 1, 0)]
+                    lanes.append(
+                        (a, heads[a], r, c, cv, vrv, a, 2 * heads[a] - 1,
+                         True, False)
+                    )
+                appends.append((a, cells))
+        if not lanes:
+            continue
+        for a, cells in appends:
+            log, _ = _log_with_versions_append(log, a, cells)
+        own, log = _fold(own, log, lanes)
+    live = np.asarray(log.live)
+    ncells = np.asarray(log.ncells)
+    assert (live >= 0).all(), "live count went negative"
+    assert (live <= ncells).all()
+
+
+def _log_with_versions_append(log, actor, cells):
+    s = len(cells)
+    seqs = log.seqs
+    arr = np.array(cells, np.int32).reshape(-1, 6)
+    pad = seqs - s
+    return append_changesets(
+        log,
+        jnp.asarray([actor], jnp.int32),
+        jnp.asarray(np.pad(arr[:, 0], (0, pad))[None]),
+        jnp.asarray(np.pad(arr[:, 1], (0, pad))[None]),
+        jnp.asarray(np.pad(arr[:, 3], (0, pad))[None]),
+        jnp.asarray(np.pad(arr[:, 2], (0, pad))[None]),
+        jnp.asarray(np.pad(arr[:, 4], (0, pad))[None]),
+        jnp.asarray([s], jnp.int32),
+        jnp.ones((1,), bool),
+    )

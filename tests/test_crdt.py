@@ -155,14 +155,51 @@ def test_local_write_bumps_col_version():
     st, cv, cl, _ = _write1(st, 0, 1, 0, 43, False)
     assert int(cv) == 2 and int(cl) == 1
     assert int(st.vr[0, 1, 0]) == 43
-    # delete: cl 1 -> 2 (even = dead), cv unchanged
+    # delete: cl 1 -> 2 (even = dead), row physically loses its cells
+    # (CR-SQLite drops the row and its clock rows on DELETE)
     st, cv, cl, dvr = _write1(st, 0, 1, 0, 0, True)
     assert int(cl) == 2 and int(st.cl[0, 1]) == 2
     assert int(dvr) < 0  # delete carries no value
-    assert int(st.vr[0, 1, 0]) == 43  # stored value untouched by delete
-    # resurrect: cl 2 -> 3
+    assert int(st.vr[0, 1, 0]) == int(NEG)  # generation wiped
+    assert int(st.cv[0, 1, 0]) == 0
+    # resurrect: cl 2 -> 3, fresh generation restarts col_version at 1
     st, cv, cl, _ = _write1(st, 0, 1, 0, 44, False)
     assert int(cl) == 3
+    assert int(cv) == 1 and int(st.vr[0, 1, 0]) == 44
+
+
+def test_stale_generation_update_loses_to_delete():
+    # A concurrent update from the old generation must not resurrect values
+    # on a node that already applied the delete.
+    st = make_table_state(1, 1, 1)
+    st, _, _, _ = _write1(st, 0, 0, 0, 42, False)  # gen 1
+    # delete arrives (cl 2): wipes
+    st = apply_cell_changes(
+        st,
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.full((1,), int(NEG), jnp.int32),
+        jnp.full((1,), int(NEG), jnp.int32),
+        jnp.full((1,), 2, jnp.int32),
+        jnp.ones((1,), bool),
+    )
+    assert int(st.cl[0, 0]) == 2 and int(st.vr[0, 0, 0]) == int(NEG)
+    # stale gen-1 update (cl=1, cv=5) delivered late: rejected
+    st = apply_cell_changes(
+        st,
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.zeros((1,), jnp.int32),
+        jnp.full((1,), 5, jnp.int32),
+        jnp.full((1,), 99, jnp.int32),
+        jnp.full((1,), 7, jnp.int32),
+        jnp.ones((1,), jnp.int32),
+        jnp.ones((1,), bool),
+    )
+    assert int(st.vr[0, 0, 0]) == int(NEG)  # still dead, no value
+    assert int(st.cl[0, 0]) == 2
 
 
 def test_local_write_multi_cell_changeset():

@@ -248,3 +248,33 @@ def test_multicell_chunked_changesets_converge():
     # chunking must actually have produced buffered partials at some point
     assert res.metrics["buffered_partials"].max() > 0
     assert res.metrics["cells_written"].sum() > res.metrics["writes"].sum()
+
+
+def test_compaction_clears_versions_and_converges():
+    # Heavy hot-row contention: most versions get fully superseded and must
+    # clear (store_empty_changeset analog); the cluster still converges to
+    # identical planes, with sync serving empties instead of rows.
+    cfg = SimConfig(
+        num_nodes=10,
+        num_rows=2,  # extreme contention -> lots of supersession
+        num_cols=2,
+        log_capacity=256,
+        write_rate=0.9,
+        delete_rate=0.2,
+        sync_interval=4,
+        sync_actor_topk=10,
+        sync_cap_per_actor=8,
+    )
+    state = init_state(cfg, seed=21)
+    res = run_sim(
+        cfg, state, Schedule(write_rounds=24), max_rounds=512, chunk=8, seed=21
+    )
+    assert res.converged_round is not None, (
+        f"no convergence; last gaps {res.metrics['gap'][-8:]}"
+    )
+    assert_converged_state(cfg, res)
+    assert res.metrics["cleared_versions"].max() > 0, "nothing ever cleared"
+    st = res.state
+    live = np.asarray(st.log.live)
+    assert (live >= 0).all()
+    assert (live <= np.asarray(st.log.ncells)).all()
