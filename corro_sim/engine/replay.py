@@ -1,0 +1,231 @@
+"""Trace replay: drive the simulator with a recorded changeset history.
+
+The reference replays real-cluster state by re-inserting ``crsql_changes``
+rows (``doc/crdts.md:105-112``); the simulator's equivalent injects an
+:class:`~corro_sim.io.traces.EncodedTrace` round by round — round ``r``
+commits version ``r+1`` of every actor locally (write path of
+``make_broadcastable_changes``, ``api/public/mod.rs:36-101``) and enqueues
+it for gossip; dissemination, delivery, merge and anti-entropy then run the
+normal :func:`~corro_sim.engine.step.sim_step` machinery until convergence.
+
+Fidelity note: replay enqueues fresh changesets into the writer's pending
+ring only (the batched dissemination path, ``broadcast/mod.rs:501-517``);
+the ring-0 eager fast path is exercised by the synthetic-workload engine,
+not by replay — it changes propagation latency by <1 round, not outcomes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.core.changelog import append_changesets
+from corro_sim.core.compaction import update_ownership
+from corro_sim.core.crdt import NEG, apply_cell_changes
+from corro_sim.engine.state import SimState, init_state
+from corro_sim.engine.step import _tile_chunks, sim_step
+from corro_sim.gossip.broadcast import enqueue_broadcasts
+from corro_sim.io.traces import EncodedTrace
+
+
+def inject_round(
+    cfg: SimConfig,
+    state: SimState,
+    valid: jnp.ndarray,  # (A,) bool
+    empty: jnp.ndarray,  # (A,) bool
+    ncells: jnp.ndarray,  # (A,) int32
+    row: jnp.ndarray,  # (A, S) int32
+    col: jnp.ndarray,  # (A, S) int32
+    vr: jnp.ndarray,  # (A, S) int32
+    cv: jnp.ndarray,  # (A, S) int32
+    cl: jnp.ndarray,  # (A, S) int32
+) -> SimState:
+    """Commit one trace round: local apply + log append + gossip enqueue.
+
+    ``A`` (the trace's actor count) may be smaller than ``cfg.num_nodes``;
+    actor ordinal == node ordinal (ActorId is the crsql site id,
+    ``corro-types/src/actor.rs:26``). Delete lanes are identified per cell
+    (``vr == NEG`` — cl-only changes), so one changeset may mix a row
+    tombstone with value writes to other rows, as one reference transaction
+    can.
+    """
+    a, s = row.shape
+    actor = jnp.arange(a, dtype=jnp.int32)
+    has_cells = valid & ~empty
+
+    cell_live = (
+        has_cells[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None])
+    )
+    site = jnp.where(vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (a, s)))
+
+    # Local apply on the writer's own table (trace carries authoritative
+    # cv/cl — no recomputation, unlike the synthetic local_write path).
+    table = apply_cell_changes(
+        state.table,
+        jnp.broadcast_to(actor[:, None], (a, s)).reshape(-1),
+        row.reshape(-1),
+        col.reshape(-1),
+        cv.reshape(-1),
+        vr.reshape(-1),
+        site.reshape(-1),
+        cl.reshape(-1),
+        cell_live.reshape(-1),
+    )
+
+    log, ver = append_changesets(
+        state.log, actor, row, col, vr, cv, cl,
+        jnp.where(empty, 0, ncells), valid,
+    )
+    # Cleared versions occupy their slot but deliver nothing.
+    aidx = jnp.where(valid & empty, actor, log.head.shape[0])
+    slot = (ver - 1) % log.capacity
+    log = log.replace(cleared=log.cleared.at[aidx, slot].set(True, mode="drop"))
+
+    book = state.book.replace(
+        head=state.book.head.at[actor, actor].add(valid.astype(jnp.int32))
+    )
+
+    own, log = update_ownership(
+        state.own,
+        log,
+        jnp.broadcast_to(actor[:, None], (a, s)).reshape(-1),
+        jnp.broadcast_to(ver[:, None], (a, s)).reshape(-1),
+        row.reshape(-1),
+        col.reshape(-1),
+        cv.reshape(-1),
+        vr.reshape(-1),
+        site.reshape(-1),
+        cl.reshape(-1),
+        cell_live.reshape(-1),
+        (vr == NEG).reshape(-1),  # per-lane tombstone marker
+    )
+
+    # Enqueue every chunk of the fresh version into the writer's own ring.
+    q_dst, q_src, q_ver, q_valid, q_chunk = _tile_chunks(
+        cfg.chunks_per_version, actor, actor, ver, valid
+    )
+    gossip = enqueue_broadcasts(
+        state.gossip, q_dst, q_src, q_ver, q_chunk, q_valid,
+        cfg.max_transmissions,
+    )
+
+    return state.replace(table=table, book=book, log=log, own=own, gossip=gossip)
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    state: SimState
+    rounds: int
+    converged_round: int | None
+    metrics: dict
+    wall_seconds: float
+
+
+def replay(
+    trace: EncodedTrace,
+    cfg: SimConfig | None = None,
+    seed: int = 0,
+    max_rounds: int = 4096,
+) -> ReplayResult:
+    """Inject the whole trace, then run gossip+sync rounds to convergence."""
+    cfg = (cfg or trace.suggest_config()).validate()
+    assert trace.num_actors <= cfg.num_nodes, (
+        f"trace has {trace.num_actors} actors > {cfg.num_nodes} nodes"
+    )
+    assert trace.seqs_per_version <= cfg.seqs_per_version, (
+        f"trace changesets carry up to {trace.seqs_per_version} cells; "
+        f"cfg.seqs_per_version={cfg.seqs_per_version} is too small"
+    )
+    # Pad cell planes up to the config's seq capacity (extra lanes are dead:
+    # ncells masks them out everywhere).
+    pad = cfg.seqs_per_version - trace.seqs_per_version
+    cells = {
+        name: np.pad(getattr(trace, name), ((0, 0), (0, 0), (0, pad)))
+        for name in ("row", "col", "vr", "cv", "cl")
+    }
+    if pad:
+        cells["vr"] = cells["vr"].copy()
+        cells["vr"][:, :, -pad:] = np.iinfo(np.int32).min  # NEG padding
+    state = init_state(cfg, seed=seed)
+    n = cfg.num_nodes
+    alive = jnp.ones((n,), bool)
+    part = jnp.zeros((n,), jnp.int32)
+    off = jnp.asarray(False)
+
+    inject = jax.jit(functools.partial(inject_round, cfg))
+
+    @jax.jit
+    def step(state, key):
+        return sim_step(cfg, state, key, alive, part, off)
+
+    root = jax.random.PRNGKey(seed)
+    t0 = time.perf_counter()
+    metrics_rounds = []
+    converged = None
+    r = 0
+    while r < max_rounds:
+        if r < trace.rounds:
+            state = inject(
+                state,
+                jnp.asarray(trace.valid[r]),
+                jnp.asarray(trace.empty[r]),
+                jnp.asarray(trace.ncells[r]),
+                jnp.asarray(cells["row"][r]),
+                jnp.asarray(cells["col"][r]),
+                jnp.asarray(cells["vr"][r]),
+                jnp.asarray(cells["cv"][r]),
+                jnp.asarray(cells["cl"][r]),
+            )
+        state, m = step(state, jax.random.fold_in(root, r))
+        r += 1
+        if r >= trace.rounds:
+            gap = float(m["gap"])
+            if gap == 0.0:
+                metrics_rounds.append(jax.tree.map(np.asarray, m))
+                converged = r
+                break
+        metrics_rounds.append(jax.tree.map(np.asarray, m))
+    wall = time.perf_counter() - t0
+
+    metrics = {
+        k: np.stack([mr[k] for mr in metrics_rounds])
+        for k in metrics_rounds[0]
+    }
+    return ReplayResult(
+        state=state,
+        rounds=r,
+        converged_round=converged,
+        metrics=metrics,
+        wall_seconds=wall,
+    )
+
+
+def read_table(state: SimState, trace: EncodedTrace, node: int) -> dict:
+    """Decode one node's table back to Python values — the query surface a
+    replay validation compares against the reference cluster's SQLite state.
+
+    Returns {(table, pk_tuple): {cid: value}} for live rows (odd cl,
+    causal-length liveness — ``doc/crdts.md:13``).
+    """
+    cl = np.asarray(state.table.cl[node])
+    vr = np.asarray(state.table.vr[node])
+    out = {}
+    for ri, key in enumerate(trace.row_keys):
+        if cl[ri] % 2 != 1:
+            continue
+        cells = {}
+        for ci, (tbl, cid) in enumerate(trace.col_keys):
+            if tbl != key[0]:
+                continue
+            rank = vr[ri, ci]
+            if rank != np.iinfo(np.int32).min and 0 <= rank < len(trace.values):
+                cells[cid] = trace.values[rank]
+        out[key] = cells
+    return out

@@ -88,15 +88,24 @@ def sim_step(
     w_del = (jax.random.uniform(k_del, (n,)) < cfg.delete_rate) & writers
 
     # Cells: 1..S distinct columns of the written row (a transaction touching
-    # several columns — each cell is a seq-numbered Change).
-    if s > 1:
-        w_ncells = jax.random.randint(k_ncell, (n,), 1, s + 1, dtype=jnp.int32)
+    # several columns — each cell is a seq-numbered Change). The synthetic
+    # workload writes one row per changeset, so it can fill at most num_cols
+    # of the S cell lanes (replayed traces may use all S across rows).
+    s_eff = min(s, cfg.num_cols)
+    if s_eff > 1:
+        w_ncells = jax.random.randint(
+            k_ncell, (n,), 1, s_eff + 1, dtype=jnp.int32
+        )
         w_col = jnp.argsort(
             jax.random.uniform(k_col, (n, cfg.num_cols)), axis=1
-        ).astype(jnp.int32)[:, :s]
+        ).astype(jnp.int32)[:, :s_eff]
+        if s_eff < s:
+            w_col = jnp.pad(w_col, ((0, 0), (0, s - s_eff)))
     else:
         w_ncells = jnp.ones((n,), jnp.int32)
         w_col = jax.random.randint(k_col, (n, 1), 0, cfg.num_cols, jnp.int32)
+        if s > 1:
+            w_col = jnp.pad(w_col, ((0, 0), (0, s - 1)))
     w_ncells = jnp.where(w_del, 1, w_ncells)  # DELETE = one cl-only change
     w_val = jax.random.randint(
         k_val, (n, s), 0, cfg.value_universe, dtype=jnp.int32

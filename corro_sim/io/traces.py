@@ -1,0 +1,336 @@
+"""Changeset-trace ingestion: `corro-api-types` JSON → replayable tensors.
+
+The driver's north star requires the simulator to consume real-cluster
+changeset traces. A trace is ND-JSON, one line per broadcast changeset,
+matching the serde JSON shapes of the reference wire types:
+
+- a **Full** changeset (``Changeset::Full``,
+  ``corro-types/src/broadcast.rs:113-132``)::
+
+    {"actor_id": "<uuid>", "version": 3,
+     "changes": [{"table": "t", "pk": [u8...], "cid": "c", "val": ...,
+                  "col_version": 2, "db_version": 3, "seq": 0,
+                  "site_id": [16 x u8], "cl": 1}, ...],
+     "seqs": [0, 1], "last_seq": 1, "ts": 123}
+
+  where each element of ``changes`` is a ``Change``
+  (``corro-api-types/src/lib.rs:235-245``): ``pk`` is the
+  ``pack_columns``-encoded primary-key tuple (decoded via
+  :mod:`corro_sim.io.columns`), ``val`` is the untagged ``SqliteValue``
+  JSON (null/int/float/str; blobs as ``{"blob": [u8...]}``), and a row
+  DELETE is a cl-only change (``cid == "__crsql_del"``, even ``cl``, null
+  ``val`` — the causal-length CRDT, ``doc/crdts.md:13``).
+
+- an **Empty** (cleared) changeset (``Changeset::Empty``)::
+
+    {"actor_id": "<uuid>", "versions": [4, 7], "ts": 124}
+
+  — versions compacted away by overwritten-version clearing
+  (``store_empty_changeset``, ``corro-types/src/change.rs:267-389``);
+  they fast-forward bookkeeping but carry no cells.
+
+Ingestion is two-phase (closed world, like
+:class:`corro_sim.io.values.ValueInterner`): scan every line to discover
+actors, tables, pk universes and values; then encode dense per-round
+injection tensors — round ``r`` carries version ``r+1`` of every actor, the
+same per-actor serialization the reference gets from its single write
+connection (``corro-types/src/agent.rs:500-731``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from corro_sim.io.columns import unpack_columns
+from corro_sim.io.values import ValueInterner, sqlite_sort_key
+
+DELETE_CID = "__crsql_del"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceChange:
+    table: str
+    pk: tuple
+    cid: str
+    val: object
+    col_version: int
+    db_version: int
+    seq: int
+    site_id: bytes
+    cl: int
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceChangeset:
+    actor_id: str
+    version: int
+    ts: int
+    changes: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceEmpty:
+    actor_id: str
+    versions: tuple  # (start, end) inclusive
+    ts: int | None
+
+
+def _parse_val(v):
+    if isinstance(v, dict) and set(v) == {"blob"}:
+        return bytes(v["blob"])
+    if isinstance(v, bool):
+        return int(v)
+    return v
+
+
+def parse_trace_line(line: str):
+    """One ND-JSON line → :class:`TraceChangeset` or :class:`TraceEmpty`."""
+    obj = json.loads(line)
+    if "versions" in obj:
+        lo, hi = obj["versions"]
+        return TraceEmpty(
+            actor_id=obj["actor_id"], versions=(int(lo), int(hi)),
+            ts=obj.get("ts"),
+        )
+    changes = tuple(
+        TraceChange(
+            table=c["table"],
+            pk=unpack_columns(bytes(c["pk"])),
+            cid=c["cid"],
+            val=_parse_val(c.get("val")),
+            col_version=int(c["col_version"]),
+            db_version=int(c["db_version"]),
+            seq=int(c["seq"]),
+            site_id=bytes(c.get("site_id", b"\x00" * 16)),
+            cl=int(c["cl"]),
+        )
+        for c in obj.get("changes", ())
+    )
+    return TraceChangeset(
+        actor_id=obj["actor_id"],
+        version=int(obj["version"]),
+        ts=int(obj.get("ts", 0)),
+        changes=changes,
+    )
+
+
+@dataclasses.dataclass
+class EncodedTrace:
+    """Dense injection tensors + the mappings that decode results back.
+
+    Cell planes have shape (rounds, actors, seqs); per-changeset planes
+    (rounds, actors). ``valid`` marks a real changeset, ``empty`` a cleared
+    version. ``delete`` is workload metadata (changeset is purely a row
+    delete); injection identifies tombstone lanes per cell (``vr == NEG``),
+    so mixed delete+write transactions replay correctly.
+    """
+
+    actors: list  # ordinal → actor_id
+    row_keys: list  # row slot → (table, pk tuple)
+    col_keys: list  # column index → (table, cid); table-scoped
+    interner: ValueInterner
+    values: list  # rank → value (inverse interner, for readback)
+
+    valid: np.ndarray
+    empty: np.ndarray
+    delete: np.ndarray
+    ncells: np.ndarray
+    row: np.ndarray
+    col: np.ndarray
+    vr: np.ndarray
+    cv: np.ndarray
+    cl: np.ndarray
+
+    @property
+    def rounds(self) -> int:
+        return self.valid.shape[0]
+
+    @property
+    def num_actors(self) -> int:
+        return len(self.actors)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.row_keys)
+
+    @property
+    def num_cols(self) -> int:
+        return max(1, len(self.col_keys))
+
+    @property
+    def seqs_per_version(self) -> int:
+        return self.row.shape[2]
+
+    def suggest_config(self, **overrides):
+        """A :class:`~corro_sim.config.SimConfig` sized for this trace."""
+        from corro_sim.config import SimConfig
+
+        fields = dict(
+            num_nodes=max(2, self.num_actors),
+            num_rows=self.num_rows,
+            num_cols=self.num_cols,
+            seqs_per_version=self.seqs_per_version,
+            log_capacity=max(2, self.rounds),
+            write_rate=0.0,
+        )
+        fields.update(overrides)
+        return SimConfig(**fields)
+
+
+def ingest(lines) -> EncodedTrace:
+    """Two-phase ingest of an iterable of trace lines (str or parsed)."""
+    events = [
+        parse_trace_line(ln) if isinstance(ln, str) else ln for ln in lines
+    ]
+
+    # --- phase 1: discover the closed world -----------------------------
+    actors: dict[str, int] = {}
+    col_keys: dict[tuple, int] = {}
+    pk_raw: set = set()
+    interner = ValueInterner()
+    per_actor: dict[str, dict[int, object]] = {}
+
+    for ev in events:
+        actors.setdefault(ev.actor_id, len(actors))
+        book = per_actor.setdefault(ev.actor_id, {})
+        if isinstance(ev, TraceEmpty):
+            for v in range(ev.versions[0], ev.versions[1] + 1):
+                book[v] = None  # cleared
+            continue
+        if ev.version in book and book[ev.version] is not None:
+            raise ValueError(
+                f"duplicate version {ev.version} for actor {ev.actor_id}"
+            )
+        book[ev.version] = ev
+        for c in ev.changes:
+            pk_raw.add((c.table, c.pk))
+            if c.cid != DELETE_CID:
+                col_keys.setdefault((c.table, c.cid), len(col_keys))
+                interner.add(c.val)
+
+    # Row slots ordered by (table, pk) with SQLite value comparison on pk
+    # parts — deterministic across runs.
+    row_keys = sorted(
+        pk_raw, key=lambda tp: (tp[0], tuple(sqlite_sort_key(p) for p in tp[1]))
+    )
+    row_of = {k: i for i, k in enumerate(row_keys)}
+    interner.freeze()
+    values = [None] * len(interner)
+
+    # --- phase 2: encode -------------------------------------------------
+    a = len(actors)
+    heads = {aid: (max(book) if book else 0) for aid, book in per_actor.items()}
+    rounds = max(heads.values(), default=0)
+    s = max(
+        (
+            len(ev.changes)
+            for book in per_actor.values()
+            for ev in book.values()
+            if isinstance(ev, TraceChangeset)
+        ),
+        default=1,
+    )
+    s = max(1, s)
+
+    valid = np.zeros((rounds, a), bool)
+    empty = np.zeros((rounds, a), bool)
+    delete = np.zeros((rounds, a), bool)
+    ncells = np.zeros((rounds, a), np.int32)
+    row = np.zeros((rounds, a, s), np.int32)
+    col = np.zeros((rounds, a, s), np.int32)
+    vr = np.zeros((rounds, a, s), np.int32)
+    cv = np.zeros((rounds, a, s), np.int32)
+    cl = np.ones((rounds, a, s), np.int32)
+
+    for aid, book in per_actor.items():
+        ai = actors[aid]
+        head = heads[aid]
+        for v in range(1, head + 1):
+            r = v - 1
+            ev = book.get(v, None)
+            valid[r, ai] = True
+            if ev is None:
+                # Cleared (or never-seen — a gap the trace itself lost;
+                # treat as cleared, the sync path's Empty answer).
+                empty[r, ai] = True
+                continue
+            chs = sorted(ev.changes, key=lambda c: c.seq)[:s]
+            ncells[r, ai] = len(chs)
+            delete[r, ai] = all(c.cid == DELETE_CID for c in chs) and bool(chs)
+            for j, c in enumerate(chs):
+                row[r, ai, j] = row_of[(c.table, c.pk)]
+                cv[r, ai, j] = c.col_version
+                cl[r, ai, j] = c.cl
+                if c.cid == DELETE_CID:
+                    col[r, ai, j] = 0
+                    vr[r, ai, j] = np.iinfo(np.int32).min  # NEG: cl-only
+                else:
+                    col[r, ai, j] = col_keys[(c.table, c.cid)]
+                    rk = interner.rank(c.val)
+                    vr[r, ai, j] = rk
+                    if values[rk] is None:
+                        values[rk] = c.val
+
+    return EncodedTrace(
+        actors=list(actors),
+        row_keys=row_keys,
+        col_keys=[k for k, _ in sorted(col_keys.items(), key=lambda kv: kv[1])],
+        interner=interner,
+        values=values,
+        valid=valid,
+        empty=empty,
+        delete=delete,
+        ncells=ncells,
+        row=row,
+        col=col,
+        vr=vr,
+        cv=cv,
+        cl=cl,
+    )
+
+
+def ingest_file(path) -> EncodedTrace:
+    with open(path) as f:
+        return ingest(ln for ln in f if ln.strip())
+
+
+def dump_changeset(
+    actor_id: str,
+    version: int,
+    ts: int,
+    cells,  # iterable of (table, pk_tuple, cid, val, col_version, cl)
+) -> str:
+    """Serialize one Full changeset back to a trace line (round-trip aid)."""
+    from corro_sim.io.columns import pack_columns
+
+    changes = []
+    for seq, (table, pk, cid, val, col_version, cl_) in enumerate(cells):
+        if isinstance(val, (bytes, bytearray)):
+            val = {"blob": list(val)}
+        changes.append(
+            {
+                "table": table,
+                "pk": list(pack_columns(pk)),
+                "cid": cid,
+                "val": val,
+                "col_version": col_version,
+                "db_version": version,
+                "seq": seq,
+                "site_id": [0] * 16,
+                "cl": cl_,
+            }
+        )
+    n = len(changes)
+    return json.dumps(
+        {
+            "actor_id": actor_id,
+            "version": version,
+            "changes": changes,
+            "seqs": [0, max(0, n - 1)],
+            "last_seq": max(0, n - 1),
+            "ts": ts,
+        }
+    )

@@ -1,0 +1,96 @@
+"""pk codec tests — format parity with pack_columns/unpack_columns
+(reference ``corro-types/src/pubsub.rs:2388-2536``)."""
+
+import math
+
+import pytest
+
+from corro_sim.io.columns import (
+    TYPE_FLOAT,
+    TYPE_INTEGER,
+    TYPE_NULL,
+    TYPE_TEXT,
+    UnpackError,
+    pack_columns,
+    unpack_columns,
+)
+
+
+ROUNDTRIP = [
+    (),
+    (None,),
+    (0,),
+    (1,),
+    (-1,),
+    (256,),
+    (2**31 - 1,),
+    (-(2**31),),
+    (2**56,),
+    (2**63 - 1,),
+    (-(2**63),),
+    (1.5,),
+    (-0.0,),
+    (math.pi,),
+    ("",),
+    ("hello",),
+    ("héllo wörld",),
+    ("x" * 128,),  # length's top bit set: must decode unsigned
+    ("y" * 70000,),  # 3-byte length
+    (b"z" * 255,),
+    (b"",),
+    (b"\x00\xff\x01",),
+    (None, 42, 2.5, "text", b"blob"),
+    tuple(range(100)),
+]
+
+
+@pytest.mark.parametrize("values", ROUNDTRIP, ids=repr)
+def test_roundtrip(values):
+    assert unpack_columns(pack_columns(values)) == values
+
+
+def test_sign_extension_quirk():
+    # The reference's put_int/get_int pair sign-extends minimal-width
+    # integers whose top bit is set — 255 decodes as -1 (see module doc).
+    assert unpack_columns(pack_columns((255,))) == (-1,)
+    assert unpack_columns(pack_columns((0x8000,))) == (-0x8000,)
+
+
+def test_wire_format_zero_int():
+    # 0 packs with zero payload bytes (minimal-int rule).
+    assert pack_columns((0,)) == bytes([1, TYPE_INTEGER])
+
+
+def test_wire_format_small_int():
+    # 7 → 1 payload byte; type byte = (1 << 3) | Integer.
+    assert pack_columns((7,)) == bytes([1, (1 << 3) | TYPE_INTEGER, 7])
+
+
+def test_wire_format_negative_int_is_8_bytes():
+    # negative ⇒ top byte of the two's complement is set ⇒ 8 bytes
+    out = pack_columns((-1,))
+    assert out == bytes([1, (8 << 3) | TYPE_INTEGER]) + b"\xff" * 8
+
+
+def test_wire_format_null_and_float_headers():
+    out = pack_columns((None, 1.0))
+    assert out[1] == TYPE_NULL
+    assert out[2] == TYPE_FLOAT  # floats always 8 raw bytes, no intlen
+
+
+def test_wire_format_text_header():
+    out = pack_columns(("abc",))
+    assert out[:3] == bytes([1, (1 << 3) | TYPE_TEXT, 3])
+    assert out[3:] == b"abc"
+
+
+def test_truncated_rejected():
+    good = pack_columns(("hello", 123456))
+    for cut in range(1, len(good)):
+        with pytest.raises(UnpackError):
+            unpack_columns(good[:cut])
+
+
+def test_bad_type_rejected():
+    with pytest.raises(UnpackError):
+        unpack_columns(bytes([1, 7]))  # type tag 7 undefined

@@ -1,0 +1,215 @@
+"""Trace ingestion + replay: ND-JSON changesets → tensors → converged state.
+
+The replay path is the simulator's devcluster-comparison surface (SURVEY
+§4): the same write history produces the same final table on every node.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from corro_sim.engine.replay import read_table, replay
+from corro_sim.io.columns import pack_columns
+from corro_sim.io.traces import (
+    DELETE_CID,
+    TraceChangeset,
+    TraceEmpty,
+    dump_changeset,
+    ingest,
+    parse_trace_line,
+)
+
+A0 = "aaaaaaaa-0000-0000-0000-000000000000"
+A1 = "bbbbbbbb-0000-0000-0000-000000000001"
+
+
+def line(actor, version, cells, ts=0):
+    return dump_changeset(actor, version, ts, cells)
+
+
+def test_parse_full_line():
+    ln = line(A0, 1, [("t", ("k1",), "c", "v", 1, 1)])
+    ev = parse_trace_line(ln)
+    assert isinstance(ev, TraceChangeset)
+    assert ev.actor_id == A0 and ev.version == 1
+    assert ev.changes[0].table == "t"
+    assert ev.changes[0].pk == ("k1",)
+    assert ev.changes[0].val == "v"
+
+
+def test_parse_empty_line():
+    ev = parse_trace_line(json.dumps({"actor_id": A0, "versions": [2, 4], "ts": 9}))
+    assert isinstance(ev, TraceEmpty)
+    assert ev.versions == (2, 4)
+
+
+def test_parse_blob_val():
+    ln = line(A0, 1, [("t", (1,), "c", b"\x01\x02", 1, 1)])
+    ev = parse_trace_line(ln)
+    assert ev.changes[0].val == b"\x01\x02"
+
+
+def test_ingest_shapes_and_mappings():
+    lines = [
+        line(A0, 1, [("t", ("x",), "a", "v0", 1, 1), ("t", ("x",), "b", 7, 1, 1)]),
+        line(A1, 1, [("t", ("y",), "a", "v1", 1, 1)]),
+        line(A0, 2, [("t", ("y",), "a", "v2", 2, 1)]),
+    ]
+    tr = ingest(lines)
+    assert tr.num_actors == 2
+    assert tr.num_rows == 2  # pks x, y
+    assert tr.num_cols == 2  # cols a, b
+    assert tr.rounds == 2
+    assert tr.seqs_per_version == 2
+    assert tr.valid[0].tolist() == [True, True]
+    assert tr.valid[1].tolist() == [True, False]
+    assert tr.ncells[0, 0] == 2
+
+
+def test_ingest_gap_becomes_cleared():
+    tr = ingest([line(A0, 3, [("t", (1,), "c", "v", 1, 1)])])
+    assert tr.rounds == 3
+    assert tr.empty[0, 0] and tr.empty[1, 0] and not tr.empty[2, 0]
+
+
+def test_ingest_empty_changeset_line():
+    tr = ingest(
+        [
+            line(A0, 1, [("t", (1,), "c", "v", 1, 1)]),
+            json.dumps({"actor_id": A0, "versions": [2, 3], "ts": 5}),
+        ]
+    )
+    assert tr.rounds == 3
+    assert not tr.empty[0, 0] and tr.empty[1, 0] and tr.empty[2, 0]
+
+
+def test_duplicate_version_rejected():
+    with pytest.raises(ValueError):
+        ingest(
+            [
+                line(A0, 1, [("t", (1,), "c", "v", 1, 1)]),
+                line(A0, 1, [("t", (1,), "c", "w", 1, 1)]),
+            ]
+        )
+
+
+def test_replay_converges_and_matches_oracle():
+    # Two actors write disjoint rows plus one contested cell.
+    lines = [
+        line(A0, 1, [("t", ("mine",), "c", "from-a0", 1, 1)]),
+        line(A1, 1, [("t", ("yours",), "c", "from-a1", 1, 1)]),
+        # contested: same cell, same col_version → bigger value wins
+        line(A0, 2, [("t", ("both",), "c", "aaa", 1, 1)]),
+        line(A1, 2, [("t", ("both",), "c", "zzz", 1, 1)]),
+    ]
+    tr = ingest(lines)
+    cfg = tr.suggest_config(fanout=2, sync_interval=2, pend_slots=8)
+    res = replay(tr, cfg, max_rounds=256)
+    assert res.converged_round is not None
+
+    t0 = read_table(res.state, tr, 0)
+    t1 = read_table(res.state, tr, 1)
+    assert t0 == t1
+    assert t0[("t", ("mine",))]["c"] == "from-a0"
+    assert t0[("t", ("yours",))]["c"] == "from-a1"
+    assert t0[("t", ("both",))]["c"] == "zzz"  # LWW tie → biggest value
+
+
+def test_replay_higher_col_version_beats_bigger_value():
+    lines = [
+        line(A0, 1, [("t", ("k",), "c", "zzz", 1, 1)]),
+        line(A1, 1, [("t", ("k",), "c", "aaa", 2, 1)]),  # newer clock
+    ]
+    tr = ingest(lines)
+    res = replay(tr, tr.suggest_config(fanout=2, sync_interval=2), max_rounds=256)
+    assert res.converged_round is not None
+    for node in range(tr.num_actors):
+        assert read_table(res.state, tr, node)[("t", ("k",))]["c"] == "aaa"
+
+
+def test_replay_delete_wins_over_stale_write():
+    # A0 inserts then deletes (cl 1 → 2); A1's concurrent write at cl=1 is
+    # a stale generation and must not resurrect the row.
+    lines = [
+        line(A0, 1, [("t", ("k",), "c", "v0", 1, 1)]),
+        line(A1, 1, [("t", ("k",), "c", "v1", 2, 1)]),
+        line(A0, 2, [("t", ("k",), DELETE_CID, None, 1, 2)]),
+    ]
+    tr = ingest(lines)
+    assert tr.delete[1, 0]
+    res = replay(tr, tr.suggest_config(fanout=2, sync_interval=2), max_rounds=256)
+    assert res.converged_round is not None
+    for node in range(tr.num_actors):
+        assert ("t", ("k",)) not in read_table(res.state, tr, node)
+
+
+def test_replay_mixed_delete_and_write_changeset():
+    # One transaction deletes row k AND writes row j — the tombstone lane
+    # must claim ownership per cell, not per changeset.
+    lines = [
+        line(A0, 1, [("t", ("k",), "c", "v0", 1, 1)]),
+        line(
+            A0,
+            2,
+            [
+                ("t", ("k",), DELETE_CID, None, 1, 2),
+                ("t", ("j",), "c", "w", 1, 1),
+            ],
+        ),
+        line(A1, 1, [("t", ("z",), "c", "q", 1, 1)]),
+    ]
+    tr = ingest(lines)
+    assert not tr.delete[1, 0]  # mixed changeset is not a pure delete
+    res = replay(tr, tr.suggest_config(fanout=2, sync_interval=2), max_rounds=256)
+    assert res.converged_round is not None
+    t = read_table(res.state, tr, 1)
+    assert ("t", ("k",)) not in t
+    assert t[("t", ("j",))]["c"] == "w"
+    # v1 of A0 lost its only cell to the tombstone → compacted (cleared).
+    assert bool(np.asarray(res.state.log.cleared)[0, 0])
+
+
+def test_replay_pads_seqs_to_config():
+    lines = [
+        line(A0, 1, [("t", ("k",), "c", "v", 1, 1)]),
+        line(A1, 1, [("t", ("q",), "c", "u", 1, 1)]),
+    ]
+    tr = ingest(lines)
+    cfg = tr.suggest_config(seqs_per_version=4, fanout=2, sync_interval=2)
+    res = replay(tr, cfg, max_rounds=256)
+    assert res.converged_round is not None
+    assert read_table(res.state, tr, 0) == read_table(res.state, tr, 1)
+
+
+def test_replay_file_roundtrip(tmp_path):
+    from corro_sim.io.traces import ingest_file
+
+    p = tmp_path / "trace.ndjson"
+    p.write_text(
+        "\n".join(
+            [
+                line(A0, 1, [("t", (i,), "c", f"v{i}", 1, 1)])
+                for i in range(1, 2)
+            ]
+            + [line(A1, 1, [("t", (9,), "c", "w", 1, 1)])]
+        )
+        + "\n"
+    )
+    tr = ingest_file(p)
+    assert tr.num_actors == 2 and tr.num_rows == 2
+
+
+def test_pack_columns_pk_ordering_stable():
+    # rows keyed by decoded pk tuples, ordered with SQLite value comparison
+    lines = [
+        line(A0, 1, [("t", (2,), "c", "b", 1, 1), ("t", (10,), "c", "a", 1, 1)]),
+    ]
+    tr = ingest(lines)
+    assert tr.row_keys == [("t", (2,)), ("t", (10,))]  # numeric, not lexical
+
+
+def test_pk_bytes_are_packed_format():
+    ln = line(A0, 1, [("t", ("k", 5), "c", "v", 1, 1)])
+    obj = json.loads(ln)
+    assert bytes(obj["changes"][0]["pk"]) == pack_columns(("k", 5))
