@@ -143,6 +143,13 @@ def replay(
         f"trace changesets carry up to {trace.seqs_per_version} cells; "
         f"cfg.seqs_per_version={cfg.seqs_per_version} is too small"
     )
+    assert trace.num_rows <= cfg.num_rows, (
+        f"trace uses {trace.num_rows} row slots > cfg.num_rows={cfg.num_rows}"
+    )
+    assert trace.num_cols <= cfg.num_cols, (
+        f"trace uses {trace.num_cols} column planes > "
+        f"cfg.num_cols={cfg.num_cols}"
+    )
     # Pad cell planes up to the config's seq capacity (extra lanes are dead:
     # ncells masks them out everywhere).
     pad = cfg.seqs_per_version - trace.seqs_per_version
@@ -150,9 +157,6 @@ def replay(
         name: np.pad(getattr(trace, name), ((0, 0), (0, 0), (0, pad)))
         for name in ("row", "col", "vr", "cv", "cl")
     }
-    if pad:
-        cells["vr"] = cells["vr"].copy()
-        cells["vr"][:, :, -pad:] = np.iinfo(np.int32).min  # NEG padding
     state = init_state(cfg, seed=seed)
     n = cfg.num_nodes
     alive = jnp.ones((n,), bool)
@@ -218,10 +222,10 @@ def read_table(state: SimState, trace: EncodedTrace, node: int) -> dict:
     vr = np.asarray(state.table.vr[node])
     out = {}
     for ri, key in enumerate(trace.row_keys):
-        if cl[ri] % 2 != 1:
+        if key is None or cl[ri] % 2 != 1:
             continue
         cells = {}
-        for ci, (tbl, cid) in enumerate(trace.col_keys):
+        for tbl, cid, ci in trace.col_keys:
             if tbl != key[0]:
                 continue
             rank = vr[ri, ci]

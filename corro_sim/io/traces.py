@@ -129,8 +129,8 @@ class EncodedTrace:
     """
 
     actors: list  # ordinal → actor_id
-    row_keys: list  # row slot → (table, pk tuple)
-    col_keys: list  # column index → (table, cid); table-scoped
+    row_keys: list  # row slot → (table, pk tuple); None = unallocated slot
+    col_keys: list  # (table, cid, plane index) triples; planes table-scoped
     interner: ValueInterner
     values: list  # rank → value (inverse interner, for readback)
 
@@ -158,7 +158,7 @@ class EncodedTrace:
 
     @property
     def num_cols(self) -> int:
-        return max(1, len(self.col_keys))
+        return max([p + 1 for (_, _, p) in self.col_keys], default=1)
 
     @property
     def seqs_per_version(self) -> int:
@@ -180,8 +180,13 @@ class EncodedTrace:
         return SimConfig(**fields)
 
 
-def ingest(lines) -> EncodedTrace:
-    """Two-phase ingest of an iterable of trace lines (str or parsed)."""
+def ingest(lines, layout=None) -> EncodedTrace:
+    """Two-phase ingest of an iterable of trace lines (str or parsed).
+
+    With a :class:`~corro_sim.schema.TableLayout`, row slots and column
+    planes come from the schema (unknown tables/columns are rejected);
+    without one, the universe is discovered from the trace itself.
+    """
     events = [
         parse_trace_line(ln) if isinstance(ln, str) else ln for ln in lines
     ]
@@ -189,6 +194,11 @@ def ingest(lines) -> EncodedTrace:
     # --- phase 1: discover the closed world -----------------------------
     actors: dict[str, int] = {}
     col_keys: dict[tuple, int] = {}
+    if layout is not None:
+        # Full schema surface, not just trace-observed columns.
+        for t in layout.schema:
+            for c in t.value_columns:
+                col_keys[(t.name, c.name)] = layout.col_index(t.name, c.name)
     pk_raw: set = set()
     interner = ValueInterner()
     per_actor: dict[str, dict[int, object]] = {}
@@ -208,15 +218,37 @@ def ingest(lines) -> EncodedTrace:
         for c in ev.changes:
             pk_raw.add((c.table, c.pk))
             if c.cid != DELETE_CID:
-                col_keys.setdefault((c.table, c.cid), len(col_keys))
+                if layout is None:
+                    # table-scoped plane numbering (row ranges are disjoint
+                    # per table, so planes can be reused across tables)
+                    if (c.table, c.cid) not in col_keys:
+                        nplanes = sum(
+                            1 for (t, _) in col_keys if t == c.table
+                        )
+                        col_keys[(c.table, c.cid)] = nplanes
+                else:
+                    col_keys.setdefault(
+                        (c.table, c.cid), layout.col_index(c.table, c.cid)
+                    )
                 interner.add(c.val)
 
-    # Row slots ordered by (table, pk) with SQLite value comparison on pk
-    # parts — deterministic across runs.
-    row_keys = sorted(
-        pk_raw, key=lambda tp: (tp[0], tuple(sqlite_sort_key(p) for p in tp[1]))
-    )
-    row_of = {k: i for i, k in enumerate(row_keys)}
+    if layout is None:
+        # Row slots ordered by (table, pk) with SQLite value comparison on
+        # pk parts — deterministic across runs.
+        row_keys = sorted(
+            pk_raw,
+            key=lambda tp: (tp[0], tuple(sqlite_sort_key(p) for p in tp[1])),
+        )
+        row_of = {k: i for i, k in enumerate(row_keys)}
+    else:
+        ordered = sorted(
+            pk_raw,
+            key=lambda tp: (tp[0], tuple(sqlite_sort_key(p) for p in tp[1])),
+        )
+        row_of = {k: layout.row_slot(*k) for k in ordered}
+        row_keys = [None] * layout.num_rows
+        for k, slot in row_of.items():
+            row_keys[slot] = k
     interner.freeze()
     values = [None] * len(interner)
 
@@ -277,7 +309,9 @@ def ingest(lines) -> EncodedTrace:
     return EncodedTrace(
         actors=list(actors),
         row_keys=row_keys,
-        col_keys=[k for k, _ in sorted(col_keys.items(), key=lambda kv: kv[1])],
+        col_keys=sorted(
+            (t, c, p) for (t, c), p in col_keys.items()
+        ),
         interner=interner,
         values=values,
         valid=valid,

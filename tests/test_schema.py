@@ -1,0 +1,239 @@
+"""Schema manager tests — parse/constrain/migrate parity with the
+reference's ``corro-types/src/schema.rs`` plus the tensor-layout mapping."""
+
+import pytest
+
+from corro_sim.schema import (
+    SchemaError,
+    TableLayout,
+    apply_schema,
+    consul_schema_sql,
+    constrain,
+    parse_and_constrain,
+    parse_schema,
+    test_schema_sql,
+)
+
+
+def test_parse_basic():
+    s = parse_schema(
+        "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, "
+        "v TEXT NOT NULL DEFAULT '');"
+    )
+    t = s.tables["t"]
+    assert t.pk == ("id",)
+    assert [c.name for c in t.value_columns] == ["v"]
+    assert t.columns[0].type == "INTEGER"
+
+
+def test_parse_composite_pk_order():
+    s = parse_schema(
+        "CREATE TABLE w (b TEXT NOT NULL, a TEXT NOT NULL, "
+        "v INTEGER, PRIMARY KEY (b, a));"
+    )
+    assert s.tables["w"].pk == ("b", "a")  # pk order, not declaration order
+
+
+def test_parse_strips_internal_tables():
+    s = parse_schema(
+        "CREATE TABLE ok (id INTEGER PRIMARY KEY, v TEXT);"
+        "CREATE TABLE __corro_members (x INTEGER PRIMARY KEY);"
+    )
+    assert list(s.tables) == ["ok"]
+
+
+def test_generated_columns_not_replicated():
+    s = parse_schema(consul_schema_sql())
+    svc = s.tables["consul_services"]
+    names = [c.name for c in svc.value_columns]
+    assert "app_id" not in names  # generated
+    assert "meta" in names
+
+
+def test_constrain_rejects_unique_index():
+    s = parse_schema(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);"
+        "CREATE UNIQUE INDEX tv ON t (v);"
+    )
+    with pytest.raises(SchemaError, match="unique"):
+        constrain(s)
+
+
+def test_constrain_allows_plain_index():
+    s = parse_schema(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);"
+        "CREATE INDEX tv ON t (v);"
+    )
+    constrain(s)
+
+
+def test_constrain_rejects_foreign_key():
+    with pytest.raises(SchemaError, match="foreign key"):
+        parse_schema(
+            "CREATE TABLE a (id INTEGER PRIMARY KEY);"
+            "CREATE TABLE b (id INTEGER PRIMARY KEY, "
+            "aid INTEGER REFERENCES a(id));"
+        )
+
+
+def test_constrain_rejects_notnull_without_default():
+    s = parse_schema(
+        "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, v TEXT NOT NULL);"
+    )
+    with pytest.raises(SchemaError, match="NOT NULL"):
+        constrain(s)
+
+
+def test_constrain_accepts_reference_schemas():
+    parse_and_constrain(consul_schema_sql())
+    parse_and_constrain(test_schema_sql())
+
+
+def test_apply_schema_new_table_and_column():
+    old = parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    new = parse_and_constrain(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT, w INTEGER DEFAULT 0);"
+        "CREATE TABLE u (id INTEGER PRIMARY KEY, x TEXT);"
+    )
+    plan = apply_schema(old, new)
+    assert plan.new_tables == ("u",)
+    assert plan.new_columns == (("t", "w"),)
+    assert plan.rebuilt_tables == ()
+
+
+def test_apply_schema_refuses_drops():
+    old = parse_and_constrain(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);"
+        "CREATE TABLE u (id INTEGER PRIMARY KEY);"
+    )
+    new = parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    with pytest.raises(SchemaError, match="drop tables"):
+        apply_schema(old, new)
+    new2 = parse_and_constrain(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY);"
+        "CREATE TABLE u (id INTEGER PRIMARY KEY);"
+    )
+    with pytest.raises(SchemaError, match="drop columns"):
+        apply_schema(old, new2)
+
+
+def test_apply_schema_refuses_pk_change():
+    old = parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    new = parse_and_constrain(
+        "CREATE TABLE t (id INTEGER, v TEXT, PRIMARY KEY (id, v));"
+    )
+    with pytest.raises(SchemaError, match="primary key"):
+        apply_schema(old, new)
+
+
+def test_apply_schema_new_notnull_column_needs_default():
+    old = parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    new = parse_and_constrain(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT, "
+        "w INTEGER NOT NULL DEFAULT 1);"
+    )
+    assert apply_schema(old, new).new_columns == (("t", "w"),)
+
+
+def test_apply_schema_column_change_rebuilds():
+    old = parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    new = parse_and_constrain(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v INTEGER);"
+    )
+    assert apply_schema(old, new).rebuilt_tables == ("t",)
+
+
+def test_layout_mapping():
+    lay = TableLayout(
+        parse_and_constrain(consul_schema_sql()),
+        capacities={"consul_services": 8, "consul_checks": 4},
+    )
+    assert lay.num_rows == 12
+    # 6 replicated cols each (pk + generated excluded) → max plane count
+    assert lay.num_cols == 6
+    s0 = lay.row_slot("consul_services", ("n1", "svc-a"))
+    s1 = lay.row_slot("consul_checks", ("n1", "chk-a"))
+    assert 0 <= s0 < 8 and 8 <= s1 < 12
+    assert lay.row_slot("consul_services", ("n1", "svc-a")) == s0  # stable
+    assert lay.col_index("consul_services", "port") != lay.col_index(
+        "consul_services", "name"
+    )
+
+
+def test_layout_overflow_refused():
+    lay = TableLayout(
+        parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);"),
+        capacities={"t": 2},
+    )
+    lay.row_slot("t", (1,))
+    lay.row_slot("t", (2,))
+    with pytest.raises(SchemaError, match="capacity"):
+        lay.row_slot("t", (3,))
+
+
+def test_layout_migrate_appends():
+    lay = TableLayout(
+        parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);"),
+        capacities={"t": 4},
+    )
+    s0 = lay.row_slot("t", (1,))
+    c0 = lay.col_index("t", "v")
+    plan = lay.migrate(
+        parse_and_constrain(
+            "CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT, w INTEGER);"
+            "CREATE TABLE u (id INTEGER PRIMARY KEY, x TEXT);"
+        ),
+        capacities={"u": 2},
+    )
+    assert plan.new_tables == ("u",)
+    assert lay.row_slot("t", (1,)) == s0  # unchanged
+    assert lay.col_index("t", "v") == c0
+    assert lay.col_index("t", "w") == c0 + 1
+    assert lay.num_rows == 6
+
+
+def test_schema_directed_ingest_and_replay():
+    from corro_sim.engine.replay import read_table, replay
+    from corro_sim.io.traces import dump_changeset, ingest
+
+    lay = TableLayout(
+        parse_and_constrain(consul_schema_sql()),
+        capacities={"consul_services": 8, "consul_checks": 8},
+    )
+    a = ["%08d-0000-0000-0000-000000000000" % i for i in range(2)]
+    lines = [
+        dump_changeset(
+            a[0], 1, 0,
+            [
+                ("consul_services", ("n0", "svc"), "address", "10.0.0.1", 1, 1),
+                ("consul_services", ("n0", "svc"), "port", 80, 1, 1),
+            ],
+        ),
+        dump_changeset(
+            a[1], 1, 1,
+            [("consul_checks", ("n1", "chk"), "status", "passing", 1, 1)],
+        ),
+    ]
+    tr = ingest(lines, layout=lay)
+    assert tr.num_rows == 16
+    assert tr.num_cols == 6
+    res = replay(tr, tr.suggest_config(fanout=2, sync_interval=2), max_rounds=128)
+    assert res.converged_round is not None
+    t = read_table(res.state, tr, 1)
+    assert t[("consul_services", ("n0", "svc"))]["address"] == "10.0.0.1"
+    assert t[("consul_services", ("n0", "svc"))]["port"] == 80
+    assert t[("consul_checks", ("n1", "chk"))]["status"] == "passing"
+
+
+def test_schema_directed_ingest_rejects_unknown():
+    from corro_sim.io.traces import dump_changeset, ingest
+
+    lay = TableLayout(
+        parse_and_constrain("CREATE TABLE t (id INTEGER PRIMARY KEY, v TEXT);")
+    )
+    bad = dump_changeset(
+        "00000000-0000-0000-0000-000000000000", 1, 0,
+        [("nope", (1,), "v", "x", 1, 1)],
+    )
+    with pytest.raises(SchemaError):
+        ingest([bad], layout=lay)

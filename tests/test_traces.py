@@ -189,15 +189,15 @@ def test_replay_file_roundtrip(tmp_path):
     p.write_text(
         "\n".join(
             [
-                line(A0, 1, [("t", (i,), "c", f"v{i}", 1, 1)])
-                for i in range(1, 2)
+                line(A0, v, [("t", (v,), "c", f"v{v}", 1, 1)])
+                for v in range(1, 5)
             ]
             + [line(A1, 1, [("t", (9,), "c", "w", 1, 1)])]
         )
         + "\n"
     )
     tr = ingest_file(p)
-    assert tr.num_actors == 2 and tr.num_rows == 2
+    assert tr.num_actors == 2 and tr.num_rows == 5 and tr.rounds == 4
 
 
 def test_pack_columns_pk_ordering_stable():
