@@ -326,9 +326,9 @@ def ingest(lines, layout=None) -> EncodedTrace:
     )
 
 
-def ingest_file(path) -> EncodedTrace:
+def ingest_file(path, layout=None) -> EncodedTrace:
     with open(path) as f:
-        return ingest(ln for ln in f if ln.strip())
+        return ingest((ln for ln in f if ln.strip()), layout=layout)
 
 
 def dump_changeset(

@@ -204,6 +204,8 @@ def apply_schema(old: Schema, new: Schema) -> MigrationPlan:
         for cname, nc in new_cols.items():
             oc = old_cols.get(cname)
             if oc is None:
+                if nc.generated:
+                    continue  # generated columns are not replicated
                 if not nc.nullable and nc.default is None:
                     raise SchemaError(
                         f"new column {name}.{cname} must be nullable or "
@@ -237,6 +239,7 @@ class TableLayout:
         self._used: dict[str, int] = {}  # table -> allocated slot count
         self._cols: dict[tuple, int] = {}  # (table, column) -> plane
         self._slots: dict[tuple, int] = {}  # (table, pk tuple) -> row slot
+        self._by_slot: dict[int, tuple] = {}  # row slot -> (table, pk)
         self._next_row = 0
         self.default_capacity = default_capacity
         for t in schema:
@@ -279,8 +282,13 @@ class TableLayout:
                 )
             slot = start + used
             self._slots[key] = slot
+            self._by_slot[slot] = key
             self._used[table] = used + 1
         return slot
+
+    def key_of(self, slot: int):
+        """(table, pk) owning a row slot, or None if unallocated."""
+        return self._by_slot.get(slot)
 
     def _range(self, table: str):
         try:

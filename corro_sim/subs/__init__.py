@@ -1,0 +1,24 @@
+"""Subscription engine — the reference's pubsub/Matcher subsystem
+(``corro-types/src/pubsub.rs``) as compiled predicates over device state."""
+
+from corro_sim.subs.manager import (
+    IdentityUniverse,
+    LayoutAdapter,
+    Matcher,
+    SubEvent,
+    SubsManager,
+    TraceUniverse,
+)
+from corro_sim.subs.query import QueryError, Select, parse_query
+
+__all__ = [
+    "IdentityUniverse",
+    "LayoutAdapter",
+    "Matcher",
+    "SubEvent",
+    "SubsManager",
+    "TraceUniverse",
+    "QueryError",
+    "Select",
+    "parse_query",
+]

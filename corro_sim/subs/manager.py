@@ -1,0 +1,347 @@
+"""Subscription engine: registered queries matched against live state.
+
+The reference's ``SubsManager``/``Matcher`` (``corro-types/src/pubsub.rs``)
+keeps one matcher per normalized SELECT: it streams the initial result set
+(``QueryEvent::{Columns,Row,EndOfQuery}``), then watches committed changes,
+filters them by the query's table+columns (``filter_matchable_change``
+``:562-597``), diffs matched rows in its own SQLite DB with EXCEPT queries
+(``handle_candidates`` ``:1518-1793``) and emits
+``QueryEvent::Change(INSERT|UPDATE|DELETE, rowid, cells, change_id)``.
+Subscribers re-attach by id with a ``from`` change-id and catch up from the
+buffered ``changes`` table (``api/public/pubsub.rs:355-617``).
+
+TPU shape: a matcher is a *compiled predicate* over one observer node's
+slice of the cluster table tensor. Evaluation runs under jit — the WHERE
+clause is integer comparisons in rank space (:mod:`corro_sim.subs.query`),
+the match mask and projected ranks come back as small arrays — and the
+host diffs them against the previous evaluation to materialize events:
+mask-on = INSERT, mask-off = DELETE, mask-kept with changed projection =
+UPDATE. The per-sub SQLite database, temp-table diffing and EXCEPT dance
+all collapse into one vectorized compare.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.core.crdt import NEG
+from corro_sim.subs.query import (
+    QueryError,
+    RankUniverse,
+    Select,
+    compile_predicate,
+    parse_query,
+)
+
+
+class IdentityUniverse:
+    """Rank space for synthetic workloads: values ARE their ranks."""
+
+    def rank_of(self, lit):
+        if lit is None:
+            return (-1, -1)  # NULL never stored in synthetic runs
+        if not isinstance(lit, int):
+            raise QueryError(
+                f"synthetic workloads store int values, got {lit!r}"
+            )
+        return (lit, lit + 1)
+
+    def decode(self, rank: int):
+        return int(rank)
+
+
+class TraceUniverse(RankUniverse):
+    """Rank space of an ingested trace (order == SQLite value order)."""
+
+    def __init__(self, trace):
+        super().__init__(trace.values)
+
+    def decode(self, rank: int):
+        return self.values[rank]
+
+
+@dataclasses.dataclass
+class SubEvent:
+    kind: str  # 'insert' | 'update' | 'delete'
+    rowid: int  # row slot (stable per run)
+    cells: list  # decoded projected values (pk… then selected columns)
+    change_id: int
+
+    def as_json(self):
+        # QueryEvent::Change serde shape: [type, rowid, cells, change_id]
+        return {
+            "change": [self.kind.upper(), self.rowid, self.cells,
+                       self.change_id]
+        }
+
+
+class Matcher:
+    """One registered query; owns its compiled eval + diff state."""
+
+    def __init__(self, sub_id, select: Select, node: int, layout, universe,
+                 max_buffer: int = 512):
+        self.id = sub_id
+        self.select = select
+        self.node = node
+        self.universe = universe
+        self.max_buffer = max_buffer
+
+        start, cap = layout.table_range(select.table)
+        self._start, self._cap = start, cap
+        table = layout.table_columns(select.table)
+        if select.columns:
+            missing = [c for c in select.columns if c not in table]
+            if missing:
+                raise QueryError(
+                    f"no such column(s) {missing} in {select.table!r}"
+                )
+            self.columns = list(select.columns)
+        else:
+            self.columns = list(table)
+        self._proj_idx = [layout.col_index(select.table, c)
+                          for c in self.columns]
+        for c in select.referenced_columns():
+            if c not in table:
+                raise QueryError(f"no such column {select.table}.{c}")
+        self._row_key = layout.row_key  # slot -> (table, pk) | None
+
+        pred = compile_predicate(
+            select.where, universe, lambda c: layout.col_index(select.table, c)
+        )
+        proj = tuple(self._proj_idx)
+        node_idx = node
+
+        @jax.jit
+        def evaluate(vr_all, cl_all):
+            vr = jax.lax.dynamic_slice_in_dim(vr_all[node_idx], start, cap, 0)
+            cl = jax.lax.dynamic_slice_in_dim(cl_all[node_idx], start, cap, 0)
+            unset = vr == NEG
+            live = (cl % 2) == 1
+            match = pred(vr, unset) & live
+            prj = vr[:, jnp.asarray(proj, jnp.int32)] if proj else vr[:, :0]
+            return match, prj
+
+        self._eval = evaluate
+        self._prev_match = np.zeros((cap,), bool)
+        self._prev_proj = np.zeros((cap, len(proj)), np.int32)
+        self._change_id = 0
+        self._events: list[SubEvent] = []
+        self._primed = False
+
+    # ---- the candidate filter (filter_matchable_change analog) ----------
+    def is_candidate(self, touched) -> bool:
+        """``touched``: set of (table, column|None) committed this round;
+        None column = structural change (insert/delete of a row)."""
+        if touched is None:
+            return True
+        watched = self.select.referenced_columns() | set(self.columns)
+        for t, c in touched:
+            if t != self.select.table:
+                continue
+            if c is None or c in watched:
+                return True
+        return False
+
+    def _decode_row(self, slot: int, proj_row) -> list:
+        key = self._row_key(self._start + slot)
+        pk = list(key[1]) if key else []
+        cells = []
+        for j, rank in enumerate(proj_row):
+            cells.append(
+                None if rank == int(NEG) else self.universe.decode(int(rank))
+            )
+        return pk + cells
+
+    def prime(self, table_state):
+        """Initial query run → columns header, row events, end-of-query
+        (``Matcher::run`` initial scan, ``pubsub.rs:1298-1430``)."""
+        match, proj = jax.tree.map(
+            np.asarray, self._eval(table_state.vr, table_state.cl)
+        )
+        self._prev_match, self._prev_proj = match, proj
+        self._primed = True
+        pk_cols = [c for c in (self._pk_cols() or ())]
+        header = {"columns": pk_cols + self.columns}
+        rows = [
+            {"row": [int(s) + self._start, self._decode_row(s, proj[s])]}
+            for s in np.nonzero(match)[0]
+        ]
+        eoq = {"eoq": {"change_id": self._change_id}}
+        return [header, *rows, eoq]
+
+    def _pk_cols(self):
+        key_probe = self._row_key(self._start) or (None, ())
+        # pk column names come from the layout's schema when present
+        schema = getattr(self._row_key, "schema", None)
+        if schema is not None:
+            t = schema.tables.get(self.select.table)
+            if t is not None:
+                return t.pk
+        return ("pk",) * len(key_probe[1]) if key_probe[1] else ()
+
+    def step(self, table_state) -> list:
+        """Re-evaluate and emit change events for the delta."""
+        if not self._primed:
+            raise RuntimeError("matcher not primed — call prime() first")
+        match, proj = jax.tree.map(
+            np.asarray, self._eval(table_state.vr, table_state.cl)
+        )
+        events = []
+        ins = match & ~self._prev_match
+        dele = ~match & self._prev_match
+        upd = (
+            match
+            & self._prev_match
+            & (proj != self._prev_proj).any(axis=1)
+        )
+        for kind, mask in (("insert", ins), ("update", upd), ("delete", dele)):
+            for s in np.nonzero(mask)[0]:
+                self._change_id += 1
+                events.append(
+                    SubEvent(
+                        kind=kind,
+                        rowid=int(s) + self._start,
+                        cells=self._decode_row(s, proj[s]),
+                        change_id=self._change_id,
+                    )
+                )
+        self._prev_match, self._prev_proj = match, proj
+        self._events.extend(events)
+        # purge like the reference (changes > last N pruned, pubsub.rs:1275)
+        if len(self._events) > self.max_buffer:
+            self._events = self._events[-self.max_buffer:]
+        return events
+
+    def catch_up(self, from_change_id: int):
+        """Buffered events with id > from; None if compacted past it
+        (subscriber must re-subscribe — the reference 404s the range)."""
+        if self._events and self._events[0].change_id > from_change_id + 1:
+            return None
+        if from_change_id > self._change_id:
+            return None
+        return [e for e in self._events if e.change_id > from_change_id]
+
+
+class LayoutAdapter:
+    """Uniform matcher-facing view over TableLayout or an EncodedTrace."""
+
+    def __init__(self, layout=None, trace=None):
+        if (layout is None) == (trace is None):
+            raise ValueError("exactly one of layout/trace required")
+        self._layout = layout
+        self._trace = trace
+        if trace is not None:
+            self._tcols = {}
+            for t, c, p in trace.col_keys:
+                self._tcols.setdefault(t, {})[c] = p
+            self._ranges = {}
+            for slot, key in enumerate(trace.row_keys):
+                if key is None:
+                    continue
+                t = key[0]
+                lo, hi = self._ranges.get(t, (slot, slot))
+                self._ranges[t] = (min(lo, slot), max(hi, slot))
+
+    def table_range(self, table):
+        if self._layout is not None:
+            return self._layout._range(table)
+        if table not in self._ranges:
+            raise QueryError(f"no such table {table!r}")
+        lo, hi = self._ranges[table]
+        return lo, hi - lo + 1
+
+    def table_columns(self, table):
+        if self._layout is not None:
+            t = self._layout.schema.tables.get(table)
+            if t is None:
+                raise QueryError(f"no such table {table!r}")
+            return [c.name for c in t.value_columns]
+        if table not in self._tcols:
+            raise QueryError(f"no such table {table!r}")
+        cols = self._tcols[table]
+        return [c for c, _ in sorted(cols.items(), key=lambda kv: kv[1])]
+
+    def col_index(self, table, column):
+        if self._layout is not None:
+            return self._layout.col_index(table, column)
+        try:
+            return self._tcols[table][column]
+        except KeyError:
+            raise QueryError(f"no such column {table}.{column}") from None
+
+    @property
+    def row_key(self):
+        if self._layout is not None:
+            lay = self._layout
+
+            def rk(slot):
+                # lazy: rows allocated after matcher creation still resolve
+                return lay.key_of(slot)
+
+            rk.schema = lay.schema
+            return rk
+        keys = self._trace.row_keys
+
+        def rk(slot):
+            return keys[slot] if 0 <= slot < len(keys) else None
+
+        return rk
+
+
+class SubsManager:
+    """Registry of matchers, deduped by (normalized SQL, observer node) —
+    the ``SubsManager::get_or_insert`` surface (``pubsub.rs:52-118``)."""
+
+    def __init__(self, layout_adapter: LayoutAdapter, universe,
+                 max_buffer: int = 512):
+        self.layout = layout_adapter
+        self.universe = universe
+        self.max_buffer = max_buffer
+        self._by_id: dict[str, Matcher] = {}
+        self._by_query: dict[tuple, str] = {}
+        self._ids = itertools.count()
+
+    def get_or_insert(self, sql: str, node: int, table_state):
+        """Returns (matcher, initial_events | None) — None when deduped to
+        an existing matcher (subscriber catches up from its buffer)."""
+        select = parse_query(sql)
+        key = (select.normalized(), node)
+        sub_id = self._by_query.get(key)
+        if sub_id is not None:
+            return self._by_id[sub_id], None
+        sub_id = f"sub-{next(self._ids)}"
+        m = Matcher(
+            sub_id, select, node, self.layout, self.universe,
+            max_buffer=self.max_buffer,
+        )
+        initial = m.prime(table_state)
+        self._by_id[sub_id] = m
+        self._by_query[key] = sub_id
+        return m, initial
+
+    def get(self, sub_id: str) -> Matcher | None:
+        return self._by_id.get(sub_id)
+
+    def remove(self, sub_id: str) -> None:
+        m = self._by_id.pop(sub_id, None)
+        if m is not None:
+            self._by_query.pop((m.select.normalized(), m.node), None)
+
+    def step(self, table_state, touched=None) -> dict:
+        """Advance every (candidate) matcher; returns {sub_id: [events]}."""
+        out = {}
+        for sub_id, m in self._by_id.items():
+            if not m.is_candidate(touched):
+                continue
+            ev = m.step(table_state)
+            if ev:
+                out[sub_id] = ev
+        return out
+
+    def __len__(self):
+        return len(self._by_id)

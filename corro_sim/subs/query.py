@@ -1,0 +1,341 @@
+"""Subscription query language: a SELECT subset compiled to rank space.
+
+The reference subscribes arbitrary SELECTs: ``Matcher::new`` parses the
+statement, extracts the involved table/columns, and rewrites per-table
+queries (``corro-types/src/pubsub.rs:640-832,1899-1993``). The simulator's
+query surface is the single-table core of that:
+
+    SELECT <col[, col…] | *> FROM <table>
+      [WHERE <predicate>]
+
+with predicates over value columns: ``=, !=, <>, <, <=, >, >=``,
+``IS [NOT] NULL``, ``AND``, ``OR``, ``NOT``, parentheses, and literals
+(integers, floats, 'strings', NULL).
+
+Compilation, not interpretation: cell values live on device as
+order-preserving interned ranks (:mod:`corro_sim.io.values`), so every
+comparison against a literal becomes an *integer* comparison against a
+precomputed rank threshold — ``col < 'foo'`` compiles to
+``rank < bisect_left(universe, 'foo')``. The whole WHERE clause becomes a
+boolean tensor expression over the (rows, cols) rank plane, evaluated for
+every row at once under jit. SQL normalization for subscription dedupe
+(reference ``normalize_sql``, ``pubsub.rs:2362``) is the canonical
+rendering of the parsed AST.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import re
+
+import jax.numpy as jnp
+
+from corro_sim.io.values import sqlite_sort_key
+
+
+class QueryError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------------- AST
+
+
+@dataclasses.dataclass(frozen=True)
+class Cmp:
+    op: str  # '=', '!=', '<', '<=', '>', '>='
+    col: str
+    lit: object
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull:
+    col: str
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class And:
+    parts: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Or:
+    parts: tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Not:
+    inner: object
+
+
+@dataclasses.dataclass(frozen=True)
+class Select:
+    table: str
+    columns: tuple  # () = *
+    where: object  # predicate AST or None
+
+    def normalized(self) -> str:
+        cols = ", ".join(self.columns) if self.columns else "*"
+        sql = f"SELECT {cols} FROM {self.table}"
+        if self.where is not None:
+            sql += f" WHERE {_render(self.where)}"
+        return sql
+
+    def referenced_columns(self) -> frozenset:
+        """Columns the WHERE clause touches — the match-candidate filter
+        set (``filter_matchable_change``, ``pubsub.rs:562-597``)."""
+        out = set()
+
+        def walk(p):
+            if isinstance(p, Cmp):
+                out.add(p.col)
+            elif isinstance(p, IsNull):
+                out.add(p.col)
+            elif isinstance(p, (And, Or)):
+                for q in p.parts:
+                    walk(q)
+            elif isinstance(p, Not):
+                walk(p.inner)
+
+        if self.where is not None:
+            walk(self.where)
+        return frozenset(out)
+
+
+def _render(p) -> str:
+    if isinstance(p, Cmp):
+        return f"{p.col} {p.op} {_render_lit(p.lit)}"
+    if isinstance(p, IsNull):
+        return f"{p.col} IS{' NOT' if p.negated else ''} NULL"
+    if isinstance(p, And):
+        return "(" + " AND ".join(_render(q) for q in p.parts) + ")"
+    if isinstance(p, Or):
+        return "(" + " OR ".join(_render(q) for q in p.parts) + ")"
+    if isinstance(p, Not):
+        return f"NOT ({_render(p.inner)})"
+    raise QueryError(f"bad predicate node {p!r}")
+
+
+def _render_lit(lit) -> str:
+    if lit is None:
+        return "NULL"
+    if isinstance(lit, str):
+        return "'" + lit.replace("'", "''") + "'"
+    return repr(lit)
+
+
+# ------------------------------------------------------------------ parser
+
+_TOKEN = re.compile(
+    r"\s*(?:"
+    r"(?P<str>'(?:[^']|'')*')"
+    r"|(?P<num>-?\d+\.\d*|-?\.\d+|-?\d+)"
+    r"|(?P<op><=|>=|!=|<>|=|<|>)"
+    r"|(?P<punct>[(),*])"
+    r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
+    r")"
+)
+
+
+def _tokenize(sql: str):
+    pos, out = 0, []
+    while pos < len(sql):
+        m = _TOKEN.match(sql, pos)
+        if not m:
+            if sql[pos:].strip() == "":
+                break
+            raise QueryError(f"bad token at {sql[pos:pos + 20]!r}")
+        pos = m.end()
+        if m.lastgroup == "str":
+            out.append(("lit", m.group("str")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "num":
+            t = m.group("num")
+            out.append(("lit", float(t) if "." in t else int(t)))
+        elif m.lastgroup == "op":
+            op = m.group("op")
+            out.append(("op", "!=" if op == "<>" else op))
+        elif m.lastgroup == "punct":
+            out.append((m.group("punct"), m.group("punct")))
+        else:
+            w = m.group("word")
+            kw = w.upper()
+            if kw in (
+                "SELECT", "FROM", "WHERE", "AND", "OR", "NOT", "IS", "NULL",
+            ):
+                out.append((kw, kw))
+            else:
+                out.append(("ident", w))
+    out.append(("eof", None))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def expect(self, kind):
+        k, v = self.next()
+        if k != kind:
+            raise QueryError(f"expected {kind}, got {k} {v!r}")
+        return v
+
+    def parse_select(self) -> Select:
+        self.expect("SELECT")
+        cols = []
+        if self.peek()[0] == "*":
+            self.next()
+        else:
+            cols.append(self.expect("ident"))
+            while self.peek()[0] == ",":
+                self.next()
+                cols.append(self.expect("ident"))
+        self.expect("FROM")
+        table = self.expect("ident")
+        where = None
+        if self.peek()[0] == "WHERE":
+            self.next()
+            where = self.parse_or()
+        if self.peek()[0] != "eof":
+            raise QueryError(f"trailing tokens at {self.peek()!r}")
+        return Select(table=table, columns=tuple(cols), where=where)
+
+    def parse_or(self):
+        parts = [self.parse_and()]
+        while self.peek()[0] == "OR":
+            self.next()
+            parts.append(self.parse_and())
+        return parts[0] if len(parts) == 1 else Or(tuple(parts))
+
+    def parse_and(self):
+        parts = [self.parse_unary()]
+        while self.peek()[0] == "AND":
+            self.next()
+            parts.append(self.parse_unary())
+        return parts[0] if len(parts) == 1 else And(tuple(parts))
+
+    def parse_unary(self):
+        if self.peek()[0] == "NOT":
+            self.next()
+            return Not(self.parse_unary())
+        if self.peek()[0] == "(":
+            self.next()
+            inner = self.parse_or()
+            self.expect(")")
+            return inner
+        col = self.expect("ident")
+        k, v = self.next()
+        if k == "IS":
+            negated = False
+            if self.peek()[0] == "NOT":
+                self.next()
+                negated = True
+            self.expect("NULL")
+            return IsNull(col, negated)
+        if k != "op":
+            raise QueryError(f"expected comparison after {col!r}, got {v!r}")
+        lk, lv = self.next()
+        if lk == "NULL":
+            lv = None
+        elif lk != "lit":
+            raise QueryError(f"expected literal, got {lk} {lv!r}")
+        return Cmp(op=v, col=col, lit=lv)
+
+
+def parse_query(sql: str) -> Select:
+    return _Parser(_tokenize(sql)).parse_select()
+
+
+# ------------------------------------------------- rank-space compilation
+
+
+class RankUniverse:
+    """The frozen, sorted value universe ranks index into."""
+
+    def __init__(self, sorted_values):
+        self.values = list(sorted_values)
+        self._keys = [sqlite_sort_key(v) for v in self.values]
+
+    def rank_of(self, lit):
+        """(lo, hi): ranks r with value == lit satisfy lo <= r < hi."""
+        k = sqlite_sort_key(lit)
+        lo = bisect.bisect_left(self._keys, k)
+        hi = bisect.bisect_right(self._keys, k)
+        return lo, hi
+
+
+def compile_predicate(pred, universe: RankUniverse, col_index):
+    """Predicate AST → ``fn(vr: (R, C) int32, unset: (R, C) bool) -> (R,) bool``.
+
+    ``vr`` is the rank plane; ``unset`` marks never-written cells (which
+    compare as NULL — SQL three-valued logic collapses to False for
+    comparisons, True only under IS NULL).
+    """
+    NULL_FALSE = object()
+
+    def comp(p):
+        if isinstance(p, Cmp):
+            ci = col_index(p.col)
+            lo, hi = universe.rank_of(p.lit)
+            if p.lit is None:
+                # SQL: comparisons with NULL are never true
+                return lambda vr, unset: jnp.zeros(vr.shape[:1], bool)
+            op = p.op
+            nlo, nhi = universe.rank_of(None)
+
+            def f(vr, unset, ci=ci, lo=lo, hi=hi, op=op, nlo=nlo, nhi=nhi):
+                r = vr[:, ci]
+                # three-valued logic: unset cells AND stored NULLs never
+                # satisfy a comparison (NULL < 5 is NULL, not true)
+                known = ~unset[:, ci] & ~((r >= nlo) & (r < nhi))
+                if op == "=":
+                    m = (r >= lo) & (r < hi)
+                elif op == "!=":
+                    m = (r < lo) | (r >= hi)
+                elif op == "<":
+                    m = r < lo
+                elif op == "<=":
+                    m = r < hi
+                elif op == ">":
+                    m = r >= hi
+                else:  # >=
+                    m = r >= lo
+                return m & known
+
+            return f
+        if isinstance(p, IsNull):
+            ci = col_index(p.col)
+            lo, hi = universe.rank_of(None)
+
+            def f(vr, unset, ci=ci, lo=lo, hi=hi, neg=p.negated):
+                isnull = unset[:, ci] | ((vr[:, ci] >= lo) & (vr[:, ci] < hi))
+                return ~isnull if neg else isnull
+
+            return f
+        if isinstance(p, And):
+            fs = [comp(q) for q in p.parts]
+            return lambda vr, unset: jnp.stack(
+                [f(vr, unset) for f in fs]
+            ).all(0)
+        if isinstance(p, Or):
+            fs = [comp(q) for q in p.parts]
+            return lambda vr, unset: jnp.stack(
+                [f(vr, unset) for f in fs]
+            ).any(0)
+        if isinstance(p, Not):
+            f = comp(p.inner)
+            return lambda vr, unset: ~f(vr, unset)
+        raise QueryError(f"bad predicate node {p!r}")
+
+    if pred is None:
+        return lambda vr, unset: jnp.ones(vr.shape[:1], bool)
+    return comp(pred)

@@ -1,0 +1,242 @@
+"""Subscription engine tests: parse/normalize, rank-space predicates,
+initial query + live diff events, dedupe, catch-up."""
+
+import pytest
+
+from corro_sim.engine.replay import replay
+from corro_sim.io.traces import dump_changeset, ingest
+from corro_sim.schema import TableLayout, parse_and_constrain
+from corro_sim.subs import (
+    LayoutAdapter,
+    QueryError,
+    SubsManager,
+    TraceUniverse,
+    parse_query,
+)
+
+A0 = "aaaaaaaa-0000-0000-0000-000000000000"
+A1 = "bbbbbbbb-0000-0000-0000-000000000001"
+
+
+# ----------------------------------------------------------------- parser
+
+
+def test_parse_and_normalize():
+    s = parse_query("select  a , b from t where a = 1 AND (b < 'x' OR b IS NULL)")
+    assert s.table == "t"
+    assert s.columns == ("a", "b")
+    assert (
+        s.normalized()
+        == "SELECT a, b FROM t WHERE (a = 1 AND (b < 'x' OR b IS NULL))"
+    )
+    # normalization is idempotent and whitespace/case-insensitive on keywords
+    assert parse_query(s.normalized()).normalized() == s.normalized()
+
+
+def test_parse_star_and_ops():
+    s = parse_query("SELECT * FROM t WHERE a <> 2")
+    assert s.columns == ()
+    assert s.normalized() == "SELECT * FROM t WHERE a != 2"
+
+
+def test_parse_rejects_garbage():
+    for bad in (
+        "SELECT FROM t",
+        "SELECT a FROM",
+        "SELECT a FROM t WHERE",
+        "SELECT a FROM t WHERE a ==",
+        "SELECT a FROM t extra",
+        "DELETE FROM t",
+    ):
+        with pytest.raises(QueryError):
+            parse_query(bad)
+
+
+def test_referenced_columns():
+    s = parse_query("SELECT a FROM t WHERE b = 1 AND NOT (c > 2 OR d IS NULL)")
+    assert s.referenced_columns() == {"b", "c", "d"}
+
+
+# ------------------------------------------------------------ end-to-end
+
+
+def _consul_setup():
+    sql = (
+        "CREATE TABLE services (node TEXT NOT NULL, id TEXT NOT NULL, "
+        "port INTEGER DEFAULT 0, status TEXT DEFAULT '', "
+        "PRIMARY KEY (node, id));"
+    )
+    lay = TableLayout(parse_and_constrain(sql), capacities={"services": 16})
+    lines = [
+        dump_changeset(
+            A0, 1, 0,
+            [
+                ("services", ("n0", "web"), "port", 80, 1, 1),
+                ("services", ("n0", "web"), "status", "up", 1, 1),
+            ],
+        ),
+        dump_changeset(
+            A1, 1, 1,
+            [
+                ("services", ("n1", "db"), "port", 5432, 1, 1),
+                ("services", ("n1", "db"), "status", "down", 1, 1),
+            ],
+        ),
+    ]
+    tr = ingest(lines, layout=lay)
+    res = replay(tr, tr.suggest_config(fanout=2, sync_interval=2), max_rounds=128)
+    assert res.converged_round is not None
+    return lay, tr, res
+
+
+def test_initial_query_rows_and_eoq():
+    lay, tr, res = _consul_setup()
+    mgr = SubsManager(LayoutAdapter(layout=lay), TraceUniverse(tr))
+    m, initial = mgr.get_or_insert(
+        "SELECT port, status FROM services WHERE status = 'up'", 0,
+        res.state.table,
+    )
+    assert initial[0] == {"columns": ["node", "id", "port", "status"]}
+    rows = [e for e in initial if "row" in e]
+    assert len(rows) == 1
+    rowid, cells = rows[0]["row"]
+    assert cells == ["n0", "web", 80, "up"]
+    assert initial[-1] == {"eoq": {"change_id": 0}}
+
+
+def test_dedupe_by_normalized_sql():
+    lay, tr, res = _consul_setup()
+    mgr = SubsManager(LayoutAdapter(layout=lay), TraceUniverse(tr))
+    m1, i1 = mgr.get_or_insert(
+        "SELECT port FROM services WHERE port > 100", 0, res.state.table
+    )
+    m2, i2 = mgr.get_or_insert(
+        "select  port  from services where port > 100", 0, res.state.table
+    )
+    assert m1 is m2 and i2 is None
+    assert len(mgr) == 1
+    # different node → different matcher
+    m3, i3 = mgr.get_or_insert(
+        "SELECT port FROM services WHERE port > 100", 1, res.state.table
+    )
+    assert m3 is not m1 and i3 is not None
+
+
+def test_change_events_insert_update_delete():
+
+    from corro_sim.io.traces import DELETE_CID
+
+    lay, tr, res = _consul_setup()
+    cfg = tr.suggest_config(fanout=2, sync_interval=2)
+    mgr = SubsManager(LayoutAdapter(layout=lay), TraceUniverse(tr))
+    m, _ = mgr.get_or_insert(
+        "SELECT status FROM services", 0, res.state.table
+    )
+
+    # New writes arrive as a second trace segment: an UPDATE of n0/web's
+    # status, an INSERT of a new service, then a DELETE of n1/db.
+    lines2 = [
+        dump_changeset(
+            A0, 2, 2, [("services", ("n0", "web"), "status", "degraded", 2, 1)]
+        ),
+        dump_changeset(
+            A1, 2, 3, [("services", ("n2", "cache"), "port", 11211, 1, 1)]
+        ),
+        dump_changeset(
+            A0, 3, 4, [("services", ("n1", "db"), DELETE_CID, None, 1, 2)]
+        ),
+    ]
+    # Ingest continuation against the same layout/universe: value set must
+    # be a superset — rebuild both from scratch with all lines.
+    lay2 = TableLayout(lay.schema, capacities={"services": 16})
+    all_lines = [
+        dump_changeset(
+            A0, 1, 0,
+            [
+                ("services", ("n0", "web"), "port", 80, 1, 1),
+                ("services", ("n0", "web"), "status", "up", 1, 1),
+            ],
+        ),
+        dump_changeset(
+            A1, 1, 1,
+            [
+                ("services", ("n1", "db"), "port", 5432, 1, 1),
+                ("services", ("n1", "db"), "status", "down", 1, 1),
+            ],
+        ),
+        *lines2,
+    ]
+    tr2 = ingest(all_lines, layout=lay2)
+    cfg2 = tr2.suggest_config(fanout=2, sync_interval=2)
+    res2 = replay(tr2, cfg2, max_rounds=128)
+    assert res2.converged_round is not None
+
+    mgr2 = SubsManager(LayoutAdapter(layout=lay2), TraceUniverse(tr2))
+    # Prime on the state as of nothing applied: a fresh empty state.
+    from corro_sim.engine.state import init_state
+
+    m2, initial = mgr2.get_or_insert(
+        "SELECT status FROM services", 0, init_state(cfg2).table
+    )
+    assert [e for e in initial if "row" in e] == []
+    events = m2.step(res2.state.table)
+    kinds = sorted(e.kind for e in events)
+    assert kinds == ["insert", "insert"]  # n0/web and n2/cache live at node 0
+    by_row = {tuple(e.cells[:2]): e for e in events}
+    assert by_row[("n0", "web")].cells[2] == "degraded"
+    # n1/db was deleted by the end — never observed live in this two-phase
+    # evaluation, so no event for it at all.
+    assert ("n1", "db") not in by_row
+
+
+def test_catch_up_and_purge():
+    lay, tr, res = _consul_setup()
+    mgr = SubsManager(LayoutAdapter(layout=lay), TraceUniverse(tr), max_buffer=4)
+    m, _ = mgr.get_or_insert("SELECT port FROM services", 0, res.state.table)
+    ev = m.step(res.state.table)
+    assert ev == []  # no changes since prime
+    assert m.catch_up(0) == []
+    assert m.catch_up(99) is None  # future change id
+
+
+def test_candidate_filter():
+    lay, tr, res = _consul_setup()
+    mgr = SubsManager(LayoutAdapter(layout=lay), TraceUniverse(tr))
+    m, _ = mgr.get_or_insert(
+        "SELECT port FROM services WHERE status = 'up'", 0, res.state.table
+    )
+    assert m.is_candidate(None)
+    assert m.is_candidate({("services", "status")})
+    assert m.is_candidate({("services", "port")})  # projected column
+    assert m.is_candidate({("services", None)})  # structural change
+    assert not m.is_candidate({("services", "meta_unwatched")})
+    assert not m.is_candidate({("other_table", "status")})
+
+
+def test_unknown_column_rejected():
+    lay, tr, res = _consul_setup()
+    mgr = SubsManager(LayoutAdapter(layout=lay), TraceUniverse(tr))
+    with pytest.raises(QueryError):
+        mgr.get_or_insert(
+            "SELECT nope FROM services", 0, res.state.table
+        )
+    with pytest.raises(QueryError):
+        mgr.get_or_insert(
+            "SELECT port FROM services WHERE ghost = 1", 0, res.state.table
+        )
+
+
+def test_trace_adapter_without_schema():
+    lines = [
+        dump_changeset(A0, 1, 0, [("t", (1,), "v", 10, 1, 1)]),
+        dump_changeset(A1, 1, 1, [("t", (2,), "v", 20, 1, 1)]),
+    ]
+    tr = ingest(lines)
+    res = replay(tr, tr.suggest_config(fanout=2, sync_interval=2), max_rounds=128)
+    mgr = SubsManager(LayoutAdapter(trace=tr), TraceUniverse(tr))
+    m, initial = mgr.get_or_insert(
+        "SELECT v FROM t WHERE v >= 20", 0, res.state.table
+    )
+    rows = [e for e in initial if "row" in e]
+    assert len(rows) == 1
+    assert rows[0]["row"][1] == [2, 20]
