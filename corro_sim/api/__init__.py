@@ -1,0 +1,1 @@
+"""Client-facing API layer (HTTP + statement parsing) — reference layer 5."""

@@ -1,0 +1,277 @@
+"""Write-statement surface: the `Statement` wire shapes + a DML parser.
+
+The reference accepts four JSON shapes for a statement
+(``corro-api-types/src/lib.rs:181-201``): a bare SQL string,
+``[sql, [params…]]``, ``{"query": sql, "params": […]}`` and
+``{"query": sql, "named_params": {…}}`` — executed verbatim by SQLite
+inside one write transaction (``api/public/mod.rs:104-131``). The TPU
+framework has no SQLite, so the DML subset that makes sense against CRDT
+tables is parsed here into *cell operations* against the
+:class:`~corro_sim.schema.TableLayout`:
+
+  INSERT INTO t (cols…) VALUES (…) [, (…)]…   -- upsert (CRDT tables are
+      ON CONFLICT/REPLACE-natured: every write is a cell-wise LWW merge)
+  UPDATE t SET c = v[, …] WHERE <pk-eq or predicate>
+  DELETE FROM t WHERE <pk-eq or predicate>
+
+Parameters bind SQLite-style: positional ``?`` against the params list,
+named ``:name`` / ``$name`` / ``@name`` against the named map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from corro_sim.subs.query import (
+    And,
+    Cmp,
+    QueryError,
+    _Parser,
+    _tokenize,
+)
+
+
+class StatementError(ValueError):
+    pass
+
+
+@dataclasses.dataclass
+class WriteOp:
+    """One parsed DML statement, normalized to cell operations."""
+
+    kind: str  # 'upsert' | 'update' | 'delete'
+    table: str
+    # upsert: list of (pk_tuple, {col: value}) — one per VALUES tuple
+    rows: list | None = None
+    # update: {col: value} applied to rows selected by `where`
+    sets: dict | None = None
+    # update/delete row selection: either resolved pk tuples or a predicate
+    pks: list | None = None
+    where: object | None = None  # predicate AST when not pure pk-equality
+
+
+def parse_statement(stmt) -> tuple[str, list | dict]:
+    """Normalize a wire `Statement` into (sql, params)."""
+    if isinstance(stmt, str):
+        return stmt, []
+    if isinstance(stmt, (list, tuple)):
+        if not stmt or not isinstance(stmt[0], str):
+            raise StatementError(f"bad statement shape: {stmt!r}")
+        if len(stmt) == 2 and isinstance(stmt[1], (list, tuple)):
+            return stmt[0], list(stmt[1])
+        return stmt[0], list(stmt[1:])  # tolerate the flat form
+    if isinstance(stmt, dict):
+        sql = stmt.get("query")
+        if not isinstance(sql, str):
+            raise StatementError(f"statement dict needs 'query': {stmt!r}")
+        if "named_params" in stmt:
+            return sql, dict(stmt["named_params"])
+        return sql, list(stmt.get("params", []))
+    raise StatementError(f"bad statement shape: {type(stmt)!r}")
+
+
+_PARAM = re.compile(r"\?|[:$@][A-Za-z_][A-Za-z_0-9]*")
+
+
+def bind_params(sql: str, params) -> str:
+    """Inline bound parameters as SQL literals (the same param-expansion
+    trick the reference uses for subscription dedupe, ``expand_sql``,
+    ``api/public/pubsub.rs:226-331``). Strings are quoted; None → NULL."""
+    pos = 0
+
+    def lit(v):
+        if v is None:
+            return "NULL"
+        if isinstance(v, bool):
+            return str(int(v))
+        if isinstance(v, (int, float)):
+            return repr(v)
+        if isinstance(v, str):
+            return "'" + v.replace("'", "''") + "'"
+        raise StatementError(f"unsupported param type {type(v)!r}")
+
+    out = []
+    last = 0
+    idx = 0
+    for m in _PARAM.finditer(sql):
+        # skip params inside string literals: count quotes before
+        prefix = sql[last:m.start()]
+        out.append(prefix)
+        whole = "".join(out)
+        if whole.count("'") % 2 == 1:  # inside a string literal
+            out.append(m.group(0))
+            last = m.end()
+            continue
+        tok = m.group(0)
+        if tok == "?":
+            if not isinstance(params, (list, tuple)) or idx >= len(params):
+                raise StatementError("not enough positional params")
+            out.append(lit(params[idx]))
+            idx += 1
+        else:
+            name = tok[1:]
+            if not isinstance(params, dict) or name not in params:
+                raise StatementError(f"missing named param {name!r}")
+            out.append(lit(params[name]))
+        last = m.end()
+    out.append(sql[last:])
+    return "".join(out)
+
+
+# ---------------------------------------------------------------- DML parse
+
+_KEYWORDS = {
+    "INSERT", "INTO", "VALUES", "UPDATE", "SET", "DELETE", "FROM", "WHERE",
+    "OR", "REPLACE", "ON", "CONFLICT", "DO", "NOTHING",
+}
+
+
+def _tok_dml(sql: str):
+    """Tokenize, mapping DML keywords that the SELECT tokenizer treats as
+    plain identifiers."""
+    toks = []
+    for k, v in _tokenize(sql):
+        if k == "ident" and v.upper() in _KEYWORDS:
+            toks.append((v.upper(), v.upper()))
+        else:
+            toks.append((k, v))
+    return toks
+
+
+def parse_dml(sql: str) -> WriteOp:
+    sql = sql.strip().rstrip(";")
+    toks = _tok_dml(sql)
+    p = _Parser(toks)
+    k, _ = p.peek()
+    if k == "INSERT":
+        return _parse_insert(p)
+    if k == "UPDATE":
+        return _parse_update(p)
+    if k == "DELETE":
+        return _parse_delete(p)
+    raise StatementError(
+        f"unsupported statement (INSERT/UPDATE/DELETE only): {sql[:60]!r}"
+    )
+
+
+def _parse_insert(p: _Parser) -> WriteOp:
+    p.expect("INSERT")
+    if p.peek()[0] == "OR":  # INSERT OR REPLACE — same thing for a CRDT table
+        p.next()
+        p.expect("REPLACE")
+    p.expect("INTO")
+    table = p.expect("ident")
+    p.expect("(")
+    cols = [p.expect("ident")]
+    while p.peek()[0] == ",":
+        p.next()
+        cols.append(p.expect("ident"))
+    p.expect(")")
+    p.expect("VALUES")
+    tuples = []
+    while True:
+        p.expect("(")
+        vals = [_value(p)]
+        while p.peek()[0] == ",":
+            p.next()
+            vals.append(_value(p))
+        p.expect(")")
+        if len(vals) != len(cols):
+            raise StatementError(
+                f"{len(cols)} columns but {len(vals)} values"
+            )
+        tuples.append(dict(zip(cols, vals)))
+        if p.peek()[0] == ",":
+            p.next()
+            continue
+        break
+    # ON CONFLICT … is tolerated and ignored: CRDT merge IS the conflict
+    # resolution (every insert is an upsert, doc/crdts.md:15-17).
+    if p.peek()[0] == "ON":
+        while p.peek()[0] != "eof":
+            p.next()
+    elif p.peek()[0] != "eof":
+        raise StatementError(f"trailing tokens at {p.peek()!r}")
+    return WriteOp(kind="upsert", table=table, rows=tuples)
+
+
+def _value(p: _Parser):
+    k, v = p.next()
+    if k == "lit":
+        return v
+    if k == "NULL":
+        return None
+    raise StatementError(f"expected literal, got {k} {v!r}")
+
+
+def _parse_update(p: _Parser) -> WriteOp:
+    p.expect("UPDATE")
+    table = p.expect("ident")
+    p.expect("SET")
+    sets = {}
+    while True:
+        col = p.expect("ident")
+        k, v = p.next()
+        if k != "op" or v != "=":
+            raise StatementError(f"expected '=' after {col!r}")
+        sets[col] = _value(p)
+        if p.peek()[0] == ",":
+            p.next()
+            continue
+        break
+    where = _parse_where(p)
+    return WriteOp(kind="update", table=table, sets=sets, where=where)
+
+
+def _parse_delete(p: _Parser) -> WriteOp:
+    p.expect("DELETE")
+    p.expect("FROM")
+    table = p.expect("ident")
+    where = _parse_where(p)
+    return WriteOp(kind="delete", table=table, where=where)
+
+
+def _parse_where(p: _Parser):
+    if p.peek()[0] != "WHERE":
+        raise StatementError(
+            "UPDATE/DELETE require a WHERE clause (full-table writes are "
+            "refused, matching the constrained schema posture)"
+        )
+    p.next()
+    where = p.parse_or()
+    if p.peek()[0] != "eof":
+        raise StatementError(f"trailing tokens at {p.peek()!r}")
+    return where
+
+
+def pk_equalities(where, pk_cols: tuple) -> tuple | None:
+    """If `where` is exactly pk1 = l1 AND pk2 = l2 … (all pk columns, only
+    pk columns), return the pk literal tuple — the fast path that skips
+    predicate evaluation. Otherwise None."""
+    eqs = {}
+
+    def walk(node) -> bool:
+        if isinstance(node, Cmp):
+            if node.op != "=" or node.col in eqs:
+                return False
+            eqs[node.col] = node.lit
+            return True
+        if isinstance(node, And):
+            return all(walk(q) for q in node.parts)
+        return False
+
+    if where is None or not walk(where):
+        return None
+    if set(eqs) != set(pk_cols):
+        return None
+    return tuple(eqs[c] for c in pk_cols)
+
+
+def parse_write(stmt) -> WriteOp:
+    """Wire statement → WriteOp (params bound, DML parsed)."""
+    sql, params = parse_statement(stmt)
+    try:
+        return parse_dml(bind_params(sql, params))
+    except QueryError as e:
+        raise StatementError(str(e)) from None

@@ -57,7 +57,18 @@ def sim_step(
     alive: jnp.ndarray,  # (N,) ground truth
     part: jnp.ndarray,  # (N,) int32 partition id (ground truth)
     write_enable: jnp.ndarray,  # () bool — workload phase switch
+    writes: tuple | None = None,  # explicit write batch (live agent path)
 ):
+    """Advance the cluster one round.
+
+    ``writes`` — when None, the synthetic workload samples this round's
+    local writes (benchmark path). A live agent instead passes the
+    transactions its API accepted this round as a tuple of arrays
+    ``(writers (N,) bool, rows (N,S) i32, cols (N,S) i32, vals (N,S) i32,
+    dels (N,) bool, ncells (N,) i32)`` — the single-write-per-node-per-round
+    shape mirrors the reference's one write conn + ``Semaphore(1)``
+    serialization (``corro-types/src/agent.rs:500-731``).
+    """
     n = cfg.num_nodes
     s = cfg.seqs_per_version
     cpv = cfg.chunks_per_version
@@ -76,41 +87,49 @@ def sim_step(
     # ---------------------------------------------------------- local writes
     # One changeset per node per round max — the reference serializes local
     # writes through one write conn + Semaphore(1) (agent.rs:500-731).
-    writers = (
-        (jax.random.uniform(k_write, (n,)) < cfg.write_rate)
-        & alive
-        & write_enable
-    )
-    u = jax.random.uniform(k_row, (n,))
-    w_row = jnp.searchsorted(state.row_cdf, u).astype(jnp.int32).clip(
-        0, cfg.num_rows - 1
-    )
-    w_del = (jax.random.uniform(k_del, (n,)) < cfg.delete_rate) & writers
-
-    # Cells: 1..S distinct columns of the written row (a transaction touching
-    # several columns — each cell is a seq-numbered Change). The synthetic
-    # workload writes one row per changeset, so it can fill at most num_cols
-    # of the S cell lanes (replayed traces may use all S across rows).
-    s_eff = min(s, cfg.num_cols)
-    if s_eff > 1:
-        w_ncells = jax.random.randint(
-            k_ncell, (n,), 1, s_eff + 1, dtype=jnp.int32
-        )
-        w_col = jnp.argsort(
-            jax.random.uniform(k_col, (n, cfg.num_cols)), axis=1
-        ).astype(jnp.int32)[:, :s_eff]
-        if s_eff < s:
-            w_col = jnp.pad(w_col, ((0, 0), (0, s - s_eff)))
+    if writes is not None:
+        writers, w_row_s, w_col, w_val, w_del, w_ncells = writes
+        writers = writers & alive
+        w_del = w_del & writers
     else:
-        w_ncells = jnp.ones((n,), jnp.int32)
-        w_col = jax.random.randint(k_col, (n, 1), 0, cfg.num_cols, jnp.int32)
-        if s > 1:
-            w_col = jnp.pad(w_col, ((0, 0), (0, s - 1)))
-    w_ncells = jnp.where(w_del, 1, w_ncells)  # DELETE = one cl-only change
-    w_val = jax.random.randint(
-        k_val, (n, s), 0, cfg.value_universe, dtype=jnp.int32
-    )
-    w_row_s = jnp.broadcast_to(w_row[:, None], (n, s))
+        writers = (
+            (jax.random.uniform(k_write, (n,)) < cfg.write_rate)
+            & alive
+            & write_enable
+        )
+        u = jax.random.uniform(k_row, (n,))
+        w_row = jnp.searchsorted(state.row_cdf, u).astype(jnp.int32).clip(
+            0, cfg.num_rows - 1
+        )
+        w_del = (jax.random.uniform(k_del, (n,)) < cfg.delete_rate) & writers
+
+        # Cells: 1..S distinct columns of the written row (a transaction
+        # touching several columns — each cell is a seq-numbered Change). The
+        # synthetic workload writes one row per changeset, so it can fill at
+        # most num_cols of the S cell lanes (replayed traces may use all S
+        # across rows).
+        s_eff = min(s, cfg.num_cols)
+        if s_eff > 1:
+            w_ncells = jax.random.randint(
+                k_ncell, (n,), 1, s_eff + 1, dtype=jnp.int32
+            )
+            w_col = jnp.argsort(
+                jax.random.uniform(k_col, (n, cfg.num_cols)), axis=1
+            ).astype(jnp.int32)[:, :s_eff]
+            if s_eff < s:
+                w_col = jnp.pad(w_col, ((0, 0), (0, s - s_eff)))
+        else:
+            w_ncells = jnp.ones((n,), jnp.int32)
+            w_col = jax.random.randint(
+                k_col, (n, 1), 0, cfg.num_cols, jnp.int32
+            )
+            if s > 1:
+                w_col = jnp.pad(w_col, ((0, 0), (0, s - 1)))
+        w_ncells = jnp.where(w_del, 1, w_ncells)  # DELETE = one cl-only change
+        w_val = jax.random.randint(
+            k_val, (n, s), 0, cfg.value_universe, dtype=jnp.int32
+        )
+        w_row_s = jnp.broadcast_to(w_row[:, None], (n, s))
 
     table, ch_cv, ch_cl, ch_vr = local_write(
         state.table, rows_idx, w_row_s, w_col, w_val, w_del, w_ncells, writers

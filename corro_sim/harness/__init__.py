@@ -1,0 +1,3 @@
+"""Host-side harness: live cluster runtime, snapshots, devcluster backend."""
+
+from corro_sim.harness.cluster import LiveCluster  # noqa: F401

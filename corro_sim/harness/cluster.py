@@ -1,0 +1,595 @@
+"""LiveCluster: the running agent — a whole simulated cluster behind an API.
+
+The reference's unit of deployment is one agent process per node
+(``corro-agent/src/agent/run_root.rs``); clients talk to *their* node's
+HTTP API and gossip spreads the writes. The TPU-native unit of deployment
+is one *cluster* process: every node's state lives in the same sharded
+tensors, one driver thread advances all nodes together, and the API
+addresses a node by ordinal (`node=` parameter = which agent you'd have
+connected to). Everything a reference agent does per node — accept writes,
+commit + version them, gossip, merge, sync, notify subscriptions — happens
+here for all nodes at once, one jitted round per tick.
+
+Write path parity (``make_broadcastable_changes``,
+``api/public/mod.rs:36-101``): `execute()` parses statements, interns
+values, queues one changeset per transaction on the target node, and ticks
+the simulator until the queue drains — the one-write-conn-per-node
+serialization is the dequeue discipline (≤1 changeset per node per round,
+``corro-types/src/agent.rs:500-731``).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.api.statements import (
+    StatementError,
+    WriteOp,
+    parse_write,
+    pk_equalities,
+)
+from corro_sim.config import SimConfig
+from corro_sim.core.crdt import NEG
+from corro_sim.engine.state import SimState, init_state
+from corro_sim.engine.step import sim_step
+from corro_sim.io.values import LiveUniverse
+from corro_sim.schema import (
+    SchemaError,
+    TableLayout,
+    parse_and_constrain,
+)
+from corro_sim.subs.manager import LayoutAdapter, Matcher, SubsManager
+from corro_sim.subs.query import QueryError, parse_query
+from corro_sim.utils.runtime import LockRegistry, Tripwire
+
+
+@dataclasses.dataclass
+class _PendingChangeset:
+    """One queued transaction: becomes exactly one version when committed."""
+
+    is_delete: bool
+    cells: list  # [(row_slot, col_plane, value_rank)]; delete: [(slot, 0, 0)]
+
+
+class ExecError(ValueError):
+    pass
+
+
+class LiveCluster:
+    def __init__(
+        self,
+        schema_sql: str,
+        num_nodes: int = 4,
+        seed: int = 0,
+        default_capacity: int = 256,
+        capacities: dict | None = None,
+        cfg_overrides: dict | None = None,
+        tripwire: Tripwire | None = None,
+    ):
+        schema = parse_and_constrain(schema_sql)
+        self.layout = TableLayout(
+            schema, capacities=capacities, default_capacity=default_capacity
+        )
+        self.universe = LiveUniverse()
+        self.locks = LockRegistry()
+        self.tripwire = tripwire or Tripwire()
+        self._lock = threading.RLock()
+        self._seed = seed
+
+        overrides = dict(cfg_overrides or {})
+        # seqs_per_version bounds cells per transaction; default generous.
+        overrides.setdefault("seqs_per_version", 8)
+        self.cfg = SimConfig(
+            num_nodes=num_nodes,
+            num_rows=self.layout.num_rows,
+            num_cols=max(self.layout.num_cols, 1),
+            **overrides,
+        ).validate()
+        self.state: SimState = init_state(self.cfg, seed=seed)
+        self._root_key = jax.random.PRNGKey(seed)
+        self._alive = np.ones((num_nodes,), bool)
+        self._part = np.zeros((num_nodes,), np.int32)
+        self._pending: list = [collections.deque() for _ in range(num_nodes)]
+        self._staging: list | None = None  # execute()'s in-flight batch
+        self._rounds_ticked = 0
+        self._totals: dict[str, float] = {}
+        self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
+
+        self.subs = SubsManager(
+            LayoutAdapter(layout=self.layout), self.universe
+        )
+        self._query_cache: dict[tuple, Matcher] = {}
+        self.universe.on_remap(self._on_remap)
+        self._build_step()
+
+    # ------------------------------------------------------------- plumbing
+    def _build_step(self):
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, static_argnames=())
+        def step(state, key, alive, part, writes):
+            return sim_step(
+                cfg, state, key, alive, part, jnp.asarray(False), writes=writes
+            )
+
+        self._step = step
+
+    def _on_remap(self, old, new):
+        """Translate every rank-typed tensor to the re-spaced universe.
+
+        Order-preserving, so merge outcomes are untouched; this is pure
+        re-labelling (like SQLite swapping its interned value ids)."""
+        o = jnp.asarray(old, jnp.int32)
+        nw = jnp.asarray(new, jnp.int32)
+
+        def remap(v):
+            idx = jnp.clip(jnp.searchsorted(o, v), 0, max(len(old) - 1, 0))
+            found = (v >= 0) & (o[idx] == v) if len(old) else jnp.zeros_like(v, bool)
+            return jnp.where(found, nw[idx], v)
+
+        st = self.state
+        self.state = st.replace(
+            table=st.table.replace(vr=remap(st.table.vr)),
+            log=st.log.replace(vr=remap(st.log.vr)),
+            own=st.own.replace(vr=remap(st.own.vr)),
+        )
+        # Queued-but-uncommitted changesets carry ranks too (including the
+        # batch still being planned inside execute()).
+        trans = dict(zip(old, new))
+        batches = list(self._pending)
+        if self._staging is not None:
+            batches.append(self._staging)
+        for q in batches:
+            for cs in q:
+                cs.cells = [
+                    (slot, plane, trans.get(rank, rank))
+                    for slot, plane, rank in cs.cells
+                ]
+        self.subs.rebind_all(old, new)
+        for m in self._query_cache.values():
+            m.rebind(old, new)
+
+    # ------------------------------------------------------------ write path
+    def execute(self, statements, node: int = 0) -> dict:
+        """POST /v1/transactions analog: one changeset per statement batch.
+
+        Returns the ``ExecResponse`` shape (``corro-api-types:209-214``):
+        per-statement results plus the committed version."""
+        self._check_node(node)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        results = []
+        with self.locks.tracked(self._lock, f"execute node={node}", "write"):
+            if not self._alive[node]:
+                # A down agent's API is unreachable in the reference; a
+                # silent success for a write the step masks out would lie.
+                raise ExecError(f"node {node} is down")
+            changesets: list[_PendingChangeset] = []
+            self._staging = changesets
+            try:
+                for stmt in statements:
+                    st0 = _time.perf_counter()
+                    try:
+                        op = parse_write(stmt)
+                        n_rows = self._plan_write(op, node, changesets)
+                    except (StatementError, SchemaError, QueryError) as e:
+                        raise ExecError(str(e)) from None
+                    results.append(
+                        {
+                            "rows_affected": n_rows,
+                            "time": _time.perf_counter() - st0,
+                        }
+                    )
+            finally:
+                self._staging = None
+            for cs in changesets:
+                self._pending[node].append(cs)
+            # Commit synchronously: tick until this node's queue drains —
+            # the API returns only after its transaction is durable, like
+            # the reference's in-tx HTTP handler.
+            while self._pending[node]:
+                self._tick_locked(1)
+            version = int(np.asarray(self.state.book.head)[node, node])
+        return {
+            "results": results,
+            "time": _time.perf_counter() - t0,
+            "version": version,
+        }
+
+    def _plan_write(
+        self, op: WriteOp, node: int, out: list
+    ) -> int:
+        """Expand one WriteOp into pending changesets; returns rows affected."""
+        t = self.layout.schema.tables.get(op.table)
+        if t is None:
+            raise StatementError(f"no such table {op.table!r}")
+        s_cap = self.cfg.seqs_per_version
+
+        if op.kind == "upsert":
+            cells = []
+            for row in op.rows:
+                missing = [c for c in t.pk if c not in row]
+                if missing:
+                    raise StatementError(
+                        f"INSERT into {t.name!r} must provide pk column(s) "
+                        f"{missing}"
+                    )
+                pk = tuple(row[c] for c in t.pk)
+                slot = self.layout.row_slot(t.name, pk)
+                wrote = False
+                for c in t.value_columns:
+                    if c.name in row:
+                        cells.append(
+                            (slot, self.layout.col_index(t.name, c.name),
+                             self.universe.rank(row[c.name]))
+                        )
+                        wrote = True
+                if not wrote:
+                    # pk-only insert: row existence is carried by the causal
+                    # length; write the first value column's default/NULL.
+                    if t.value_columns:
+                        c = t.value_columns[0]
+                        cells.append(
+                            (slot, self.layout.col_index(t.name, c.name),
+                             self.universe.rank(c.default))
+                        )
+                    else:
+                        cells.append((slot, 0, self.universe.rank(None)))
+            for i in range(0, len(cells), s_cap):
+                out.append(
+                    _PendingChangeset(False, cells[i:i + s_cap])
+                )
+            return len(op.rows)
+
+        slots = self._resolve_rows(op, t, node)
+        if op.kind == "update":
+            for c in op.sets:
+                self.layout.col_index(t.name, c)  # validate
+            cells = [
+                (slot, self.layout.col_index(t.name, c),
+                 self.universe.rank(v))
+                for slot in slots
+                for c, v in op.sets.items()
+            ]
+            for i in range(0, len(cells), s_cap):
+                out.append(_PendingChangeset(False, cells[i:i + s_cap]))
+            return len(slots)
+
+        # delete: one cl-only changeset per row (a DELETE bumps the row's
+        # causal length; CR-SQLite emits no value changes for it).
+        for slot in slots:
+            out.append(_PendingChangeset(True, [(slot, 0, 0)]))
+        return len(slots)
+
+    def _resolve_rows(self, op: WriteOp, t, node: int) -> list[int]:
+        """Row slots an UPDATE/DELETE targets: pk fast path or predicate.
+
+        Both paths only select rows that are *live on the target node*
+        (odd causal length) — SQL UPDATE/DELETE of an absent row affects 0
+        rows; a CRDT resurrect requires an INSERT."""
+        pk = pk_equalities(op.where, t.pk)
+        if pk is not None:
+            slot = self.layout._slots.get((t.name, pk))
+            if slot is None:
+                return []
+            cl = int(np.asarray(self.state.table.cl[node, slot]))
+            return [slot] if cl % 2 == 1 else []
+        # General predicate: evaluate against the node's current view
+        # (liveness + pk-term mask applied by Matcher._evaluate).
+        from corro_sim.subs.query import Select
+
+        sel = Select(table=t.name, columns=(), where=op.where)
+        matcher = self._matcher_for(sel, node)
+        match, _ = matcher._evaluate(self.state.table)
+        return [int(s) + matcher._start for s in np.nonzero(match)[0]]
+
+    # ------------------------------------------------------------ query path
+    def _matcher_for(self, select, node: int) -> Matcher:
+        # Remaps don't invalidate entries — _on_remap rebinds them in place.
+        key = (select.normalized(), node)
+        m = self._query_cache.get(key)
+        if m is None:
+            m = Matcher(
+                f"query-{len(self._query_cache)}", select, node,
+                LayoutAdapter(layout=self.layout), self.universe,
+            )
+            self._query_cache[key] = m
+            if len(self._query_cache) > 128:  # bounded compile cache
+                self._query_cache.pop(next(iter(self._query_cache)))
+        return m
+
+    def query(self, sql: str, node: int = 0) -> list:
+        """POST /v1/queries analog: QueryEvent stream as a list of dicts
+        (``{"columns"}``, ``{"row"}``…, ``{"eoq"}``)."""
+        self._check_node(node)
+        with self.locks.tracked(self._lock, f"query node={node}", "read"):
+            select = parse_query(sql)
+            m = self._matcher_for(select, node)
+            return m.prime(self.state.table)
+
+    def query_rows(self, sql: str, node: int = 0) -> tuple[list, list]:
+        """(columns, rows) convenience over :meth:`query`."""
+        events = self.query(sql, node)
+        cols, rows = [], []
+        for e in events:
+            if "columns" in e:
+                cols = e["columns"]
+            elif "row" in e:
+                rows.append(e["row"][1])
+        return cols, rows
+
+    # ----------------------------------------------------------- subs path
+    def subscribe(self, sql: str, node: int = 0):
+        """POST /v1/subscriptions analog → (sub_id, initial events)."""
+        self._check_node(node)
+        with self.locks.tracked(self._lock, f"subscribe node={node}", "write"):
+            m, initial = self.subs.get_or_insert(sql, node, self.state.table)
+            if initial is None:
+                # deduped — replay the initial state from the matcher
+                initial = m.prime(self.state.table)
+            self._sub_queues.setdefault(m.id, [])
+            return m.id, initial
+
+    def sub_catch_up(self, sub_id: str, from_change_id: int):
+        m = self.subs.get(sub_id)
+        if m is None:
+            return None
+        return m.catch_up(from_change_id)
+
+    def sub_attach_queue(self, sub_id: str) -> collections.deque | None:
+        """Register a live event queue for a subscriber stream."""
+        if self.subs.get(sub_id) is None:
+            return None
+        q: collections.deque = collections.deque()
+        self._sub_queues.setdefault(sub_id, []).append(q)
+        return q
+
+    def sub_detach_queue(self, sub_id: str, q) -> None:
+        queues = self._sub_queues.get(sub_id)
+        if queues and q in queues:
+            queues.remove(q)
+
+    def unsubscribe(self, sub_id: str) -> None:
+        with self._lock:
+            self.subs.remove(sub_id)
+            self._sub_queues.pop(sub_id, None)
+
+    # ------------------------------------------------------------- stepping
+    def _dequeue_writes(self):
+        """≤1 pending changeset per node → padded write arrays (or None)."""
+        n, s = self.cfg.num_nodes, self.cfg.seqs_per_version
+        if not any(self._pending):
+            return None
+        writers = np.zeros((n,), bool)
+        rows = np.zeros((n, s), np.int32)
+        cols = np.zeros((n, s), np.int32)
+        vals = np.zeros((n, s), np.int32)
+        dels = np.zeros((n,), bool)
+        ncells = np.zeros((n,), np.int32)
+        for i in range(n):
+            if not self._pending[i]:
+                continue
+            cs: _PendingChangeset = self._pending[i].popleft()
+            writers[i] = True
+            dels[i] = cs.is_delete
+            ncells[i] = len(cs.cells)
+            for j, (slot, plane, rank) in enumerate(cs.cells):
+                rows[i, j], cols[i, j], vals[i, j] = slot, plane, rank
+        return writers, rows, cols, vals, dels, ncells
+
+    def _tick_locked(self, rounds: int) -> None:
+        for _ in range(rounds):
+            w = self._dequeue_writes()
+            if w is None:
+                n, s = self.cfg.num_nodes, self.cfg.seqs_per_version
+                w = (
+                    np.zeros((n,), bool),
+                    np.zeros((n, s), np.int32),
+                    np.zeros((n, s), np.int32),
+                    np.zeros((n, s), np.int32),
+                    np.zeros((n,), bool),
+                    np.zeros((n,), np.int32),
+                )
+            key = jax.random.fold_in(self._root_key, self._rounds_ticked)
+            self.state, metrics = self._step(
+                self.state,
+                key,
+                jnp.asarray(self._alive),
+                jnp.asarray(self._part),
+                tuple(jnp.asarray(x) for x in w),
+            )
+            self._rounds_ticked += 1
+            for k, v in jax.tree.map(np.asarray, metrics).items():
+                self._totals[k] = self._totals.get(k, 0.0) + float(v)
+            self._totals["rounds"] = self._rounds_ticked
+            self._notify_subs()
+
+    def tick(self, rounds: int = 1) -> None:
+        """Advance the cluster `rounds` gossip rounds (no new writes)."""
+        with self.locks.tracked(self._lock, "tick", "write"):
+            self._tick_locked(rounds)
+
+    def _notify_subs(self) -> None:
+        events = self.subs.step(self.state.table)
+        for sub_id, evs in events.items():
+            for q in self._sub_queues.get(sub_id, ()):  # live streams
+                q.extend(evs)
+
+    def run_until_converged(self, max_rounds: int = 512) -> int | None:
+        """Tick until every live node caught up (gap == 0); round count."""
+        with self.locks.tracked(self._lock, "run_until_converged", "write"):
+            for i in range(max_rounds):
+                self._tick_locked(1)
+                gap = float(np.asarray(self._last_gap()))
+                if gap == 0.0 and not any(self._pending):
+                    return i + 1
+        return None
+
+    def _last_gap(self):
+        head = np.asarray(self.state.log.head)
+        book = np.asarray(self.state.book.head)
+        alive = self._alive
+        return float(
+            np.where(alive[:, None], head[None, :] - book, 0).sum()
+        )
+
+    # ------------------------------------------------------- introspection
+    def table_stats(self) -> dict:
+        """GET /v1/table_stats analog (``api/public/mod.rs:535-590``)."""
+        cl = np.asarray(self.state.table.cl)
+        out = {}
+        for name in self.layout.schema.tables:
+            start, cap = self.layout._range(name)
+            live = (cl[:, start:start + cap] % 2 == 1).sum(axis=1)
+            out[name] = {
+                "allocated_pks": self.layout._used[name],
+                "capacity": cap,
+                "live_rows_per_node": live.tolist(),
+            }
+        return out
+
+    def actor_versions(self, actor: int) -> dict:
+        """Admin `actor version` analog: bookkeeping for one actor
+        (``corro-admin`` Actor Version command)."""
+        self._check_node(actor)
+        head = np.asarray(self.state.book.head)[:, actor]
+        written = int(np.asarray(self.state.log.head)[actor])
+        cleared = int(np.asarray(self.state.log.cleared)[actor].sum())
+        return {
+            "actor": actor,
+            "versions_written": written,
+            "versions_cleared": cleared,
+            "applied_head_per_node": head.tolist(),
+        }
+
+    def members(self) -> list[dict]:
+        """Cluster membership view (admin `cluster members` analog)."""
+        out = []
+        inc = None
+        if self.cfg.swim_enabled:
+            inc = np.asarray(self.state.swim.inc)
+        for i in range(self.cfg.num_nodes):
+            out.append(
+                {
+                    "id": i,
+                    "alive": bool(self._alive[i]),
+                    "partition": int(self._part[i]),
+                    "pending_writes": len(self._pending[i]),
+                    **({"incarnation": int(inc[i, i])} if inc is not None else {}),
+                }
+            )
+        return out
+
+    def metrics_totals(self) -> dict:
+        with self._lock:
+            return dict(self._totals)
+
+    # ---------------------------------------------------- fault injection
+    def set_alive(self, node: int, alive: bool) -> None:
+        self._check_node(node)
+        with self._lock:
+            self._alive[node] = alive
+
+    def set_partition(self, part: list[int]) -> None:
+        with self._lock:
+            assert len(part) == self.cfg.num_nodes
+            self._part = np.asarray(part, np.int32)
+
+    # --------------------------------------------------------- migrations
+    def migrate(self, schema_sql: str) -> dict:
+        """POST /v1/migrations analog: diff-based, additive-only
+        (``apply_schema``, ``corro-types/src/schema.rs:274-646``)."""
+        with self.locks.tracked(self._lock, "migrate", "write"):
+            new_schema = parse_and_constrain(schema_sql)
+            plan = self.layout.migrate(new_schema)
+            new_rows = self.layout.num_rows
+            new_cols = max(self.layout.num_cols, 1)
+            grew = (
+                new_rows > self.cfg.num_rows or new_cols > self.cfg.num_cols
+            )
+            if grew:
+                self._grow(new_rows, new_cols)
+            self._query_cache.clear()
+            return {
+                "new_tables": sorted(plan.new_tables),
+                "new_columns": sorted(plan.new_columns),
+                "resized": grew,
+            }
+
+    def _grow(self, new_rows: int, new_cols: int) -> None:
+        """Pad the row/col axes of every table-shaped tensor; recompile."""
+        cfg = dataclasses.replace(
+            self.cfg, num_rows=new_rows, num_cols=new_cols
+        ).validate()
+        st = self.state
+        dr = new_rows - self.cfg.num_rows
+        dc = new_cols - self.cfg.num_cols
+
+        def pad_rc(x, fill):
+            return jnp.pad(
+                x, ((0, 0), (0, dr), (0, dc)), constant_values=fill
+            )
+
+        table = st.table.replace(
+            cv=pad_rc(st.table.cv, 0),
+            vr=pad_rc(st.table.vr, int(NEG)),
+            site=pad_rc(st.table.site, -1),
+            cl=jnp.pad(st.table.cl, ((0, 0), (0, dr)), constant_values=0),
+        )
+        own = st.own
+        own_pads = {}
+        for f in dataclasses.fields(own):
+            v = getattr(own, f.name)
+            if v.ndim == 2:  # (R, C) planes
+                fill = int(NEG) if f.name == "vr" else (
+                    -1 if f.name in ("site", "actor", "ractor") else 0
+                )
+                own_pads[f.name] = jnp.pad(
+                    v, ((0, dr), (0, dc)), constant_values=fill
+                )
+            elif v.ndim == 1:  # (R,) rows
+                fill = -1 if f.name in ("ractor", "rsite") else 0
+                own_pads[f.name] = jnp.pad(
+                    v, ((0, dr),), constant_values=fill
+                )
+            else:
+                own_pads[f.name] = v
+        row_cdf = jnp.pad(st.row_cdf, ((0, dr),), constant_values=1.0)
+        self.state = st.replace(
+            table=table, own=own.replace(**own_pads), row_cdf=row_cdf
+        )
+        self.cfg = cfg
+        self._build_step()
+
+    def schema_sql(self) -> dict:
+        """The current schema, rendered table-by-table."""
+        return {
+            name: {
+                "pk": list(t.pk),
+                "columns": [
+                    {
+                        "name": c.name,
+                        "type": c.type,
+                        "nullable": c.nullable,
+                        "pk": c.primary_key,
+                    }
+                    for c in t.columns
+                ],
+            }
+            for name, t in self.layout.schema.tables.items()
+        }
+
+    def _check_node(self, node: int) -> None:
+        if not (0 <= node < self.cfg.num_nodes):
+            raise ExecError(
+                f"node {node} out of range (cluster size "
+                f"{self.cfg.num_nodes})"
+            )

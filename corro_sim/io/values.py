@@ -63,3 +63,106 @@ def _hashable(value):
     if isinstance(value, bytearray):
         return bytes(value)
     return value
+
+
+class LiveUniverse:
+    """Order-preserving *online* interning for live writes.
+
+    Trace replay interns a closed world (:class:`ValueInterner`). A live
+    agent accepting ``/v1/transactions`` sees new values forever, so ranks
+    are assigned with gaps (spacing ``GAP``): a new value between two
+    neighbors takes the midpoint rank. When a gap is exhausted the whole
+    space is re-spaced and every listener is told to remap its rank-typed
+    tensors (old→new is order-preserving, so CRDT merge outcomes are
+    unchanged — the tie-break only reads rank *order*, matching CR-SQLite's
+    "biggest value" comparison, ``doc/crdts.md:13-16``).
+
+    Satisfies the matcher-facing universe protocol (``rank_of`` /
+    ``decode``) used by :mod:`corro_sim.subs.query`.
+    """
+
+    GAP = 1 << 14
+
+    def __init__(self, initial=()):
+        vals = sorted({_hashable(v) for v in initial}, key=sqlite_sort_key)
+        self._values: list = vals
+        self._keys = [sqlite_sort_key(v) for v in vals]
+        self._ranks: list[int] = [(i + 1) * self.GAP for i in range(len(vals))]
+        self._by_value: dict = dict(zip(vals, self._ranks))
+        self.version = 0  # bumped on every remap
+        self._remap_listeners: list = []
+
+    def __len__(self) -> int:
+        return len(self._values)
+
+    def on_remap(self, fn) -> None:
+        """``fn(old_ranks: list[int], new_ranks: list[int])`` — called with
+        parallel arrays whenever the space is re-spaced."""
+        self._remap_listeners.append(fn)
+
+    def rank(self, value) -> int:
+        """Intern ``value`` (idempotent) and return its rank."""
+        import bisect
+
+        v = _hashable(value)
+        r = self._by_value.get(v)
+        if r is not None:
+            return r
+        k = sqlite_sort_key(v)
+        i = bisect.bisect_left(self._keys, k)
+        lo = self._ranks[i - 1] if i > 0 else 0
+        hi = (
+            self._ranks[i]
+            if i < len(self._ranks)
+            else (self._ranks[-1] + 2 * self.GAP if self._ranks else 2 * self.GAP)
+        )
+        if hi - lo < 2:
+            self._respace()
+            lo = self._ranks[i - 1] if i > 0 else 0
+            hi = (
+                self._ranks[i]
+                if i < len(self._ranks)
+                else self._ranks[-1] + 2 * self.GAP
+            )
+        r = (lo + hi) // 2
+        self._values.insert(i, v)
+        self._keys.insert(i, k)
+        self._ranks.insert(i, r)
+        self._by_value[v] = r
+        return r
+
+    def _respace(self) -> None:
+        old = list(self._ranks)
+        self._ranks = [(i + 1) * self.GAP for i in range(len(self._values))]
+        self._by_value = dict(zip(self._values, self._ranks))
+        self.version += 1
+        for fn in self._remap_listeners:
+            fn(old, list(self._ranks))
+
+    # ---- matcher universe protocol -------------------------------------
+    def rank_of(self, lit):
+        """(lo, hi): stored ranks r of values == lit satisfy lo <= r < hi.
+
+        For an un-interned literal both bounds collapse to the insertion
+        point, so ``=`` matches nothing while ``<``/``>`` stay correct.
+        """
+        import bisect
+
+        v = _hashable(lit)
+        r = self._by_value.get(v)
+        if r is not None:
+            return r, r + 1
+        k = sqlite_sort_key(v)
+        i = bisect.bisect_left(self._keys, k)
+        edge = self._ranks[i] if i < len(self._ranks) else (
+            self._ranks[-1] + self.GAP if self._ranks else self.GAP
+        )
+        return edge, edge
+
+    def decode(self, rank: int):
+        import bisect
+
+        i = bisect.bisect_left(self._ranks, rank)
+        if i < len(self._ranks) and self._ranks[i] == rank:
+            return self._values[i]
+        raise KeyError(f"rank {rank} not in universe")

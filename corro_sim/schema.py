@@ -242,6 +242,8 @@ class TableLayout:
         self._by_slot: dict[int, tuple] = {}  # row slot -> (table, pk)
         self._next_row = 0
         self.default_capacity = default_capacity
+        self.generation = 0  # bumped on every slot allocation / migration
+        # (lets cached host-side pk masks invalidate cheaply)
         for t in schema:
             self._add_table(t, (capacities or {}).get(t.name, default_capacity))
 
@@ -284,6 +286,7 @@ class TableLayout:
             self._slots[key] = slot
             self._by_slot[slot] = key
             self._used[table] = used + 1
+            self.generation += 1
         return slot
 
     def key_of(self, slot: int):
@@ -316,6 +319,7 @@ class TableLayout:
             if (name, cname) not in self._cols:
                 self._cols[(name, cname)] = nxt
         self.schema = new_schema
+        self.generation += 1
         return plan
 
     def sorted_pks(self, table: str) -> list:

@@ -35,7 +35,10 @@ from corro_sim.subs.query import (
     RankUniverse,
     Select,
     compile_predicate,
+    eval_predicate_py,
     parse_query,
+    predicate_columns,
+    split_pk_predicate,
 )
 
 
@@ -80,6 +83,19 @@ class SubEvent:
         }
 
 
+def _predicate_literals(pred):
+    from corro_sim.subs.query import And, Cmp, Not, Or
+
+    if isinstance(pred, Cmp):
+        if pred.lit is not None:
+            yield pred.lit
+    elif isinstance(pred, (And, Or)):
+        for q in pred.parts:
+            yield from _predicate_literals(q)
+    elif isinstance(pred, Not):
+        yield from _predicate_literals(pred.inner)
+
+
 class Matcher:
     """One registered query; owns its compiled eval + diff state."""
 
@@ -90,31 +106,66 @@ class Matcher:
         self.node = node
         self.universe = universe
         self.max_buffer = max_buffer
+        self._layout_ref = layout
 
         start, cap = layout.table_range(select.table)
         self._start, self._cap = start, cap
         table = layout.table_columns(select.table)
+        pk_names = layout.pk_columns(select.table)
         if select.columns:
-            missing = [c for c in select.columns if c not in table]
+            # pk columns are always emitted as the row-key prefix; selecting
+            # them explicitly must not double them or hit the rank planes.
+            self.columns = [c for c in select.columns if c not in pk_names]
+            missing = [c for c in self.columns if c not in table]
             if missing:
                 raise QueryError(
                     f"no such column(s) {missing} in {select.table!r}"
                 )
-            self.columns = list(select.columns)
         else:
             self.columns = list(table)
         self._proj_idx = [layout.col_index(select.table, c)
                           for c in self.columns]
-        for c in select.referenced_columns():
+        # WHERE splits: pk terms run host-side over the slot-allocation
+        # map; value terms compile to device rank comparisons.
+        self._pk_where, dev_where = split_pk_predicate(
+            select.where, frozenset(pk_names)
+        )
+        self._dev_where = dev_where
+        self._pk_names = tuple(pk_names)
+        self._pk_mask_cache = (None, None)  # (layout generation, mask)
+        for c in predicate_columns(dev_where):
             if c not in table:
                 raise QueryError(f"no such column {select.table}.{c}")
         self._row_key = layout.row_key  # slot -> (table, pk) | None
 
+        self._eval = self._build_eval()
+        self._prev_match = np.zeros((cap,), bool)
+        self._prev_proj = np.zeros((cap, len(self._proj_idx)), np.int32)
+        self._change_id = 0
+        self._events: list[SubEvent] = []
+        self._primed = False
+
+    def _build_eval(self):
+        """Compile the value-column WHERE terms to the current rank space."""
+        select, layout = self.select, self._layout_ref
+        start, cap = self._start, self._cap
+        # Live universes intern lazily; a literal ranked by its would-be
+        # insertion edge would go stale the moment a row stores it (the
+        # stored rank lands at a midpoint, not the edge). Interning every
+        # literal first gives it a permanent rank, so the baked comparison
+        # constants stay correct for values arriving later; any respace
+        # this triggers lands before compilation and rebinds other
+        # matchers through the normal remap path.
+        if hasattr(self.universe, "rank"):
+            self.universe.rank(None)
+            for lit in _predicate_literals(self._dev_where):
+                self.universe.rank(lit)
         pred = compile_predicate(
-            select.where, universe, lambda c: layout.col_index(select.table, c)
+            self._dev_where, self.universe,
+            lambda c: layout.col_index(select.table, c),
         )
         proj = tuple(self._proj_idx)
-        node_idx = node
+        node_idx = self.node
 
         @jax.jit
         def evaluate(vr_all, cl_all):
@@ -126,12 +177,23 @@ class Matcher:
             prj = vr[:, jnp.asarray(proj, jnp.int32)] if proj else vr[:, :0]
             return match, prj
 
-        self._eval = evaluate
-        self._prev_match = np.zeros((cap,), bool)
-        self._prev_proj = np.zeros((cap, len(proj)), np.int32)
-        self._change_id = 0
-        self._events: list[SubEvent] = []
-        self._primed = False
+        return evaluate
+
+    def rebind(self, old_ranks, new_ranks) -> None:
+        """Adopt a re-spaced rank universe (LiveUniverse remap).
+
+        Rank constants baked into the compiled predicate are stale, and the
+        previous projection snapshot is in the old space — recompile the
+        eval and translate the snapshot so no spurious UPDATE events fire.
+        """
+        self._eval = self._build_eval()
+        if self._prev_proj.size:
+            o = np.asarray(old_ranks, np.int64)
+            nw = np.asarray(new_ranks, np.int64)
+            pp = self._prev_proj.astype(np.int64)
+            idx = np.clip(np.searchsorted(o, pp), 0, max(len(o) - 1, 0))
+            found = (len(o) > 0) & (o[idx] == pp)
+            self._prev_proj = np.where(found, nw[idx], pp).astype(np.int32)
 
     # ---- the candidate filter (filter_matchable_change analog) ----------
     def is_candidate(self, touched) -> bool:
@@ -157,12 +219,39 @@ class Matcher:
             )
         return pk + cells
 
-    def prime(self, table_state):
-        """Initial query run → columns header, row events, end-of-query
-        (``Matcher::run`` initial scan, ``pubsub.rs:1298-1430``)."""
+    def _pk_mask(self):
+        """(cap,) bool of slots whose pk tuple satisfies the pk WHERE terms;
+        None when the query has no pk terms. Cached per layout generation
+        (slots allocate append-only, so the mask only grows)."""
+        if self._pk_where is None:
+            return None
+        gen = getattr(self._layout_ref, "generation", 0)
+        cached_gen, mask = self._pk_mask_cache
+        if cached_gen == gen:
+            return mask
+        mask = np.zeros((self._cap,), bool)
+        for s in range(self._cap):
+            key = self._row_key(self._start + s)
+            if key is None:
+                continue
+            pk = dict(zip(self._pk_names, key[1]))
+            mask[s] = eval_predicate_py(self._pk_where, pk.get)
+        self._pk_mask_cache = (gen, mask)
+        return mask
+
+    def _evaluate(self, table_state):
         match, proj = jax.tree.map(
             np.asarray, self._eval(table_state.vr, table_state.cl)
         )
+        pk_mask = self._pk_mask()
+        if pk_mask is not None:
+            match = match & pk_mask
+        return match, proj
+
+    def prime(self, table_state):
+        """Initial query run → columns header, row events, end-of-query
+        (``Matcher::run`` initial scan, ``pubsub.rs:1298-1430``)."""
+        match, proj = self._evaluate(table_state)
         self._prev_match, self._prev_proj = match, proj
         self._primed = True
         pk_cols = [c for c in (self._pk_cols() or ())]
@@ -188,9 +277,7 @@ class Matcher:
         """Re-evaluate and emit change events for the delta."""
         if not self._primed:
             raise RuntimeError("matcher not primed — call prime() first")
-        match, proj = jax.tree.map(
-            np.asarray, self._eval(table_state.vr, table_state.cl)
-        )
+        match, proj = self._evaluate(table_state)
         events = []
         ins = match & ~self._prev_match
         dele = ~match & self._prev_match
@@ -274,6 +361,18 @@ class LayoutAdapter:
         except KeyError:
             raise QueryError(f"no such column {table}.{column}") from None
 
+    def pk_columns(self, table) -> tuple:
+        """pk column names — () for traces (names aren't in the wire
+        format, so pk predicates aren't resolvable there)."""
+        if self._layout is not None:
+            t = self._layout.schema.tables.get(table)
+            return tuple(t.pk) if t is not None else ()
+        return ()
+
+    @property
+    def generation(self) -> int:
+        return self._layout.generation if self._layout is not None else 0
+
     @property
     def row_key(self):
         if self._layout is not None:
@@ -345,3 +444,8 @@ class SubsManager:
 
     def __len__(self):
         return len(self._by_id)
+
+    def rebind_all(self, old_ranks, new_ranks) -> None:
+        """Propagate a LiveUniverse remap to every registered matcher."""
+        for m in self._by_id.values():
+            m.rebind(old_ranks, new_ranks)

@@ -255,6 +255,93 @@ def parse_query(sql: str) -> Select:
     return _Parser(_tokenize(sql)).parse_select()
 
 
+def predicate_columns(p) -> frozenset:
+    """All columns a predicate AST references."""
+    out = set()
+
+    def walk(q):
+        if isinstance(q, (Cmp, IsNull)):
+            out.add(q.col)
+        elif isinstance(q, (And, Or)):
+            for r in q.parts:
+                walk(r)
+        elif isinstance(q, Not):
+            walk(q.inner)
+
+    if p is not None:
+        walk(p)
+    return frozenset(out)
+
+
+def split_pk_predicate(where, pk_cols: frozenset):
+    """Partition a WHERE AST into (pk_pred, value_pred).
+
+    Primary-key values are host-side data (the slot allocation map), not
+    device ranks, so pk comparisons evaluate on host while value
+    comparisons compile to rank space. Top-level AND parts split cleanly;
+    a single part mixing pk and value columns (e.g. ``pk = 1 OR v > 2``)
+    cannot run half-on-host and is rejected.
+    """
+    if where is None:
+        return None, None
+    parts = where.parts if isinstance(where, And) else (where,)
+    pk_parts, val_parts = [], []
+    for p in parts:
+        cs = predicate_columns(p)
+        if cs and cs <= pk_cols:
+            pk_parts.append(p)
+        elif cs & pk_cols:
+            raise QueryError(
+                "a predicate term mixing primary-key and value columns is "
+                f"unsupported: {_render(p)}"
+            )
+        else:
+            val_parts.append(p)
+
+    def join(ps):
+        if not ps:
+            return None
+        return ps[0] if len(ps) == 1 else And(tuple(ps))
+
+    return join(pk_parts), join(val_parts)
+
+
+def eval_predicate_py(p, get) -> bool:
+    """Host-side predicate evaluation with the same semantics as the
+    compiled rank-space version: comparisons against NULL (or a missing
+    value) are False; ``IS [NOT] NULL`` sees them; Not is plain negation.
+
+    ``get(col)`` returns the column's Python value (None for NULL).
+    """
+    if isinstance(p, Cmp):
+        v = get(p.col)
+        if v is None or p.lit is None:
+            return False
+        kv, kl = sqlite_sort_key(v), sqlite_sort_key(p.lit)
+        if p.op == "=":
+            return kv == kl
+        if p.op == "!=":
+            return kv != kl
+        if p.op == "<":
+            return kv < kl
+        if p.op == "<=":
+            return kv <= kl
+        if p.op == ">":
+            return kv > kl
+        if p.op == ">=":
+            return kv >= kl
+        raise QueryError(f"bad op {p.op!r}")
+    if isinstance(p, IsNull):
+        return (get(p.col) is not None) if p.negated else (get(p.col) is None)
+    if isinstance(p, And):
+        return all(eval_predicate_py(q, get) for q in p.parts)
+    if isinstance(p, Or):
+        return any(eval_predicate_py(q, get) for q in p.parts)
+    if isinstance(p, Not):
+        return not eval_predicate_py(p.inner, get)
+    raise QueryError(f"bad predicate node {p!r}")
+
+
 # ------------------------------------------------- rank-space compilation
 
 

@@ -1,0 +1,240 @@
+"""LiveCluster behavior tests: the write→gossip→merge→query→subs loop.
+
+Mirrors the reference's multi-node-in-one-process posture
+(``corro-agent/src/agent/tests.rs``): full protocol code, tiny cluster,
+no mocks.
+"""
+
+import pytest
+
+from corro_sim.harness.cluster import ExecError, LiveCluster
+
+SCHEMA = """
+CREATE TABLE todos (
+    id INTEGER NOT NULL PRIMARY KEY,
+    title TEXT NOT NULL DEFAULT '',
+    done INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE kv (
+    ns TEXT NOT NULL,
+    k TEXT NOT NULL,
+    v TEXT,
+    PRIMARY KEY (ns, k)
+);
+"""
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    return LiveCluster(SCHEMA, num_nodes=4, seed=7, default_capacity=64)
+
+
+def test_execute_and_local_query(cluster):
+    res = cluster.execute(
+        [
+            ["INSERT INTO todos (id, title, done) VALUES (?, ?, ?)",
+             [1, "write the tests", 0]],
+            {"query": "INSERT INTO todos (id, title) VALUES (:id, :t)",
+             "named_params": {"id": 2, "t": "ship it"}},
+        ],
+        node=0,
+    )
+    assert res["version"] >= 2
+    assert [r["rows_affected"] for r in res["results"]] == [1, 1]
+
+    cols, rows = cluster.query_rows("SELECT title, done FROM todos", node=0)
+    assert cols == ["id", "title", "done"]
+    got = {tuple(r) for r in rows}
+    assert (1, "write the tests", 0) in got
+    assert any(r[0] == 2 and r[1] == "ship it" for r in rows)
+
+
+def test_gossip_convergence_to_other_nodes(cluster):
+    assert cluster.run_until_converged(max_rounds=64) is not None
+    for node in range(4):
+        _, rows = cluster.query_rows("SELECT title FROM todos", node=node)
+        titles = {r[1] for r in rows}
+        assert "write the tests" in titles, f"node {node} missing row"
+
+
+def test_update_and_delete_propagate(cluster):
+    cluster.execute(
+        ["UPDATE todos SET done = 1 WHERE id = 1"], node=1
+    )
+    cluster.execute(["DELETE FROM todos WHERE id = 2"], node=2)
+    assert cluster.run_until_converged(max_rounds=64) is not None
+    for node in range(4):
+        _, rows = cluster.query_rows(
+            "SELECT done FROM todos WHERE id = 1", node=node
+        )
+        assert len(rows) == 1 and rows[0][1] == 1
+        _, rows = cluster.query_rows(
+            "SELECT title FROM todos WHERE id = 2", node=node
+        )
+        assert rows == [], f"node {node} still sees deleted row"
+
+
+def test_composite_pk_and_predicate_update(cluster):
+    cluster.execute(
+        [
+            ["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)", ["a", "x", "1"]],
+            ["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)", ["a", "y", "1"]],
+            ["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)", ["b", "x", "2"]],
+        ],
+        node=3,
+    )
+    # predicate (non-pk) UPDATE: touches every row with v = '1'
+    res = cluster.execute(["UPDATE kv SET v = '9' WHERE v = '1'"], node=3)
+    assert res["results"][0]["rows_affected"] == 2
+    assert cluster.run_until_converged(max_rounds=64) is not None
+    _, rows = cluster.query_rows("SELECT v FROM kv WHERE v = '9'", node=0)
+    assert len(rows) == 2
+
+
+def test_lww_conflict_converges_to_one_winner(cluster):
+    # Two nodes write the same cell in the same round-trip window.
+    cluster.execute(
+        [["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)", ["c", "w", "n0"]]],
+        node=0,
+    )
+    cluster.execute(
+        [["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)", ["c", "w", "n1"]]],
+        node=1,
+    )
+    assert cluster.run_until_converged(max_rounds=64) is not None
+    vals = set()
+    for node in range(4):
+        _, rows = cluster.query_rows(
+            "SELECT v FROM kv WHERE ns = 'c'", node=node
+        )
+        assert len(rows) == 1
+        vals.add(rows[0][-1])
+    assert len(vals) == 1, f"divergent LWW outcome: {vals}"
+
+
+def test_subscription_sees_remote_changes(cluster):
+    sub_id, initial = cluster.subscribe(
+        "SELECT v FROM kv WHERE ns = 'sub'", node=0
+    )
+    assert initial[0] == {"columns": ["ns", "k", "v"]}
+    assert initial[-1]["eoq"]["change_id"] == 0
+    q = cluster.sub_attach_queue(sub_id)
+
+    cluster.execute(
+        [["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)", ["sub", "e", "hi"]]],
+        node=2,  # remote node writes; observer is node 0
+    )
+    cluster.run_until_converged(max_rounds=64)
+    kinds = [e.kind for e in q]
+    assert "insert" in kinds
+    ev = next(e for e in q if e.kind == "insert")
+    assert ev.cells == ["sub", "e", "hi"]
+
+    # catch-up API: events after a change id
+    missed = cluster.sub_catch_up(sub_id, 0)
+    assert missed and missed[0].change_id == 1
+
+
+def test_errors(cluster):
+    with pytest.raises(ExecError):
+        cluster.execute(["DROP TABLE todos"], node=0)
+    with pytest.raises(ExecError):
+        cluster.execute(
+            [["INSERT INTO todos (title) VALUES (?)", ["no pk"]]], node=0
+        )
+    with pytest.raises(ExecError):
+        cluster.execute(["DELETE FROM todos"], node=0)  # no WHERE
+    with pytest.raises(ExecError):
+        cluster.execute(
+            [["INSERT INTO nope (id) VALUES (?)", [1]]], node=0
+        )
+
+
+def test_migration_adds_table_and_grows_state(cluster):
+    new_schema = SCHEMA + """
+    CREATE TABLE notes (
+        id INTEGER NOT NULL PRIMARY KEY,
+        body TEXT NOT NULL DEFAULT ''
+    );
+    """
+    plan = cluster.migrate(new_schema)
+    assert plan["new_tables"] == ["notes"]
+    cluster.execute(
+        [["INSERT INTO notes (id, body) VALUES (?, ?)", [1, "post-migrate"]]],
+        node=0,
+    )
+    assert cluster.run_until_converged(max_rounds=64) is not None
+    _, rows = cluster.query_rows("SELECT body FROM notes", node=3)
+    assert rows and rows[0][1] == "post-migrate"
+    # old data still intact after the grow
+    _, rows = cluster.query_rows("SELECT title FROM todos", node=3)
+    assert any(r[1] == "write the tests" for r in rows)
+
+
+def test_pk_range_delete_respects_pk_predicate(cluster):
+    cluster.execute(
+        [
+            ["INSERT INTO todos (id, title) VALUES (?, ?)", [10, "keep"]],
+            ["INSERT INTO todos (id, title) VALUES (?, ?)", [11, "drop"]],
+            ["INSERT INTO todos (id, title) VALUES (?, ?)", [12, "drop"]],
+        ],
+        node=0,
+    )
+    res = cluster.execute(["DELETE FROM todos WHERE id > 10"], node=0)
+    assert res["results"][0]["rows_affected"] == 2
+    _, rows = cluster.query_rows("SELECT title FROM todos WHERE id >= 10")
+    assert [r[1] for r in rows] == ["keep"]
+
+
+def test_update_does_not_resurrect_deleted_row(cluster):
+    cluster.execute(
+        [["INSERT INTO todos (id, title) VALUES (?, ?)", [20, "gone"]]],
+        node=0,
+    )
+    cluster.execute(["DELETE FROM todos WHERE id = 20"], node=0)
+    res = cluster.execute(
+        ["UPDATE todos SET title = 'back?' WHERE id = 20"], node=0
+    )
+    assert res["results"][0]["rows_affected"] == 0
+    _, rows = cluster.query_rows("SELECT title FROM todos WHERE id = 20")
+    assert rows == []
+
+
+def test_write_to_down_node_is_refused(cluster):
+    cluster.set_alive(1, False)
+    try:
+        with pytest.raises(ExecError):
+            cluster.execute(
+                [["INSERT INTO todos (id) VALUES (?)", [99]]], node=1
+            )
+    finally:
+        cluster.set_alive(1, True)
+
+
+def test_subscription_literal_interned_before_rows_exist(cluster):
+    # The WHERE literal doesn't exist in the universe yet; the compiled
+    # predicate must still match a row that stores it later.
+    sub_id, initial = cluster.subscribe(
+        "SELECT v FROM kv WHERE v = 'latecomer'", node=0
+    )
+    assert not any("row" in e for e in initial)
+    q = cluster.sub_attach_queue(sub_id)
+    cluster.execute(
+        [["INSERT INTO kv (ns, k, v) VALUES (?, ?, ?)",
+          ["late", "x", "latecomer"]]],
+        node=1,
+    )
+    cluster.run_until_converged(max_rounds=64)
+    assert any(
+        e.kind == "insert" and e.cells[-1] == "latecomer" for e in q
+    )
+
+
+def test_table_stats_and_introspection(cluster):
+    stats = cluster.table_stats()
+    assert "todos" in stats and "kv" in stats
+    assert stats["todos"]["live_rows_per_node"][0] >= 1
+    av = cluster.actor_versions(0)
+    assert av["versions_written"] >= 2
+    members = cluster.members()
+    assert len(members) == 4 and all(m["alive"] for m in members)

@@ -1,0 +1,151 @@
+"""Runtime utilities + live interning + statement parsing."""
+
+import threading
+import time
+
+import pytest
+
+from corro_sim.api.statements import (
+    StatementError,
+    bind_params,
+    parse_statement,
+    parse_write,
+    pk_equalities,
+)
+from corro_sim.io.values import LiveUniverse, sqlite_sort_key
+from corro_sim.utils.runtime import (
+    Backoff,
+    LockRegistry,
+    Tripwire,
+    pending_handles,
+    spawn_counted,
+    wait_for_all_pending_handles,
+)
+
+
+def test_tripwire_trip_and_callbacks():
+    tw = Tripwire()
+    hits = []
+    tw.on_trip(lambda: hits.append(1))
+    assert not tw.tripped
+    tw.trip()
+    assert tw.tripped and hits == [1]
+    tw.on_trip(lambda: hits.append(2))  # late registration fires immediately
+    assert hits == [1, 2]
+    assert tw.sleep(5.0) is True  # preempted instantly
+
+
+def test_spawn_counted_drain():
+    ev = threading.Event()
+
+    def work():
+        ev.wait(5)
+
+    before = pending_handles()
+    spawn_counted(work)
+    spawn_counted(work)
+    assert pending_handles() >= before + 2
+    ev.set()
+    assert wait_for_all_pending_handles(timeout=5)
+
+
+def test_backoff_sequence():
+    delays = list(iter(Backoff(1, 15, max_retries=6)))
+    assert delays == [1, 2, 4, 8, 15, 15]
+
+
+def test_lock_registry_snapshot():
+    reg = LockRegistry()
+    lk = threading.Lock()
+    with reg.tracked(lk, "test-label", "write"):
+        snap = reg.snapshot(top=5)
+        assert snap and snap[0]["label"] == "test-label"
+        assert snap[0]["state"] == "locked"
+    assert reg.snapshot() == []
+
+
+def test_live_universe_order_preserved():
+    u = LiveUniverse()
+    ranks = {v: u.rank(v) for v in [5, "b", 1.5, None, "a", b"z", 3]}
+    vals = sorted(ranks, key=sqlite_sort_key)
+    got = sorted(ranks, key=lambda v: ranks[v])
+    assert [str(v) for v in vals] == [str(v) for v in got]
+    # interning is idempotent
+    assert u.rank(5) == ranks[5]
+
+
+def test_live_universe_remap_on_gap_exhaustion():
+    u = LiveUniverse()
+    remaps = []
+    u.on_remap(lambda old, new: remaps.append((list(old), list(new))))
+    # Force rank-space pressure: repeatedly insert between 0 and the
+    # smallest existing value.
+    u.rank(0.0)
+    u.rank(1.0)
+    x = 0.5
+    for _ in range(40):
+        u.rank(x)
+        x /= 2
+    assert remaps, "expected at least one re-spacing"
+    old, new = remaps[-1]
+    # remap is order-preserving and parallel
+    assert len(old) == len(new)
+    assert sorted(new) == new
+    # after the dust settles, order still matches value order
+    vs = [u.decode(r) for r in sorted(u._ranks)]
+    assert vs == sorted(vs, key=sqlite_sort_key)
+
+
+def test_statement_shapes():
+    assert parse_statement("SELECT 1") == ("SELECT 1", [])
+    assert parse_statement(["q", [1, 2]]) == ("q", [1, 2])
+    assert parse_statement(["q", 1, 2]) == ("q", [1, 2])
+    assert parse_statement({"query": "q", "params": [3]}) == ("q", [3])
+    assert parse_statement({"query": "q", "named_params": {"a": 1}}) == (
+        "q", {"a": 1}
+    )
+    with pytest.raises(StatementError):
+        parse_statement(42)
+
+
+def test_bind_params():
+    assert (
+        bind_params("INSERT INTO t (a, b) VALUES (?, ?)", [1, "x'y"])
+        == "INSERT INTO t (a, b) VALUES (1, 'x''y')"
+    )
+    assert (
+        bind_params("UPDATE t SET a = :v WHERE b = $w", {"v": None, "w": 2})
+        == "UPDATE t SET a = NULL WHERE b = 2"
+    )
+    with pytest.raises(StatementError):
+        bind_params("VALUES (?)", [])
+
+
+def test_parse_write_upsert_multi_values():
+    op = parse_write(
+        ["INSERT INTO t (id, v) VALUES (?, ?), (?, ?)", [1, "a", 2, "b"]]
+    )
+    assert op.kind == "upsert" and op.table == "t"
+    assert op.rows == [{"id": 1, "v": "a"}, {"id": 2, "v": "b"}]
+
+
+def test_parse_write_update_delete():
+    op = parse_write("UPDATE t SET v = 'x' WHERE id = 3")
+    assert op.kind == "update" and op.sets == {"v": "x"}
+    assert pk_equalities(op.where, ("id",)) == (3,)
+    op = parse_write("DELETE FROM t WHERE a = 1 AND b = 2")
+    assert pk_equalities(op.where, ("a", "b")) == (1, 2)
+    assert pk_equalities(op.where, ("a",)) is None  # extra non-pk col
+    with pytest.raises(StatementError):
+        parse_write("UPDATE t SET v = 1")  # no WHERE
+    with pytest.raises(StatementError):
+        parse_write("CREATE TABLE t (id INTEGER PRIMARY KEY)")
+
+
+def test_insert_or_replace_and_on_conflict_tolerated():
+    op = parse_write("INSERT OR REPLACE INTO t (id) VALUES (1)")
+    assert op.kind == "upsert"
+    op = parse_write(
+        "INSERT INTO t (id) VALUES (1) ON CONFLICT (id) DO NOTHING"
+    )
+    assert op.kind == "upsert"
