@@ -1,0 +1,443 @@
+"""HTTP API: the reference's public client surface over a LiveCluster.
+
+Route parity with ``corro-agent/src/api/public`` (SURVEY §2.3):
+
+  POST /v1/transactions   — statement batch → one committed version
+                            (``api_v1_transactions``, ``public/mod.rs:134-205``)
+  POST /v1/queries        — one SELECT → streaming ND-JSON ``QueryEvent``s
+                            (``api_v1_queries``, ``public/mod.rs:215-441``)
+  POST /v1/subscriptions  — SELECT → live query stream; dedupe by normalized
+                            SQL; ``corro-query-id``/``corro-query-hash``
+                            headers (``public/pubsub.rs:665``)
+  GET  /v1/subscriptions/:id?from=N&skip_rows= — re-attach an existing sub
+                            (``api_v1_sub_by_id``, ``public/pubsub.rs:36-110``)
+  POST /v1/migrations     — DDL batch → additive schema migration
+                            (``api_v1_db_schema``, ``public/mod.rs:443-528``)
+  POST /v1/table_stats    — per-table row counts (``public/mod.rs:535-590``)
+  GET  /v1/cluster/members, GET /metrics — membership + Prometheus text
+                            (the reference serves these via corro-admin and
+                            the Prometheus exporter; one port suffices here)
+
+Differences by design: one server fronts the *whole simulated cluster*, so
+every route takes ``?node=N`` to pick which agent you'd have dialed
+(default 0). Event bodies are ND-JSON lines exactly like the reference
+(serde shapes of ``TypedQueryEvent``, ``corro-api-types/src/lib.rs:25-38``),
+so a reference client's decode loop works unchanged.
+
+Authorization mirrors ``BearerToken`` authz (``agent/util.rs:219-246``):
+when the server is given a token, every request must carry
+``Authorization: Bearer <token>``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import select
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from corro_sim.harness.cluster import ExecError, LiveCluster
+
+_SUB_PATH = re.compile(r"^/v1/subscriptions/([A-Za-z0-9_-]+)$")
+
+# Stream poll cadence. The reference parks on a tokio broadcast receiver;
+# an HTTP thread here polls its deque instead.
+_POLL_S = 0.02
+
+
+class _ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+def _parse_qs(query: str) -> dict:
+    out = {}
+    for part in query.split("&"):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        out[k] = v
+    return out
+
+
+def query_hash(sql: str) -> str:
+    """Stable hash of the normalized query — the ``corro-query-hash``
+    header value (``public/pubsub.rs:640-663`` hashes the statement)."""
+    return hashlib.sha256(sql.strip().encode()).hexdigest()[:16]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "corro-sim"
+
+    # quiet request logging; the cluster has its own metrics
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    # ----------------------------------------------------------- plumbing
+    @property
+    def api(self) -> "ApiServer":
+        return self.server.api  # type: ignore[attr-defined]
+
+    def _authz(self) -> bool:
+        token = self.api.authz_token
+        if token is None:
+            return True
+        got = self.headers.get("Authorization", "")
+        if got == f"Bearer {token}":
+            return True
+        self._send_json({"error": "unauthorized"}, status=401)
+        return False
+
+    def _body_json(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        if not raw:
+            raise _ApiError(400, "empty body")
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError as e:
+            raise _ApiError(400, f"invalid JSON body: {e}") from None
+
+    def _node(self, params: dict) -> int:
+        try:
+            return int(params.get("node", "0") or 0)
+        except ValueError:
+            raise _ApiError(400, "node must be an integer") from None
+
+    def _send_json(self, obj, status: int = 200, headers: dict | None = None):
+        body = (json.dumps(obj) + "\n").encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _start_stream(self, status: int = 200, headers: dict | None = None):
+        """Open an unbounded ND-JSON response (read-until-close framing)."""
+        self.send_response(status)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Connection", "close")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.close_connection = True
+
+    def _stream_events(self, events) -> None:
+        for e in events:
+            self.wfile.write((json.dumps(_as_wire(e)) + "\n").encode())
+        self.wfile.flush()
+
+    # ------------------------------------------------------------- routes
+    def do_POST(self):  # noqa: N802
+        if not self._authz():
+            return
+        path, _, qs = self.path.partition("?")
+        params = _parse_qs(qs)
+        try:
+            if path == "/v1/transactions":
+                self._post_transactions(params)
+            elif path == "/v1/queries":
+                self._post_queries(params)
+            elif path == "/v1/subscriptions":
+                self._post_subscriptions(params)
+            elif path in ("/v1/migrations", "/v1/db/schema"):
+                self._post_migrations(params)
+            elif path == "/v1/table_stats":
+                self._post_table_stats(params)
+            else:
+                self._send_json({"error": "not found"}, status=404)
+        except _ApiError as e:
+            self._send_json({"error": e.message}, status=e.status)
+        except BrokenPipeError:
+            pass
+
+    def do_GET(self):  # noqa: N802
+        if not self._authz():
+            return
+        path, _, qs = self.path.partition("?")
+        params = _parse_qs(qs)
+        try:
+            m = _SUB_PATH.match(path)
+            if m:
+                self._get_subscription(m.group(1), params)
+            elif path == "/v1/cluster/members":
+                self._send_json(self.api.cluster.members())
+            elif path == "/v1/table_stats":
+                self._post_table_stats(params, body={"tables": []})
+            elif path == "/metrics":
+                self._get_metrics()
+            else:
+                self._send_json({"error": "not found"}, status=404)
+        except _ApiError as e:
+            self._send_json({"error": e.message}, status=e.status)
+        except BrokenPipeError:
+            pass
+
+    # POST /v1/transactions — ExecResponse; statement errors come back as
+    # per-statement {"error"} results with HTTP 200, like the reference.
+    def _post_transactions(self, params):
+        stmts = self._body_json()
+        if not isinstance(stmts, list):
+            raise _ApiError(400, "body must be a JSON array of statements")
+        t0 = time.perf_counter()
+        try:
+            resp = self.api.cluster.execute(stmts, node=self._node(params))
+        except ExecError as e:
+            resp = {
+                "results": [{"error": str(e)}],
+                "time": time.perf_counter() - t0,
+                "version": None,
+            }
+        self._send_json(resp)
+
+    def _post_queries(self, params):
+        stmt = self._body_json()
+        node = self._node(params)
+        sql = stmt if isinstance(stmt, str) else None
+        if sql is None:
+            # accept the Statement wire shapes for queries too
+            from corro_sim.api.statements import parse_statement
+
+            try:
+                sql, _ = parse_statement(stmt)
+            except Exception as e:
+                raise _ApiError(400, str(e)) from None
+        self._start_stream()
+        t0 = time.perf_counter()
+        try:
+            events = self.api.cluster.query(sql, node=node)
+        except Exception as e:  # streamed QueryEvent::Error, like reference
+            self._stream_events([{"error": str(e)}])
+            return
+        events = _with_eoq_time(events, time.perf_counter() - t0)
+        self._stream_events(events)
+
+    def _post_subscriptions(self, params):
+        stmt = self._body_json()
+        node = self._node(params)
+        skip_rows = params.get("skip_rows", "") in ("true", "1")
+        sql = stmt if isinstance(stmt, str) else None
+        if sql is None:
+            from corro_sim.api.statements import parse_statement
+
+            try:
+                sql, _ = parse_statement(stmt)
+            except Exception as e:
+                raise _ApiError(400, str(e)) from None
+        cluster = self.api.cluster
+        try:
+            sub_id, initial, q = cluster.subscribe_attached(sql, node=node)
+        except Exception as e:
+            raise _ApiError(400, str(e)) from None
+        try:
+            # hash the *normalized* SQL so create and re-attach agree
+            # (the reference hashes the deduped statement the same way)
+            norm = cluster.subs.get(sub_id).select.normalized()
+            self._start_stream(
+                headers={
+                    "corro-query-id": sub_id,
+                    "corro-query-hash": query_hash(norm),
+                }
+            )
+            if not skip_rows:
+                self._stream_events(initial)
+            else:
+                # skip_rows still announces where the change feed starts
+                eoq = [e for e in initial if "eoq" in e]
+                self._stream_events(eoq)
+            self._tail(q)
+        finally:
+            cluster.sub_detach_queue(sub_id, q)
+
+    def _get_subscription(self, sub_id: str, params):
+        cluster = self.api.cluster
+        skip_rows = params.get("skip_rows", "") in ("true", "1")
+        from_raw = params.get("from")
+        from_id = None
+        if from_raw is not None:
+            try:
+                from_id = int(from_raw)
+            except ValueError:
+                raise _ApiError(400, "from must be an integer") from None
+        try:
+            initial, q = cluster.sub_attach(
+                sub_id, from_change_id=from_id, skip_rows=skip_rows
+            )
+        except KeyError:
+            raise _ApiError(404, f"no such subscription {sub_id!r}") from None
+        if initial is None:
+            # compacted past `from` — reference 404s; resubscribe
+            raise _ApiError(404, f"change id {from_id} no longer buffered")
+        m = cluster.subs.get(sub_id)
+        try:
+            self._start_stream(
+                headers={
+                    "corro-query-id": sub_id,
+                    "corro-query-hash": query_hash(m.select.normalized()),
+                }
+            )
+            self._stream_events(initial)
+            self._tail(q)
+        finally:
+            cluster.sub_detach_queue(sub_id, q)
+
+    def _tail(self, q) -> None:
+        """Forward live events until the client hangs up or shutdown.
+
+        Hangup on an *idle* stream is detected by readability: the client
+        sends nothing after its request, so a readable socket means EOF —
+        without this, an event-less subscription would pin its handler
+        thread and queue forever."""
+        trip = self.api.cluster.tripwire
+        sock = self.connection
+        while not trip.tripped and not self.api._closing:
+            if q:
+                batch = []
+                while q:
+                    batch.append(q.popleft())
+                try:
+                    self._stream_events(batch)
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    return
+            else:
+                readable, _, _ = select.select([sock], [], [], _POLL_S)
+                if readable:
+                    return  # EOF (or protocol violation) — hang up
+
+    def _post_migrations(self, params):
+        stmts = self._body_json()
+        if isinstance(stmts, str):
+            stmts = [stmts]
+        if not isinstance(stmts, list) or not all(
+            isinstance(s, str) for s in stmts
+        ):
+            raise _ApiError(400, "body must be DDL statement string(s)")
+        sql = ";\n".join(s.rstrip().rstrip(";") for s in stmts)
+        try:
+            plan = self.api.cluster.migrate(sql)
+        except Exception as e:
+            raise _ApiError(400, str(e)) from None
+        self._send_json(plan)
+
+    def _post_table_stats(self, params, body=None):
+        req = body if body is not None else self._body_json()
+        want = req.get("tables") if isinstance(req, dict) else None
+        stats = self.api.cluster.table_stats()
+        invalid = [t for t in (want or []) if t not in stats]
+        picked = (
+            {t: stats[t] for t in want if t in stats} if want else stats
+        )
+        total = sum(
+            sum(s["live_rows_per_node"]) for s in picked.values()
+        )
+        self._send_json(
+            {
+                "total_row_count": total,
+                "invalid_tables": invalid,
+                "tables": picked,
+            }
+        )
+
+    def _get_metrics(self):
+        from corro_sim.utils.metrics import render_prometheus
+
+        text = render_prometheus(self.api.cluster)
+        body = text.encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _as_wire(e) -> dict:
+    """Events are dicts already; buffered SubEvents expose as_json()."""
+    return e if isinstance(e, dict) else e.as_json()
+
+
+def _with_eoq_time(events, elapsed: float):
+    out = []
+    for e in events:
+        if isinstance(e, dict) and "eoq" in e:
+            eoq = dict(e["eoq"])
+            eoq["time"] = elapsed
+            e = {"eoq": eoq}
+        out.append(e)
+    return out
+
+
+class ApiServer:
+    """Threaded HTTP front-end bound to one LiveCluster.
+
+    Lifecycle mirrors ``setup_http_api_handler`` (``agent/util.rs:167-296``):
+    bind, serve until tripwire, drain. ``tick_interval`` optionally runs a
+    background gossip ticker so subscription tails advance without writes
+    (the reference's agents gossip on their own clock)."""
+
+    def __init__(
+        self,
+        cluster: LiveCluster,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        authz_token: str | None = None,
+        tick_interval: float | None = None,
+    ):
+        self.cluster = cluster
+        self.authz_token = authz_token
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.api = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+        self._ticker: threading.Thread | None = None
+        self._tick_interval = tick_interval
+        self._closing = False
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.addr
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="corro-api", daemon=True
+        )
+        self._thread.start()
+        if self._tick_interval:
+            self._ticker = threading.Thread(
+                target=self._tick_loop, name="corro-ticker", daemon=True
+            )
+            self._ticker.start()
+        return self
+
+    def _tick_loop(self):
+        trip = self.cluster.tripwire
+        while not trip.tripped and not self._closing:
+            self.cluster.tick(1)
+            time.sleep(self._tick_interval)
+
+    def close(self) -> None:
+        self._closing = True
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if self._ticker:
+            self._ticker.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()

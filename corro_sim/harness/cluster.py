@@ -47,6 +47,7 @@ from corro_sim.schema import (
 )
 from corro_sim.subs.manager import LayoutAdapter, Matcher, SubsManager
 from corro_sim.subs.query import QueryError, parse_query
+from corro_sim.utils.ranks import rank_map, translate_ranks
 from corro_sim.utils.runtime import LockRegistry, Tripwire
 
 
@@ -98,6 +99,10 @@ class LiveCluster:
         self._part = np.zeros((num_nodes,), np.int32)
         self._pending: list = [collections.deque() for _ in range(num_nodes)]
         self._staging: list | None = None  # execute()'s in-flight batch
+        # in-flight batch overlay: ((slot -> live), ((slot, plane) -> rank))
+        # — later statements in one transaction see earlier ones' effects,
+        # like the reference's single SQLite tx (api/public/mod.rs:104-131)
+        self._staging_overlay: tuple[dict, dict] | None = None
         self._rounds_ticked = 0
         self._totals: dict[str, float] = {}
         self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
@@ -126,13 +131,8 @@ class LiveCluster:
 
         Order-preserving, so merge outcomes are untouched; this is pure
         re-labelling (like SQLite swapping its interned value ids)."""
-        o = jnp.asarray(old, jnp.int32)
-        nw = jnp.asarray(new, jnp.int32)
-
         def remap(v):
-            idx = jnp.clip(jnp.searchsorted(o, v), 0, max(len(old) - 1, 0))
-            found = (v >= 0) & (o[idx] == v) if len(old) else jnp.zeros_like(v, bool)
-            return jnp.where(found, nw[idx], v)
+            return translate_ranks(v, old, new, xp=jnp)
 
         st = self.state
         self.state = st.replace(
@@ -142,7 +142,7 @@ class LiveCluster:
         )
         # Queued-but-uncommitted changesets carry ranks too (including the
         # batch still being planned inside execute()).
-        trans = dict(zip(old, new))
+        trans = rank_map(old, new)
         batches = list(self._pending)
         if self._staging is not None:
             batches.append(self._staging)
@@ -152,6 +152,10 @@ class LiveCluster:
                     (slot, plane, trans.get(rank, rank))
                     for slot, plane, rank in cs.cells
                 ]
+        if self._staging_overlay is not None:
+            _, cells = self._staging_overlay
+            for k, rank in cells.items():
+                cells[k] = trans.get(rank, rank)
         self.subs.rebind_all(old, new)
         for m in self._query_cache.values():
             m.rebind(old, new)
@@ -173,13 +177,17 @@ class LiveCluster:
                 # silent success for a write the step masks out would lie.
                 raise ExecError(f"node {node} is down")
             changesets: list[_PendingChangeset] = []
+            overlay: tuple[dict, dict] = ({}, {})
             self._staging = changesets
+            self._staging_overlay = overlay
             try:
                 for stmt in statements:
                     st0 = _time.perf_counter()
                     try:
                         op = parse_write(stmt)
-                        n_rows = self._plan_write(op, node, changesets)
+                        n_rows = self._plan_write(
+                            op, node, changesets, overlay
+                        )
                     except (StatementError, SchemaError, QueryError) as e:
                         raise ExecError(str(e)) from None
                     results.append(
@@ -190,6 +198,7 @@ class LiveCluster:
                     )
             finally:
                 self._staging = None
+                self._staging_overlay = None
             for cs in changesets:
                 self._pending[node].append(cs)
             # Commit synchronously: tick until this node's queue drains —
@@ -205,16 +214,25 @@ class LiveCluster:
         }
 
     def _plan_write(
-        self, op: WriteOp, node: int, out: list
+        self, op: WriteOp, node: int, out: list, overlay: tuple[dict, dict]
     ) -> int:
-        """Expand one WriteOp into pending changesets; returns rows affected."""
+        """Expand one WriteOp into pending changesets; returns rows affected.
+
+        ``overlay`` accumulates the batch's staged effects (liveness + cell
+        values) so later statements in the same transaction observe earlier
+        ones, matching the reference's single-SQLite-tx visibility."""
         t = self.layout.schema.tables.get(op.table)
         if t is None:
             raise StatementError(f"no such table {op.table!r}")
         s_cap = self.cfg.seqs_per_version
+        live_ov, cell_ov = overlay
 
         if op.kind == "upsert":
-            cells = []
+            # last-occurrence-wins per (row, col): SQLite upsert semantics,
+            # and local_write's invariant that one changeset never carries
+            # duplicate (row, col) cells (core/crdt.py local_write).
+            dedup: dict[tuple[int, int], int] = {}
+            touched_slots = []
             for row in op.rows:
                 missing = [c for c in t.pk if c not in row]
                 if missing:
@@ -224,32 +242,35 @@ class LiveCluster:
                     )
                 pk = tuple(row[c] for c in t.pk)
                 slot = self.layout.row_slot(t.name, pk)
+                touched_slots.append(slot)
                 wrote = False
                 for c in t.value_columns:
                     if c.name in row:
-                        cells.append(
-                            (slot, self.layout.col_index(t.name, c.name),
-                             self.universe.rank(row[c.name]))
-                        )
+                        key = (slot, self.layout.col_index(t.name, c.name))
+                        dedup[key] = self.universe.rank(row[c.name])
                         wrote = True
                 if not wrote:
                     # pk-only insert: row existence is carried by the causal
                     # length; write the first value column's default/NULL.
                     if t.value_columns:
                         c = t.value_columns[0]
-                        cells.append(
-                            (slot, self.layout.col_index(t.name, c.name),
-                             self.universe.rank(c.default))
+                        key = (slot, self.layout.col_index(t.name, c.name))
+                        dedup.setdefault(
+                            key, self.universe.rank(c.default_value)
                         )
                     else:
-                        cells.append((slot, 0, self.universe.rank(None)))
+                        dedup.setdefault((slot, 0), self.universe.rank(None))
+            cells = [(r, c, v) for (r, c), v in dedup.items()]
             for i in range(0, len(cells), s_cap):
                 out.append(
                     _PendingChangeset(False, cells[i:i + s_cap])
                 )
+            for slot in touched_slots:
+                live_ov[slot] = True
+            cell_ov.update(dedup)
             return len(op.rows)
 
-        slots = self._resolve_rows(op, t, node)
+        slots = self._resolve_rows(op, t, node, overlay)
         if op.kind == "update":
             for c in op.sets:
                 self.layout.col_index(t.name, c)  # validate
@@ -261,35 +282,73 @@ class LiveCluster:
             ]
             for i in range(0, len(cells), s_cap):
                 out.append(_PendingChangeset(False, cells[i:i + s_cap]))
+            for slot, plane, rank in cells:
+                cell_ov[(slot, plane)] = rank
             return len(slots)
 
         # delete: one cl-only changeset per row (a DELETE bumps the row's
         # causal length; CR-SQLite emits no value changes for it).
         for slot in slots:
             out.append(_PendingChangeset(True, [(slot, 0, 0)]))
+            live_ov[slot] = False
         return len(slots)
 
-    def _resolve_rows(self, op: WriteOp, t, node: int) -> list[int]:
+    def _resolve_rows(
+        self, op: WriteOp, t, node: int, overlay: tuple[dict, dict]
+    ) -> list[int]:
         """Row slots an UPDATE/DELETE targets: pk fast path or predicate.
 
         Both paths only select rows that are *live on the target node*
         (odd causal length) — SQL UPDATE/DELETE of an absent row affects 0
-        rows; a CRDT resurrect requires an INSERT."""
+        rows; a CRDT resurrect requires an INSERT. Rows staged earlier in
+        the same batch count as live/dead per the overlay."""
+        live_ov, _ = overlay
         pk = pk_equalities(op.where, t.pk)
         if pk is not None:
             slot = self.layout._slots.get((t.name, pk))
             if slot is None:
                 return []
+            if slot in live_ov:
+                return [slot] if live_ov[slot] else []
             cl = int(np.asarray(self.state.table.cl[node, slot]))
             return [slot] if cl % 2 == 1 else []
         # General predicate: evaluate against the node's current view
-        # (liveness + pk-term mask applied by Matcher._evaluate).
+        # (liveness + pk-term mask applied by Matcher._evaluate), overlaid
+        # with the batch's staged writes.
         from corro_sim.subs.query import Select
 
         sel = Select(table=t.name, columns=(), where=op.where)
         matcher = self._matcher_for(sel, node)
-        match, _ = matcher._evaluate(self.state.table)
+        match, _ = matcher._evaluate(self._overlaid_table(node, overlay))
         return [int(s) + matcher._start for s in np.nonzero(match)[0]]
+
+    def _overlaid_table(self, node: int, overlay: tuple[dict, dict]):
+        """The committed table state with the batch's staged cells applied
+        on the target node — the transaction's own-writes view. Device-side
+        scatter of the few staged coordinates; no host round-trip."""
+        live_ov, cell_ov = overlay
+        st = self.state.table
+        if not live_ov and not cell_ov:
+            return st
+        vr, cl = st.vr, st.cl
+        if cell_ov:
+            slots = np.fromiter(
+                (s for s, _ in cell_ov), np.int32, len(cell_ov)
+            )
+            planes = np.fromiter(
+                (p for _, p in cell_ov), np.int32, len(cell_ov)
+            )
+            ranks = np.fromiter(cell_ov.values(), np.int32, len(cell_ov))
+            vr = vr.at[node, slots, planes].set(ranks)
+        if live_ov:
+            ls = np.fromiter(live_ov, np.int32, len(live_ov))
+            want = np.fromiter(
+                (1 if v else 0 for v in live_ov.values()),
+                np.int32, len(live_ov),
+            )
+            bump = ((cl[node, ls] % 2) != want).astype(cl.dtype)
+            cl = cl.at[node, ls].add(bump)
+        return st.replace(vr=vr, cl=cl)
 
     # ------------------------------------------------------------ query path
     def _matcher_for(self, select, node: int) -> Matcher:
@@ -329,14 +388,54 @@ class LiveCluster:
     # ----------------------------------------------------------- subs path
     def subscribe(self, sql: str, node: int = 0):
         """POST /v1/subscriptions analog → (sub_id, initial events)."""
+        sub_id, initial, q = self.subscribe_attached(sql, node)
+        self.sub_detach_queue(sub_id, q)
+        return sub_id, initial
+
+    def subscribe_attached(self, sql: str, node: int = 0):
+        """Subscribe AND attach a live queue atomically (no event can land
+        between the initial snapshot and the queue registration).
+
+        Returns (sub_id, initial_events, queue)."""
         self._check_node(node)
         with self.locks.tracked(self._lock, f"subscribe node={node}", "write"):
             m, initial = self.subs.get_or_insert(sql, node, self.state.table)
             if initial is None:
                 # deduped — replay the initial state from the matcher
                 initial = m.prime(self.state.table)
-            self._sub_queues.setdefault(m.id, [])
-            return m.id, initial
+            q: collections.deque = collections.deque()
+            self._sub_queues.setdefault(m.id, []).append(q)
+            return m.id, initial, q
+
+    def sub_attach(
+        self, sub_id: str, from_change_id: int | None = None,
+        skip_rows: bool = False,
+    ):
+        """Re-attach to an existing sub atomically: catch-up (or re-prime)
+        and queue registration under one lock, so no event is lost or
+        duplicated across the boundary.
+
+        Returns (initial_events, queue). Raises KeyError for an unknown
+        sub; returns (None, None) when ``from_change_id`` was compacted
+        past (the reference 404s — subscriber must re-subscribe)."""
+        with self.locks.tracked(self._lock, f"sub_attach {sub_id}", "write"):
+            m = self.subs.get(sub_id)
+            if m is None:
+                raise KeyError(sub_id)
+            if from_change_id is not None:
+                caught = m.catch_up(from_change_id)
+                if caught is None:
+                    return None, None
+                initial = [e.as_json() for e in caught]
+            elif skip_rows:
+                # still announce the feed position (the eoq carries the
+                # current change id) so the client knows where it attached
+                initial = [{"eoq": {"change_id": m.change_id}}]
+            else:
+                initial = m.prime(self.state.table)
+            q: collections.deque = collections.deque()
+            self._sub_queues.setdefault(sub_id, []).append(q)
+            return initial, q
 
     def sub_catch_up(self, sub_id: str, from_change_id: int):
         m = self.subs.get(sub_id)

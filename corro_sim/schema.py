@@ -47,6 +47,33 @@ class Column:
     primary_key: bool
     generated: bool  # generated columns are not replicated
 
+    @property
+    def default_value(self):
+        """The DEFAULT as a Python value (``PRAGMA table_xinfo`` hands back
+        the raw SQL expression text: ``''``, ``0``, ``'[]'`` …). Literal
+        NULL and unsupported expressions decode to None."""
+        d = self.default
+        if d is None or not isinstance(d, str):
+            return d
+        s = d.strip()
+        up = s.upper()
+        if up == "NULL":
+            return None
+        if up == "TRUE":  # SQLite materializes boolean keywords as 1/0
+            return 1
+        if up == "FALSE":
+            return 0
+        if len(s) >= 2 and s[0] == "'" and s[-1] == "'":
+            return s[1:-1].replace("''", "'")
+        try:
+            return int(s)
+        except ValueError:
+            pass
+        try:
+            return float(s)
+        except ValueError:
+            return None  # expression defaults are not evaluated
+
 
 @dataclasses.dataclass(frozen=True)
 class Table:

@@ -156,10 +156,23 @@ class Matcher:
         # constants stay correct for values arriving later; any respace
         # this triggers lands before compilation and rebinds other
         # matchers through the normal remap path.
+        # Intern EVERY value the compiled program will bake as a constant
+        # (predicate literals AND column defaults) BEFORE compiling: a
+        # lazy intern can trigger a respace, and constants captured before
+        # a respace would be stale. After this block every needed value
+        # has a permanent rank, so the rank() calls below are pure lookups.
+        col_defaults = []
         if hasattr(self.universe, "rank"):
             self.universe.rank(None)
             for lit in _predicate_literals(self._dev_where):
                 self.universe.rank(lit)
+            for c in layout.table_columns(select.table):
+                d = layout.column_default(select.table, c)
+                if d is not None:
+                    self.universe.rank(d)
+                    col_defaults.append(
+                        (layout.col_index(select.table, c), d)
+                    )
         pred = compile_predicate(
             self._dev_where, self.universe,
             lambda c: layout.col_index(select.table, c),
@@ -167,10 +180,24 @@ class Matcher:
         proj = tuple(self._proj_idx)
         node_idx = self.node
 
+        # Declared column defaults: a never-written cell of a live row
+        # reads as its DEFAULT (SQLite materializes it at INSERT). Baked
+        # as rank constants; rebind() recompiles after any respace.
+        dflt_planes_np = np.asarray([p for p, _ in col_defaults], np.int32)
+        dflt_ranks_np = np.asarray(
+            [self.universe.rank(d) for _, d in col_defaults], np.int32
+        )
+
         @jax.jit
         def evaluate(vr_all, cl_all):
             vr = jax.lax.dynamic_slice_in_dim(vr_all[node_idx], start, cap, 0)
             cl = jax.lax.dynamic_slice_in_dim(cl_all[node_idx], start, cap, 0)
+            if len(dflt_planes_np):
+                fill = jnp.full((vr.shape[1],), NEG, vr.dtype)
+                fill = fill.at[dflt_planes_np].set(
+                    dflt_ranks_np.astype(vr.dtype)
+                )
+                vr = jnp.where(vr == NEG, fill[None, :], vr)
             unset = vr == NEG
             live = (cl % 2) == 1
             match = pred(vr, unset) & live
@@ -178,6 +205,11 @@ class Matcher:
             return match, prj
 
         return evaluate
+
+    @property
+    def change_id(self) -> int:
+        """Latest change id this matcher has emitted (feed position)."""
+        return self._change_id
 
     def rebind(self, old_ranks, new_ranks) -> None:
         """Adopt a re-spaced rank universe (LiveUniverse remap).
@@ -188,12 +220,11 @@ class Matcher:
         """
         self._eval = self._build_eval()
         if self._prev_proj.size:
-            o = np.asarray(old_ranks, np.int64)
-            nw = np.asarray(new_ranks, np.int64)
-            pp = self._prev_proj.astype(np.int64)
-            idx = np.clip(np.searchsorted(o, pp), 0, max(len(o) - 1, 0))
-            found = (len(o) > 0) & (o[idx] == pp)
-            self._prev_proj = np.where(found, nw[idx], pp).astype(np.int32)
+            from corro_sim.utils.ranks import translate_ranks
+
+            self._prev_proj = translate_ranks(
+                self._prev_proj.astype(np.int64), old_ranks, new_ranks
+            ).astype(np.int32)
 
     # ---- the candidate filter (filter_matchable_change analog) ----------
     def is_candidate(self, touched) -> bool:
@@ -360,6 +391,21 @@ class LayoutAdapter:
             return self._tcols[table][column]
         except KeyError:
             raise QueryError(f"no such column {table}.{column}") from None
+
+    def column_default(self, table, column):
+        """Declared DEFAULT literal, or None. A never-written cell of a
+        live row reads as its column default — SQLite materializes the
+        default at INSERT; the tensor layout materializes it at read.
+        Traces carry no schema, so no defaults there."""
+        if self._layout is None:
+            return None
+        t = self._layout.schema.tables.get(table)
+        if t is None:
+            return None
+        for c in t.value_columns:
+            if c.name == column:
+                return c.default_value
+        return None
 
     def pk_columns(self, table) -> tuple:
         """pk column names — () for traces (names aren't in the wire

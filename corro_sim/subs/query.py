@@ -130,7 +130,7 @@ def _render_lit(lit) -> str:
 _TOKEN = re.compile(
     r"\s*(?:"
     r"(?P<str>'(?:[^']|'')*')"
-    r"|(?P<num>-?\d+\.\d*|-?\.\d+|-?\d+)"
+    r"|(?P<num>-?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)"
     r"|(?P<op><=|>=|!=|<>|=|<|>)"
     r"|(?P<punct>[(),*])"
     r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
@@ -151,7 +151,8 @@ def _tokenize(sql: str):
             out.append(("lit", m.group("str")[1:-1].replace("''", "'")))
         elif m.lastgroup == "num":
             t = m.group("num")
-            out.append(("lit", float(t) if "." in t else int(t)))
+            is_float = "." in t or "e" in t or "E" in t
+            out.append(("lit", float(t) if is_float else int(t)))
         elif m.lastgroup == "op":
             op = m.group("op")
             out.append(("op", "!=" if op == "<>" else op))

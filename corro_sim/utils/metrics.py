@@ -1,0 +1,98 @@
+"""Prometheus text exposition for a LiveCluster.
+
+The reference installs a `metrics` Prometheus exporter with ~120 series
+under ``corro.*`` (``corrosion/src/command/agent.rs:95-117``; inventory in
+SURVEY §5). The simulator's per-round metrics come out of the jitted step
+as a dict; this module renders their running totals plus live gauges in
+the exposition format so the same dashboards/scrapers point here.
+
+Metric names follow the reference's (dots become underscores, the
+Prometheus exporter does the same mangling): e.g.
+``corro_broadcast_recv_count`` ← `corro.broadcast.recv.count`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# step-metric key → (prometheus name, type, help)
+_SERIES = {
+    "writes": (
+        "corro_changes_committed_total", "counter",
+        "local versions committed (make_broadcastable_changes analog)",
+    ),
+    "fresh": (
+        "corro_changes_applied_total", "counter",
+        "remote broadcast changes applied (process_multiple_changes analog)",
+    ),
+    "sync_versions": (
+        "corro_sync_changes_recv_total", "counter",
+        "versions repaired by anti-entropy sync",
+    ),
+    "dropped_window": (
+        "corro_broadcast_dropped_total", "counter",
+        "broadcasts dropped by bounded inboxes (handlers.rs:866-884 analog)",
+    ),
+    "deletes": (
+        "corro_deletes_applied_total", "counter",
+        "causal-length delete merges applied",
+    ),
+    "rounds": (
+        "corro_sim_rounds_total", "counter",
+        "simulation rounds executed",
+    ),
+}
+
+
+def render_prometheus(cluster) -> str:
+    lines: list[str] = []
+
+    def emit(name, kind, help_, value, labels=""):
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name}{labels} {value}")
+
+    totals = cluster.metrics_totals()
+    for key, (name, kind, help_) in _SERIES.items():
+        if key in totals:
+            emit(name, kind, help_, int(totals[key]))
+    # remaining step metrics, generically
+    for key, v in sorted(totals.items()):
+        if key not in _SERIES:
+            emit(
+                f"corro_sim_{key}_total", "counter",
+                f"sim step metric {key}", v,
+            )
+
+    # live gauges (agent/metrics.rs:18-108 analog: rows, gaps, members)
+    head = np.asarray(cluster.state.log.head)
+    book = np.asarray(cluster.state.book.head)
+    gap = np.maximum(head[None, :] - book, 0).sum()
+    emit(
+        "corro_sync_gaps_count", "gauge",
+        "total unapplied (node, actor) version gap", int(gap),
+    )
+    alive = int(cluster._alive.sum())
+    emit(
+        "corro_members_alive", "gauge",
+        "nodes marked alive by the harness", alive,
+    )
+    emit(
+        "corro_subs_count", "gauge",
+        "registered live-query matchers", len(cluster.subs),
+    )
+    stats = cluster.table_stats()
+    lines.append(
+        "# HELP corro_db_table_rows live rows per table (max over nodes)"
+    )
+    lines.append("# TYPE corro_db_table_rows gauge")
+    for t, s in stats.items():
+        rows = max(s["live_rows_per_node"], default=0)
+        lines.append(f'corro_db_table_rows{{table="{t}"}} {rows}')
+    pending = sum(len(q) for q in cluster._pending)
+    emit(
+        "corro_write_queue_pending", "gauge",
+        "queued uncommitted changesets (SplitPool write queue analog)",
+        pending,
+    )
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,33 @@
+"""Order-preserving rank translation for LiveUniverse respacing.
+
+When the live value universe re-spaces (new values interleave the total
+order), every tensor/snapshot/queued-cell holding old ranks must be
+re-labelled. One implementation serves all three holders (state tensors,
+matcher snapshots, pending changesets) so the semantics cannot diverge:
+unknown/sentinel ranks (anything not in ``old``, e.g. the NEG fill) pass
+through unchanged.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def translate_ranks(values, old, new, xp=np):
+    """Map each element of `values` from old-rank space to new-rank space.
+
+    ``xp`` is the array namespace (numpy or jax.numpy); `values` may be any
+    integer dtype/shape. Elements not present in ``old`` are unchanged.
+    """
+    if len(old) == 0:
+        return values
+    o = xp.asarray(old, values.dtype)
+    nw = xp.asarray(new, values.dtype)
+    idx = xp.clip(xp.searchsorted(o, values), 0, len(old) - 1)
+    found = (values >= 0) & (o[idx] == values)
+    return xp.where(found, nw[idx], values)
+
+
+def rank_map(old, new) -> dict:
+    """Python-side translation dict for scalar rank fields."""
+    return dict(zip(old, new))

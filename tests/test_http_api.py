@@ -1,0 +1,268 @@
+"""HTTP API + client library: route parity with api/public + corro-client.
+
+Covers the reference behaviors: ExecResponse shape on /v1/transactions
+(``public/mod.rs:134-205``), streaming QueryEvents on /v1/queries
+(``:215-441``), subscription create/attach/catch-up with corro-query-id
+headers (``public/pubsub.rs``), migrations (``:443-528``), table_stats,
+bearer authz (``agent/util.rs:219-246``), and client failover
+(``corro-client/src/lib.rs:377-640``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from corro_sim.api.http import ApiServer, query_hash
+from corro_sim.client import ApiClient, ApiClientError, PooledApiClient
+from corro_sim.harness.cluster import LiveCluster
+
+SCHEMA = """
+CREATE TABLE users (
+    id INTEGER PRIMARY KEY,
+    name TEXT NOT NULL DEFAULT '',
+    score INTEGER NOT NULL DEFAULT 0
+);
+"""
+
+
+@pytest.fixture(scope="module")
+def server():
+    cluster = LiveCluster(SCHEMA, num_nodes=4, default_capacity=64)
+    with ApiServer(cluster) as srv:
+        yield srv
+    cluster.tripwire.trip()
+
+
+@pytest.fixture()
+def client(server):
+    return ApiClient(server.addr)
+
+
+def test_transactions_exec_response(client):
+    resp = client.execute(
+        [
+            "INSERT INTO users (id, name, score) VALUES (1, 'ada', 10)",
+            ["INSERT INTO users (id, name, score) VALUES (?, ?, ?)",
+             [2, "grace", 20]],
+            {"query": "UPDATE users SET score = :s WHERE id = :id",
+             "named_params": {"s": 30, "id": 1}},
+        ]
+    )
+    assert len(resp["results"]) == 3
+    assert all("rows_affected" in r for r in resp["results"])
+    assert resp["version"] >= 1
+    assert resp["time"] > 0
+
+
+def test_transactions_error_results(client):
+    resp = client.execute(["INSERT INTO nope (id) VALUES (1)"])
+    assert "error" in resp["results"][0]
+    assert resp["version"] is None
+
+
+def test_query_stream_events(client):
+    client.execute(
+        ["INSERT INTO users (id, name, score) VALUES (7, 'sim', 70)"]
+    )
+    events = list(client.query("SELECT id, name, score FROM users WHERE id = 7"))
+    kinds = [next(iter(e)) for e in events]
+    assert kinds[0] == "columns"
+    assert kinds[-1] == "eoq"
+    rows = [e["row"][1] for e in events if "row" in e]
+    assert [7, "sim", 70] in rows
+    eoq = events[-1]["eoq"]
+    assert "time" in eoq and "change_id" in eoq
+
+
+def test_query_error_streamed(client):
+    events = list(client.query("SELECT id FROM missing_table"))
+    assert any("error" in e for e in events)
+
+
+def test_query_rows_on_other_node(server, client):
+    client.execute(
+        ["INSERT INTO users (id, name) VALUES (42, 'remote')"], node=1
+    )
+    server.cluster.run_until_converged()
+    cols, rows = client.query_rows(
+        "SELECT id, name FROM users WHERE id = 42", node=3
+    )
+    assert cols[:1] == ["id"]
+    assert [42, "remote"] in rows
+
+
+def test_subscription_live_stream(server, client):
+    sub = client.subscribe("SELECT id, score FROM users WHERE score > 100")
+    try:
+        assert sub.id
+        assert sub.hash == query_hash(
+            "SELECT id, score FROM users WHERE score > 100"
+        )
+        first = sub.events(2)  # columns + eoq (no matching rows yet)
+        assert "columns" in first[0]
+        assert "eoq" in first[1]
+
+        def write():
+            ApiClient(client.addr).execute(
+                ["INSERT INTO users (id, score) VALUES (200, 150)"]
+            )
+
+        t = threading.Thread(target=write)
+        t.start()
+        ev = sub.events(1)[0]
+        t.join()
+        assert "change" in ev
+        kind, _rowid, cells, change_id = ev["change"]
+        assert kind == "INSERT"
+        assert cells[0] == 200 and cells[-1] == 150
+        assert sub.last_change_id == change_id
+    finally:
+        sub.close()
+
+
+def test_subscription_reattach_catch_up(server, client):
+    sub = client.subscribe("SELECT id FROM users WHERE id >= 300")
+    sub.events(2)
+    client.execute(["INSERT INTO users (id) VALUES (300)"])
+    ev = sub.events(1)[0]
+    assert ev["change"][1] is not None
+    sub.close()
+
+    # new events while detached
+    client.execute(["INSERT INTO users (id) VALUES (301)"])
+    time.sleep(0.05)
+    resumed = sub.resume()
+    try:
+        ev2 = resumed.events(1)[0]
+        assert "change" in ev2
+        assert ev2["change"][2][0] == 301  # only the missed event replays
+    finally:
+        resumed.close()
+
+
+def test_subscription_unknown_404(client):
+    with pytest.raises(ApiClientError) as ei:
+        client.subscription("sub-9999")
+    assert ei.value.status == 404
+
+
+def test_migrations_additive(server, client):
+    resp = client.schema(
+        SCHEMA + """
+        CREATE TABLE events (
+            eid INTEGER PRIMARY KEY,
+            kind TEXT NOT NULL DEFAULT ''
+        );
+        """
+    )
+    assert "events" in resp["new_tables"]
+    client.execute(["INSERT INTO events (eid, kind) VALUES (1, 'boot')"])
+    _, rows = client.query_rows("SELECT eid, kind FROM events")
+    assert [1, "boot"] in rows
+
+
+def test_migration_destructive_rejected(client):
+    with pytest.raises(ApiClientError) as ei:
+        client.schema("CREATE TABLE users (id INTEGER PRIMARY KEY)")
+    assert ei.value.status == 400
+    assert "drop" in ei.value.message
+
+
+def test_table_stats(client):
+    stats = client.table_stats(["users", "ghost"])
+    assert stats["invalid_tables"] == ["ghost"]
+    assert "users" in stats["tables"]
+    assert stats["total_row_count"] >= 1
+
+
+def test_members_and_metrics(client):
+    members = client.members()
+    assert len(members) == 4
+    assert all(m["alive"] for m in members)
+    text = client.metrics_text()
+    assert "corro_changes_committed_total" in text
+    assert 'corro_db_table_rows{table="users"}' in text
+
+
+def test_bearer_authz():
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    with ApiServer(cluster, authz_token="s3cret") as srv:
+        anon = ApiClient(srv.addr)
+        with pytest.raises(ApiClientError) as ei:
+            anon.execute(["INSERT INTO users (id) VALUES (1)"])
+        assert ei.value.status == 401
+        authed = ApiClient(srv.addr, token="s3cret")
+        resp = authed.execute(["INSERT INTO users (id) VALUES (1)"])
+        assert resp["version"] == 1
+    cluster.tripwire.trip()
+
+
+def test_pooled_client_failover(server):
+    dead = ("127.0.0.1", 1)  # nothing listens on port 1
+    pooled = PooledApiClient([dead, server.addr], timeout=2.0)
+    resp = pooled.execute(["INSERT INTO users (id, name) VALUES (900, 'p')"])
+    assert resp["version"] >= 1
+    _, rows = pooled.query_rows("SELECT id FROM users WHERE id = 900")
+    assert [900] in rows
+
+
+def test_batch_sees_own_writes(client):
+    """Insert-then-update in one transaction: the update must see the
+    insert (single-SQLite-tx visibility, public/mod.rs:104-131)."""
+    resp = client.execute(
+        [
+            ["INSERT INTO users (id, name) VALUES (?, ?)", [500, "pre"]],
+            "UPDATE users SET score = 5 WHERE id = 500",
+            "UPDATE users SET name = 'post' WHERE score = 5",
+            "DELETE FROM users WHERE id = 500",
+            "UPDATE users SET score = 9 WHERE id = 500",  # row now dead
+        ]
+    )
+    affected = [r["rows_affected"] for r in resp["results"]]
+    assert affected == [1, 1, 1, 1, 0]
+    _, rows = client.query_rows("SELECT id FROM users WHERE id = 500")
+    assert rows == []
+
+
+def test_multi_values_last_wins(client):
+    """Duplicate pk in one INSERT: the later VALUES tuple wins (SQLite
+    upsert order), not the larger interned rank."""
+    client.execute(
+        [["INSERT INTO users (id, name) VALUES (?, ?), (?, ?)",
+          [600, "zzz", 600, "aaa"]]]
+    )
+    _, rows = client.query_rows("SELECT name FROM users WHERE id = 600")
+    assert rows == [[600, "aaa"]]  # pk prefix + the later tuple's value
+
+
+def test_float_exponent_params(client):
+    resp = client.execute(
+        [["INSERT INTO users (id, score) VALUES (?, ?)", [700, 1e-05]],
+         ["INSERT INTO users (id, score) VALUES (?, ?)", [701, 1e20]]]
+    )
+    assert all("rows_affected" in r for r in resp["results"])
+    _, rows = client.query_rows("SELECT score FROM users WHERE id = 700")
+    assert rows == [[700, 1e-05]]
+
+
+def test_subscription_bad_body_400(server):
+    import http.client as hc
+    import json as j
+
+    c = hc.HTTPConnection(*server.addr, timeout=5)
+    c.request("POST", "/v1/subscriptions", body=j.dumps(42),
+              headers={"Content-Type": "application/json"})
+    resp = c.getresponse()
+    assert resp.status == 400
+    c.close()
+
+
+def test_subscription_hash_stable_across_reattach(server, client):
+    sub = client.subscribe("SELECT id FROM users WHERE id > 100000")
+    sub.events(2)
+    h1 = sub.hash
+    sub.close()
+    re = client.subscription(sub.id, skip_rows=True)
+    assert re.hash == h1
+    re.close()
