@@ -1,0 +1,234 @@
+"""Admin server — the `corro-admin` unix-socket command surface.
+
+The reference runs a JSON-framed command server on a unix socket
+(``corro-admin/src/lib.rs:44-120``) driven by the ``corrosion`` CLI:
+Ping, Sync Generate, Locks{top}, Cluster Members / MembershipStates,
+Actor Version, Subs Info/List. Same surface here, over ND-JSON lines
+(one request object in, one response object out per line) against the
+in-process LiveCluster.
+
+Extra commands the reference does through other channels map naturally
+onto the socket because the cluster lives in-process: ``backup`` /
+``restore`` (``corrosion backup|restore``, ``main.rs:155-324``) and
+fault injection (`corro-devcluster`'s role).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+
+class AdminError(Exception):
+    pass
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        api: AdminServer = self.server.admin  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            raw = raw.strip()
+            if not raw:
+                continue
+            try:
+                req = json.loads(raw)
+                resp = api.dispatch(req)
+            except AdminError as e:
+                resp = {"ok": False, "error": str(e)}
+            except json.JSONDecodeError as e:
+                resp = {"ok": False, "error": f"bad request: {e}"}
+            except Exception as e:  # survivable command failure
+                resp = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _Server(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class AdminServer:
+    def __init__(self, cluster, sock_path: str):
+        self.cluster = cluster
+        self.path = str(sock_path)
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        self._srv = _Server(self.path, _Handler)
+        self._srv.admin = self  # type: ignore[attr-defined]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "AdminServer":
+        self._thread = threading.Thread(
+            target=self._srv.serve_forever, name="corro-admin", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+        if os.path.exists(self.path):
+            os.unlink(self.path)
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------ dispatch
+    def dispatch(self, req: dict) -> dict:
+        cmd = req.get("cmd")
+        fn = getattr(self, f"_cmd_{cmd}", None)
+        if fn is None:
+            raise AdminError(f"unknown command {cmd!r}")
+        out = fn(req)
+        return {"ok": True, **(out or {})}
+
+    def _cmd_ping(self, req):
+        return {"pong": True}
+
+    def _cmd_locks(self, req):
+        """`corrosion locks --top N` — LockRegistry dump
+        (``corro-types/src/agent.rs:890-1099``, admin Locks{top})."""
+        top = req.get("top")
+        return {"locks": self.cluster.locks.snapshot(top=top)}
+
+    def _cmd_cluster_members(self, req):
+        return {"members": self.cluster.members()}
+
+    def _cmd_cluster_membership_states(self, req):
+        """SWIM per-node view matrix (admin MembershipStates analog)."""
+        c = self.cluster
+        out = {"swim_enabled": bool(c.cfg.swim_enabled)}
+        if c.cfg.swim_enabled:
+            sw = c.state.swim
+            status = np.asarray(sw.status)
+            out["incarnation"] = np.asarray(sw.inc).diagonal().tolist()
+            # per-node summary, not the full N×N belief matrix
+            out["suspected_by"] = (status == 1).sum(axis=0).tolist()
+            out["down_by"] = (status >= 2).sum(axis=0).tolist()
+        return out
+
+    def _cmd_actor_version(self, req):
+        actor = int(req.get("actor", 0))
+        return self.cluster.actor_versions(actor)
+
+    def _cmd_sync_generate(self, req):
+        """SyncStateV1 analog for one node (admin Sync Generate):
+        per-actor applied heads + total need vs the cluster's written
+        heads (``generate_sync``, ``corro-types/src/sync.rs:284-344``)."""
+        node = int(req.get("node", 0))
+        self.cluster._check_node(node)
+        heads = np.asarray(self.cluster.state.book.head)[node]
+        written = np.asarray(self.cluster.state.log.head)
+        need = np.maximum(written - heads, 0)
+        return {
+            "actor_id": node,
+            "heads": heads.tolist(),
+            "need": {
+                str(a): int(n) for a, n in enumerate(need) if n > 0
+            },
+            "total_need": int(need.sum()),
+        }
+
+    def _cmd_subs_list(self, req):
+        subs = []
+        for sub_id, m in self.cluster.subs._by_id.items():
+            subs.append(
+                {
+                    "id": sub_id,
+                    "sql": m.select.normalized(),
+                    "node": m.node,
+                    "change_id": m.change_id,
+                    "streams": len(self.cluster._sub_queues.get(sub_id, [])),
+                }
+            )
+        return {"subs": subs}
+
+    def _cmd_subs_info(self, req):
+        sub_id = req.get("id")
+        m = self.cluster.subs.get(sub_id)
+        if m is None:
+            raise AdminError(f"no such subscription {sub_id!r}")
+        return {
+            "id": sub_id,
+            "sql": m.select.normalized(),
+            "node": m.node,
+            "change_id": m.change_id,
+            "buffered_events": len(m._events),
+            "streams": len(self.cluster._sub_queues.get(sub_id, [])),
+        }
+
+    def _cmd_table_stats(self, req):
+        return {"tables": self.cluster.table_stats()}
+
+    def _cmd_backup(self, req):
+        from corro_sim.io.checkpoint import backup
+
+        path = req.get("path")
+        if not path:
+            raise AdminError("backup needs a path")
+        backup(self.cluster, path, node=int(req.get("node", 0)))
+        return {"path": path}
+
+    def _cmd_restore(self, req):
+        from corro_sim.io.checkpoint import restore_into
+
+        path = req.get("path")
+        if not path or not os.path.exists(path):
+            raise AdminError(f"no such backup file {path!r}")
+        restore_into(self.cluster, path, node=int(req.get("node", 0)))
+        return {"path": path}
+
+    def _cmd_checkpoint(self, req):
+        from corro_sim.io.checkpoint import save_checkpoint
+
+        path = req.get("path")
+        if not path:
+            raise AdminError("checkpoint needs a path")
+        save_checkpoint(self.cluster, path)
+        return {"path": path}
+
+    def _cmd_set_alive(self, req):
+        """Fault injection (devcluster role): mark a node up/down."""
+        self.cluster.set_alive(int(req["node"]), bool(req["alive"]))
+        return {}
+
+    def _cmd_tick(self, req):
+        self.cluster.tick(int(req.get("rounds", 1)))
+        return {"rounds_ticked": self.cluster._rounds_ticked}
+
+
+class AdminClient:
+    """Line-oriented client for the admin socket (CLI side)."""
+
+    def __init__(self, sock_path: str, timeout: float = 30.0):
+        self.path = str(sock_path)
+        self.timeout = timeout
+
+    def call(self, cmd: str, **args) -> dict:
+        with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+            s.settimeout(self.timeout)
+            s.connect(self.path)
+            s.sendall(
+                (json.dumps({"cmd": cmd, **args}) + "\n").encode()
+            )
+            buf = b""
+            while not buf.endswith(b"\n"):
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+        resp = json.loads(buf)
+        if not resp.get("ok"):
+            raise AdminError(resp.get("error", "command failed"))
+        return resp

@@ -37,6 +37,7 @@ import re
 import select
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from corro_sim.harness.cluster import ExecError, LiveCluster
@@ -56,13 +57,7 @@ class _ApiError(Exception):
 
 
 def _parse_qs(query: str) -> dict:
-    out = {}
-    for part in query.split("&"):
-        if not part:
-            continue
-        k, _, v = part.partition("=")
-        out[k] = v
-    return out
+    return dict(urllib.parse.parse_qsl(query, keep_blank_values=True))
 
 
 def query_hash(sql: str) -> str:
@@ -201,15 +196,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _post_queries(self, params):
         stmt = self._body_json()
         node = self._node(params)
-        sql = stmt if isinstance(stmt, str) else None
-        if sql is None:
-            # accept the Statement wire shapes for queries too
-            from corro_sim.api.statements import parse_statement
-
-            try:
-                sql, _ = parse_statement(stmt)
-            except Exception as e:
-                raise _ApiError(400, str(e)) from None
+        sql = _sql_of_body(stmt)
         self._start_stream()
         t0 = time.perf_counter()
         try:
@@ -224,14 +211,7 @@ class _Handler(BaseHTTPRequestHandler):
         stmt = self._body_json()
         node = self._node(params)
         skip_rows = params.get("skip_rows", "") in ("true", "1")
-        sql = stmt if isinstance(stmt, str) else None
-        if sql is None:
-            from corro_sim.api.statements import parse_statement
-
-            try:
-                sql, _ = parse_statement(stmt)
-            except Exception as e:
-                raise _ApiError(400, str(e)) from None
+        sql = _sql_of_body(stmt)
         cluster = self.api.cluster
         try:
             sub_id, initial, q = cluster.subscribe_attached(sql, node=node)
@@ -356,6 +336,20 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+
+def _sql_of_body(stmt) -> str:
+    """A request body as SQL text: bare string or any Statement wire shape
+    (``corro-api-types/src/lib.rs:181-201``); malformed → 400."""
+    if isinstance(stmt, str):
+        return stmt
+    from corro_sim.api.statements import parse_statement
+
+    try:
+        sql, _ = parse_statement(stmt)
+    except Exception as e:
+        raise _ApiError(400, str(e)) from None
+    return sql
 
 
 def _as_wire(e) -> dict:

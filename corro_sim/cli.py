@@ -77,6 +77,139 @@ def _cmd_bench(_args: argparse.Namespace) -> int:
     return bench_main() or 0
 
 
+def _cmd_agent(args: argparse.Namespace) -> int:
+    """`corrosion agent` analog: run a live cluster behind the HTTP API
+    and the admin socket until SIGINT/SIGTERM
+    (``corrosion/src/command/agent.rs:16-93``)."""
+    from corro_sim.admin import AdminServer
+    from corro_sim.api.http import ApiServer
+    from corro_sim.harness.cluster import LiveCluster
+    from corro_sim.io.checkpoint import load_checkpoint
+    from corro_sim.utils.runtime import Tripwire, wait_for_all_pending_handles
+
+    tripwire = Tripwire.new_signals()
+    if not args.resume and not args.schema:
+        print("agent needs --schema or --resume", file=sys.stderr)
+        return 2
+    if args.resume:
+        cluster = load_checkpoint(args.resume, tripwire=tripwire)
+    else:
+        with open(args.schema) as f:
+            schema_sql = f.read()
+        cluster = LiveCluster(
+            schema_sql,
+            num_nodes=args.nodes,
+            seed=args.seed,
+            default_capacity=args.capacity,
+            tripwire=tripwire,
+        )
+    host, _, port = args.api_addr.partition(":")
+    api = ApiServer(
+        cluster,
+        host=host or "127.0.0.1",
+        port=int(port or 0),
+        authz_token=args.authz_token,
+        tick_interval=args.tick_interval or None,
+    ).start()
+    admin = AdminServer(cluster, args.admin_path).start()
+    print(
+        json.dumps(
+            {
+                "api": f"http://{api.addr[0]}:{api.addr[1]}",
+                "admin": args.admin_path,
+                "nodes": cluster.cfg.num_nodes,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        tripwire.wait()
+    finally:
+        api.close()
+        admin.close()
+        wait_for_all_pending_handles(timeout=10)
+    return 0
+
+
+def _client(args):
+    from corro_sim.client import ApiClient
+
+    return ApiClient(args.api, node=args.node, token=args.authz_token)
+
+
+def _cmd_query(args: argparse.Namespace) -> int:
+    """`corrosion query` — streams rows (``main.rs:368-412`` analog)."""
+    client = _client(args)
+    code = 0
+    for e in client.query(args.sql):
+        if args.raw:
+            print(json.dumps(e))
+        elif "row" in e:
+            print("|".join(str(v) for v in e["row"][1]))
+        elif "error" in e:
+            print(f"error: {e['error']}", file=sys.stderr)
+            code = 1
+    return code
+
+
+def _cmd_exec(args: argparse.Namespace) -> int:
+    """`corrosion exec` — one transaction of statements."""
+    resp = _client(args).execute(list(args.sql))
+    print(json.dumps(resp))
+    return 0 if all("error" not in r for r in resp["results"]) else 1
+
+
+def _admin(args):
+    from corro_sim.admin import AdminClient
+
+    return AdminClient(args.admin_path)
+
+
+def _print_json(obj) -> int:
+    print(json.dumps(obj, indent=2))
+    return 0
+
+
+def _cmd_backup(args: argparse.Namespace) -> int:
+    return _print_json(
+        _admin(args).call("backup", path=args.path, node=args.node)
+    )
+
+
+def _cmd_restore(args: argparse.Namespace) -> int:
+    return _print_json(
+        _admin(args).call("restore", path=args.path, node=args.node)
+    )
+
+
+def _cmd_locks(args: argparse.Namespace) -> int:
+    return _print_json(_admin(args).call("locks", top=args.top))
+
+
+def _cmd_sync(args: argparse.Namespace) -> int:
+    return _print_json(
+        _admin(args).call("sync_generate", node=args.node)
+    )
+
+
+def _cmd_actor(args: argparse.Namespace) -> int:
+    return _print_json(
+        _admin(args).call("actor_version", actor=args.actor)
+    )
+
+
+def _cmd_subs(args: argparse.Namespace) -> int:
+    if args.id:
+        return _print_json(_admin(args).call("subs_info", id=args.id))
+    return _print_json(_admin(args).call("subs_list"))
+
+
+def _cmd_cluster(args: argparse.Namespace) -> int:
+    if args.what == "members":
+        return _print_json(_admin(args).call("cluster_members"))
+    return _print_json(_admin(args).call("cluster_membership_states"))
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="corro-sim",
@@ -102,12 +235,92 @@ def build_parser() -> argparse.ArgumentParser:
 
     pb = sub.add_parser("bench", help="run the headline benchmark")
     pb.set_defaults(fn=_cmd_bench)
+
+    pa = sub.add_parser("agent", help="run a live cluster (HTTP API + admin)")
+    pa.add_argument("--schema", help="schema DDL file")
+    pa.add_argument("--resume", help="warm-boot from a checkpoint file")
+    pa.add_argument("--nodes", type=int, default=4)
+    pa.add_argument("--seed", type=int, default=0)
+    pa.add_argument("--capacity", type=int, default=256)
+    pa.add_argument("--api-addr", default="127.0.0.1:0")
+    pa.add_argument("--admin-path", default="./corro-sim-admin.sock")
+    pa.add_argument("--authz-token")
+    pa.add_argument(
+        "--tick-interval", type=float, default=0.1,
+        help="background gossip cadence in seconds (0 disables)",
+    )
+    pa.set_defaults(fn=_cmd_agent)
+
+    def api_args(sp):
+        sp.add_argument("--api", default="127.0.0.1:8080",
+                        help="agent HTTP address")
+        sp.add_argument("--node", type=int, default=0)
+        sp.add_argument("--authz-token")
+
+    def admin_args(sp):
+        sp.add_argument("--admin-path", default="./corro-sim-admin.sock")
+
+    pq = sub.add_parser("query", help="run a SELECT against an agent")
+    api_args(pq)
+    pq.add_argument("--raw", action="store_true", help="print raw events")
+    pq.add_argument("sql")
+    pq.set_defaults(fn=_cmd_query)
+
+    pe = sub.add_parser("exec", help="execute DML statements (one tx)")
+    api_args(pe)
+    pe.add_argument("sql", nargs="+")
+    pe.set_defaults(fn=_cmd_exec)
+
+    pbk = sub.add_parser("backup", help="portable actor-neutral snapshot")
+    admin_args(pbk)
+    pbk.add_argument("--node", type=int, default=0)
+    pbk.add_argument("path")
+    pbk.set_defaults(fn=_cmd_backup)
+
+    prs = sub.add_parser("restore", help="restore a backup into the agent")
+    admin_args(prs)
+    prs.add_argument("--node", type=int, default=0)
+    prs.add_argument("path")
+    prs.set_defaults(fn=_cmd_restore)
+
+    pl = sub.add_parser("locks", help="lock registry dump")
+    admin_args(pl)
+    pl.add_argument("--top", type=int)
+    pl.set_defaults(fn=_cmd_locks)
+
+    psy = sub.add_parser("sync", help="generate a node's sync state")
+    admin_args(psy)
+    psy.add_argument("--node", type=int, default=0)
+    psy.set_defaults(fn=_cmd_sync)
+
+    pac = sub.add_parser("actor", help="actor version bookkeeping")
+    admin_args(pac)
+    pac.add_argument("actor", type=int)
+    pac.set_defaults(fn=_cmd_actor)
+
+    psb = sub.add_parser("subs", help="list/inspect subscriptions")
+    admin_args(psb)
+    psb.add_argument("id", nargs="?")
+    psb.set_defaults(fn=_cmd_subs)
+
+    pc = sub.add_parser("cluster", help="membership introspection")
+    admin_args(pc)
+    pc.add_argument("what", choices=["members", "membership-states"])
+    pc.set_defaults(fn=_cmd_cluster)
     return p
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout piped into a pager/head that exited — standard CLI manners
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
 
 
 if __name__ == "__main__":

@@ -73,12 +73,22 @@ class LiveCluster:
         capacities: dict | None = None,
         cfg_overrides: dict | None = None,
         tripwire: Tripwire | None = None,
+        layout: TableLayout | None = None,
+        universe: LiveUniverse | None = None,
     ):
-        schema = parse_and_constrain(schema_sql)
-        self.layout = TableLayout(
-            schema, capacities=capacities, default_capacity=default_capacity
-        )
-        self.universe = LiveUniverse()
+        # layout/universe injection is the warm-boot path: checkpoint
+        # restore rebuilds them with their exact slot/rank assignments
+        # (BookedVersions::from_conn analog, agent.rs:1334-1403).
+        if layout is not None:
+            self.layout = layout
+        else:
+            schema = parse_and_constrain(schema_sql)
+            self.layout = TableLayout(
+                schema, capacities=capacities,
+                default_capacity=default_capacity,
+            )
+        self._schema_history: list[str] = [schema_sql]
+        self.universe = universe if universe is not None else LiveUniverse()
         self.locks = LockRegistry()
         self.tripwire = tripwire or Tripwire()
         self._lock = threading.RLock()
@@ -603,12 +613,13 @@ class LiveCluster:
             self._part = np.asarray(part, np.int32)
 
     # --------------------------------------------------------- migrations
-    def migrate(self, schema_sql: str) -> dict:
+    def migrate(self, schema_sql: str, capacities: dict | None = None) -> dict:
         """POST /v1/migrations analog: diff-based, additive-only
         (``apply_schema``, ``corro-types/src/schema.rs:274-646``)."""
         with self.locks.tracked(self._lock, "migrate", "write"):
             new_schema = parse_and_constrain(schema_sql)
-            plan = self.layout.migrate(new_schema)
+            plan = self.layout.migrate(new_schema, capacities=capacities)
+            self._schema_history.append(schema_sql)
             new_rows = self.layout.num_rows
             new_cols = max(self.layout.num_cols, 1)
             grew = (

@@ -1,0 +1,411 @@
+"""Checkpoint / backup / restore — the reference's durability surface.
+
+Three operations, mirroring SURVEY §5 "Checkpoint / resume":
+
+- :func:`save_checkpoint` / :func:`load_checkpoint` — warm-boot resume:
+  everything a restarted agent reloads from disk in the reference
+  (bookkeeping ``BookedVersions::from_conn`` ``agent.rs:1334-1403``,
+  buffered changes, member state, subscriptions ``setup.rs:224-277``)
+  comes back: state tensors, value universe, slot layout, config, PRNG
+  position, and registered subscriptions under their original ids.
+
+- :func:`backup` — ``corrosion backup`` (``corrosion/src/main.rs:155-220``):
+  a *portable, actor-neutral* snapshot. The origin node's actor ordinal is
+  rewritten to 0 (the reference rewrites the crsql ``site_id`` ordinal-0
+  row), and volatile per-run state is scrubbed: subscriptions, gossip
+  in-flight buffers, SWIM membership (``__corro_members``/``__corro_subs``
+  scrub in the reference).
+
+- :func:`restore` / :func:`restore_into` — ``corrosion restore``
+  (``main.rs:221-324``): swaps the desired actor ordinal back in (site_id
+  swap + clock-table rewrite analog = a full actor-relabel permutation
+  over every actor-indexed tensor), wipes subscriptions, and — for
+  :func:`restore_into` — installs the data under the running cluster's
+  write lock, the moral equivalent of the byte-range-locked live file swap
+  in ``sqlite3-restore/src/lib.rs:16-57``.
+
+File format: one ``.npz`` holding the flax state-dict tensors plus a JSON
+metadata blob (config, schema history, interned values, slot allocations,
+subscriptions, counters).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import io as _io
+import json
+
+import flax.serialization
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 2
+
+
+# ------------------------------------------------------------- value codec
+
+def _enc_value(v):
+    """Tag a SQLite value for JSON transport (bytes aren't JSON)."""
+    if v is None:
+        return ["n"]
+    if isinstance(v, bool):
+        return ["i", int(v)]
+    if isinstance(v, int):
+        return ["i", v]
+    if isinstance(v, float):
+        return ["f", v]
+    if isinstance(v, str):
+        return ["s", v]
+    if isinstance(v, (bytes, bytearray)):
+        return ["b", base64.b64encode(bytes(v)).decode()]
+    raise TypeError(f"not a SQLite value: {type(v)!r}")
+
+
+def _dec_value(t):
+    tag = t[0]
+    if tag == "n":
+        return None
+    if tag == "i":
+        return int(t[1])
+    if tag == "f":
+        return float(t[1])
+    if tag == "s":
+        return t[1]
+    if tag == "b":
+        return base64.b64decode(t[1])
+    raise ValueError(f"bad value tag {tag!r}")
+
+
+# ------------------------------------------------------------ state (de)ser
+
+def _flatten(d, prefix=""):
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = np.asarray(v)
+    return out
+
+
+def _unflatten(flat):
+    out: dict = {}
+    for key, v in flat.items():
+        parts = key.split("/")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out
+
+
+def _meta_of(cluster, scrub: bool, origin_node: int) -> dict:
+    values, ranks = cluster.universe.snapshot()
+    layout = cluster.layout
+    slots = {}
+    for name in layout.schema.tables:
+        start, cap = layout._ranges[name]
+        # pk tuples in slot order — re-allocation replays identically
+        per = [None] * layout._used[name]
+        for (t, pk), slot in layout._slots.items():
+            if t == name:
+                per[slot - start] = [_enc_value(p) for p in pk]
+        slots[name] = per
+    subs = []
+    if not scrub:
+        for sub_id, m in cluster.subs._by_id.items():
+            subs.append(
+                {
+                    "id": sub_id,
+                    "sql": m.select.normalized(),
+                    "node": m.node,
+                    "change_id": m.change_id,
+                }
+            )
+    return {
+        "format": FORMAT_VERSION,
+        "scrubbed": scrub,
+        "origin_node": origin_node,
+        "cfg": dataclasses.asdict(cluster.cfg),
+        "seed": cluster._seed,
+        "rounds_ticked": cluster._rounds_ticked,
+        "totals": cluster._totals,
+        "alive": cluster._alive.astype(int).tolist(),
+        "partition": np.asarray(cluster._part).tolist(),
+        "schema_history": list(cluster._schema_history),
+        "universe": {
+            "values": [_enc_value(v) for v in values],
+            "ranks": [int(r) for r in ranks],
+        },
+        "layout": {
+            "ranges": {
+                t: list(r) for t, r in layout._ranges.items()
+            },
+            "cols": [
+                [t, c, plane] for (t, c), plane in layout._cols.items()
+            ],
+            "slots": slots,
+            "default_capacity": layout.default_capacity,
+            "generation": layout.generation,
+        },
+        "subs": subs,
+    }
+
+
+# --------------------------------------------------------- actor relabeling
+
+def _relabel_values(arr: np.ndarray, a: int, b: int) -> np.ndarray:
+    """Swap actor ids a<->b where stored as *values* (site/actor fields);
+    sentinels (negatives) pass through."""
+    out = arr.copy()
+    out[arr == a] = b
+    out[arr == b] = a
+    return out
+
+
+def _swap_axis(arr: np.ndarray, a: int, b: int, axis: int) -> np.ndarray:
+    idx = [slice(None)] * arr.ndim
+    out = arr.copy()
+    ia, ib = list(idx), list(idx)
+    ia[axis], ib[axis] = a, b
+    out[tuple(ia)], out[tuple(ib)] = arr[tuple(ib)], arr[tuple(ia)]
+    return out
+
+
+def _permute_actors(sd: dict, a: int, b: int) -> dict:
+    """Apply the actor relabel a<->b to a SimState state-dict.
+
+    In the simulator node ordinal == actor id (SURVEY §2.5: the node axis
+    is the parallel axis), so the reference's site_id swap + clock-table
+    rewrite (``main.rs:221-324``) becomes one permutation applied to every
+    node-axis *and* every actor-valued tensor."""
+    if a == b:
+        return sd
+    table = sd["table"]
+    for f in ("cv", "vr", "site", "cl"):
+        table[f] = _swap_axis(table[f], a, b, 0)
+    table["site"] = _relabel_values(table["site"], a, b)
+    book = sd["book"]
+    for f in book:
+        book[f] = _swap_axis(_swap_axis(book[f], a, b, 0), a, b, 1)
+    log = sd["log"]
+    for f in log:
+        log[f] = _swap_axis(log[f], a, b, 0)
+    own = sd["own"]
+    for f in ("site", "actor", "ractor", "rsite"):
+        own[f] = _relabel_values(own[f], a, b)
+    for f in ("hlc", "last_cleared"):
+        sd[f] = _swap_axis(sd[f], a, b, 0)
+    return sd
+
+
+# ------------------------------------------------------------------- public
+
+def save_checkpoint(cluster, path, *, scrub: bool = False,
+                    origin_node: int = 0) -> None:
+    """Serialize a LiveCluster to ``path`` (.npz)."""
+    with cluster._lock:
+        meta = _meta_of(cluster, scrub, origin_node)
+        sd = flax.serialization.to_state_dict(cluster.state)
+        flat = _flatten(sd)
+        if scrub:
+            # __corro_members / __corro_subs / in-flight buffers scrub:
+            # gossip + swim state do not travel in a portable backup
+            flat = {
+                k: v for k, v in flat.items()
+                if not (k.startswith("gossip/") or k.startswith("swim/"))
+            }
+            if origin_node != 0:
+                nested = _unflatten(flat)
+                nested = _permute_actors(nested, origin_node, 0)
+                flat = _flatten(nested)
+        buf = _io.BytesIO()
+        np.savez_compressed(
+            buf, __meta__=np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8
+            ), **flat,
+        )
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+
+def _read(path):
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        flat = {k: z[k] for k in z.files if k != "__meta__"}
+    if meta.get("format") != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported checkpoint format {meta.get('format')!r}"
+        )
+    return meta, flat
+
+
+def _rebuild_layout(meta):
+    from corro_sim.schema import TableLayout, parse_and_constrain
+
+    lm = meta["layout"]
+    schema = parse_and_constrain(meta["schema_history"][-1])
+    layout = TableLayout.__new__(TableLayout)
+    layout.schema = schema
+    layout._ranges = {t: tuple(r) for t, r in lm["ranges"].items()}
+    layout._used = {t: len(s) for t, s in lm["slots"].items()}
+    layout._cols = {(t, c): plane for t, c, plane in lm["cols"]}
+    layout._slots = {}
+    layout._by_slot = {}
+    for t, per in lm["slots"].items():
+        start, _cap = layout._ranges[t]
+        for i, pk_enc in enumerate(per):
+            pk = tuple(_dec_value(p) for p in pk_enc)
+            layout._slots[(t, pk)] = start + i
+            layout._by_slot[start + i] = (t, pk)
+    layout._next_row = max(
+        (start + cap for start, cap in layout._ranges.values()), default=0
+    )
+    layout.default_capacity = lm["default_capacity"]
+    layout.generation = lm["generation"]
+    return layout
+
+
+def _cluster_from_meta(meta, tripwire=None):
+    from corro_sim.harness.cluster import LiveCluster
+    from corro_sim.io.values import LiveUniverse
+
+    cfg = dict(meta["cfg"])
+    num_nodes = cfg.pop("num_nodes")
+    for k in ("num_rows", "num_cols"):
+        cfg.pop(k)  # derived from the layout
+    layout = _rebuild_layout(meta)
+    universe = LiveUniverse.restore(
+        [_dec_value(v) for v in meta["universe"]["values"]],
+        meta["universe"]["ranks"],
+    )
+    cluster = LiveCluster(
+        meta["schema_history"][-1],
+        num_nodes=num_nodes,
+        seed=meta["seed"],
+        cfg_overrides=cfg,
+        tripwire=tripwire,
+        layout=layout,
+        universe=universe,
+    )
+    cluster._schema_history = list(meta["schema_history"])
+    return cluster
+
+
+def load_checkpoint(path, tripwire=None):
+    """Warm-boot a LiveCluster from a checkpoint file."""
+    meta, flat = _read(path)
+    cluster = _cluster_from_meta(meta, tripwire)
+    _install(cluster, meta, flat, node=None)
+    # warm boot restores subscriptions under their original ids
+    for s in meta["subs"]:
+        cluster.subs.restore_sub(
+            s["id"], s["sql"], s["node"], cluster.state.table,
+            change_id=s["change_id"],
+        )
+        cluster._sub_queues.setdefault(s["id"], [])
+    return cluster
+
+
+def _install(cluster, meta, flat, node):
+    """Write tensors + counters into ``cluster`` (shapes must match)."""
+    nested = _unflatten(flat)
+    if node is not None and node != 0:
+        nested = _permute_actors(nested, 0, node)
+    base = flax.serialization.to_state_dict(cluster.state)
+
+    def merge(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict):
+                merge(dst[k], v)
+            else:
+                if tuple(dst[k].shape) != tuple(v.shape):
+                    raise ValueError(
+                        f"shape mismatch for {k}: checkpoint "
+                        f"{tuple(v.shape)} vs cluster {tuple(dst[k].shape)}"
+                    )
+                dst[k] = jnp.asarray(v)
+
+    merge(base, nested)
+    cluster.state = flax.serialization.from_state_dict(cluster.state, base)
+    cluster._rounds_ticked = meta["rounds_ticked"]
+    cluster._totals = dict(meta["totals"])
+    cluster._alive = np.asarray(meta["alive"], bool)
+    cluster._part = np.asarray(meta["partition"], np.int32)
+
+
+def backup(cluster, path, node: int = 0) -> None:
+    """Portable actor-neutral snapshot (``corrosion backup`` analog)."""
+    cluster._check_node(node)
+    save_checkpoint(cluster, path, scrub=True, origin_node=node)
+
+
+def restore(path, node: int = 0, tripwire=None):
+    """Build a fresh LiveCluster from a backup, assuming actor ``node``
+    (``corrosion restore`` analog: site_id swap-back + subs wipe)."""
+    meta, flat = _read(path)
+    # restore() treats any file as a portable backup: volatile per-run
+    # state (subs, gossip buffers, SWIM membership, topology) never
+    # survives a restore — the target re-derives its own.
+    meta = {**meta, "subs": []}
+    flat = {
+        k: v for k, v in flat.items()
+        if not k.startswith(("gossip/", "swim/", "ring0", "row_cdf"))
+    }
+    cluster = _cluster_from_meta(meta, tripwire)
+    if node >= cluster.cfg.num_nodes:
+        raise ValueError(
+            f"node {node} out of range for cluster of "
+            f"{cluster.cfg.num_nodes}"
+        )
+    _install(cluster, meta, flat, node=node)
+    return cluster
+
+
+def restore_into(cluster, path, node: int = 0) -> None:
+    """Swap a backup's data into a *running* cluster under its write lock
+    — the live-readers-safe restore (``sqlite3-restore`` byte-lock swap).
+
+    The cluster keeps its identity, config shapes, gossip/SWIM state and
+    HTTP surface; table data, bookkeeping, change log, value universe and
+    slot layout are replaced wholesale; subscriptions are wiped
+    (the reference restore wipes ``__corro_subs``)."""
+    meta, flat = _read(path)
+    # volatile per-run state never crosses a restore (same filter as
+    # restore()): the running cluster keeps its own topology + membership
+    flat = {
+        k: v for k, v in flat.items()
+        if not k.startswith(("gossip/", "swim/", "ring0", "row_cdf"))
+    }
+    with cluster.locks.tracked(cluster._lock, "restore", "write"):
+        new_layout = _rebuild_layout(meta)
+        # validate EVERYTHING before mutating: a failure below this block
+        # would leave the cluster half-swapped
+        base = _flatten(flax.serialization.to_state_dict(cluster.state))
+        for k, v in flat.items():
+            if k not in base:
+                raise ValueError(f"unknown tensor {k!r} in backup")
+            if tuple(base[k].shape) != tuple(v.shape):
+                raise ValueError(
+                    f"backup shape mismatch for {k}: "
+                    f"{tuple(v.shape)} vs cluster {tuple(base[k].shape)} "
+                    "(restore_into needs an identically-shaped cluster)"
+                )
+        from corro_sim.io.values import LiveUniverse
+
+        for sub_id in list(cluster.subs._by_id):
+            cluster.subs.remove(sub_id)
+        cluster._sub_queues.clear()
+        cluster._query_cache.clear()
+        cluster.layout = new_layout
+        cluster.universe = LiveUniverse.restore(
+            [_dec_value(v) for v in meta["universe"]["values"]],
+            meta["universe"]["ranks"],
+        )
+        cluster.universe.on_remap(cluster._on_remap)
+        cluster.subs.universe = cluster.universe
+        cluster.subs.layout._layout = new_layout
+        cluster._schema_history = list(meta["schema_history"])
+        _install(cluster, meta, flat, node=node)

@@ -95,6 +95,22 @@ class LiveUniverse:
     def __len__(self) -> int:
         return len(self._values)
 
+    @classmethod
+    def restore(cls, values, ranks) -> "LiveUniverse":
+        """Rebuild a universe with its exact value→rank assignment (warm
+        checkpoint restore: stored tensors hold these ranks)."""
+        u = cls()
+        vals = [_hashable(v) for v in values]
+        u._values = list(vals)
+        u._keys = [sqlite_sort_key(v) for v in vals]
+        u._ranks = [int(r) for r in ranks]
+        u._by_value = dict(zip(vals, u._ranks))
+        return u
+
+    def snapshot(self) -> tuple[list, list[int]]:
+        """(values, ranks) parallel lists — feed to :meth:`restore`."""
+        return list(self._values), list(self._ranks)
+
     def on_remap(self, fn) -> None:
         """``fn(old_ranks: list[int], new_ranks: list[int])`` — called with
         parallel arrays whenever the space is re-spaced."""

@@ -23,7 +23,6 @@ all collapse into one vectorized compare.
 from __future__ import annotations
 
 import dataclasses
-import itertools
 
 import jax
 import jax.numpy as jnp
@@ -340,6 +339,10 @@ class Matcher:
         (subscriber must re-subscribe — the reference 404s the range)."""
         if self._events and self._events[0].change_id > from_change_id + 1:
             return None
+        if not self._events and from_change_id < self._change_id:
+            # buffer gone (warm-boot restore / purge) but ids advanced past
+            # `from` — the gap is unservable, same 404 as compaction
+            return None
         if from_change_id > self._change_id:
             return None
         return [e for e in self._events if e.change_id > from_change_id]
@@ -449,7 +452,7 @@ class SubsManager:
         self.max_buffer = max_buffer
         self._by_id: dict[str, Matcher] = {}
         self._by_query: dict[tuple, str] = {}
-        self._ids = itertools.count()
+        self._next_id = 0
 
     def get_or_insert(self, sql: str, node: int, table_state):
         """Returns (matcher, initial_events | None) — None when deduped to
@@ -459,7 +462,8 @@ class SubsManager:
         sub_id = self._by_query.get(key)
         if sub_id is not None:
             return self._by_id[sub_id], None
-        sub_id = f"sub-{next(self._ids)}"
+        sub_id = f"sub-{self._next_id}"
+        self._next_id += 1
         m = Matcher(
             sub_id, select, node, self.layout, self.universe,
             max_buffer=self.max_buffer,
@@ -468,6 +472,32 @@ class SubsManager:
         self._by_id[sub_id] = m
         self._by_query[key] = sub_id
         return m, initial
+
+    def restore_sub(
+        self, sub_id: str, sql: str, node: int, table_state,
+        change_id: int = 0,
+    ) -> Matcher:
+        """Re-register a persisted subscription under its original id —
+        warm-boot restore (``setup_spawn_subscriptions``,
+        ``agent/setup.rs:224-277``). The event buffer is gone (clients
+        whose ``from`` predates the restart re-subscribe), but the change
+        id continues from where it was so ids never regress."""
+        select = parse_query(sql)
+        m = Matcher(
+            sub_id, select, node, self.layout, self.universe,
+            max_buffer=self.max_buffer,
+        )
+        m.prime(table_state)
+        m._change_id = max(m._change_id, change_id)
+        self._by_id[sub_id] = m
+        self._by_query[(select.normalized(), node)] = sub_id
+        # keep generated ids clear of restored ones
+        try:
+            n = int(sub_id.rsplit("-", 1)[1])
+            self._next_id = max(self._next_id, n + 1)
+        except (IndexError, ValueError):
+            pass
+        return m
 
     def get(self, sub_id: str) -> Matcher | None:
         return self._by_id.get(sub_id)

@@ -165,6 +165,10 @@ def _tokenize(sql: str):
                 "SELECT", "FROM", "WHERE", "AND", "OR", "NOT", "IS", "NULL",
             ):
                 out.append((kw, kw))
+            elif kw == "TRUE":  # SQLite boolean keywords are 1/0 literals
+                out.append(("lit", 1))
+            elif kw == "FALSE":
+                out.append(("lit", 0))
             else:
                 out.append(("ident", w))
     out.append(("eof", None))

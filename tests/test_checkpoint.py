@@ -1,0 +1,211 @@
+"""Checkpoint / backup / restore (SURVEY §5 checkpoint-resume parity).
+
+Warm-boot resume restores everything the reference reloads from disk
+(bookkeeping, subs, member aliveness); `backup` is actor-neutral and
+scrubbed like ``corrosion backup`` (``main.rs:155-220``); `restore`
+swaps the actor ordinal back and wipes subs (``main.rs:221-324``);
+`restore_into` swaps data under a live cluster.
+"""
+
+import numpy as np
+import pytest
+
+from corro_sim.harness.cluster import LiveCluster
+from corro_sim.io.checkpoint import (
+    backup,
+    load_checkpoint,
+    restore,
+    restore_into,
+    save_checkpoint,
+)
+
+SCHEMA = """
+CREATE TABLE kv (
+    k TEXT PRIMARY KEY,
+    v TEXT NOT NULL DEFAULT '',
+    n INTEGER NOT NULL DEFAULT 0
+);
+"""
+
+
+def make_cluster(**kw):
+    kw.setdefault("num_nodes", 4)
+    kw.setdefault("default_capacity", 32)
+    return LiveCluster(SCHEMA, **kw)
+
+
+def seeded_cluster():
+    c = make_cluster()
+    c.execute([["INSERT INTO kv (k, v, n) VALUES (?, ?, ?)", ["a", "x", 1]]],
+              node=0)
+    c.execute([["INSERT INTO kv (k, v, n) VALUES (?, ?, ?)", ["b", "y", 2]]],
+              node=2)
+    c.execute(["UPDATE kv SET v = 'xx' WHERE k = 'a'"], node=1)
+    c.run_until_converged()
+    return c
+
+
+def test_warm_checkpoint_roundtrip(tmp_path):
+    c = seeded_cluster()
+    sub_id, _ = c.subscribe("SELECT k, v FROM kv WHERE n >= 1", node=3)
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(c, path)
+
+    r = load_checkpoint(path)
+    # data identical on every node
+    for node in range(4):
+        assert r.query_rows("SELECT k, v, n FROM kv", node=node) == \
+            c.query_rows("SELECT k, v, n FROM kv", node=node)
+    # bookkeeping identical (applied heads)
+    assert np.array_equal(
+        np.asarray(r.state.book.head), np.asarray(c.state.book.head)
+    )
+    # subscription back under its original id, change id preserved
+    m = r.subs.get(sub_id)
+    assert m is not None
+    assert m.change_id == c.subs.get(sub_id).change_id
+    # the restored cluster keeps working: write + converge + sub fires
+    _, q = r.sub_attach(sub_id, skip_rows=True)
+    r.execute([["INSERT INTO kv (k, v, n) VALUES (?, ?, ?)",
+                ["c", "z", 3]]], node=1)
+    r.run_until_converged()
+    assert any("change" in (e if isinstance(e, dict) else e.as_json())
+               for e in q)
+
+
+def test_warm_checkpoint_resumes_prng_position(tmp_path):
+    c = seeded_cluster()
+    path = tmp_path / "ckpt.npz"
+    save_checkpoint(c, path)
+    r = load_checkpoint(path)
+    # same tick count → the same fold_in stream → identical next rounds
+    c.tick(3)
+    r.tick(3)
+    assert np.array_equal(
+        np.asarray(c.state.table.vr), np.asarray(r.state.table.vr)
+    )
+    assert np.array_equal(
+        np.asarray(c.state.book.head), np.asarray(r.state.book.head)
+    )
+
+
+def test_backup_is_scrubbed_and_restores_elsewhere(tmp_path):
+    c = seeded_cluster()
+    c.subscribe("SELECT k FROM kv", node=1)
+    path = tmp_path / "backup.npz"
+    backup(c, path, node=2)
+
+    r = restore(path, node=2)
+    assert len(r.subs) == 0  # subs wiped (reference wipes __corro_subs)
+    for node in range(4):
+        assert r.query_rows("SELECT k, v, n FROM kv", node=node) == \
+            c.query_rows("SELECT k, v, n FROM kv", node=node)
+    # still a working cluster
+    r.execute([["INSERT INTO kv (k, v) VALUES (?, ?)", ["new", "w"]]])
+    r.run_until_converged()
+    _, rows = r.query_rows("SELECT k FROM kv WHERE k = 'new'", node=3)
+    assert rows == [["new"]]
+
+
+def test_backup_actor_neutral_identity_swap(tmp_path):
+    """Backing up as node 2 and restoring as node 1 relabels actor 2's
+    authorship to actor 1 — the site_id ordinal swap."""
+    c = seeded_cluster()  # 'b' was written by node 2
+    path = tmp_path / "neutral.npz"
+    backup(c, path, node=2)
+    r = restore(path, node=1)
+    # row 'b' exists with the same value everywhere
+    _, rows = r.query_rows("SELECT k, v FROM kv WHERE k = 'b'", node=0)
+    assert rows == [["b", "y"]]
+    # authorship moved: versions written by old actor 2 now belong to 1
+    old_heads = np.asarray(c.state.log.head)
+    new_heads = np.asarray(r.state.log.head)
+    assert new_heads[1] == old_heads[2]
+    assert new_heads[2] == old_heads[1]
+
+
+def test_restore_into_live_cluster(tmp_path):
+    c = seeded_cluster()
+    path = tmp_path / "b.npz"
+    backup(c, path, node=0)
+
+    other = make_cluster()
+    other.execute([["INSERT INTO kv (k, v) VALUES (?, ?)", ["junk", "j"]]])
+    other.subscribe("SELECT k FROM kv")
+    restore_into(other, path, node=0)
+    assert len(other.subs) == 0
+    _, rows = other.query_rows("SELECT k, v, n FROM kv", node=0)
+    assert sorted(r[0] for r in rows) == ["a", "b"]  # junk is gone
+    # live afterwards: writes, gossip, queries all work
+    other.execute([["INSERT INTO kv (k, v) VALUES (?, ?)", ["post", "p"]]],
+                  node=3)
+    other.run_until_converged()
+    _, rows = other.query_rows("SELECT k FROM kv WHERE k = 'post'", node=1)
+    assert rows == [["post"]]
+
+
+def test_restore_into_shape_mismatch_rejected(tmp_path):
+    c = seeded_cluster()
+    path = tmp_path / "b.npz"
+    backup(c, path)
+    small = LiveCluster(SCHEMA, num_nodes=2, default_capacity=32)
+    with pytest.raises(ValueError):
+        restore_into(small, path)
+
+
+def test_checkpoint_after_migration(tmp_path):
+    c = seeded_cluster()
+    c.migrate(SCHEMA + "CREATE TABLE t2 (id INTEGER PRIMARY KEY, "
+                       "w TEXT NOT NULL DEFAULT '');")
+    c.execute([["INSERT INTO t2 (id, w) VALUES (?, ?)", [1, "m"]]])
+    c.run_until_converged()
+    path = tmp_path / "mig.npz"
+    save_checkpoint(c, path)
+    r = load_checkpoint(path)
+    _, rows = r.query_rows("SELECT id, w FROM t2", node=2)
+    assert rows == [[1, "m"]]
+    # migrated layout still grows correctly after restore
+    r.migrate(SCHEMA
+              + "CREATE TABLE t2 (id INTEGER PRIMARY KEY, "
+                "w TEXT NOT NULL DEFAULT '');"
+              + "CREATE TABLE t3 (id INTEGER PRIMARY KEY);")
+    r.execute(["INSERT INTO t3 (id) VALUES (9)"])
+    _, rows = r.query_rows("SELECT id FROM t3")
+    assert rows == [[9]]
+
+
+def test_restore_into_smaller_backup_rejected_without_corruption(tmp_path):
+    """A shape mismatch must be detected BEFORE any cluster state mutates."""
+    small = LiveCluster(SCHEMA, num_nodes=4, default_capacity=16)
+    small.execute([["INSERT INTO kv (k, v) VALUES (?, ?)", ["s", "small"]]])
+    path = tmp_path / "small.npz"
+    backup(small, path)
+
+    big = make_cluster()  # capacity 32 → different row shapes
+    big.execute([["INSERT INTO kv (k, v) VALUES (?, ?)", ["keep", "me"]]])
+    sub_id, _ = big.subscribe("SELECT k FROM kv")
+    with pytest.raises(ValueError):
+        restore_into(big, path)
+    # nothing was mutated: data, subs, layout all intact
+    _, rows = big.query_rows("SELECT k, v FROM kv")
+    assert rows == [["keep", "me"]]
+    assert big.subs.get(sub_id) is not None
+    big.execute([["INSERT INTO kv (k, v) VALUES (?, ?)", ["still", "up"]]])
+    _, rows = big.query_rows("SELECT k FROM kv WHERE k = 'still'")
+    assert rows == [["still"]]
+
+
+def test_warm_restore_catch_up_past_buffer_404s(tmp_path):
+    """After a warm boot the event buffer is empty; a client whose `from`
+    predates the restart must get the 404 (None), not silent loss."""
+    c = seeded_cluster()
+    sub_id, _ = c.subscribe("SELECT k FROM kv", node=0)
+    c.execute(["INSERT INTO kv (k) VALUES ('evt')"])
+    c.run_until_converged()
+    assert c.subs.get(sub_id).change_id >= 1
+    path = tmp_path / "warm.npz"
+    save_checkpoint(c, path)
+    r = load_checkpoint(path)
+    assert r.sub_catch_up(sub_id, 0) is None  # unservable gap → 404
+    init, q = r.sub_attach(sub_id, from_change_id=None, skip_rows=False)
+    assert init is not None and q is not None  # full re-prime still works
