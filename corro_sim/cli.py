@@ -210,6 +210,51 @@ def _cmd_cluster(args: argparse.Namespace) -> int:
     return _print_json(_admin(args).call("cluster_membership_states"))
 
 
+def _cmd_template(args: argparse.Namespace) -> int:
+    """`corrosion template` — render + live re-render config files
+    (``corrosion/src/command/tpl.rs``)."""
+    from corro_sim.tpl import TemplateWatcher
+    from corro_sim.utils.runtime import Tripwire
+
+    src, _, dst = args.template.partition(":")
+    if not dst:
+        dst = src + ".out"
+    w = TemplateWatcher(
+        _client(args), src, dst, node=args.node,
+        tripwire=Tripwire.new_signals(),
+    )
+    if args.once:
+        w.render_once()
+        return 0
+    w.run()
+    return 0
+
+
+def _cmd_consul_sync(args: argparse.Namespace) -> int:
+    """`corrosion consul sync` — poll the local Consul agent and mirror
+    services/checks into the cluster (``command/consul/sync.rs``)."""
+    from corro_sim.integrations.consul import (
+        ConsulAgentClient,
+        ConsulSync,
+        FileConsulSource,
+    )
+    from corro_sim.utils.runtime import Tripwire
+
+    source = (
+        FileConsulSource(args.consul_file) if args.consul_file
+        else ConsulAgentClient(args.consul_addr)
+    )
+    sync = ConsulSync(
+        source, _client(args), node_name=args.node_name,
+        state_path=args.state_path, target_node=args.node,
+    )
+    if args.once:
+        print(json.dumps(sync.sync_once()))
+        return 0
+    sync.run(Tripwire.new_signals(), interval=args.interval)
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="corro-sim",
@@ -307,6 +352,31 @@ def build_parser() -> argparse.ArgumentParser:
     admin_args(pc)
     pc.add_argument("what", choices=["members", "membership-states"])
     pc.set_defaults(fn=_cmd_cluster)
+
+    pt = sub.add_parser(
+        "template", help="render a template (live re-render on change)"
+    )
+    api_args(pt)
+    pt.add_argument("template", help="src[:dst] template/output paths")
+    pt.add_argument("--once", action="store_true")
+    pt.set_defaults(fn=_cmd_template)
+
+    pcs = sub.add_parser(
+        "consul-sync", help="mirror Consul services/checks into the cluster"
+    )
+    api_args(pcs)
+    pcs.add_argument("--consul-addr", default="http://127.0.0.1:8500")
+    pcs.add_argument("--consul-file",
+                     help="JSON file source instead of a live agent")
+    pcs.add_argument("--node-name", default="corro-sim-node")
+    pcs.add_argument(
+        "--state-path", default="./corro-consul-state.json",
+        help="hash-state sidecar file (persisting it lets deletions that "
+             "happen while the daemon is down propagate on restart)",
+    )
+    pcs.add_argument("--interval", type=float, default=1.0)
+    pcs.add_argument("--once", action="store_true")
+    pcs.set_defaults(fn=_cmd_consul_sync)
     return p
 
 

@@ -1,0 +1,265 @@
+"""Template engine (corro-tpl analog) + Consul sync daemon.
+
+Template behaviors from ``crates/corro-tpl/src/lib.rs``: sql() iteration,
+to_json/to_csv serialization, hostname(), live re-render when a watched
+query's results change. Consul behaviors from
+``corrosion/src/command/consul/sync.rs``: hash-diffed upserts, deletes of
+vanished entities, app_id extraction, hash-state persistence.
+"""
+
+import json
+import socket
+
+import pytest
+
+from corro_sim.api.http import ApiServer
+from corro_sim.client import ApiClient
+from corro_sim.harness.cluster import LiveCluster
+from corro_sim.integrations.consul import (
+    ConsulSync,
+    FileConsulSource,
+    app_id_of,
+    hash_check,
+    hash_service,
+)
+from corro_sim.schema import consul_schema_sql
+from corro_sim.tpl import (
+    Engine,
+    TemplateError,
+    TemplateWatcher,
+    compile_template,
+    wait_for_render,
+)
+
+SCHEMA = """
+CREATE TABLE upstreams (
+    name TEXT PRIMARY KEY,
+    addr TEXT NOT NULL DEFAULT '',
+    port INTEGER NOT NULL DEFAULT 0,
+    weight INTEGER NOT NULL DEFAULT 1
+);
+"""
+
+
+@pytest.fixture(scope="module")
+def rig():
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=32)
+    with ApiServer(cluster, tick_interval=0.05) as srv:
+        client = ApiClient(srv.addr, timeout=60)
+        client.execute(
+            [["INSERT INTO upstreams (name, addr, port, weight) VALUES "
+              "(?, ?, ?, ?)", ["web", "10.0.0.1", 8080, 2]],
+             ["INSERT INTO upstreams (name, addr, port) VALUES (?, ?, ?)",
+              ["api", "10.0.0.2", 9090]]]
+        )
+        yield cluster, client
+    cluster.tripwire.trip()
+
+
+def test_template_loop_and_expr(rig):
+    _, client = rig
+    out, queries = Engine(client).render(
+        "# upstreams\n"
+        "<% for u in sql(\"SELECT name, addr, port FROM upstreams\") %>"
+        "server <%= u.name %> <%= u.addr %>:<%= u.port %>\n"
+        "<% end %>"
+    )
+    assert "server web 10.0.0.1:8080" in out
+    assert "server api 10.0.0.2:9090" in out
+    assert len(queries) == 1
+
+
+def test_template_if_else_and_hostname(rig):
+    _, client = rig
+    out, _ = Engine(client).render(
+        "<% for u in sql(\"SELECT name, weight FROM upstreams\") %>"
+        "<% if u.weight > 1 %>H <%= u.name %><% else %>L <%= u.name %>"
+        "<% end %><% end %> @<%= hostname() %>"
+    )
+    assert "H web" in out and "L api" in out
+    assert socket.gethostname() in out
+
+
+def test_template_to_json_and_csv(rig):
+    _, client = rig
+    out, _ = Engine(client).render(
+        "<%= sql(\"SELECT name, port FROM upstreams\").to_json() %>"
+    )
+    rows = [json.loads(line) for line in out.splitlines()]
+    assert {"name": "web", "port": 8080} in rows
+    out, _ = Engine(client).render(
+        "<%= sql(\"SELECT name, port FROM upstreams\")"
+        ".to_json(row_values_as_array=True) %>"
+    )
+    assert ["api", 9090] in [json.loads(line) for line in out.splitlines()]
+    out, _ = Engine(client).render(
+        "<%= sql(\"SELECT name, port FROM upstreams\").to_csv() %>"
+    )
+    lines = out.splitlines()
+    assert lines[0] == "name,port"
+    assert "web,8080" in lines
+
+
+def test_template_errors():
+    with pytest.raises(TemplateError):
+        compile_template("<% for x in y %> no end")
+    with pytest.raises(TemplateError):
+        compile_template("<% end %>")
+    with pytest.raises(TemplateError):
+        compile_template("<% unterminated")
+
+
+def test_template_live_rerender(rig, tmp_path):
+    _, client = rig
+    src = tmp_path / "upstreams.tpl"
+    dst = tmp_path / "upstreams.conf"
+    src.write_text(
+        "<% for u in sql(\"SELECT name, port FROM upstreams "
+        "WHERE weight >= 1\") %>"
+        "<%= u.name %>:<%= u.port %>\n<% end %>"
+    )
+    w = TemplateWatcher(client, src, dst)
+    th = w.spawn()
+    # generous timeouts: first render + subscribe each compile a matcher,
+    # which can take tens of seconds on a cold, contended CPU run
+    assert wait_for_render(w, 1, timeout=90)
+    assert "web:8080" in dst.read_text()
+    # a change to the watched query's rows must trigger a re-render
+    client.execute(
+        [["INSERT INTO upstreams (name, addr, port) VALUES (?, ?, ?)",
+          ["cache", "10.0.0.3", 6379]]]
+    )
+    assert wait_for_render(w, 2, timeout=90)
+    for _ in range(100):
+        if "cache:6379" in dst.read_text():
+            break
+        import time
+
+        time.sleep(0.05)
+    assert "cache:6379" in dst.read_text()
+    w.tripwire.trip()
+    th.join(timeout=10)
+
+
+# ---------------------------------------------------------------- consul
+
+SERVICES_V1 = {
+    "web": {
+        "ID": "web", "Service": "web-app", "Tags": ["http"],
+        "Meta": {"app_id": "42"}, "Port": 8080, "Address": "10.0.0.1",
+    },
+    "db": {
+        "ID": "db", "Service": "postgres", "Tags": [],
+        "Meta": {}, "Port": 5432, "Address": "10.0.0.2",
+    },
+}
+CHECKS_V1 = {
+    "web-check": {
+        "CheckID": "web-check", "Name": "web alive", "Status": "passing",
+        "Output": "ok", "ServiceID": "web", "ServiceName": "web-app",
+    },
+}
+
+
+@pytest.fixture()
+def consul_rig(tmp_path):
+    cluster = LiveCluster(consul_schema_sql(), num_nodes=2,
+                          default_capacity=64)
+    with ApiServer(cluster) as srv:
+        client = ApiClient(srv.addr, timeout=60)
+        agent_file = tmp_path / "consul.json"
+        agent_file.write_text(
+            json.dumps({"services": SERVICES_V1, "checks": CHECKS_V1})
+        )
+        sync = ConsulSync(
+            FileConsulSource(agent_file), client, node_name="nodeA",
+            state_path=tmp_path / "hashes.json",
+        )
+        yield cluster, client, sync, agent_file
+    cluster.tripwire.trip()
+
+
+def test_consul_initial_sync_and_idempotence(consul_rig):
+    _, client, sync, _ = consul_rig
+    stats = sync.sync_once()
+    assert stats["services_upserted"] == 2
+    assert stats["checks_upserted"] == 1
+    _, rows = client.query_rows(
+        "SELECT node, id, name, port FROM consul_services"
+    )
+    assert ["nodeA", "web", "web-app", 8080] in rows
+    assert ["nodeA", "db", "postgres", 5432] in rows
+    _, rows = client.query_rows(
+        "SELECT id, status FROM consul_checks"
+    )
+    assert ["nodeA", "web-check", "passing"] in rows
+    # second pass: hashes unchanged → zero statements
+    stats = sync.sync_once()
+    assert all(v == 0 for v in stats.values())
+
+
+def test_consul_update_and_delete(consul_rig):
+    _, client, sync, agent_file = consul_rig
+    sync.sync_once()
+    # web changes port; db disappears; check output flaps (hash-exempt)
+    services = {
+        "web": {**SERVICES_V1["web"], "Port": 8081},
+    }
+    checks = {
+        "web-check": {**CHECKS_V1["web-check"], "Output": "still ok"},
+    }
+    agent_file.write_text(
+        json.dumps({"services": services, "checks": checks})
+    )
+    stats = sync.sync_once()
+    assert stats["services_upserted"] == 1
+    assert stats["services_deleted"] == 1
+    assert stats["checks_upserted"] == 0  # output excluded from the hash
+    _, rows = client.query_rows("SELECT id, port FROM consul_services")
+    assert rows == [["nodeA", "web", 8081]]
+
+
+def test_consul_hash_state_persistence(consul_rig, tmp_path):
+    cluster, client, sync, agent_file = consul_rig
+    sync.sync_once()
+    # a new daemon instance with the same state file sees no work
+    sync2 = ConsulSync(
+        FileConsulSource(agent_file), client, node_name="nodeA",
+        state_path=sync.state_path,
+    )
+    stats = sync2.sync_once()
+    assert all(v == 0 for v in stats.values())
+
+
+def test_consul_hash_and_app_id_helpers():
+    assert hash_service(SERVICES_V1["web"]) != hash_service(
+        {**SERVICES_V1["web"], "Port": 1}
+    )
+    assert hash_check(CHECKS_V1["web-check"]) == hash_check(
+        {**CHECKS_V1["web-check"], "Output": "different"}
+    )
+    assert app_id_of(SERVICES_V1["web"]) == 42
+    assert app_id_of(SERVICES_V1["db"]) is None
+
+
+def test_template_else_prefix_identifier(rig):
+    """Identifiers beginning with 'else'/'end' keywords must not be
+    misparsed as block structure."""
+    _, client = rig
+    out, _ = Engine(client).render(
+        "<% else_count = 3 %><% endgame = 2 %><%= else_count + endgame %>"
+    )
+    assert out == "5"
+
+
+def test_consul_corrupt_state_file_recovers(consul_rig):
+    _, client, sync, agent_file = consul_rig
+    sync.sync_once()
+    with open(sync.state_path, "w") as f:
+        f.write('{"services": {tru')  # simulated crash mid-write
+    sync2 = ConsulSync(
+        FileConsulSource(agent_file), client, node_name="nodeA",
+        state_path=sync.state_path,
+    )
+    stats = sync2.sync_once()  # re-upserts idempotently, no crash
+    assert stats["services_upserted"] == 2
