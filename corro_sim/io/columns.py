@@ -68,6 +68,10 @@ def _put_int(buf: bytearray, value: int, nbytes: int) -> None:
 
 
 def _get_int(data: bytes, pos: int, nbytes: int) -> tuple[int, int]:
+    if nbytes > 8:
+        # no valid encoder emits >8 payload bytes (ints cap at 8, lengths
+        # at 4); reject so the native decoder can agree bit-for-bit
+        raise UnpackError(f"integer width {nbytes} out of range")
     if pos + nbytes > len(data):
         raise UnpackError("truncated integer")
     if nbytes == 0:

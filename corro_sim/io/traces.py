@@ -44,7 +44,7 @@ import json
 
 import numpy as np
 
-from corro_sim.io.columns import unpack_columns
+from corro_sim.io.native import unpack_columns_batch
 from corro_sim.io.values import ValueInterner, sqlite_sort_key
 
 DELETE_CID = "__crsql_del"
@@ -86,9 +86,9 @@ def _parse_val(v):
     return v
 
 
-def parse_trace_line(line: str):
-    """One ND-JSON line → :class:`TraceChangeset` or :class:`TraceEmpty`."""
-    obj = json.loads(line)
+def _build_event(obj, pks):
+    """Assemble one parsed-JSON object into a trace event, consuming its
+    changes' decoded pk tuples from the ``pks`` iterator."""
     if "versions" in obj:
         lo, hi = obj["versions"]
         return TraceEmpty(
@@ -98,7 +98,7 @@ def parse_trace_line(line: str):
     changes = tuple(
         TraceChange(
             table=c["table"],
-            pk=unpack_columns(bytes(c["pk"])),
+            pk=next(pks),
             cid=c["cid"],
             val=_parse_val(c.get("val")),
             col_version=int(c["col_version"]),
@@ -115,6 +115,34 @@ def parse_trace_line(line: str):
         ts=int(obj.get("ts", 0)),
         changes=changes,
     )
+
+
+def parse_trace_line(line: str):
+    """One ND-JSON line → :class:`TraceChangeset` or :class:`TraceEmpty`."""
+    obj = json.loads(line)
+    pks = iter(
+        unpack_columns_batch(
+            [bytes(c["pk"]) for c in obj.get("changes", ())]
+        )
+    )
+    return _build_event(obj, pks)
+
+
+def parse_trace_lines(lines) -> list:
+    """Bulk parse: every pk blob in the whole trace decodes in ONE native
+    batch call (C++ hot path) instead of per line."""
+    objs = [json.loads(ln) for ln in lines]
+    # mirror _build_event's branch exactly: an empty-set line ("versions")
+    # never consumes pk tuples, so its changes (if any) must not be packed
+    # into the shared batch or every later pk would misalign
+    blobs = [
+        bytes(c["pk"])
+        for obj in objs
+        if "versions" not in obj
+        for c in obj.get("changes", ())
+    ]
+    pks = iter(unpack_columns_batch(blobs))
+    return [_build_event(obj, pks) for obj in objs]
 
 
 @dataclasses.dataclass
@@ -187,8 +215,11 @@ def ingest(lines, layout=None) -> EncodedTrace:
     planes come from the schema (unknown tables/columns are rejected);
     without one, the universe is discovered from the trace itself.
     """
+    lines = list(lines)
+    raw = [ln for ln in lines if isinstance(ln, str)]
+    parsed = iter(parse_trace_lines(raw))  # one bulk pk-decode batch
     events = [
-        parse_trace_line(ln) if isinstance(ln, str) else ln for ln in lines
+        next(parsed) if isinstance(ln, str) else ln for ln in lines
     ]
 
     # --- phase 1: discover the closed world -----------------------------

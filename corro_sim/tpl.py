@@ -204,7 +204,6 @@ class TemplateWatcher:
         self.output_path = str(output_path)
         self.tripwire = tripwire or Tripwire()
         self.renders = 0
-        self._subs: list = []
         # one wake event for the watcher's whole life: set by any sub
         # reader on a change, and by the tripwire on shutdown (on_trip
         # registers exactly once — per-wait registration would accumulate)

@@ -1,0 +1,139 @@
+"""Native (C++) pk codec vs the pure-Python reference implementation.
+
+The two must agree byte-for-byte on encode and value-for-value on decode
+— including the reference's sign-extension quirk (pubsub.rs get_int reads
+minimal-width ints signed, so 255 packed in one byte decodes as -1).
+"""
+
+import random
+
+import pytest
+
+from corro_sim.io import columns as py
+from corro_sim.io import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native lib did not build"
+)
+
+
+def random_value(rng):
+    kind = rng.randrange(5)
+    if kind == 0:
+        return None
+    if kind == 1:
+        return rng.randint(-(2**63), 2**63 - 1)
+    if kind == 2:
+        return rng.random() * 10**rng.randint(-10, 10) * rng.choice([-1, 1])
+    if kind == 3:
+        n = rng.randint(0, 300)
+        return "".join(chr(rng.randint(32, 0x2FF)) for _ in range(n))
+    return bytes(rng.randint(0, 255) for _ in range(rng.randint(0, 300)))
+
+
+def test_differential_pack_unpack():
+    rng = random.Random(7)
+    for _ in range(300):
+        vals = tuple(random_value(rng) for _ in range(rng.randint(0, 12)))
+        enc_py = py.pack_columns(vals)
+        enc_c = native.pack_columns(vals)
+        assert enc_c == enc_py, vals
+        assert native.unpack_columns(enc_py) == py.unpack_columns(enc_py)
+
+
+def test_sign_extension_quirk_matches():
+    # 255 fits one byte; the reference reads it back sign-extended → -1
+    enc = py.pack_columns((255,))
+    assert py.unpack_columns(enc) == (-1,)
+    assert native.unpack_columns(enc) == (-1,)
+    enc = py.pack_columns((65535,))
+    assert native.unpack_columns(enc) == py.unpack_columns(enc) == (-1,)
+    # but a 128-byte string length (0x80, sign-extended in the reference)
+    # must decode unsigned
+    s = "x" * 128
+    assert native.unpack_columns(py.pack_columns((s,))) == (s,)
+
+
+def test_batch_matches_sequential():
+    rng = random.Random(11)
+    blobs = [
+        py.pack_columns(
+            tuple(random_value(rng) for _ in range(rng.randint(0, 6)))
+        )
+        for _ in range(600)  # above _BATCH_THRESHOLD: the native path runs
+    ]
+    batch = native.unpack_columns_batch(blobs)
+    assert batch == [py.unpack_columns(b) for b in blobs]
+    # below the threshold the python fallback must agree too
+    small = native.unpack_columns_batch(blobs[:10])
+    assert small == batch[:10]
+
+
+def test_native_truncation_errors():
+    enc = py.pack_columns((12345, "hello"))
+    for cut in range(1, len(enc)):
+        with pytest.raises(py.UnpackError):
+            native.unpack_columns(enc[:cut])
+
+
+def test_trace_parse_uses_batch_path():
+    from corro_sim.io.traces import parse_trace_line
+    import json
+
+    line = json.dumps(
+        {
+            "actor_id": 0,
+            "version": 1,
+            "ts": 0,
+            "seqs": [0, 0],
+            "last_seq": 0,
+            "changes": [
+                {
+                    "table": "t", "pk": list(py.pack_columns(("k1", 7))),
+                    "cid": "v", "val": "x", "col_version": 1,
+                    "db_version": 1, "seq": 0, "cl": 1,
+                }
+            ],
+        }
+    )
+    cs = parse_trace_line(line)
+    assert cs.changes[0].pk == ("k1", 7)
+
+
+def test_batch_throughput_not_pathological():
+    """The native batch path should beat pure Python on bulk decode."""
+    import time
+
+    rng = random.Random(3)
+    blobs = [
+        py.pack_columns((f"key-{i}", i, rng.random()))
+        for i in range(5000)
+    ]
+    t0 = time.perf_counter()
+    native.unpack_columns_batch(blobs)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for b in blobs:
+        py.unpack_columns(b)
+    t_py = time.perf_counter() - t0
+    # generous bound: just catch a pathological regression, not a race
+    assert t_native < t_py * 2.0, (t_native, t_py)
+
+
+def test_malformed_width_rejected_identically():
+    """ilen > 8 in a type byte: both decoders reject (UB-free native)."""
+    bad_int = bytes([1, (31 << 3) | py.TYPE_INTEGER]) + b"\x01" * 31
+    with pytest.raises(py.UnpackError):
+        py.unpack_columns(bad_int)
+    with pytest.raises(py.UnpackError):
+        native.unpack_columns(bad_int)
+    bad_len = bytes([1, (9 << 3) | py.TYPE_TEXT]) + b"\x00" * 9
+    with pytest.raises(py.UnpackError):
+        py.unpack_columns(bad_len)
+    with pytest.raises(py.UnpackError):
+        native.unpack_columns(bad_len)
+
+
+def test_out_of_range_int_wraps_like_python():
+    for v in (2**63, -(2**63) - 1, 2**64 + 5):
+        assert native.pack_columns((v,)) == py.pack_columns((v,))
