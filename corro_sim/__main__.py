@@ -1,0 +1,8 @@
+"""`python -m corro_sim` → the CLI (same entry as the corro-sim script)."""
+
+import sys
+
+from corro_sim.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,7 +71,7 @@ def parse_statement(stmt) -> tuple[str, list | dict]:
     raise StatementError(f"bad statement shape: {type(stmt)!r}")
 
 
-_PARAM = re.compile(r"\?|[:$@][A-Za-z_][A-Za-z_0-9]*")
+_PARAM = re.compile(r"\?|\$\d+|[:$@][A-Za-z_][A-Za-z_0-9]*")
 
 
 def bind_params(sql: str, params) -> str:
@@ -94,12 +94,12 @@ def bind_params(sql: str, params) -> str:
     out = []
     last = 0
     idx = 0
+    quotes = 0  # incremental quote parity: odd = inside a string literal
     for m in _PARAM.finditer(sql):
-        # skip params inside string literals: count quotes before
         prefix = sql[last:m.start()]
         out.append(prefix)
-        whole = "".join(out)
-        if whole.count("'") % 2 == 1:  # inside a string literal
+        quotes += prefix.count("'")
+        if quotes % 2 == 1:  # inside a string literal
             out.append(m.group(0))
             last = m.end()
             continue
@@ -109,6 +109,14 @@ def bind_params(sql: str, params) -> str:
                 raise StatementError("not enough positional params")
             out.append(lit(params[idx]))
             idx += 1
+        elif tok[0] == "$" and tok[1:].isdigit():
+            # Postgres-style 1-based positional (the pg wire API binds these)
+            i = int(tok[1:]) - 1
+            if not isinstance(params, (list, tuple)) or not (
+                0 <= i < len(params)
+            ):
+                raise StatementError(f"missing positional param {tok}")
+            out.append(lit(params[i]))
         else:
             name = tok[1:]
             if not isinstance(params, dict) or name not in params:

@@ -112,19 +112,27 @@ def _cmd_agent(args: argparse.Namespace) -> int:
         tick_interval=args.tick_interval or None,
     ).start()
     admin = AdminServer(cluster, args.admin_path).start()
-    print(
-        json.dumps(
-            {
-                "api": f"http://{api.addr[0]}:{api.addr[1]}",
-                "admin": args.admin_path,
-                "nodes": cluster.cfg.num_nodes,
-            }
-        ),
-        flush=True,
-    )
+    pg = None
+    if args.pg_addr:
+        from corro_sim.api.pg import PgServer
+
+        pg_host, _, pg_port = args.pg_addr.partition(":")
+        pg = PgServer(
+            cluster, host=pg_host or "127.0.0.1", port=int(pg_port or 0)
+        ).start()
+    info = {
+        "api": f"http://{api.addr[0]}:{api.addr[1]}",
+        "admin": args.admin_path,
+        "nodes": cluster.cfg.num_nodes,
+    }
+    if pg is not None:
+        info["pg"] = f"{pg.addr[0]}:{pg.addr[1]}"
+    print(json.dumps(info), flush=True)
     try:
         tripwire.wait()
     finally:
+        if pg is not None:
+            pg.close()
         api.close()
         admin.close()
         wait_for_all_pending_handles(timeout=10)
@@ -288,6 +296,11 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--seed", type=int, default=0)
     pa.add_argument("--capacity", type=int, default=256)
     pa.add_argument("--api-addr", default="127.0.0.1:0")
+    pa.add_argument(
+        "--pg-addr",
+        help="also serve the Postgres wire protocol on host:port "
+             "(api.pg.addr analog; off when omitted)",
+    )
     pa.add_argument("--admin-path", default="./corro-sim-admin.sock")
     pa.add_argument("--authz-token")
     pa.add_argument(

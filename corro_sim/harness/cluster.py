@@ -615,10 +615,19 @@ class LiveCluster:
     # --------------------------------------------------------- migrations
     def migrate(self, schema_sql: str, capacities: dict | None = None) -> dict:
         """POST /v1/migrations analog: diff-based, additive-only
-        (``apply_schema``, ``corro-types/src/schema.rs:274-646``)."""
+        (``apply_schema``, ``corro-types/src/schema.rs:274-646``).
+
+        Merge semantics, like the reference's ``execute_schema``
+        (``api/public/mod.rs:443-528``): the DDL is *merged* into the
+        current schema — tables it doesn't mention are retained (drops are
+        refused anyway), tables it does mention must be additive."""
         with self.locks.tracked(self._lock, "migrate", "write"):
             new_schema = parse_and_constrain(schema_sql)
-            plan = self.layout.migrate(new_schema, capacities=capacities)
+            merged = dataclasses.replace(
+                new_schema,
+                tables={**self.layout.schema.tables, **new_schema.tables},
+            )
+            plan = self.layout.migrate(merged, capacities=capacities)
             self._schema_history.append(schema_sql)
             new_rows = self.layout.num_rows
             new_cols = max(self.layout.num_cols, 1)

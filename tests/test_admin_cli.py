@@ -139,7 +139,7 @@ def test_cli_agent_end_to_end(tmp_path):
                 [
                     "agent", "--schema", str(schema), "--nodes", "2",
                     "--capacity", "16", "--admin-path", sock,
-                    "--tick-interval", "0",
+                    "--tick-interval", "0", "--pg-addr", "127.0.0.1:0",
                 ]
             )
 
@@ -175,6 +175,15 @@ def test_cli_agent_end_to_end(tmp_path):
         import os
 
         assert os.path.exists(bkp)
+
+        # the --pg-addr listener speaks pgwire against the same cluster
+        from corro_sim.api.pg import SimplePgClient
+
+        pg_host, _, pg_port = info["pg"].rpartition(":")
+        pc = SimplePgClient(pg_host, int(pg_port))
+        _, rows, _, errors = pc.query("SELECT id, v FROM app WHERE id = 5")
+        assert not errors and rows == [[5, "cli"]]
+        pc.close()
     finally:
         Tripwire.new_signals = staticmethod(orig)
         trip_holder["t"].trip()

@@ -1,0 +1,441 @@
+"""Postgres wire API: raw-protocol tests against a LiveCluster.
+
+The reference exposes its agent over pgwire v3 (`crates/corro-pg`); these
+tests speak the raw protocol (startup, simple + extended query, portals,
+transactions, SQLSTATE errors) through the SimplePgClient helper — no
+external driver needed, and both encode and decode paths get exercised.
+"""
+
+import socket
+import struct
+
+import pytest
+
+from corro_sim.api.pg import (
+    OID_FLOAT8,
+    OID_INT8,
+    OID_TEXT,
+    PgServer,
+    SimplePgClient,
+    classify,
+    split_statements,
+)
+from corro_sim.harness.cluster import LiveCluster
+
+SCHEMA = """
+CREATE TABLE users (
+    id INTEGER NOT NULL PRIMARY KEY,
+    name TEXT NOT NULL DEFAULT '',
+    score REAL NOT NULL DEFAULT 0.0
+);
+"""
+
+
+@pytest.fixture(scope="module")
+def server():
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=64)
+    with PgServer(cluster) as srv:
+        yield srv
+    # no cluster.close needed: pure in-process state
+
+
+@pytest.fixture()
+def client(server):
+    c = SimplePgClient(*server.addr)
+    yield c
+    c.close()
+
+
+def test_startup_handshake(server):
+    c = SimplePgClient(*server.addr)
+    assert c.params["server_version"].startswith("14.0")
+    assert c.params["client_encoding"] == "UTF8"
+    assert c.status == b"I"
+    c.close()
+
+
+def test_ssl_request_refused(server):
+    s = socket.create_connection(server.addr)
+    s.sendall(struct.pack("!II", 8, 80877103))
+    assert s.recv(1) == b"N"
+    s.close()
+
+
+def test_simple_insert_select(client):
+    _, _, tags, errors = client.query(
+        "INSERT INTO users (id, name, score) VALUES (1, 'ana', 4.5)")
+    assert not errors
+    assert tags == ["INSERT 0 1"]
+    fields, rows, tags, errors = client.query(
+        "SELECT id, name, score FROM users WHERE id = 1")
+    assert not errors
+    assert [f[0] for f in fields] == ["id", "name", "score"]
+    assert [f[1] for f in fields] == [OID_INT8, OID_TEXT, OID_FLOAT8]
+    assert rows == [[1, "ana", 4.5]]
+    assert tags == ["SELECT 1"]
+
+
+def test_multi_statement_simple_query(client):
+    _, rows, tags, errors = client.query(
+        "INSERT INTO users (id, name) VALUES (2, 'bo');"
+        "SELECT name FROM users WHERE id = 2")
+    assert not errors
+    assert tags == ["INSERT 0 1", "SELECT 1"]
+    assert rows == [["bo"]]
+
+
+def test_update_delete_tags(client):
+    client.query("INSERT INTO users (id, name) VALUES (10, 'del-me')")
+    _, _, tags, errors = client.query(
+        "UPDATE users SET name = 'kept' WHERE id = 10")
+    assert not errors and tags == ["UPDATE 1"]
+    _, _, tags, errors = client.query("DELETE FROM users WHERE id = 10")
+    assert not errors and tags == ["DELETE 1"]
+    _, rows, _, _ = client.query("SELECT id FROM users WHERE id = 10")
+    assert rows == []
+
+
+def test_error_sqlstate_undefined_table(client):
+    _, _, _, errors = client.query("SELECT * FROM nope")
+    assert errors and errors[0]["C"] == "42P01"
+
+
+def test_error_sqlstate_syntax(client):
+    _, _, _, errors = client.query("SELEC bogus")
+    assert errors
+    assert errors[0]["C"] in ("42601", "0A000")
+
+
+def test_transaction_commit_atomic(server, client):
+    _, _, tags, errors = client.query("BEGIN")
+    assert not errors and tags == ["BEGIN"] and client.status == b"T"
+    client.query("INSERT INTO users (id, name) VALUES (20, 'tx1')")
+    client.query("INSERT INTO users (id, name) VALUES (21, 'tx2')")
+    # other connections must not see the buffered writes yet
+    c2 = SimplePgClient(*server.addr)
+    _, rows, _, _ = c2.query("SELECT id FROM users WHERE id = 20")
+    assert rows == []
+    _, _, tags, errors = client.query("COMMIT")
+    assert not errors and tags == ["COMMIT"] and client.status == b"I"
+    _, rows, _, _ = c2.query(
+        "SELECT id FROM users WHERE id = 20 OR id = 21")
+    assert sorted(r[0] for r in rows) == [20, 21]
+    c2.close()
+
+
+def test_transaction_rollback(client):
+    client.query("BEGIN")
+    client.query("INSERT INTO users (id, name) VALUES (30, 'gone')")
+    _, _, tags, _ = client.query("ROLLBACK")
+    assert tags == ["ROLLBACK"]
+    _, rows, _, _ = client.query("SELECT id FROM users WHERE id = 30")
+    assert rows == []
+
+
+def test_failed_transaction_blocks_until_rollback(client):
+    client.query("BEGIN")
+    _, _, _, errors = client.query("SELECT * FROM missing_table")
+    assert errors and client.status == b"E"
+    _, _, _, errors = client.query("SELECT 1 FROM users")
+    assert errors and errors[0]["C"] == "25P02"
+    _, _, tags, _ = client.query("COMMIT")  # commit of failed tx → rollback
+    assert tags == ["ROLLBACK"]
+    assert client.status == b"I"
+
+
+def test_set_and_show(client):
+    _, _, tags, errors = client.query("SET search_path TO public")
+    assert not errors and tags == ["SET"]
+    fields, rows, tags, errors = client.query("SHOW server_version")
+    assert not errors
+    assert rows[0][0].startswith("14.0")
+    _, rows, _, errors = client.query("SHOW transaction isolation level")
+    assert not errors and rows == [["serializable"]]
+
+
+def test_extended_protocol_text_params(client):
+    fields, rows, tags, errors, _ = client.extended(
+        "INSERT INTO users (id, name, score) VALUES ($1, $2, $3)",
+        params=[40, "ext", 1.25],
+        param_oids=[OID_INT8, OID_TEXT, OID_FLOAT8])
+    assert not errors and tags == ["INSERT 0 1"]
+    fields, rows, tags, errors, _ = client.extended(
+        "SELECT name, score FROM users WHERE id = $1",
+        params=[40], param_oids=[OID_INT8])
+    assert not errors
+    assert rows == [["ext", 1.25]]
+    assert [f[0] for f in fields] == ["name", "score"]
+
+
+def test_extended_unknown_param_oid_inferred(client):
+    _, rows, tags, errors, _ = client.extended(
+        "SELECT id FROM users WHERE id = $1", params=[40])
+    assert not errors
+    assert rows == [[40]]
+
+
+def test_portal_suspension(client):
+    for i in range(50, 55):
+        client.query(f"INSERT INTO users (id, name) VALUES ({i}, 'p{i}')")
+    _, rows, tags, errors, suspended = client.extended(
+        "SELECT id FROM users WHERE id >= 50 AND id < 55", max_rows=2)
+    assert not errors
+    assert suspended
+    assert len(rows) == 2
+
+
+def test_prepared_statement_missing(client):
+    import corro_sim.api.pg as pg
+    # Bind to a statement name that was never Parsed
+    msgs = [
+        pg._msg(b"B", pg._cstr("") + pg._cstr("ghost")
+                + struct.pack("!HHH", 0, 0, 0)),
+        pg._msg(b"S"),
+    ]
+    client.sock.sendall(b"".join(msgs))
+    saw_err = None
+    while True:
+        tag, body = client.read_msg()
+        if tag == b"E":
+            saw_err = client._parse_error(body)
+        if tag == b"Z":
+            break
+    assert saw_err and saw_err["C"] == "26000"
+
+
+def test_node_routing_via_database_name(server):
+    """database=nodeK talks to node K; gossip converges the write."""
+    c1 = SimplePgClient(*server.addr, database="node1")
+    c1.query("INSERT INTO users (id, name) VALUES (60, 'from-node1')")
+    # node 1 sees its own write immediately
+    _, rows, _, _ = c1.query("SELECT name FROM users WHERE id = 60")
+    assert rows == [["from-node1"]]
+    c1.close()
+    # node 0 sees it after convergence (execute ticks synchronously and
+    # gossip fanout covers a 2-node cluster within the committed rounds,
+    # but tick explicitly to be deterministic)
+    server.cluster.run_until_converged()
+    c0 = SimplePgClient(*server.addr, database="node0")
+    _, rows, _, _ = c0.query("SELECT name FROM users WHERE id = 60")
+    assert rows == [["from-node1"]]
+    c0.close()
+
+
+def test_bad_database_name(server):
+    s = socket.create_connection(server.addr)
+    body = struct.pack("!I", 196608)
+    body += b"user\x00u\x00database\x00node99\x00\x00"
+    s.sendall(struct.pack("!I", len(body) + 4) + body)
+    tag = s.recv(1)
+    assert tag == b"E"
+    s.close()
+
+
+def test_pg_catalog_tables(client):
+    fields, rows, _, errors = client.query(
+        "SELECT typname FROM pg_type WHERE oid = 25")
+    assert not errors and rows == [["text"]]
+    _, rows, _, errors = client.query(
+        "SELECT relname FROM pg_catalog.pg_class")
+    assert not errors
+    assert ["users"] in rows
+    _, rows, _, errors = client.query("SELECT nspname FROM pg_namespace")
+    assert not errors and sorted(r[0] for r in rows) == [
+        "pg_catalog", "public"]
+    _, rows, _, errors = client.query(
+        "SELECT attname FROM pg_attribute WHERE attrelid = 16384")
+    assert not errors
+    assert sorted(r[0] for r in rows) == ["id", "name", "score"]
+
+
+def test_empty_query(client):
+    fields, rows, tags, errors = client.query("")
+    assert not errors and not tags and not rows
+
+
+def test_classify_and_split():
+    assert classify("  -- hi\n select 1") == "SELECT"
+    assert classify("/* x */ BEGIN") == "BEGIN"
+    assert classify("START TRANSACTION") == "BEGIN"
+    assert classify("end") == "COMMIT"
+    assert classify("abort") == "ROLLBACK"
+    assert split_statements("a; b'x;y'; c") == ["a", "b'x;y'", "c"]
+    assert split_statements("one") == ["one"]
+    assert split_statements("''';'''") == ["''';'''"]
+
+
+def test_in_tx_planned_counts(client):
+    client.query("INSERT INTO users (id, name) VALUES (70, 'pre')")
+    client.query("BEGIN")
+    _, _, tags, errors = client.query(
+        "UPDATE users SET name = 'post' WHERE id = 70")
+    assert not errors and tags == ["UPDATE 1"]
+    _, _, tags, _ = client.query("COMMIT")
+    assert tags == ["COMMIT"]
+    _, rows, _, _ = client.query("SELECT name FROM users WHERE id = 70")
+    assert rows == [["post"]]
+
+
+def test_select_star_describe_matches_row_order(server):
+    """pk-last-in-declaration schema: Describe and DataRow must agree
+    (the matcher emits pk row-key columns first)."""
+    server.cluster.migrate(
+        SCHEMA + "\nCREATE TABLE flipped ("
+        "  label TEXT NOT NULL DEFAULT '',"
+        "  key INTEGER NOT NULL PRIMARY KEY"
+        ");")
+    c = SimplePgClient(*server.addr)
+    c.query("INSERT INTO flipped (key, label) VALUES (1, 'x')")
+    fields, rows, tags, errors, _ = c.extended("SELECT * FROM flipped")
+    assert not errors
+    assert [f[0] for f in fields] == ["key", "label"]
+    assert rows == [[1, "x"]]
+    c.close()
+
+
+def test_comment_with_semicolon(client):
+    _, rows, tags, errors = client.query(
+        "SELECT id FROM users WHERE id = 1 -- note; not a new stmt")
+    assert not errors and tags == ["SELECT 1"]
+    _, rows, tags, errors = client.query(
+        "SELECT id /* a;b */ FROM users WHERE id = 1")
+    assert not errors and tags == ["SELECT 1"]
+
+
+def test_unknown_oid_preserves_noncanonical_text(client):
+    _, _, tags, errors, _ = client.extended(
+        "INSERT INTO users (id, name) VALUES ($1, $2)",
+        params=[80, "007"])
+    assert not errors
+    _, rows, _, _ = client.query("SELECT name FROM users WHERE id = 80")
+    assert rows == [["007"]]
+
+
+def test_show_all_extended_describe_matches(client):
+    fields, rows, tags, errors, _ = client.extended("SHOW ALL")
+    assert not errors
+    assert [f[0] for f in fields] == ["name", "setting"]
+    assert all(len(r) == 2 for r in rows)
+
+
+def test_bind_count_mismatch(client):
+    _, _, _, errors, _ = client.extended(
+        "SELECT id FROM users WHERE id = $1", params=[])
+    assert errors and errors[0]["C"] == "08P01"
+
+
+def test_pg_catalog_in_string_literal(client):
+    client.query(
+        "INSERT INTO users (id, name) VALUES (81, 'pg_catalog.pg_class')")
+    _, rows, _, errors = client.query(
+        "SELECT id FROM users WHERE name = 'pg_catalog.pg_class'")
+    assert not errors and rows == [[81]]
+
+
+def test_in_tx_syntax_error_code(client):
+    client.query("BEGIN")
+    _, _, _, errors = client.query("UPDATE users SET WHERE id = 1")
+    assert errors and errors[0]["C"] == "42601"
+    client.query("ROLLBACK")
+
+
+def test_create_table_with_existing_schema(server):
+    """CREATE merges into the live schema (execute_schema semantics) —
+    it must not require restating existing tables or imply drops."""
+    c = SimplePgClient(*server.addr)
+    _, _, tags, errors = c.query(
+        "CREATE TABLE pgmade (k INTEGER NOT NULL PRIMARY KEY, "
+        "v TEXT NOT NULL DEFAULT '')")
+    assert not errors and tags == ["CREATE TABLE"]
+    _, _, tags, errors = c.query(
+        "INSERT INTO pgmade (k, v) VALUES (1, 'new')")
+    assert not errors
+    _, rows, _, errors = c.query("SELECT v FROM pgmade WHERE k = 1")
+    assert not errors and rows == [["new"]]
+    # the pre-existing table is untouched
+    _, _, _, errors = c.query("SELECT id FROM users WHERE id = 1")
+    assert not errors
+    c.close()
+
+
+def test_dollar_in_string_literal_not_a_param(client):
+    _, _, tags, errors, _ = client.extended(
+        "INSERT INTO users (id, name) VALUES ($1, 'price $2')",
+        params=[90])
+    assert not errors, errors
+    _, rows, _, _ = client.query("SELECT name FROM users WHERE id = 90")
+    assert rows == [["price $2"]]
+
+
+def test_gapped_param_index_counts_to_max(client):
+    # $2 with no $1: ParameterDescription must advertise 2 params
+    import corro_sim.api.pg as pg
+    msgs = [
+        pg._msg(b"P", pg._cstr("gap")
+                + pg._cstr("SELECT id FROM users WHERE id = $2")
+                + struct.pack("!H", 0)),
+        pg._msg(b"D", b"S" + pg._cstr("gap")),
+        pg._msg(b"S"),
+    ]
+    client.sock.sendall(b"".join(msgs))
+    n_oids = None
+    while True:
+        tag, body = client.read_msg()
+        if tag == b"t":
+            (n_oids,) = struct.unpack_from("!H", body, 0)
+        if tag == b"Z":
+            break
+    assert n_oids == 2
+
+
+def test_catalog_types_same_in_both_protocols(client):
+    f1, rows1, _, errors = client.query(
+        "SELECT oid FROM pg_type WHERE typname = 'int8'")
+    assert not errors
+    f2, rows2, _, errors, _ = client.extended(
+        "SELECT oid FROM pg_type WHERE typname = 'int8'")
+    assert not errors
+    assert rows1 == rows2 == [[20]]
+    assert f1[0][1] == f2[0][1] == OID_INT8
+
+
+def test_unmodeled_catalog_column_reads_null(client):
+    """Driver probes of unmodeled pg_catalog columns must not error;
+    the column reads as NULL (matches no equality predicate)."""
+    _, rows, _, errors = client.query(
+        "SELECT typname FROM pg_type WHERE typtype = 'b'")
+    assert not errors
+    assert rows == []
+    _, rows, _, errors = client.query(
+        "SELECT typname FROM pg_type WHERE typtype IS NULL AND oid = 25")
+    assert not errors and rows == [["text"]]
+
+
+def test_in_tx_unknown_column_is_42703(client):
+    client.query("BEGIN")
+    _, _, _, errors = client.query(
+        "UPDATE users SET name = 'x' WHERE nope = 1")
+    assert errors and errors[0]["C"] == "42703"
+    client.query("ROLLBACK")
+
+
+def test_ddl_inside_transaction_refused(client):
+    client.query("BEGIN")
+    _, _, _, errors = client.query(
+        "CREATE TABLE txddl (k INTEGER NOT NULL PRIMARY KEY)")
+    assert errors and errors[0]["C"] == "25001"
+    client.query("ROLLBACK")
+    _, _, _, errors = client.query("SELECT k FROM txddl")
+    assert errors and errors[0]["C"] == "42P01"
+
+
+def test_count_params_and_lexer():
+    from corro_sim.api.pg import count_params, strip_comments
+    assert count_params("WHERE a = $1 AND b = $3") == 3
+    assert count_params("VALUES ($1, 'has $9 inside')") == 1
+    assert count_params("-- $5\nSELECT $2") == 2
+    assert count_params("/* $7 */ SELECT 1") == 0
+    assert strip_comments("a -- x\nb") == "a \nb"
+    assert strip_comments("a /* x */ b") == "a   b"
+    assert strip_comments("'/* not a comment */'") == "'/* not a comment */'"
+    assert strip_comments("'it''s' -- c") == "'it''s' "
