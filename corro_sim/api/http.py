@@ -106,7 +106,7 @@ class _Handler(BaseHTTPRequestHandler):
             raise _ApiError(400, "node must be an integer") from None
 
     def _send_json(self, obj, status: int = 200, headers: dict | None = None):
-        body = (json.dumps(obj) + "\n").encode()
+        body = (json.dumps(obj, default=_json_value) + "\n").encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -127,7 +127,10 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _stream_events(self, events) -> None:
         for e in events:
-            self.wfile.write((json.dumps(_as_wire(e)) + "\n").encode())
+            self.wfile.write(
+                (json.dumps(_as_wire(e), default=_json_value) + "\n")
+                .encode()
+            )
         self.wfile.flush()
 
     # ------------------------------------------------------------- routes
@@ -352,6 +355,14 @@ def _sql_of_body(stmt) -> str:
     return sql
 
 
+def _json_value(v):
+    """Non-JSON-native cells on the wire: blobs use the reference's
+    SqliteValue JSON shape ``{"blob": [u8…]}`` (corro-api-types)."""
+    if isinstance(v, (bytes, bytearray)):
+        return {"blob": list(v)}
+    raise TypeError(f"not JSON-serializable: {type(v)!r}")
+
+
 def _as_wire(e) -> dict:
     """Events are dicts already; buffered SubEvents expose as_json()."""
     return e if isinstance(e, dict) else e.as_json()
@@ -383,12 +394,37 @@ class ApiServer:
         port: int = 0,
         authz_token: str | None = None,
         tick_interval: float | None = None,
+        ssl_context=None,
     ):
         self.cluster = cluster
         self.authz_token = authz_token
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.api = self  # type: ignore[attr-defined]
+        self._tls = ssl_context is not None
+        if ssl_context is not None:
+            # TLS (optionally mutual) on the API listener — the posture
+            # the reference applies to its gossip endpoint
+            # (api/peer.rs:129-343). Wrap per-CONNECTION with the
+            # handshake deferred: an eager handshake would run inside the
+            # single accept loop, letting one stalled client wedge every
+            # other connection. Deferred, OpenSSL negotiates on the
+            # handler thread's first read — the same exposure profile as
+            # a plain-HTTP silent client.
+            httpd = self._httpd
+            plain_get_request = httpd.get_request
+
+            def get_request():
+                sock, addr = plain_get_request()
+                return (
+                    ssl_context.wrap_socket(
+                        sock, server_side=True,
+                        do_handshake_on_connect=False,
+                    ),
+                    addr,
+                )
+
+            httpd.get_request = get_request
         self._thread: threading.Thread | None = None
         self._ticker: threading.Thread | None = None
         self._tick_interval = tick_interval
@@ -401,7 +437,8 @@ class ApiServer:
     @property
     def url(self) -> str:
         host, port = self.addr
-        return f"http://{host}:{port}"
+        scheme = "https" if self._tls else "http"
+        return f"{scheme}://{host}:{port}"
 
     def start(self) -> "ApiServer":
         self._thread = threading.Thread(

@@ -89,6 +89,8 @@ def bind_params(sql: str, params) -> str:
             return repr(v)
         if isinstance(v, str):
             return "'" + v.replace("'", "''") + "'"
+        if isinstance(v, (bytes, bytearray)):
+            return "X'" + bytes(v).hex() + "'"  # SQLite blob literal
         raise StatementError(f"unsupported param type {type(v)!r}")
 
     out = []

@@ -104,12 +104,21 @@ def _cmd_agent(args: argparse.Namespace) -> int:
             tripwire=tripwire,
         )
     host, _, port = args.api_addr.partition(":")
+    ssl_ctx = None
+    if args.tls_cert:
+        from corro_sim.tls import server_ssl_context
+
+        ssl_ctx = server_ssl_context(
+            args.tls_cert, args.tls_key, ca_file=args.tls_ca,
+            require_client_auth=args.tls_client_auth,
+        )
     api = ApiServer(
         cluster,
         host=host or "127.0.0.1",
         port=int(port or 0),
         authz_token=args.authz_token,
         tick_interval=args.tick_interval or None,
+        ssl_context=ssl_ctx,
     ).start()
     admin = AdminServer(cluster, args.admin_path).start()
     pg = None
@@ -121,7 +130,7 @@ def _cmd_agent(args: argparse.Namespace) -> int:
             cluster, host=pg_host or "127.0.0.1", port=int(pg_port or 0)
         ).start()
     info = {
-        "api": f"http://{api.addr[0]}:{api.addr[1]}",
+        "api": api.url,
         "admin": args.admin_path,
         "nodes": cluster.cfg.num_nodes,
     }
@@ -303,6 +312,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pa.add_argument("--admin-path", default="./corro-sim-admin.sock")
     pa.add_argument("--authz-token")
+    pa.add_argument("--tls-cert", help="serve the HTTP API over TLS")
+    pa.add_argument("--tls-key")
+    pa.add_argument("--tls-ca", help="CA bundle for client verification")
+    pa.add_argument(
+        "--tls-client-auth", action="store_true",
+        help="require client certificates (mutual TLS)",
+    )
     pa.add_argument(
         "--tick-interval", type=float, default=0.1,
         help="background gossip cadence in seconds (0 disables)",
@@ -390,7 +406,177 @@ def build_parser() -> argparse.ArgumentParser:
     pcs.add_argument("--interval", type=float, default=1.0)
     pcs.add_argument("--once", action="store_true")
     pcs.set_defaults(fn=_cmd_consul_sync)
+
+    pdc = sub.add_parser(
+        "devcluster",
+        help="run an `A -> B` topology file as one simulated cluster",
+    )
+    pdc.add_argument("topology", help="topology file of `A -> B` lines")
+    pdc.add_argument("--schema", required=True, help="schema DDL file")
+    pdc.add_argument(
+        "--statedir", help="write per-node state dirs with node.json maps"
+    )
+    pdc.add_argument("--seed", type=int, default=0)
+    pdc.add_argument("--capacity", type=int, default=256)
+    pdc.add_argument("--api-addr", default="127.0.0.1:0")
+    pdc.add_argument("--admin-path", default="./corro-devcluster-admin.sock")
+    pdc.add_argument("--tick-interval", type=float, default=0.1)
+    pdc.set_defaults(fn=_cmd_devcluster)
+
+    prl = sub.add_parser(
+        "reload", help="re-apply schema files through the running agent"
+    )
+    api_args(prl)
+    prl.add_argument("schema_files", nargs="+")
+    prl.set_defaults(fn=_cmd_reload)
+
+    ptls = sub.add_parser(
+        "tls", help="certificate authority / server / client cert tooling"
+    )
+    tls_sub = ptls.add_subparsers(dest="tls_cmd", required=True)
+    tca = tls_sub.add_parser("ca", help="certificate authority commands")
+    tca_sub = tca.add_subparsers(dest="tls_sub_cmd", required=True)
+    tcag = tca_sub.add_parser("generate", help="generate a root CA")
+    tcag.add_argument("--output-dir", default=".")
+    tcag.set_defaults(fn=_cmd_tls_ca_generate)
+    tsv = tls_sub.add_parser("server", help="server certificate commands")
+    tsv_sub = tsv.add_subparsers(dest="tls_sub_cmd", required=True)
+    tsvg = tsv_sub.add_parser(
+        "generate", help="generate a server cert from a CA"
+    )
+    tsvg.add_argument("ip", help="IP address for the subject alt name")
+    tsvg.add_argument("--ca-key", required=True)
+    tsvg.add_argument("--ca-cert", required=True)
+    tsvg.add_argument("--output-dir", default=".")
+    tsvg.set_defaults(fn=_cmd_tls_server_generate)
+    tcl = tls_sub.add_parser(
+        "client", help="client certificate commands (mutual TLS)"
+    )
+    tcl_sub = tcl.add_subparsers(dest="tls_sub_cmd", required=True)
+    tclg = tcl_sub.add_parser(
+        "generate", help="generate a client cert from a CA"
+    )
+    tclg.add_argument("--ca-key", required=True)
+    tclg.add_argument("--ca-cert", required=True)
+    tclg.add_argument("--output-dir", default=".")
+    tclg.set_defaults(fn=_cmd_tls_client_generate)
     return p
+
+
+def _cmd_devcluster(args) -> int:
+    """`corro-devcluster simple <topology>` analog: run the topology file
+    as one simulated cluster behind the HTTP API + admin socket
+    (`corro-devcluster/src/main.rs:104-216`)."""
+    from corro_sim.admin import AdminServer
+    from corro_sim.api.http import ApiServer
+    from corro_sim.harness.devcluster import TopologyError, build_cluster
+    from corro_sim.utils.runtime import Tripwire, wait_for_all_pending_handles
+
+    tripwire = Tripwire.new_signals()
+    with open(args.topology) as f:
+        topo_text = f.read()
+    with open(args.schema) as f:
+        schema_sql = f.read()
+    try:
+        cluster, ordinals = build_cluster(
+            topo_text,
+            schema_sql,
+            state_dir=args.statedir,
+            seed=args.seed,
+            default_capacity=args.capacity,
+            tripwire=tripwire,
+        )
+    except TopologyError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    host, _, port = args.api_addr.partition(":")
+    api = ApiServer(
+        cluster,
+        host=host or "127.0.0.1",
+        port=int(port or 0),
+        tick_interval=args.tick_interval or None,
+    ).start()
+    admin = AdminServer(cluster, args.admin_path).start()
+    print(
+        json.dumps(
+            {
+                "api": api.url,
+                "admin": args.admin_path,
+                "nodes": ordinals,
+            }
+        ),
+        flush=True,
+    )
+    try:
+        tripwire.wait()
+    finally:
+        api.close()
+        admin.close()
+        wait_for_all_pending_handles(timeout=10)
+    return 0
+
+
+def _cmd_reload(args) -> int:
+    """`corrosion reload` analog: re-apply schema files through the
+    migrations endpoint (`corrosion/src/command/reload.rs`)."""
+    client = _client(args)
+    plan = client.schema_from_paths(args.schema_files)
+    print(json.dumps(plan))
+    return 0
+
+
+def _write_pem(path, content) -> None:
+    import os
+
+    with open(path, "w") as f:
+        f.write(content)
+    os.chmod(path, 0o600)
+    print(f"wrote {path}")
+
+
+def _cmd_tls_ca_generate(args) -> int:
+    """`corrosion tls ca generate` (command/tls.rs:7-28): ca_cert.pem +
+    ca_key.pem in the output dir."""
+    import os
+
+    from corro_sim.tls import generate_ca
+
+    cert, key = generate_ca()
+    _write_pem(os.path.join(args.output_dir, "ca_cert.pem"), cert)
+    _write_pem(os.path.join(args.output_dir, "ca_key.pem"), key)
+    return 0
+
+
+def _cmd_tls_server_generate(args) -> int:
+    """`corrosion tls server generate <ip>` (command/tls.rs:30-62)."""
+    import os
+
+    from corro_sim.tls import generate_server_cert
+
+    with open(args.ca_cert) as f:
+        ca_cert = f.read()
+    with open(args.ca_key) as f:
+        ca_key = f.read()
+    cert, key = generate_server_cert(ca_cert, ca_key, args.ip)
+    _write_pem(os.path.join(args.output_dir, "server_cert.pem"), cert)
+    _write_pem(os.path.join(args.output_dir, "server_key.pem"), key)
+    return 0
+
+
+def _cmd_tls_client_generate(args) -> int:
+    """`corrosion tls client generate` (command/tls.rs:64-96)."""
+    import os
+
+    from corro_sim.tls import generate_client_cert
+
+    with open(args.ca_cert) as f:
+        ca_cert = f.read()
+    with open(args.ca_key) as f:
+        ca_key = f.read()
+    cert, key = generate_client_cert(ca_cert, ca_key)
+    _write_pem(os.path.join(args.output_dir, "client_cert.pem"), cert)
+    _write_pem(os.path.join(args.output_dir, "client_key.pem"), key)
+    return 0
 
 
 def main(argv=None) -> int:

@@ -91,19 +91,32 @@ class ApiClient:
         token: str | None = None,
         node: int | None = None,
         timeout: float = 30.0,
+        ssl_context=None,
     ):
+        tls = ssl_context is not None
         if isinstance(addr, str):
             u = urllib.parse.urlparse(
                 addr if "//" in addr else f"http://{addr}"
             )
-            addr = (u.hostname or "127.0.0.1", u.port or 80)
+            tls = tls or u.scheme == "https"
+            addr = (u.hostname or "127.0.0.1",
+                    u.port or (443 if tls else 80))
         self.addr = addr
         self.token = token
         self.node = node  # default target agent ordinal
         self.timeout = timeout
+        if tls and ssl_context is None:
+            import ssl as _ssl
+
+            ssl_context = _ssl.create_default_context()
+        self.ssl_context = ssl_context
 
     # ---------------------------------------------------------- plumbing
     def _conn(self) -> http.client.HTTPConnection:
+        if self.ssl_context is not None:
+            return http.client.HTTPSConnection(
+                *self.addr, timeout=self.timeout, context=self.ssl_context
+            )
         return http.client.HTTPConnection(*self.addr, timeout=self.timeout)
 
     def _headers(self) -> dict:

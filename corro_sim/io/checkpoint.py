@@ -243,10 +243,12 @@ def _read(path):
 
 
 def _rebuild_layout(meta):
-    from corro_sim.schema import TableLayout, parse_and_constrain
+    from corro_sim.schema import TableLayout, schema_from_history
 
     lm = meta["layout"]
-    schema = parse_and_constrain(meta["schema_history"][-1])
+    # replay the whole migration history: entries after the first may be
+    # partial DDL (migrate() has merge semantics)
+    schema = schema_from_history(meta["schema_history"])
     layout = TableLayout.__new__(TableLayout)
     layout.schema = schema
     layout._ranges = {t: tuple(r) for t, r in lm["ranges"].items()}

@@ -197,6 +197,28 @@ def parse_and_constrain(sql: str) -> Schema:
     return constrain(parse_schema(sql))
 
 
+def schema_from_history(history) -> Schema:
+    """Fold a migration history (list of DDL texts) into the live schema.
+
+    Each entry merges into the accumulated schema the same way
+    ``LiveCluster.migrate`` does (``execute_schema`` merge semantics,
+    ``api/public/mod.rs:443-528``): tables an entry doesn't mention are
+    retained. Checkpoint restore replays the whole history — the last
+    entry alone may be a partial migration."""
+    schema = None
+    for sql in history:
+        new = parse_and_constrain(sql)
+        if schema is None:
+            schema = new
+        else:
+            schema = dataclasses.replace(
+                new, tables={**schema.tables, **new.tables}
+            )
+    if schema is None:
+        raise SchemaError("empty schema history")
+    return schema
+
+
 @dataclasses.dataclass(frozen=True)
 class MigrationPlan:
     new_tables: tuple  # table names

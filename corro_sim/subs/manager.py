@@ -37,6 +37,7 @@ from corro_sim.subs.query import (
     eval_predicate_py,
     parse_query,
     predicate_columns,
+    split_host_predicate,
     split_pk_predicate,
 )
 
@@ -125,16 +126,31 @@ class Matcher:
         self._proj_idx = [layout.col_index(select.table, c)
                           for c in self.columns]
         # WHERE splits: pk terms run host-side over the slot-allocation
-        # map; value terms compile to device rank comparisons.
-        self._pk_where, dev_where = split_pk_predicate(
+        # map; corro_json_contains terms run host-side over decoded
+        # values; the rest compiles to device rank comparisons.
+        self._pk_where, rest_where = split_pk_predicate(
             select.where, frozenset(pk_names)
         )
+        host_where, dev_where = split_host_predicate(rest_where)
         self._dev_where = dev_where
+        self._host_where = host_where
         self._pk_names = tuple(pk_names)
         self._pk_mask_cache = (None, None)  # (layout generation, mask)
-        for c in predicate_columns(dev_where):
+        for c in predicate_columns(dev_where) | predicate_columns(host_where):
             if c not in table:
                 raise QueryError(f"no such column {select.table}.{c}")
+        # host terms need their columns decoded: extend the projection
+        # with any not already selected; only the first _n_vis cells are
+        # client-visible (emitted / diffed)
+        self._n_vis = len(self._proj_idx)
+        self._host_cols = sorted(predicate_columns(host_where))
+        self._host_pos = {}
+        for c in self._host_cols:
+            if c in self.columns:
+                self._host_pos[c] = self.columns.index(c)
+            else:
+                self._host_pos[c] = len(self._proj_idx)
+                self._proj_idx.append(layout.col_index(select.table, c))
         self._row_key = layout.row_key  # slot -> (table, pk) | None
 
         self._eval = self._build_eval()
@@ -243,7 +259,7 @@ class Matcher:
         key = self._row_key(self._start + slot)
         pk = list(key[1]) if key else []
         cells = []
-        for j, rank in enumerate(proj_row):
+        for rank in proj_row[: self._n_vis]:  # host-only cols stay hidden
             cells.append(
                 None if rank == int(NEG) else self.universe.decode(int(rank))
             )
@@ -276,6 +292,16 @@ class Matcher:
         pk_mask = self._pk_mask()
         if pk_mask is not None:
             match = match & pk_mask
+        if self._host_where is not None:
+            match = match.copy()
+            for s in np.nonzero(match)[0]:
+                vals = {
+                    c: (None if proj[s, j] == int(NEG)
+                        else self.universe.decode(int(proj[s, j])))
+                    for c, j in self._host_pos.items()
+                }
+                if not eval_predicate_py(self._host_where, vals.get):
+                    match[s] = False
         return match, proj
 
     def prime(self, table_state):
@@ -311,10 +337,14 @@ class Matcher:
         events = []
         ins = match & ~self._prev_match
         dele = ~match & self._prev_match
+        # diff only the client-visible cells: a change in a host-predicate
+        # column that doesn't flip the match is not an UPDATE (the
+        # reference's query-table diff sees only selected columns)
+        n = self._n_vis
         upd = (
             match
             & self._prev_match
-            & (proj != self._prev_proj).any(axis=1)
+            & (proj[:, :n] != self._prev_proj[:, :n]).any(axis=1)
         )
         for kind, mask in (("insert", ins), ("update", upd), ("delete", dele)):
             for s in np.nonzero(mask)[0]:

@@ -70,6 +70,19 @@ class Not:
 
 
 @dataclasses.dataclass(frozen=True)
+class JsonContains:
+    """``corro_json_contains(a, b)`` predicate term: one argument is a
+    column, the other a JSON text literal; true iff the first JSON value
+    is contained in the second (the reference's custom SQLite scalar,
+    ``sqlite-functions/src/lib.rs:14-51``). Evaluated host-side over
+    decoded values — containment has no rank-interval compilation."""
+
+    col: str
+    selector: str  # the JSON text literal argument
+    col_is_object: bool  # True: literal ⊆ column value; False: reverse
+
+
+@dataclasses.dataclass(frozen=True)
 class Select:
     table: str
     columns: tuple  # () = *
@@ -88,9 +101,7 @@ class Select:
         out = set()
 
         def walk(p):
-            if isinstance(p, Cmp):
-                out.add(p.col)
-            elif isinstance(p, IsNull):
+            if isinstance(p, (Cmp, IsNull, JsonContains)):
                 out.add(p.col)
             elif isinstance(p, (And, Or)):
                 for q in p.parts:
@@ -106,6 +117,11 @@ class Select:
 def _render(p) -> str:
     if isinstance(p, Cmp):
         return f"{p.col} {p.op} {_render_lit(p.lit)}"
+    if isinstance(p, JsonContains):
+        lit = _render_lit(p.selector)
+        if p.col_is_object:
+            return f"corro_json_contains({lit}, {p.col})"
+        return f"corro_json_contains({p.col}, {lit})"
     if isinstance(p, IsNull):
         return f"{p.col} IS{' NOT' if p.negated else ''} NULL"
     if isinstance(p, And):
@@ -122,6 +138,8 @@ def _render_lit(lit) -> str:
         return "NULL"
     if isinstance(lit, str):
         return "'" + lit.replace("'", "''") + "'"
+    if isinstance(lit, (bytes, bytearray)):
+        return "X'" + bytes(lit).hex() + "'"
     return repr(lit)
 
 
@@ -129,7 +147,8 @@ def _render_lit(lit) -> str:
 
 _TOKEN = re.compile(
     r"\s*(?:"
-    r"(?P<str>'(?:[^']|'')*')"
+    r"(?P<blob>[xX]'(?:[0-9A-Fa-f][0-9A-Fa-f])*')"
+    r"|(?P<str>'(?:[^']|'')*')"
     r"|(?P<num>-?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)"
     r"|(?P<op><=|>=|!=|<>|=|<|>)"
     r"|(?P<punct>[(),*])"
@@ -147,7 +166,9 @@ def _tokenize(sql: str):
                 break
             raise QueryError(f"bad token at {sql[pos:pos + 20]!r}")
         pos = m.end()
-        if m.lastgroup == "str":
+        if m.lastgroup == "blob":
+            out.append(("lit", bytes.fromhex(m.group("blob")[2:-1])))
+        elif m.lastgroup == "str":
             out.append(("lit", m.group("str")[1:-1].replace("''", "'")))
         elif m.lastgroup == "num":
             t = m.group("num")
@@ -238,6 +259,8 @@ class _Parser:
             self.expect(")")
             return inner
         col = self.expect("ident")
+        if col.lower() == "corro_json_contains" and self.peek()[0] == "(":
+            return self._parse_json_contains()
         k, v = self.next()
         if k == "IS":
             negated = False
@@ -255,6 +278,38 @@ class _Parser:
             raise QueryError(f"expected literal, got {lk} {lv!r}")
         return Cmp(op=v, col=col, lit=lv)
 
+    def _parse_json_contains(self):
+        import json as _json
+
+        self.expect("(")
+        args = [self.next()]
+        self.expect(",")
+        args.append(self.next())
+        self.expect(")")
+        kinds = tuple(k for k, _ in args)
+        if kinds == ("lit", "ident"):
+            lit, col, col_is_object = args[0][1], args[1][1], True
+        elif kinds == ("ident", "lit"):
+            col, lit, col_is_object = args[0][1], args[1][1], False
+        else:
+            raise QueryError(
+                "corro_json_contains needs one column and one JSON text "
+                f"literal, got {kinds}"
+            )
+        if not isinstance(lit, str):
+            raise QueryError(
+                "corro_json_contains literal argument must be JSON text"
+            )
+        try:
+            _json.loads(lit)
+        except ValueError:
+            raise QueryError(
+                f"corro_json_contains: invalid JSON literal {lit!r}"
+            ) from None
+        return JsonContains(
+            col=col, selector=lit, col_is_object=col_is_object
+        )
+
 
 def parse_query(sql: str) -> Select:
     return _Parser(_tokenize(sql)).parse_select()
@@ -265,7 +320,7 @@ def predicate_columns(p) -> frozenset:
     out = set()
 
     def walk(q):
-        if isinstance(q, (Cmp, IsNull)):
+        if isinstance(q, (Cmp, IsNull, JsonContains)):
             out.add(q.col)
         elif isinstance(q, (And, Or)):
             for r in q.parts:
@@ -276,6 +331,40 @@ def predicate_columns(p) -> frozenset:
     if p is not None:
         walk(p)
     return frozenset(out)
+
+
+def _has_json_contains(p) -> bool:
+    if isinstance(p, JsonContains):
+        return True
+    if isinstance(p, (And, Or)):
+        return any(_has_json_contains(q) for q in p.parts)
+    if isinstance(p, Not):
+        return _has_json_contains(p.inner)
+    return False
+
+
+def split_host_predicate(where):
+    """Partition a (value-column) WHERE AST into (host_pred, dev_pred).
+
+    Terms containing ``corro_json_contains`` evaluate host-side over
+    decoded values — containment has no rank-interval form, and values
+    interned after compilation would miss a baked rank mask. Top-level
+    AND parts split independently; a part is host as soon as it contains
+    a containment call anywhere (OR/NOT mixing is fine: host evaluation
+    handles the full predicate grammar).
+    """
+    if where is None:
+        return None, None
+    parts = where.parts if isinstance(where, And) else (where,)
+    host_parts = [p for p in parts if _has_json_contains(p)]
+    dev_parts = [p for p in parts if not _has_json_contains(p)]
+
+    def join(ps):
+        if not ps:
+            return None
+        return ps[0] if len(ps) == 1 else And(tuple(ps))
+
+    return join(host_parts), join(dev_parts)
 
 
 def split_pk_predicate(where, pk_cols: frozenset):
@@ -338,6 +427,21 @@ def eval_predicate_py(p, get) -> bool:
         raise QueryError(f"bad op {p.op!r}")
     if isinstance(p, IsNull):
         return (get(p.col) is not None) if p.negated else (get(p.col) is None)
+    if isinstance(p, JsonContains):
+        from corro_sim.functions import json_contains, json_contains_text
+
+        v = get(p.col)
+        if p.col_is_object:
+            return json_contains_text(p.selector, v)
+        if not isinstance(v, str):
+            return False
+        try:
+            import json as _json
+
+            parsed = _json.loads(v)
+        except ValueError:
+            return False
+        return json_contains(parsed, _json.loads(p.selector))
     if isinstance(p, And):
         return all(eval_predicate_py(q, get) for q in p.parts)
     if isinstance(p, Or):
@@ -426,6 +530,11 @@ def compile_predicate(pred, universe: RankUniverse, col_index):
         if isinstance(p, Not):
             f = comp(p.inner)
             return lambda vr, unset: ~f(vr, unset)
+        if isinstance(p, JsonContains):
+            raise QueryError(
+                "corro_json_contains cannot compile to rank space — "
+                "split it host-side first (split_host_predicate)"
+            )
         raise QueryError(f"bad predicate node {p!r}")
 
     if pred is None:

@@ -209,3 +209,23 @@ def test_warm_restore_catch_up_past_buffer_404s(tmp_path):
     assert r.sub_catch_up(sub_id, 0) is None  # unservable gap → 404
     init, q = r.sub_attach(sub_id, from_change_id=None, skip_rows=False)
     assert init is not None and q is not None  # full re-prime still works
+
+
+def test_restore_after_partial_ddl_migration(tmp_path):
+    """migrate() has merge semantics: a partial-DDL migration entry in the
+    schema history must not become the whole schema on restore."""
+    from corro_sim.harness.cluster import LiveCluster
+    from corro_sim.io.checkpoint import load_checkpoint, save_checkpoint
+
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    c.execute(["INSERT INTO kv (k, v) VALUES ('a', 'keep')"])
+    c.migrate("CREATE TABLE added (k INTEGER NOT NULL PRIMARY KEY);")
+    c.execute(["INSERT INTO added (k) VALUES (7)"])
+    path = str(tmp_path / "partial.npz")
+    save_checkpoint(c, path)
+
+    r = load_checkpoint(path)
+    _, rows = r.query_rows("SELECT k, v FROM kv")
+    assert rows == [["a", "keep"]]
+    _, rows = r.query_rows("SELECT k FROM added")
+    assert rows == [[7]]

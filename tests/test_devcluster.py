@@ -1,0 +1,164 @@
+"""Devcluster topology harness: `A -> B` files → one simulated cluster.
+
+Mirrors `corro-devcluster` (topology parsing `topology/mod.rs:22-52`,
+per-node state dirs `main.rs:104-135`); connectivity maps bootstrap-graph
+components onto the simulator's partition ids.
+"""
+
+import json
+
+import pytest
+
+from corro_sim.harness.devcluster import (
+    TopologyError,
+    all_nodes,
+    build_cluster,
+    components,
+    parse_topology,
+)
+
+SCHEMA = """
+CREATE TABLE kv (
+    k TEXT NOT NULL PRIMARY KEY,
+    v TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def test_parse_edges():
+    adj = parse_topology("A -> B\nB -> C\nA -> C\n")
+    assert adj == {"A": ["B", "C"], "B": ["C"], "C": []}
+    assert all_nodes(adj) == ["A", "B", "C"]
+
+
+def test_parse_right_only_node_registered():
+    adj = parse_topology("A -> B")
+    assert adj == {"A": ["B"], "B": []}
+
+
+def test_parse_comments_and_blanks():
+    adj = parse_topology("# cluster\n\nA -> B\n  # tail\n")
+    assert all_nodes(adj) == ["A", "B"]
+
+
+def test_parse_syntax_error():
+    with pytest.raises(TopologyError):
+        parse_topology("A => B")
+    with pytest.raises(TopologyError):
+        parse_topology("A ->")
+
+
+def test_components():
+    adj = parse_topology("A -> B\nC -> D\nB -> A\n")
+    comp = components(adj)
+    assert comp["A"] == comp["B"]
+    assert comp["C"] == comp["D"]
+    assert comp["A"] != comp["C"]
+
+
+def test_build_cluster_converges_within_component(tmp_path):
+    cluster, ordinals = build_cluster(
+        "A -> B\nB -> C\n", SCHEMA, state_dir=str(tmp_path),
+        default_capacity=16,
+    )
+    assert ordinals == {"A": 0, "B": 1, "C": 2}
+    cluster.execute(["INSERT INTO kv (k, v) VALUES ('x', '1')"],
+                    node=ordinals["A"])
+    assert cluster.run_until_converged() is not None
+    for name in ("B", "C"):
+        _, rows = cluster.query_rows("SELECT k, v FROM kv",
+                                     node=ordinals[name])
+        assert rows == [["x", "1"]]
+    # per-node state dirs with the name -> ordinal mapping
+    meta = json.loads((tmp_path / "B" / "node.json").read_text())
+    assert meta["node"] == 1 and meta["bootstrap"] == ["C"]
+
+
+def test_disconnected_components_never_converge():
+    cluster, ordinals = build_cluster(
+        "A -> B\nC -> D\n", SCHEMA, default_capacity=16,
+    )
+    cluster.execute(["INSERT INTO kv (k, v) VALUES ('only-ab', '1')"],
+                    node=ordinals["A"])
+    cluster.tick(64)
+    _, rows = cluster.query_rows("SELECT k FROM kv", node=ordinals["B"])
+    assert rows == [["only-ab"]]
+    for name in ("C", "D"):
+        _, rows = cluster.query_rows("SELECT k FROM kv",
+                                     node=ordinals[name])
+        assert rows == []
+
+
+def test_empty_topology_rejected():
+    with pytest.raises(TopologyError):
+        build_cluster("# nothing\n", SCHEMA)
+
+
+def test_cli_devcluster_and_reload(tmp_path):
+    """Drive the devcluster + reload subcommands in-process."""
+    import contextlib
+    import io
+    import threading
+
+    from corro_sim import cli
+    from corro_sim.utils.runtime import Tripwire
+
+    schema = tmp_path / "schema.sql"
+    schema.write_text(SCHEMA)
+    topo = tmp_path / "topo.txt"
+    topo.write_text("A -> B\n")
+    sock = str(tmp_path / "dc.sock")
+
+    trip_holder = {}
+    orig = Tripwire.new_signals
+    Tripwire.new_signals = staticmethod(
+        lambda: trip_holder.setdefault("t", Tripwire()))
+    buf = io.StringIO()
+    out = {}
+
+    def run():
+        with contextlib.redirect_stdout(buf):
+            out["rc"] = cli.main([
+                "devcluster", str(topo), "--schema", str(schema),
+                "--statedir", str(tmp_path / "state"),
+                "--admin-path", sock, "--capacity", "16",
+                "--tick-interval", "0",
+            ])
+
+    th = threading.Thread(target=run)
+    th.start()
+    try:
+        import time
+
+        for _ in range(600):
+            if buf.getvalue().strip():
+                break
+            time.sleep(0.05)
+        info = json.loads(buf.getvalue().splitlines()[0])
+        assert info["nodes"] == {"A": 0, "B": 1}
+        api = info["api"]
+
+        rc = cli.main(["exec", "--api", api,
+                       "INSERT INTO kv (k, v) VALUES ('c', 'li')"])
+        assert rc == 0
+
+        # reload: apply an additional schema file through the migrations
+        # endpoint, then write to the new table
+        extra = tmp_path / "extra.sql"
+        extra.write_text(
+            "CREATE TABLE extra2 (id INTEGER NOT NULL PRIMARY KEY);")
+        rbuf = io.StringIO()
+        with contextlib.redirect_stdout(rbuf):
+            rc = cli.main(["reload", "--api", api, str(extra)])
+        assert rc == 0
+        plan = json.loads(rbuf.getvalue())
+        assert "extra2" in plan["new_tables"]
+        rc = cli.main(["exec", "--api", api,
+                       "INSERT INTO extra2 (id) VALUES (9)"])
+        assert rc == 0
+        assert (tmp_path / "state" / "A" / "node.json").exists()
+    finally:
+        Tripwire.new_signals = staticmethod(orig)
+        trip_holder["t"].trip()
+        th.join(timeout=20)
+    assert out["rc"] == 0

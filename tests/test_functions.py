@@ -1,0 +1,147 @@
+"""corro_json_contains: the custom SQL scalar (sqlite-functions crate).
+
+Containment semantics mirror `sqlite-functions/src/lib.rs:34-51` (the
+behavior cases below follow its test matrix, `lib.rs:71-126`); the query
+integration is this framework's own: containment terms evaluate
+host-side in the matcher (no rank-interval form exists), composing with
+device-compiled terms and pk terms.
+"""
+
+import pytest
+
+from corro_sim.functions import json_contains, json_contains_text
+from corro_sim.harness.cluster import LiveCluster
+from corro_sim.subs.query import JsonContains, QueryError, parse_query
+
+SCHEMA = """
+CREATE TABLE services (
+    name TEXT NOT NULL PRIMARY KEY,
+    meta TEXT NOT NULL DEFAULT '{}',
+    port INTEGER NOT NULL DEFAULT 0
+);
+"""
+
+
+def j(s, o):
+    import json
+
+    return json_contains(json.loads(s), json.loads(o))
+
+
+def test_containment_matrix():
+    # the reference's own test matrix (lib.rs:71-126)
+    assert j("{}", "{}")
+    assert j("{}", '{"key": "value"}')
+    assert not j('{"key": "value"}', "{}")
+    assert j('{"key": "value"}', '{"key": "value"}')
+    assert j('{"key": "value"}', '{"key": "value", "key2": "value2"}')
+    assert not j('{"key": "value"}', '{"key": "wrong value"}')
+    assert j('{"metadata": {"key": "value"}}',
+             '{"metadata": {"key": "value"}}')
+    assert not j('{"metadata": {"key": "value"}}',
+                 '{"metadata": {"key": "wrong value"}}')
+    # non-objects: strict equality
+    assert j("3", "3")
+    assert not j("3", "4")
+    assert j('"x"', '"x"')
+    assert not j('[1, 2]', '[1, 2, 3]')  # arrays are not subset-matched
+
+
+def test_text_helper_malformed_is_false():
+    assert not json_contains_text("{}", "{not json")
+    assert not json_contains_text("{}", None)
+    assert not json_contains_text("{}", 42)
+    assert json_contains_text("{}", "{}")
+
+
+def test_parse_shapes():
+    q = parse_query(
+        "SELECT name FROM services WHERE "
+        "corro_json_contains('{\"app\": \"web\"}', meta)")
+    assert isinstance(q.where, JsonContains)
+    assert q.where.col == "meta" and q.where.col_is_object
+    assert "meta" in q.referenced_columns()
+    q2 = parse_query(
+        "SELECT name FROM services WHERE corro_json_contains(meta, '{}')")
+    assert not q2.where.col_is_object
+    with pytest.raises(QueryError):
+        parse_query(
+            "SELECT name FROM services WHERE corro_json_contains('{', meta)")
+    with pytest.raises(QueryError):
+        parse_query(
+            "SELECT name FROM services WHERE corro_json_contains(1, meta)")
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=32)
+    c.execute([
+        "INSERT INTO services (name, meta, port) VALUES "
+        "('web', '{\"app\": \"web\", \"env\": \"prod\"}', 80), "
+        "('db', '{\"app\": \"db\", \"env\": \"prod\"}', 5432), "
+        "('bad', 'not json', 1)",
+    ])
+    return c
+
+
+def test_query_filter_selector_in_column(cluster):
+    _, rows = cluster.query_rows(
+        "SELECT name, port FROM services WHERE "
+        "corro_json_contains('{\"env\": \"prod\"}', meta)")
+    assert sorted(r[0] for r in rows) == ["db", "web"]
+    _, rows = cluster.query_rows(
+        "SELECT name FROM services WHERE "
+        "corro_json_contains('{\"app\": \"web\"}', meta)")
+    assert [r[0] for r in rows] == ["web"]
+
+
+def test_query_filter_composes_with_device_terms(cluster):
+    _, rows = cluster.query_rows(
+        "SELECT name FROM services WHERE "
+        "corro_json_contains('{\"env\": \"prod\"}', meta) AND port > 100")
+    assert [r[0] for r in rows] == ["db"]
+    _, rows = cluster.query_rows(
+        "SELECT name FROM services WHERE "
+        "NOT corro_json_contains('{\"env\": \"prod\"}', meta)")
+    assert [r[0] for r in rows] == ["bad"]  # malformed json never contains
+
+
+def test_query_filter_column_as_selector(cluster):
+    # column ⊆ literal: db's meta is contained in this superset
+    _, rows = cluster.query_rows(
+        "SELECT name FROM services WHERE corro_json_contains(meta, "
+        "'{\"app\": \"db\", \"env\": \"prod\", \"extra\": 1}')")
+    assert [r[0] for r in rows] == ["db"]
+
+
+def test_subscription_with_containment(cluster):
+    sub_id, initial, q = cluster.subscribe_attached(
+        "SELECT name FROM services WHERE "
+        "corro_json_contains('{\"env\": \"stage\"}', meta)")
+    names = [e["row"][1][0] for e in initial if "row" in e]
+    assert names == []
+    cluster.execute([
+        "INSERT INTO services (name, meta) VALUES "
+        "('api', '{\"env\": \"stage\"}')"])
+    cluster.tick(1)
+    events = list(q)
+    assert any(
+        e.kind == "insert" and e.cells[0] == "api" for e in events
+    ), events
+    # flipping an unrelated json key keeps it matching: UPDATE only if a
+    # *visible* column changed — name didn't, so no spurious update
+    q.clear()
+    cluster.execute([
+        "UPDATE services SET meta = '{\"env\": \"stage\", \"x\": 1}' "
+        "WHERE name = 'api'"])
+    cluster.tick(1)
+    assert not [e for e in q if e.kind == "update"], list(q)
+    # and leaving the filter emits a delete
+    q.clear()
+    cluster.execute([
+        "UPDATE services SET meta = '{\"env\": \"prod\"}' "
+        "WHERE name = 'api'"])
+    cluster.tick(1)
+    kinds = [e.kind for e in q]
+    assert "delete" in kinds, list(q)
+    cluster.unsubscribe(sub_id)

@@ -266,3 +266,15 @@ def test_subscription_hash_stable_across_reattach(server, client):
     re = client.subscription(sub.id, skip_rows=True)
     assert re.hash == h1
     re.close()
+
+
+def test_blob_values_over_http(client):
+    """Blob cells serialize as the SqliteValue JSON shape {"blob": [u8…]}
+    (corro-api-types) on the query stream."""
+    client.schema([
+        "CREATE TABLE blobby (k INTEGER NOT NULL PRIMARY KEY, "
+        "data BLOB);"])
+    client.execute(
+        ["INSERT INTO blobby (k, data) VALUES (1, X'0badcafe')"])
+    cols, rows = client.query_rows("SELECT k, data FROM blobby")
+    assert rows == [[1, {"blob": [0x0B, 0xAD, 0xCA, 0xFE]}]]

@@ -439,3 +439,29 @@ def test_count_params_and_lexer():
     assert strip_comments("a /* x */ b") == "a   b"
     assert strip_comments("'/* not a comment */'") == "'/* not a comment */'"
     assert strip_comments("'it''s' -- c") == "'it''s' "
+
+
+def test_bytea_param_roundtrip(server):
+    """bytea binds as a blob literal (X'..') and round-trips both ways."""
+    import corro_sim.api.pg as pg
+    server.cluster.migrate(
+        "CREATE TABLE blobs (k INTEGER NOT NULL PRIMARY KEY, "
+        "data BLOB);")
+    c = SimplePgClient(*server.addr)
+    payload = bytes(range(16))
+    _, _, tags, errors, _ = c.extended(
+        "INSERT INTO blobs (k, data) VALUES ($1, $2)",
+        params=[1, payload],
+        param_oids=[pg.OID_INT8, pg.OID_BYTEA])
+    assert not errors, errors
+    fields, rows, _, errors = c.query("SELECT data FROM blobs WHERE k = 1")
+    assert not errors
+    assert rows == [[payload]]
+    assert fields[0][1] == pg.OID_BYTEA
+    # blob literal directly in SQL
+    _, _, _, errors = c.query(
+        "INSERT INTO blobs (k, data) VALUES (2, X'deadbeef')")
+    assert not errors, errors
+    _, rows, _, _ = c.query("SELECT k FROM blobs WHERE data = X'deadbeef'")
+    assert rows == [[2]]
+    c.close()

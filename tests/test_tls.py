@@ -1,0 +1,209 @@
+"""TLS tooling: cert generation + mTLS on the HTTP API.
+
+Mirrors the reference's cert tooling (``corro-types/src/tls.rs``: ECDSA
+P-384 CA/server/client certs) and the `corrosion tls` CLI
+(``corrosion/src/command/tls.rs``); the consumer here is the HTTP API
+listener (the framework's network surface).
+"""
+
+import contextlib
+import io
+import ssl
+
+import pytest
+from cryptography import x509
+from cryptography.hazmat.primitives.asymmetric import ec
+
+from corro_sim.tls import (
+    client_ssl_context,
+    generate_ca,
+    generate_client_cert,
+    generate_server_cert,
+    server_ssl_context,
+)
+
+SCHEMA = """
+CREATE TABLE kv (
+    k TEXT NOT NULL PRIMARY KEY,
+    v TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+@pytest.fixture(scope="module")
+def ca():
+    return generate_ca()
+
+
+def test_ca_properties(ca):
+    cert = x509.load_pem_x509_certificate(ca[0].encode())
+    bc = cert.extensions.get_extension_for_class(x509.BasicConstraints)
+    assert bc.value.ca
+    ku = cert.extensions.get_extension_for_class(x509.KeyUsage)
+    assert ku.value.key_cert_sign and ku.value.crl_sign
+    assert isinstance(cert.public_key().curve, ec.SECP384R1)
+    # 5-year validity (tls.rs:33)
+    days = (cert.not_valid_after_utc - cert.not_valid_before_utc).days
+    assert days == 365 * 5
+
+
+def test_server_cert_san_and_chain(ca):
+    cert_pem, key_pem = generate_server_cert(*ca, "127.0.0.1")
+    cert = x509.load_pem_x509_certificate(cert_pem.encode())
+    san = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName)
+    ips = san.value.get_values_for_type(x509.IPAddress)
+    assert [str(i) for i in ips] == ["127.0.0.1"]
+    ca_cert = x509.load_pem_x509_certificate(ca[0].encode())
+    assert cert.issuer == ca_cert.subject
+    cert.verify_directly_issued_by(ca_cert)  # signature check
+    days = (cert.not_valid_after_utc - cert.not_valid_before_utc).days
+    assert days == 365
+
+
+def test_client_cert_empty_dn(ca):
+    cert_pem, _ = generate_client_cert(*ca)
+    cert = x509.load_pem_x509_certificate(cert_pem.encode())
+    assert list(cert.subject) == []  # tls.rs:90: empty DistinguishedName
+    cert.verify_directly_issued_by(
+        x509.load_pem_x509_certificate(ca[0].encode()))
+
+
+def _write(tmp_path, name, content):
+    p = tmp_path / name
+    p.write_text(content)
+    return str(p)
+
+
+def test_https_api_end_to_end(ca, tmp_path):
+    from corro_sim.api.http import ApiServer
+    from corro_sim.client import ApiClient
+    from corro_sim.harness.cluster import LiveCluster
+
+    cert, key = generate_server_cert(*ca, "127.0.0.1")
+    ctx = server_ssl_context(
+        _write(tmp_path, "s.pem", cert), _write(tmp_path, "s.key", key))
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    with ApiServer(cluster, ssl_context=ctx) as srv:
+        assert srv.url.startswith("https://")
+        cctx = client_ssl_context(ca_file=_write(tmp_path, "ca.pem", ca[0]))
+        cctx.check_hostname = False  # cert has an IP SAN, not a hostname
+        client = ApiClient(srv.addr, ssl_context=cctx)
+        client.execute(["INSERT INTO kv (k, v) VALUES ('a', '1')"])
+        rows = client.query_rows("SELECT k, v FROM kv")[1]
+        assert rows == [["a", "1"]]
+
+        # a client that doesn't trust the CA must fail the handshake
+        strict = client_ssl_context()
+        strict.check_hostname = False
+        bad = ApiClient(srv.addr, ssl_context=strict)
+        with pytest.raises((ssl.SSLError, OSError)):
+            bad.query_rows("SELECT k FROM kv")
+
+        # insecure mode skips verification (InsecureVerifier analog)
+        insecure = client_ssl_context(insecure=True)
+        loose = ApiClient(srv.addr, ssl_context=insecure)
+        assert loose.query_rows("SELECT k FROM kv")[1] == [["a"]]
+
+
+def test_mutual_tls_requires_client_cert(ca, tmp_path):
+    from corro_sim.api.http import ApiServer
+    from corro_sim.client import ApiClient
+    from corro_sim.harness.cluster import LiveCluster
+
+    scert, skey = generate_server_cert(*ca, "127.0.0.1")
+    ccert, ckey = generate_client_cert(*ca)
+    ca_f = _write(tmp_path, "ca.pem", ca[0])
+    ctx = server_ssl_context(
+        _write(tmp_path, "s.pem", scert), _write(tmp_path, "s.key", skey),
+        ca_file=ca_f, require_client_auth=True)
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    with ApiServer(cluster, ssl_context=ctx) as srv:
+        # with a client cert: works
+        cctx = client_ssl_context(
+            ca_file=ca_f,
+            cert_file=_write(tmp_path, "c.pem", ccert),
+            key_file=_write(tmp_path, "c.key", ckey))
+        cctx.check_hostname = False
+        good = ApiClient(srv.addr, ssl_context=cctx)
+        good.execute(["INSERT INTO kv (k, v) VALUES ('m', 'tls')"])
+
+        # without: handshake (or first request) fails
+        nocert = client_ssl_context(ca_file=ca_f)
+        nocert.check_hostname = False
+        bad = ApiClient(srv.addr, ssl_context=nocert)
+        with pytest.raises((ssl.SSLError, OSError, ConnectionError)):
+            bad.query_rows("SELECT k FROM kv")
+
+
+def test_tls_cli_commands(tmp_path):
+    from corro_sim import cli
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = cli.main(["tls", "ca", "generate",
+                       "--output-dir", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "ca_cert.pem").exists()
+    assert (tmp_path / "ca_key.pem").exists()
+
+    with contextlib.redirect_stdout(out):
+        rc = cli.main([
+            "tls", "server", "generate", "10.0.0.7",
+            "--ca-cert", str(tmp_path / "ca_cert.pem"),
+            "--ca-key", str(tmp_path / "ca_key.pem"),
+            "--output-dir", str(tmp_path)])
+    assert rc == 0
+    cert = x509.load_pem_x509_certificate(
+        (tmp_path / "server_cert.pem").read_bytes())
+    san = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName)
+    assert [str(i) for i in san.value.get_values_for_type(
+        x509.IPAddress)] == ["10.0.0.7"]
+
+    with contextlib.redirect_stdout(out):
+        rc = cli.main([
+            "tls", "client", "generate",
+            "--ca-cert", str(tmp_path / "ca_cert.pem"),
+            "--ca-key", str(tmp_path / "ca_key.pem"),
+            "--output-dir", str(tmp_path)])
+    assert rc == 0
+    assert (tmp_path / "client_cert.pem").exists()
+    assert (tmp_path / "client_key.pem").exists()
+
+
+def test_stalled_client_does_not_wedge_accept_loop(ca, tmp_path):
+    """A TCP client that never speaks TLS must not block other clients
+    (the handshake is deferred off the accept loop)."""
+    import socket
+
+    from corro_sim.api.http import ApiServer
+    from corro_sim.client import ApiClient
+    from corro_sim.harness.cluster import LiveCluster
+
+    cert, key = generate_server_cert(*ca, "127.0.0.1")
+    ctx = server_ssl_context(
+        _write(tmp_path, "s.pem", cert), _write(tmp_path, "s.key", key))
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    with ApiServer(cluster, ssl_context=ctx) as srv:
+        # open a raw TCP connection and send nothing
+        stall = socket.create_connection(srv.addr)
+        try:
+            cctx = client_ssl_context(
+                ca_file=_write(tmp_path, "ca.pem", ca[0]))
+            cctx.check_hostname = False
+            client = ApiClient(srv.addr, ssl_context=cctx, timeout=20)
+            client.execute(["INSERT INTO kv (k, v) VALUES ('go', 'on')"])
+            assert client.query_rows("SELECT k FROM kv")[1] == [["go"]]
+        finally:
+            stall.close()
+
+
+def test_https_url_default_port():
+    from corro_sim.client import ApiClient
+
+    c = ApiClient("https://example.invalid")
+    assert c.addr == ("example.invalid", 443)
+    assert c.ssl_context is not None
+    c2 = ApiClient("http://example.invalid")
+    assert c2.addr == ("example.invalid", 80)
