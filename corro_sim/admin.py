@@ -118,6 +118,30 @@ class AdminServer:
             out["down_by"] = (status >= 2).sum(axis=0).tolist()
         return out
 
+    def _cmd_cluster_rejoin(self, req):
+        """Admin Cluster Rejoin: revive a node with a renewed identity
+        (``FocaCmd::Rejoin``, ``corro-admin/src/lib.rs:364-383``)."""
+        if "node" not in req:
+            raise AdminError("cluster_rejoin requires 'node'")
+        return self.cluster.rejoin(int(req["node"]))
+
+    def _cmd_cluster_set_id(self, req):
+        """Admin Cluster SetId (``corro-admin/src/lib.rs:431-474``):
+        cluster ids map onto the partition plane (see
+        LiveCluster.set_cluster_id). Both fields are required — a
+        defaulted cluster_id of 0 would silently re-admit a walled-off
+        node into the main cluster."""
+        for field in ("node", "cluster_id"):
+            if field not in req:
+                raise AdminError(f"cluster_set_id requires {field!r}")
+        return self.cluster.set_cluster_id(
+            int(req["node"]), int(req["cluster_id"])
+        )
+
+    def _cmd_sync_reconcile_gaps(self, req):
+        """Admin Sync ReconcileGaps (``corro-admin/src/lib.rs:315-341``)."""
+        return self.cluster.reconcile_gaps()
+
     def _cmd_actor_version(self, req):
         actor = int(req.get("actor", 0))
         return self.cluster.actor_versions(actor)

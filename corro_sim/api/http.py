@@ -95,7 +95,7 @@ class _Handler(BaseHTTPRequestHandler):
         if not raw:
             raise _ApiError(400, "empty body")
         try:
-            return json.loads(raw)
+            return _decode_wire_values(json.loads(raw))
         except json.JSONDecodeError as e:
             raise _ApiError(400, f"invalid JSON body: {e}") from None
 
@@ -361,6 +361,18 @@ def _json_value(v):
     if isinstance(v, (bytes, bytearray)):
         return {"blob": list(v)}
     raise TypeError(f"not JSON-serializable: {type(v)!r}")
+
+
+def _decode_wire_values(v):
+    """Inverse of :func:`_json_value` for request bodies: statement
+    params of shape ``{"blob": [u8…]}`` become bytes."""
+    if isinstance(v, dict):
+        if set(v) == {"blob"} and isinstance(v["blob"], list):
+            return bytes(v["blob"])
+        return {k: _decode_wire_values(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_wire_values(x) for x in v]
+    return v
 
 
 def _as_wire(e) -> dict:

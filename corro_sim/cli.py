@@ -108,6 +108,12 @@ def _cmd_agent(args: argparse.Namespace) -> int:
     if args.tls_cert:
         from corro_sim.tls import server_ssl_context
 
+        if not args.tls_key:
+            print("--tls-cert requires --tls-key", file=sys.stderr)
+            return 2
+        if args.tls_client_auth and not args.tls_ca:
+            print("--tls-client-auth requires --tls-ca", file=sys.stderr)
+            return 2
         ssl_ctx = server_ssl_context(
             args.tls_cert, args.tls_key, ca_file=args.tls_ca,
             require_client_auth=args.tls_client_auth,
@@ -204,6 +210,8 @@ def _cmd_locks(args: argparse.Namespace) -> int:
 
 
 def _cmd_sync(args: argparse.Namespace) -> int:
+    if args.what == "reconcile-gaps":
+        return _print_json(_admin(args).call("sync_reconcile_gaps"))
     return _print_json(
         _admin(args).call("sync_generate", node=args.node)
     )
@@ -224,6 +232,20 @@ def _cmd_subs(args: argparse.Namespace) -> int:
 def _cmd_cluster(args: argparse.Namespace) -> int:
     if args.what == "members":
         return _print_json(_admin(args).call("cluster_members"))
+    if args.what == "rejoin":
+        return _print_json(
+            _admin(args).call("cluster_rejoin", node=args.node)
+        )
+    if args.what == "set-id":
+        if args.cluster_id is None:
+            print("set-id requires --cluster-id", file=sys.stderr)
+            return 2
+        return _print_json(
+            _admin(args).call(
+                "cluster_set_id", node=args.node,
+                cluster_id=args.cluster_id,
+            )
+        )
     return _print_json(_admin(args).call("cluster_membership_states"))
 
 
@@ -313,7 +335,7 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument("--admin-path", default="./corro-sim-admin.sock")
     pa.add_argument("--authz-token")
     pa.add_argument("--tls-cert", help="serve the HTTP API over TLS")
-    pa.add_argument("--tls-key")
+    pa.add_argument("--tls-key", help="private key for --tls-cert")
     pa.add_argument("--tls-ca", help="CA bundle for client verification")
     pa.add_argument(
         "--tls-client-auth", action="store_true",
@@ -362,8 +384,12 @@ def build_parser() -> argparse.ArgumentParser:
     pl.add_argument("--top", type=int)
     pl.set_defaults(fn=_cmd_locks)
 
-    psy = sub.add_parser("sync", help="generate a node's sync state")
+    psy = sub.add_parser("sync", help="sync state tooling")
     admin_args(psy)
+    psy.add_argument(
+        "what", nargs="?", default="generate",
+        choices=["generate", "reconcile-gaps"],
+    )
     psy.add_argument("--node", type=int, default=0)
     psy.set_defaults(fn=_cmd_sync)
 
@@ -377,9 +403,14 @@ def build_parser() -> argparse.ArgumentParser:
     psb.add_argument("id", nargs="?")
     psb.set_defaults(fn=_cmd_subs)
 
-    pc = sub.add_parser("cluster", help="membership introspection")
+    pc = sub.add_parser("cluster", help="membership introspection + ops")
     admin_args(pc)
-    pc.add_argument("what", choices=["members", "membership-states"])
+    pc.add_argument(
+        "what",
+        choices=["members", "membership-states", "rejoin", "set-id"],
+    )
+    pc.add_argument("--node", type=int, default=0)
+    pc.add_argument("--cluster-id", type=int)
     pc.set_defaults(fn=_cmd_cluster)
 
     pt = sub.add_parser(

@@ -45,7 +45,7 @@ class SubscriptionStream:
         line = self._resp.readline()
         if not line:
             raise StopIteration
-        event = json.loads(line)
+        event = _decode_wire(json.loads(line))
         cid = _change_id_of(event)
         if cid is not None:
             self.last_change_id = cid
@@ -72,6 +72,26 @@ class SubscriptionStream:
         return self.client.subscription(
             self.id, from_change_id=self.last_change_id, skip_rows=True
         )
+
+
+def _encode_wire(v):
+    """JSON default hook: bytes params → the SqliteValue blob shape."""
+    if isinstance(v, (bytes, bytearray)):
+        return {"blob": list(v)}
+    raise TypeError(f"not JSON-serializable: {type(v)!r}")
+
+
+def _decode_wire(v):
+    """Undo the SqliteValue JSON wire shapes: ``{"blob": [u8…]}`` →
+    bytes, recursively through event rows — the symmetric decode of the
+    server's ``_json_value`` encoder (api/http.py)."""
+    if isinstance(v, dict):
+        if set(v) == {"blob"} and isinstance(v["blob"], list):
+            return bytes(v["blob"])
+        return {k: _decode_wire(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_wire(x) for x in v]
+    return v
 
 
 def _change_id_of(event: dict) -> int | None:
@@ -137,7 +157,8 @@ class ApiClient:
         try:
             c.request(
                 method, path,
-                body=None if body is None else json.dumps(body),
+                body=None if body is None
+                else json.dumps(body, default=_encode_wire),
                 headers=self._headers(),
             )
             resp = c.getresponse()
@@ -154,7 +175,8 @@ class ApiClient:
         c = self._conn()
         c.request(
             method, path,
-            body=None if body is None else json.dumps(body),
+            body=None if body is None
+            else json.dumps(body, default=_encode_wire),
             headers=self._headers(),
         )
         resp = c.getresponse()
@@ -183,7 +205,7 @@ class ApiClient:
                 line = resp.readline()
                 if not line:
                     return
-                yield json.loads(line)
+                yield _decode_wire(json.loads(line))
         finally:
             resp.close()
 

@@ -612,6 +612,71 @@ class LiveCluster:
             assert len(part) == self.cfg.num_nodes
             self._part = np.asarray(part, np.int32)
 
+    def rejoin(self, node: int) -> dict:
+        """Admin `cluster rejoin` analog: revive with a *renewed identity*.
+
+        The reference sends ``FocaCmd::Rejoin`` — foca re-announces with a
+        fresh timestamp so peers that declared the node down accept it
+        back (``actor.rs:199-210``, ``corro-admin/src/lib.rs:364-383``).
+        Here: mark the node alive and bump its self-incarnation, the
+        SWIM refutation that overrides any DOWN belief as it gossips out.
+        """
+        self._check_node(node)
+        with self.locks.tracked(self._lock, f"rejoin node={node}", "write"):
+            self._alive[node] = True
+            inc = None
+            if self.cfg.swim_enabled:
+                swim = self.state.swim
+                new_inc = swim.inc[node, node] + 1
+                swim = swim.replace(
+                    inc=swim.inc.at[node, node].set(new_inc),
+                    status=swim.status.at[node, node].set(0),  # ALIVE
+                    since=swim.since.at[node, node].set(0),
+                )
+                self.state = self.state.replace(swim=swim)
+                inc = int(new_inc)
+            return {"node": node, "alive": True, "incarnation": inc}
+
+    def set_cluster_id(self, node: int, cluster_id: int) -> dict:
+        """Admin `cluster set-id` analog.
+
+        The reference stores a ``ClusterId(u16)`` per agent and refuses
+        gossip/sync across different ids (``actor.rs:222``, sync
+        ``Rejection::DifferentCluster``, ``api/peer.rs:1488-1499``). The
+        simulator's partition plane IS that wall — nodes with different
+        partition ids exchange nothing — so cluster ids map onto it."""
+        self._check_node(node)
+        if not (0 <= cluster_id < 2**16):
+            raise ExecError(f"cluster id {cluster_id} out of u16 range")
+        with self.locks.tracked(
+            self._lock, f"set_cluster_id node={node}", "write"
+        ):
+            self._part[node] = cluster_id
+            return {"node": node, "cluster_id": cluster_id}
+
+    def reconcile_gaps(self) -> dict:
+        """Admin `sync reconcile-gaps` analog: collapse bookkeeping state.
+
+        The reference's ``collapse_gaps`` rewrites
+        ``__corro_bookkeeping_gaps`` so adjacent/overlapping ranges merge
+        (``corro-admin/src/lib.rs:315-341``). The tensor bookkeeping
+        equivalent: re-absorb any window bits contiguous with the head
+        into the head counter. Normally a no-op — the step function
+        absorbs eagerly — so a nonzero head delta means drift repair."""
+        from corro_sim.core.bookkeeping import Bookkeeping
+        from corro_sim.utils.bits import absorb
+
+        with self.locks.tracked(self._lock, "reconcile gaps", "write"):
+            book = self.state.book
+            head, win = absorb(
+                book.head, book.win, self.cfg.chunks_per_version
+            )
+            moved = int(np.asarray((head != book.head).sum()))
+            self.state = self.state.replace(
+                book=Bookkeeping(head=head, win=win)
+            )
+            return {"actors_reconciled": moved}
+
     # --------------------------------------------------------- migrations
     def migrate(self, schema_sql: str, capacities: dict | None = None) -> dict:
         """POST /v1/migrations analog: diff-based, additive-only

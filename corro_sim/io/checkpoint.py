@@ -373,7 +373,14 @@ def restore_into(cluster, path, node: int = 0) -> None:
     The cluster keeps its identity, config shapes, gossip/SWIM state and
     HTTP surface; table data, bookkeeping, change log, value universe and
     slot layout are replaced wholesale; subscriptions are wiped
-    (the reference restore wipes ``__corro_subs``)."""
+    (the reference restore wipes ``__corro_subs``).
+
+    Sharp edge (shared with the reference): restoring a backup older
+    than what peers have already applied rewinds this actor's version
+    counter, so its next writes REUSE version numbers peers have seen —
+    and they will ignore them as duplicates. Restore into a cluster
+    whose peers are also being restored (or fresh), exactly like
+    ``corrosion restore`` is meant to be used (``main.rs:221-324``)."""
     meta, flat = _read(path)
     # volatile per-run state never crosses a restore (same filter as
     # restore()): the running cluster keeps its own topology + membership

@@ -80,6 +80,10 @@ class JsonContains:
     col: str
     selector: str  # the JSON text literal argument
     col_is_object: bool  # True: literal ⊆ column value; False: reverse
+    # parse-time cache of json.loads(selector); compare/hash by the text
+    selector_obj: object = dataclasses.field(
+        default=None, compare=False, hash=False
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -301,13 +305,14 @@ class _Parser:
                 "corro_json_contains literal argument must be JSON text"
             )
         try:
-            _json.loads(lit)
+            sel_obj = _json.loads(lit)
         except ValueError:
             raise QueryError(
                 f"corro_json_contains: invalid JSON literal {lit!r}"
             ) from None
         return JsonContains(
-            col=col, selector=lit, col_is_object=col_is_object
+            col=col, selector=lit, col_is_object=col_is_object,
+            selector_obj=sel_obj,
         )
 
 
@@ -428,20 +433,22 @@ def eval_predicate_py(p, get) -> bool:
     if isinstance(p, IsNull):
         return (get(p.col) is not None) if p.negated else (get(p.col) is None)
     if isinstance(p, JsonContains):
-        from corro_sim.functions import json_contains, json_contains_text
+        import json as _json
+
+        from corro_sim.functions import json_contains
 
         v = get(p.col)
-        if p.col_is_object:
-            return json_contains_text(p.selector, v)
         if not isinstance(v, str):
             return False
         try:
-            import json as _json
-
             parsed = _json.loads(v)
         except ValueError:
             return False
-        return json_contains(parsed, _json.loads(p.selector))
+        sel = p.selector_obj if p.selector_obj is not None \
+            else _json.loads(p.selector)
+        if p.col_is_object:
+            return json_contains(sel, parsed)
+        return json_contains(parsed, sel)
     if isinstance(p, And):
         return all(eval_predicate_py(q, get) for q in p.parts)
     if isinstance(p, Or):

@@ -152,6 +152,11 @@ def server_ssl_context(
 ) -> ssl.SSLContext:
     """Server-side context; with ``require_client_auth`` this is the mTLS
     posture of the reference's gossip server (peer.rs:168-204)."""
+    if require_client_auth and not ca_file:
+        raise ValueError(
+            "require_client_auth needs a CA bundle (ca_file) — an empty "
+            "trust store would reject every client"
+        )
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.load_cert_chain(cert_file, key_file)
     if ca_file:

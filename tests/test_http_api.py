@@ -277,4 +277,22 @@ def test_blob_values_over_http(client):
     client.execute(
         ["INSERT INTO blobby (k, data) VALUES (1, X'0badcafe')"])
     cols, rows = client.query_rows("SELECT k, data FROM blobby")
-    assert rows == [[1, {"blob": [0x0B, 0xAD, 0xCA, 0xFE]}]]
+    # the client decodes the {"blob": [u8...]} wire shape back to bytes
+    assert rows == [[1, b"\x0b\xad\xca\xfe"]]
+
+
+def test_blob_roundtrip_through_client(client):
+    """query_rows decodes the blob wire shape back to bytes, so
+    read-modify-write round-trips."""
+    client.schema([
+        "CREATE TABLE blobrt (k INTEGER NOT NULL PRIMARY KEY, "
+        "data BLOB);"])
+    client.execute([["INSERT INTO blobrt (k, data) VALUES (?, ?)",
+                     [1, None]],
+                    "INSERT INTO blobrt (k, data) VALUES (2, X'0102')"])
+    _, rows = client.query_rows("SELECT k, data FROM blobrt WHERE k = 2")
+    assert rows == [[2, b"\x01\x02"]]
+    v = rows[0][1]
+    client.execute([["UPDATE blobrt SET data = ? WHERE k = ?", [v, 1]]])
+    _, rows = client.query_rows("SELECT data FROM blobrt WHERE k = 1")
+    assert rows == [[1, b"\x01\x02"]]  # pk row-key prefix + projection

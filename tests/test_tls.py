@@ -207,3 +207,11 @@ def test_https_url_default_port():
     assert c.ssl_context is not None
     c2 = ApiClient("http://example.invalid")
     assert c2.addr == ("example.invalid", 80)
+
+
+def test_client_auth_requires_ca(ca, tmp_path):
+    cert, key = generate_server_cert(*ca, "127.0.0.1")
+    with pytest.raises(ValueError):
+        server_ssl_context(
+            _write(tmp_path, "s.pem", cert), _write(tmp_path, "s.key", key),
+            require_client_auth=True)
