@@ -40,6 +40,8 @@ import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from corro_sim.api.wire import decode_values as _decode_wire_values
+from corro_sim.api.wire import encode_value as _json_value
 from corro_sim.harness.cluster import ExecError, LiveCluster
 
 _SUB_PATH = re.compile(r"^/v1/subscriptions/([A-Za-z0-9_-]+)$")
@@ -98,6 +100,8 @@ class _Handler(BaseHTTPRequestHandler):
             return _decode_wire_values(json.loads(raw))
         except json.JSONDecodeError as e:
             raise _ApiError(400, f"invalid JSON body: {e}") from None
+        except ValueError as e:  # malformed blob shape
+            raise _ApiError(400, str(e)) from None
 
     def _node(self, params: dict) -> int:
         try:
@@ -355,24 +359,6 @@ def _sql_of_body(stmt) -> str:
     return sql
 
 
-def _json_value(v):
-    """Non-JSON-native cells on the wire: blobs use the reference's
-    SqliteValue JSON shape ``{"blob": [u8…]}`` (corro-api-types)."""
-    if isinstance(v, (bytes, bytearray)):
-        return {"blob": list(v)}
-    raise TypeError(f"not JSON-serializable: {type(v)!r}")
-
-
-def _decode_wire_values(v):
-    """Inverse of :func:`_json_value` for request bodies: statement
-    params of shape ``{"blob": [u8…]}`` become bytes."""
-    if isinstance(v, dict):
-        if set(v) == {"blob"} and isinstance(v["blob"], list):
-            return bytes(v["blob"])
-        return {k: _decode_wire_values(x) for k, x in v.items()}
-    if isinstance(v, list):
-        return [_decode_wire_values(x) for x in v]
-    return v
 
 
 def _as_wire(e) -> dict:

@@ -105,6 +105,11 @@ def _cmd_agent(args: argparse.Namespace) -> int:
         )
     host, _, port = args.api_addr.partition(":")
     ssl_ctx = None
+    if (args.tls_key or args.tls_ca or args.tls_client_auth) \
+            and not args.tls_cert:
+        # a TLS flag without --tls-cert would silently serve plain HTTP
+        print("TLS flags require --tls-cert", file=sys.stderr)
+        return 2
     if args.tls_cert:
         from corro_sim.tls import server_ssl_context
 

@@ -16,6 +16,9 @@ import json
 import socket
 import urllib.parse
 
+from corro_sim.api.wire import decode_values as _decode_wire
+from corro_sim.api.wire import encode_value as _encode_wire
+
 
 class ApiClientError(Exception):
     def __init__(self, status: int, message: str):
@@ -72,26 +75,6 @@ class SubscriptionStream:
         return self.client.subscription(
             self.id, from_change_id=self.last_change_id, skip_rows=True
         )
-
-
-def _encode_wire(v):
-    """JSON default hook: bytes params → the SqliteValue blob shape."""
-    if isinstance(v, (bytes, bytearray)):
-        return {"blob": list(v)}
-    raise TypeError(f"not JSON-serializable: {type(v)!r}")
-
-
-def _decode_wire(v):
-    """Undo the SqliteValue JSON wire shapes: ``{"blob": [u8…]}`` →
-    bytes, recursively through event rows — the symmetric decode of the
-    server's ``_json_value`` encoder (api/http.py)."""
-    if isinstance(v, dict):
-        if set(v) == {"blob"} and isinstance(v["blob"], list):
-            return bytes(v["blob"])
-        return {k: _decode_wire(x) for k, x in v.items()}
-    if isinstance(v, list):
-        return [_decode_wire(x) for x in v]
-    return v
 
 
 def _change_id_of(event: dict) -> int | None:

@@ -64,6 +64,7 @@ def deliver_versions(
     valid: jnp.ndarray,
     chunk: jnp.ndarray | None = None,
     bits_per_version: int = 1,
+    presorted: bool = False,
 ):
     """Record a flat batch of (dst, actor, version[, chunk]) deliveries.
 
@@ -84,6 +85,12 @@ def deliver_versions(
     chunk); the window bits are then applied with a plain scatter-add of
     ``1 << offset`` (safe once unique).
 
+    ``presorted=True`` skips the sort AND the inverse permutation: the
+    caller promises the lanes are already ordered by
+    ``(where(valid, dst, n+1), actor, ver, chunk)`` (the step function
+    hoists ONE sort for the whole per-lane pipeline) and receives the
+    result masks in the given (sorted) lane order.
+
     Batch semantics: window offsets are computed against the head *before*
     the batch — a batch models one round's concurrent deliveries, so a
     version more than ``window`` ahead of the pre-round head is dropped
@@ -101,12 +108,18 @@ def deliver_versions(
     # Sort by (dst, actor, ver, chunk); invalid lanes sort to the end.
     big = jnp.int32(n + 1)
     sdst = jnp.where(valid, dst, big)
-    order = jnp.lexsort((chunk, ver, actor, sdst))
-    s_dst = sdst[order]
-    s_actor = actor[order]
-    s_ver = ver[order]
-    s_chunk = chunk[order]
-    s_valid = valid[order]
+    if presorted:
+        order = None
+        s_dst, s_actor, s_ver, s_chunk, s_valid = (
+            sdst, actor, ver, chunk, valid
+        )
+    else:
+        order = jnp.lexsort((chunk, ver, actor, sdst))
+        s_dst = sdst[order]
+        s_actor = actor[order]
+        s_ver = ver[order]
+        s_chunk = chunk[order]
+        s_valid = valid[order]
 
     first_chunk = dedupe_sorted_mask(s_dst, s_actor, s_ver, s_chunk) & s_valid
     first_ver = dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
@@ -142,6 +155,13 @@ def deliver_versions(
 
     new_head, new_win = absorb(book.head, new_win, bpv)
 
+    if presorted:
+        return (
+            Bookkeeping(head=new_head, win=new_win),
+            fresh_sorted,
+            complete_sorted,
+            dropped_sorted,
+        )
     # Un-sort the masks back to caller order.
     inv = jnp.zeros((m,), jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
     return (

@@ -196,9 +196,27 @@ def sim_step(
     # Ground truth: the packet only lands if the link is actually up.
     delivered = valid & reach(src, dst)
 
+    # ONE lane sort for the whole delivery pipeline: bookkeeping dedupe
+    # (deliver_versions presorted path), changeset gathers, the merge
+    # scatter (coalesced by dst), and ring enqueue (grouped path) all run
+    # in this order — instead of each stage sorting for itself.
+    big = jnp.int32(n + 1)
+    sort_dst = jnp.where(delivered, dst, big)
+    if cpv == 1 and (n + 2) * (n + 2) < 2**31:
+        # pack (dst, actor) into one key; chunk is identically 0
+        order = jnp.lexsort((ver, sort_dst * jnp.int32(n + 2) + actor))
+    else:
+        order = jnp.lexsort((chunk, ver, actor, sort_dst))
+    dst = dst[order]
+    actor = actor[order]
+    ver = ver[order]
+    chunk = chunk[order]
+    delivered = delivered[order]
+
     # ------------------------------------- delivery: bookkeeping + merge
     book, fresh_chunk, complete, dropped = deliver_versions(
-        book, dst, actor, ver, delivered, chunk=chunk, bits_per_version=cpv
+        book, dst, actor, ver, delivered, chunk=chunk, bits_per_version=cpv,
+        presorted=True,
     )
     c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
         log, jnp.where(complete, actor, 0), jnp.maximum(ver, 1)
@@ -237,13 +255,15 @@ def sim_step(
     wq_dst, wq_actor, wq_ver, wq_valid, wq_chunk = _tile_chunks(
         cpv, rows_idx, rows_idx, w_ver, writers
     )
+    # both enqueues take the sort-free grouped path: wq lanes are keyed by
+    # the (sorted) node iota; delivery lanes carry the hoisted sort order
     gossip = enqueue_broadcasts(
         gossip, wq_dst, wq_actor, wq_ver, wq_chunk, wq_valid,
-        cfg.max_transmissions,
+        cfg.max_transmissions, grouped=True,
     )
     gossip = enqueue_broadcasts(
         gossip, dst, actor, ver, chunk, fresh_chunk,
-        cfg.rebroadcast_transmissions,
+        cfg.rebroadcast_transmissions, grouped=True,
     )
 
     # ----------------------------------------------------------------- SWIM

@@ -25,7 +25,11 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 
-from corro_sim.utils.slots import group_counts, ranks_within_group
+from corro_sim.utils.slots import (
+    group_counts,
+    ranks_within_group,
+    ranks_within_group_masked,
+)
 
 
 @flax.struct.dataclass
@@ -58,6 +62,7 @@ def enqueue_broadcasts(
     chunk: jnp.ndarray,
     valid: jnp.ndarray,
     transmissions: int,
+    grouped: bool = False,
 ) -> GossipState:
     """Append (actor, ver, chunk) to each dst's pending ring buffer.
 
@@ -65,18 +70,39 @@ def enqueue_broadcasts(
     order by dst, rank within group, slot = (cursor + rank) % P. Overwriting
     a still-live slot is counted as overflow (the bounded-queue drop of
     ``handlers.rs:866-884``).
+
+    ``grouped=True`` skips the sort: the caller promises valid lanes'
+    dst values are already nondecreasing (the step function's hoisted
+    lane sort), so ranks come from a sort-free cumsum/cummax pass.
     """
     n, p = gossip.pend_tx.shape
     big = jnp.int32(n + 1)
-    key = jnp.where(valid, dst, big)
-    order = jnp.argsort(key)
-    s_dst = key[order]
-    s_actor = actor[order]
-    s_ver = ver[order]
-    s_chunk = chunk[order]
-    s_valid = valid[order]
+    if grouped:
+        s_dst = jnp.where(valid, dst, big)
+        s_actor, s_ver, s_chunk, s_valid = actor, ver, chunk, valid
+        rank = ranks_within_group_masked(dst, valid)
+        # Grouped lanes arrive sorted by (dst, actor, ver); a plain
+        # rank<P cutoff would then systematically starve high actor ids
+        # on overflow. Rotate the kept window by a per-dst phase (derived
+        # from the ring cursor, which changes every round) so overflow
+        # drops are unbiased across actors over time.
+        counts_all = group_counts(jnp.where(valid, dst, big), n)
+        cnt = counts_all[jnp.where(valid, dst, 0)]
+        phase = (gossip.cursor[jnp.where(valid, dst, 0)]
+                 * jnp.int32(0x9E37)) % jnp.maximum(cnt, 1)
+        rank = jnp.where(
+            cnt > p, (rank + phase) % jnp.maximum(cnt, 1), rank
+        )
+    else:
+        key = jnp.where(valid, dst, big)
+        order = jnp.argsort(key)
+        s_dst = key[order]
+        s_actor = actor[order]
+        s_ver = ver[order]
+        s_chunk = chunk[order]
+        s_valid = valid[order]
 
-    rank = ranks_within_group(s_dst)
+        rank = ranks_within_group(s_dst)
     # More than P appends to one node in a single round: lanes past the ring
     # capacity are dropped outright (counted as overflow) — wrapping them
     # would make later lanes clobber earlier ones *within this batch* with a

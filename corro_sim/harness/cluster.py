@@ -144,10 +144,13 @@ class LiveCluster:
         def remap(v):
             return translate_ranks(v, old, new, xp=jnp)
 
+        from corro_sim.core.changelog import CELL_VR
+
         st = self.state
+        log_cells = st.log.cells.at[..., CELL_VR].set(remap(st.log.vr))
         self.state = st.replace(
             table=st.table.replace(vr=remap(st.table.vr)),
-            log=st.log.replace(vr=remap(st.log.vr)),
+            log=st.log.replace(cells=log_cells),
             own=st.own.replace(vr=remap(st.own.vr)),
         )
         # Queued-but-uncommitted changesets carry ranks too (including the
@@ -671,11 +674,16 @@ class LiveCluster:
             head, win = absorb(
                 book.head, book.win, self.cfg.chunks_per_version
             )
-            moved = int(np.asarray((head != book.head).sum()))
+            changed = np.asarray(head != book.head)
             self.state = self.state.replace(
                 book=Bookkeeping(head=head, win=win)
             )
-            return {"actors_reconciled": moved}
+            return {
+                # (node, actor) head entries that moved, and how many
+                # distinct actors they span
+                "entries_reconciled": int(changed.sum()),
+                "actors_reconciled": int(changed.any(axis=0).sum()),
+            }
 
     # --------------------------------------------------------- migrations
     def migrate(self, schema_sql: str, capacities: dict | None = None) -> dict:

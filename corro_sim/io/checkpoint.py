@@ -40,7 +40,7 @@ import flax.serialization
 import jax.numpy as jnp
 import numpy as np
 
-FORMAT_VERSION = 2
+FORMAT_VERSION = 3  # 3: packed changelog cell tensor (log/cells)
 
 
 # ------------------------------------------------------------- value codec
@@ -235,6 +235,12 @@ def _read(path):
     with np.load(path) as z:
         meta = json.loads(bytes(z["__meta__"]).decode())
         flat = {k: z[k] for k in z.files if k != "__meta__"}
+    if meta.get("format") == 2:
+        # v2 → v3: the five changelog cell planes became one packed tensor
+        planes = [flat.pop(f"log/{f}") for f in
+                  ("row", "col", "vr", "cv", "cl")]
+        flat["log/cells"] = np.stack(planes, axis=-1)
+        meta["format"] = FORMAT_VERSION
     if meta.get("format") != FORMAT_VERSION:
         raise ValueError(
             f"unsupported checkpoint format {meta.get('format')!r}"

@@ -17,6 +17,7 @@ first-occurrence masks and within-group ranks are elementwise ops.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 
 def dedupe_sorted_mask(*keys: jnp.ndarray) -> jnp.ndarray:
@@ -46,3 +47,27 @@ def group_counts(group_sorted: jnp.ndarray, num_groups: int) -> jnp.ndarray:
     return jnp.zeros((num_groups,), jnp.int32).at[group_sorted].add(
         ones, mode="drop"
     )
+
+
+def ranks_within_group_masked(
+    group: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Rank of each mask-selected element among selected elements of its
+    group — WITHOUT sorting, for lanes already grouped.
+
+    Requires: the subsequence of ``group`` where ``mask`` is set is
+    nondecreasing (unselected lanes may hold anything, anywhere). This is
+    exactly the state of a lane batch sorted by a validity-masked key
+    whose valid subset shrank afterwards. Sort-free: exclusive-cumsum of
+    the mask gives global selected-counts; a cummax over run starts
+    rebases them per group."""
+    m = mask.astype(jnp.int32)
+    ex = jnp.cumsum(m) - m  # selected lanes before me, globally
+    gdst = jnp.where(mask, group, -1)
+    run = lax.cummax(gdst)  # group id of the latest selected lane <= i
+    prev_run = jnp.concatenate(
+        [jnp.full((1,), -1, run.dtype), run[:-1]]
+    )
+    is_start = mask & (prev_run != group)
+    base = lax.cummax(jnp.where(is_start, ex, -1))  # ex at my group's start
+    return jnp.where(mask, ex - base, 0).astype(jnp.int32)

@@ -238,7 +238,8 @@ def test_sync_reconcile_gaps(rig):
     cluster, admin = rig
     out = admin.call("sync_reconcile_gaps")
     # steady state: the step function absorbs eagerly, nothing to repair
-    assert out == {"ok": True, "actors_reconciled": 0}
+    assert out == {"ok": True, "entries_reconciled": 0,
+                   "actors_reconciled": 0}
 
 
 def test_set_id_and_rejoin_require_fields(rig):
