@@ -62,6 +62,7 @@ class AdminServer:
         self._srv = _Server(self.path, _Handler)
         self._srv.admin = self  # type: ignore[attr-defined]
         self._thread: threading.Thread | None = None
+        self._db_locks: dict = {}  # token -> (holder thread, release event)
 
     def start(self) -> "AdminServer":
         self._thread = threading.Thread(
@@ -141,6 +142,70 @@ class AdminServer:
     def _cmd_sync_reconcile_gaps(self, req):
         """Admin Sync ReconcileGaps (``corro-admin/src/lib.rs:315-341``)."""
         return self.cluster.reconcile_gaps()
+
+    def _cmd_traces(self, req):
+        """Recent spans from the process tracer — the admin-side read of
+        what the reference ships to its OTLP collector."""
+        from corro_sim.utils.tracing import tracer
+
+        n = int(req.get("n", 100))
+        name = req.get("name")
+        trace_id = req.get("trace_id")
+        if trace_id:
+            spans = tracer.trace(trace_id)
+        else:
+            spans = tracer.recent(n=n, name=name)
+        return {"spans": [s.as_json() for s in spans]}
+
+    # ------------------------------------------------------------- db lock
+    # `corrosion db lock "cmd"` holds exclusive byte-range locks on the DB
+    # while a shell command runs (``main.rs:492-530``,
+    # ``sqlite3-restore/src/lib.rs:16-57``). The tensor-state analog: hold
+    # the cluster's write lock between acquire/release admin calls — every
+    # write, tick, migration and restore blocks until released. A holder
+    # thread owns the (thread-bound) RLock and auto-releases on timeout in
+    # case the client dies with the lock held.
+    def _cmd_db_lock_acquire(self, req):
+        import uuid
+
+        timeout = float(req.get("timeout", 30.0))
+        if not (0 < timeout <= 24 * 3600):
+            raise AdminError(
+                f"db lock timeout must be in (0, 86400], got {timeout}"
+            )
+        token = uuid.uuid4().hex[:12]
+        acquired = threading.Event()
+        release = threading.Event()
+        expired = threading.Event()
+
+        def hold():
+            with self.cluster.locks.tracked(
+                self.cluster._lock, f"db lock {token}", "write"
+            ):
+                acquired.set()
+                if not release.wait(timeout):
+                    expired.set()  # crash-safety auto-release fired
+
+        th = threading.Thread(target=hold, name=f"db-lock-{token}",
+                              daemon=True)
+        th.start()
+        if not acquired.wait(10):
+            release.set()
+            raise AdminError("could not acquire the write lock in 10s")
+        self._db_locks[token] = (th, release, expired)
+        return {"token": token, "timeout": timeout}
+
+    def _cmd_db_lock_release(self, req):
+        token = req.get("token")
+        entry = self._db_locks.pop(token, None)
+        if entry is None:
+            raise AdminError(f"unknown db lock token {token!r}")
+        th, release, expired = entry
+        release.set()
+        th.join(timeout=5)
+        # an expired hold means the lock was NOT protecting the tail of
+        # whatever ran under it — the caller must know
+        return {"released": token, "expired": expired.is_set()}
 
     def _cmd_actor_version(self, req):
         actor = int(req.get("actor", 0))

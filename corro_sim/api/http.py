@@ -43,6 +43,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from corro_sim.api.wire import decode_values as _decode_wire_values
 from corro_sim.api.wire import encode_value as _json_value
 from corro_sim.harness.cluster import ExecError, LiveCluster
+from corro_sim.utils.tracing import parse_traceparent, tracer
 
 _SUB_PATH = re.compile(r"^/v1/subscriptions/([A-Za-z0-9_-]+)$")
 
@@ -114,6 +115,9 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            self.send_header("traceparent", ctx.to_traceparent())
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -138,50 +142,77 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.flush()
 
     # ------------------------------------------------------------- routes
-    def do_POST(self):  # noqa: N802
-        if not self._authz():
-            return
-        path, _, qs = self.path.partition("?")
-        params = _parse_qs(qs)
+    def _traced(self, name: str, fn, streaming: bool = False):
+        """Run a route handler under a span.
+
+        Distributed trace propagation: an incoming W3C ``traceparent``
+        parents the span (``SyncTraceContextV1`` analog,
+        ``corro-types/src/sync.rs:33-67``). Streaming routes record only
+        an *accept* span — a subscription body lives as long as the
+        client stays connected, and measuring connection lifetime would
+        flood the slow-span watchdog and bury real signals."""
+        parent = parse_traceparent(self.headers.get("traceparent"))
         try:
-            if path == "/v1/transactions":
-                self._post_transactions(params)
-            elif path == "/v1/queries":
-                self._post_queries(params)
-            elif path == "/v1/subscriptions":
-                self._post_subscriptions(params)
-            elif path in ("/v1/migrations", "/v1/db/schema"):
-                self._post_migrations(params)
-            elif path == "/v1/table_stats":
-                self._post_table_stats(params)
+            if streaming:
+                with tracer.span(f"{name} accept", parent=parent) as ctx:
+                    self._trace_ctx = ctx
+                fn()
             else:
-                self._send_json({"error": "not found"}, status=404)
+                with tracer.span(name, parent=parent) as ctx:
+                    self._trace_ctx = ctx
+                    fn()
         except _ApiError as e:
             self._send_json({"error": e.message}, status=e.status)
         except BrokenPipeError:
             pass
 
-    def do_GET(self):  # noqa: N802
+    def do_POST(self):  # noqa: N802
+        self._trace_ctx = None  # never leak a prior request's context
         if not self._authz():
             return
         path, _, qs = self.path.partition("?")
         params = _parse_qs(qs)
-        try:
-            m = _SUB_PATH.match(path)
-            if m:
-                self._get_subscription(m.group(1), params)
-            elif path == "/v1/cluster/members":
-                self._send_json(self.api.cluster.members())
-            elif path == "/v1/table_stats":
-                self._post_table_stats(params, body={"tables": []})
-            elif path == "/metrics":
-                self._get_metrics()
-            else:
-                self._send_json({"error": "not found"}, status=404)
-        except _ApiError as e:
-            self._send_json({"error": e.message}, status=e.status)
-        except BrokenPipeError:
-            pass
+        name = f"http POST {path}"
+        if path == "/v1/transactions":
+            self._traced(name, lambda: self._post_transactions(params))
+        elif path == "/v1/queries":
+            self._traced(name, lambda: self._post_queries(params))
+        elif path == "/v1/subscriptions":
+            self._traced(name, lambda: self._post_subscriptions(params),
+                         streaming=True)
+        elif path in ("/v1/migrations", "/v1/db/schema"):
+            self._traced(name, lambda: self._post_migrations(params))
+        elif path == "/v1/table_stats":
+            self._traced(name, lambda: self._post_table_stats(params))
+        else:
+            self._send_json({"error": "not found"}, status=404)
+
+    def do_GET(self):  # noqa: N802
+        self._trace_ctx = None
+        if not self._authz():
+            return
+        path, _, qs = self.path.partition("?")
+        params = _parse_qs(qs)
+        name = f"http GET {path}"
+        m = _SUB_PATH.match(path)
+        if m:
+            self._traced(
+                name, lambda: self._get_subscription(m.group(1), params),
+                streaming=True,
+            )
+        elif path == "/v1/cluster/members":
+            self._traced(
+                name, lambda: self._send_json(self.api.cluster.members())
+            )
+        elif path == "/v1/table_stats":
+            self._traced(
+                name,
+                lambda: self._post_table_stats(params, body={"tables": []}),
+            )
+        elif path == "/metrics":
+            self._traced(name, self._get_metrics)
+        else:
+            self._send_json({"error": "not found"}, status=404)
 
     # POST /v1/transactions — ExecResponse; statement errors come back as
     # per-statement {"error"} results with HTTP 200, like the reference.

@@ -91,19 +91,8 @@ def _cmd_agent(args: argparse.Namespace) -> int:
     if not args.resume and not args.schema:
         print("agent needs --schema or --resume", file=sys.stderr)
         return 2
-    if args.resume:
-        cluster = load_checkpoint(args.resume, tripwire=tripwire)
-    else:
-        with open(args.schema) as f:
-            schema_sql = f.read()
-        cluster = LiveCluster(
-            schema_sql,
-            num_nodes=args.nodes,
-            seed=args.seed,
-            default_capacity=args.capacity,
-            tripwire=tripwire,
-        )
-    host, _, port = args.api_addr.partition(":")
+    # TLS flag validation (and context build) runs BEFORE the cluster is
+    # constructed — a misconfiguration must not cost minutes of compile
     ssl_ctx = None
     if (args.tls_key or args.tls_ca or args.tls_client_auth) \
             and not args.tls_cert:
@@ -123,6 +112,19 @@ def _cmd_agent(args: argparse.Namespace) -> int:
             args.tls_cert, args.tls_key, ca_file=args.tls_ca,
             require_client_auth=args.tls_client_auth,
         )
+    if args.resume:
+        cluster = load_checkpoint(args.resume, tripwire=tripwire)
+    else:
+        with open(args.schema) as f:
+            schema_sql = f.read()
+        cluster = LiveCluster(
+            schema_sql,
+            num_nodes=args.nodes,
+            seed=args.seed,
+            default_capacity=args.capacity,
+            tripwire=tripwire,
+        )
+    host, _, port = args.api_addr.partition(":")
     api = ApiServer(
         cluster,
         host=host or "127.0.0.1",
@@ -466,6 +468,27 @@ def build_parser() -> argparse.ArgumentParser:
     prl.add_argument("schema_files", nargs="+")
     prl.set_defaults(fn=_cmd_reload)
 
+    ptr = sub.add_parser("traces", help="recent spans from the tracer")
+    admin_args(ptr)
+    ptr.add_argument("-n", type=int, default=100)
+    ptr.add_argument("--name", help="filter by span name")
+    ptr.add_argument("--trace-id", help="all spans of one trace")
+    ptr.set_defaults(fn=_cmd_traces)
+
+    pdb = sub.add_parser("db", help="database-level operations")
+    db_sub = pdb.add_subparsers(dest="db_cmd", required=True)
+    pdbl = db_sub.add_parser(
+        "lock", help="hold the write lock while a command runs"
+    )
+    admin_args(pdbl)
+    pdbl.add_argument("cmd", help="shell command to run under the lock")
+    pdbl.add_argument(
+        "--timeout", type=float, default=600.0,
+        help="crash-safety auto-release deadline (seconds); must exceed "
+             "the command's runtime or its tail runs unprotected",
+    )
+    pdbl.set_defaults(fn=_cmd_db_lock)
+
     ptls = sub.add_parser(
         "tls", help="certificate authority / server / client cert tooling"
     )
@@ -559,6 +582,44 @@ def _cmd_reload(args) -> int:
     plan = client.schema_from_paths(args.schema_files)
     print(json.dumps(plan))
     return 0
+
+
+def _cmd_traces(args) -> int:
+    """Dump recent spans from the agent's tracer."""
+    return _print_json(
+        _admin(args).call(
+            "traces", n=args.n, name=args.name, trace_id=args.trace_id
+        )
+    )
+
+
+def _cmd_db_lock(args) -> int:
+    """`corrosion db lock "cmd"` analog (``main.rs:492-530``): hold the
+    cluster write lock while a shell command runs."""
+    import shlex
+    import subprocess
+    import time as _time
+
+    admin = _admin(args)
+    t0 = _time.perf_counter()
+    resp = admin.call("db_lock_acquire", timeout=args.timeout)
+    token = resp["token"]
+    print(f"lock acquired after {_time.perf_counter() - t0:.3f}s "
+          f"(token {token})", file=sys.stderr)
+    try:
+        argv = shlex.split(args.cmd)
+        exit_code = subprocess.run(argv).returncode
+    finally:
+        rel = admin.call("db_lock_release", token=token)
+    if rel.get("expired"):
+        print(
+            "WARNING: the lock auto-released (timeout "
+            f"{args.timeout}s) BEFORE the command finished — its tail ran "
+            "unprotected; re-run with a larger --timeout",
+            file=sys.stderr,
+        )
+        return exit_code or 1
+    return exit_code
 
 
 def _write_pem(path, content) -> None:

@@ -93,6 +93,9 @@ def enqueue_broadcasts(
         rank = jnp.where(
             cnt > p, (rank + phase) % jnp.maximum(cnt, 1), rank
         )
+        # post-cutoff counts are exactly min(counts, P): skip the second
+        # full-lane scatter-add the sorted path needs
+        counts = jnp.minimum(counts_all, p)
     else:
         key = jnp.where(valid, dst, big)
         order = jnp.argsort(key)
@@ -114,7 +117,8 @@ def enqueue_broadcasts(
     idx = (jnp.where(s_valid, s_dst, n), slot)
 
     clobbered = ((gossip.pend_tx[idx] > 0) & s_valid) | over_capacity
-    counts = group_counts(jnp.where(s_valid, s_dst, big), n)
+    if not grouped:
+        counts = group_counts(jnp.where(s_valid, s_dst, big), n)
 
     return GossipState(
         pend_actor=gossip.pend_actor.at[idx].set(s_actor, mode="drop"),

@@ -1,0 +1,162 @@
+"""Tracing + W3C trace-context propagation and the db-lock command.
+
+Mirrors SURVEY §5: the reference's tracing/OTEL pipeline with
+traceparent propagation across protocol boundaries
+(``SyncTraceContextV1``, sync.rs:33-67) and `corrosion db lock`
+(main.rs:492-530).
+"""
+
+import threading
+import time
+
+import pytest
+
+from corro_sim.admin import AdminClient, AdminError, AdminServer
+from corro_sim.api.http import ApiServer
+from corro_sim.client import ApiClient
+from corro_sim.harness.cluster import LiveCluster
+from corro_sim.utils.tracing import (
+    TraceContext,
+    Tracer,
+    parse_traceparent,
+    tracer,
+)
+
+SCHEMA = """
+CREATE TABLE kv (
+    k TEXT NOT NULL PRIMARY KEY,
+    v TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def test_traceparent_codec():
+    ctx = TraceContext("ab" * 16, "cd" * 8, 1)
+    hdr = ctx.to_traceparent()
+    assert hdr == "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    back = parse_traceparent(hdr)
+    assert back.trace_id == ctx.trace_id and back.span_id == ctx.span_id
+
+
+def test_traceparent_rejects_malformed():
+    assert parse_traceparent(None) is None
+    assert parse_traceparent("") is None
+    assert parse_traceparent("00-zz-cd-01") is None
+    assert parse_traceparent("00-" + "0" * 32 + "-" + "cd" * 8 + "-01") is None
+    assert parse_traceparent("ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01") is None
+
+
+def test_span_nesting_and_ring():
+    t = Tracer(capacity=4)
+    with t.span("outer") as octx:
+        with t.span("inner") as ictx:
+            assert ictx.trace_id == octx.trace_id  # same trace
+    spans = t.recent()
+    assert [s.name for s in spans] == ["inner", "outer"]
+    assert spans[0].parent_id == octx.span_id
+    assert spans[1].parent_id is None
+    for i in range(10):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.recent(100)) == 4  # bounded ring
+
+
+def test_slow_span_warns(caplog):
+    t = Tracer(slow_warn_s=0.0)
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="corro_sim.tracing"):
+        with t.span("slowpoke"):
+            time.sleep(0.01)
+    assert any("slowpoke" in r.message for r in caplog.records)
+
+
+@pytest.fixture(scope="module")
+def rig(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tracing")
+    cluster = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    api = ApiServer(cluster).start()
+    admin = AdminServer(cluster, str(tmp / "t.sock")).start()
+    yield cluster, api, AdminClient(admin.path)
+    api.close()
+    admin.close()
+
+
+def test_http_trace_propagation(rig):
+    cluster, api, admin = rig
+    tracer.clear()
+    parent = TraceContext("12" * 16, "34" * 8)
+    import http.client
+    import json as _json
+
+    c = http.client.HTTPConnection(*api.addr, timeout=30)
+    c.request(
+        "POST", "/v1/transactions",
+        body=_json.dumps(["INSERT INTO kv (k, v) VALUES ('t', '1')"]),
+        headers={"Content-Type": "application/json",
+                 "traceparent": parent.to_traceparent()},
+    )
+    resp = c.getresponse()
+    resp.read()
+    echoed = resp.getheader("traceparent")
+    c.close()
+    assert echoed is not None and echoed.split("-")[1] == parent.trace_id
+    spans = tracer.trace(parent.trace_id)
+    assert any(s.name == "http POST /v1/transactions" for s in spans)
+    assert spans[0].parent_id == parent.span_id
+
+    # admin traces command sees the same spans
+    out = admin.call("traces", trace_id=parent.trace_id)
+    assert any(
+        s["name"] == "http POST /v1/transactions" for s in out["spans"]
+    )
+
+
+def test_untraced_requests_start_new_traces(rig):
+    cluster, api, admin = rig
+    tracer.clear()
+    client = ApiClient(api.addr)
+    client.query_rows("SELECT k FROM kv")
+    spans = tracer.recent(10, name="http POST /v1/queries")
+    assert spans and spans[-1].parent_id is None
+
+
+def test_db_lock_blocks_writes(rig):
+    cluster, api, admin = rig
+    resp = admin.call("db_lock_acquire", timeout=10.0)
+    token = resp["token"]
+    try:
+        client = ApiClient(api.addr, timeout=60)
+        done = {}
+
+        def write():
+            done["resp"] = client.execute(
+                ["INSERT INTO kv (k, v) VALUES ('locked', 'out')"])
+
+        th = threading.Thread(target=write)
+        th.start()
+        time.sleep(0.5)
+        # the write is stuck behind the held lock
+        assert th.is_alive()
+        _, rows = cluster.subs, None
+    finally:
+        admin.call("db_lock_release", token=token)
+    th.join(timeout=30)
+    assert not th.is_alive()
+    assert done["resp"]["results"][0]["rows_affected"] == 1
+
+
+def test_db_lock_timeout_autoreleases(rig):
+    cluster, api, admin = rig
+    resp = admin.call("db_lock_acquire", timeout=0.3)
+    time.sleep(0.6)  # holder auto-releases
+    client = ApiClient(api.addr, timeout=60)
+    client.execute(["INSERT INTO kv (k, v) VALUES ('auto', 'free')"])
+    # release of the already-expired token still cleans up without error
+    admin.call("db_lock_release", token=resp["token"])
+
+
+def test_db_lock_bad_token(rig):
+    _, _, admin = rig
+    with pytest.raises(AdminError):
+        admin.call("db_lock_release", token="nope")
