@@ -185,6 +185,9 @@ class AdminServer:
                 acquired.set()
                 if not release.wait(timeout):
                     expired.set()  # crash-safety auto-release fired
+                    # prune our own entry: the client that would have
+                    # released it is exactly the one that crashed
+                    self._db_locks.pop(token, None)
 
         th = threading.Thread(target=hold, name=f"db-lock-{token}",
                               daemon=True)

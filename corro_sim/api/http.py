@@ -185,7 +185,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/v1/table_stats":
             self._traced(name, lambda: self._post_table_stats(params))
         else:
-            self._send_json({"error": "not found"}, status=404)
+            self._traced(name, lambda: self._send_json(
+                {"error": "not found"}, status=404))
 
     def do_GET(self):  # noqa: N802
         self._trace_ctx = None
@@ -212,7 +213,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             self._traced(name, self._get_metrics)
         else:
-            self._send_json({"error": "not found"}, status=404)
+            self._traced(name, lambda: self._send_json(
+                {"error": "not found"}, status=404))
 
     # POST /v1/transactions — ExecResponse; statement errors come back as
     # per-statement {"error"} results with HTTP 200, like the reference.

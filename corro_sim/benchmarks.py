@@ -105,6 +105,189 @@ def run_headline_bench(
     }
 
 
-def main() -> int:
-    print(json.dumps(run_headline_bench()))
+# --------------------------------------------------- the 5 BASELINE configs
+# (BASELINE.md: devcluster CPU baseline; 64-node slice; 1k realism;
+# 10k headline; 50k outage catch-up.)
+
+CONSUL_SCHEMA = """
+CREATE TABLE consul_services (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    name TEXT NOT NULL DEFAULT '',
+    port INTEGER NOT NULL DEFAULT 0,
+    meta TEXT NOT NULL DEFAULT '{}',
+    PRIMARY KEY (node, id)
+);
+CREATE TABLE consul_checks (
+    node TEXT NOT NULL,
+    id TEXT NOT NULL,
+    status TEXT NOT NULL DEFAULT '',
+    output TEXT NOT NULL DEFAULT '',
+    PRIMARY KEY (node, id)
+);
+"""
+
+
+def run_config_1(inserts: int = 1000, nodes: int = 3) -> dict:
+    """Config 1 — devcluster analog: N live agents, single-table schema,
+    1k INSERTs through the real write path, then convergence."""
+    from corro_sim.harness.cluster import LiveCluster
+
+    schema = """
+    CREATE TABLE t (
+        id INTEGER NOT NULL PRIMARY KEY,
+        v TEXT NOT NULL DEFAULT ''
+    );
+    """
+    cluster = LiveCluster(
+        schema, num_nodes=nodes, default_capacity=max(inserts + 16, 64),
+        cfg_overrides={"log_capacity": max(2 * inserts, 1024)},
+    )
+    # warm-up (compile) outside the timed window
+    cluster.execute(["INSERT INTO t (id, v) VALUES (0, 'warm')"])
+    # Multi-row INSERTs: one transaction = one changeset (the reference's
+    # clients batch statements into /v1/transactions the same way); each
+    # agent drains one changeset per round, so spread them round-robin.
+    rows_per_stmt = max(cluster.cfg.seqs_per_version, 1)
+    stmts = []
+    for start in range(1, inserts + 1, rows_per_stmt):
+        values = ", ".join(
+            f"({i}, 'w{i}')"
+            for i in range(start, min(start + rows_per_stmt, inserts + 1))
+        )
+        stmts.append(f"INSERT INTO t (id, v) VALUES {values}")
+    t0 = time.perf_counter()
+    # one concurrent client per agent (the devcluster shape): each sends
+    # its whole statement list in one transactions call; the queues drain
+    # together, one changeset per node per round
+    import threading
+
+    def drive(node):
+        batch = stmts[node::nodes]
+        if batch:
+            cluster.execute(batch, node=node)
+
+    threads = [threading.Thread(target=drive, args=(i,))
+               for i in range(nodes)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    converged = cluster.run_until_converged(max_rounds=4096)
+    wall = time.perf_counter() - t0
+    return {
+        "metric": f"devcluster_{nodes}_agents_{inserts}_inserts_wall_s",
+        "value": round(wall, 3),
+        "unit": "s",
+        "inserts_per_sec": round(inserts / wall, 1),
+        "converged": converged is not None,
+    }
+
+
+def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
+    from corro_sim.engine.driver import run_sim
+    from corro_sim.engine.state import init_state
+
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), schedule,
+        max_rounds=max_rounds, chunk=8, seed=0, min_rounds=min_rounds,
+    )
+    return {
+        "metric": label,
+        "value": res.converged_round,
+        "unit": "rounds_to_convergence",
+        "wall_per_round_ms": round(res.wall_per_round_ms, 3),
+        "converged": res.converged_round is not None,
+        "changes_applied": int(res.metrics["fresh"].sum())
+        + int(res.metrics["sync_versions"].sum()),
+    }
+
+
+def run_config_2(nodes: int = 64) -> dict:
+    """Config 2 — minimum end-to-end slice: single-column LWW, uniform
+    random writes, fanout 3."""
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule
+
+    cfg = SimConfig(
+        num_nodes=nodes, num_rows=64, num_cols=1, log_capacity=256,
+        write_rate=0.5, fanout=3, swim_enabled=False, sync_interval=8,
+    )
+    return _sim_report(
+        cfg, Schedule(write_rounds=16),
+        f"config2_{nodes}_node_rounds_to_convergence",
+    )
+
+
+def run_config_3(nodes: int = 1000) -> dict:
+    """Config 3 — realism: the multi-table Consul-services schema's
+    tensor layout, Zipf-skewed hot-row contention."""
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule
+    from corro_sim.schema import TableLayout, parse_and_constrain
+
+    # size the row/column planes from the REAL Consul schema the consul
+    # integration writes into (two tables, composite pks, value columns)
+    layout = TableLayout(
+        parse_and_constrain(CONSUL_SCHEMA), default_capacity=256
+    )
+    cfg = SimConfig(
+        num_nodes=nodes, num_rows=layout.num_rows,
+        num_cols=max(layout.num_cols, 1), log_capacity=512,
+        write_rate=0.5, zipf_alpha=1.1, seqs_per_version=4,
+        chunks_per_version=2, swim_enabled=True, sync_interval=8,
+        sync_actor_topk=16,
+    )
+    return _sim_report(
+        cfg, Schedule(write_rounds=32),
+        f"config3_{nodes}_node_zipf_rounds_to_convergence",
+    )
+
+
+def run_config_4(n: int | None = None) -> dict:
+    """Config 4 — the headline: 10k nodes, SWIM churn + partitions."""
+    return run_headline_bench(n=n)
+
+
+def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
+                 write_rounds: int = 24) -> dict:
+    """Config 5 — stretch: anti-entropy catch-up after a 30% outage.
+
+    ``outage_frac`` of the cluster is down for the whole write phase and
+    returns at quiesce; convergence then requires sync to repair every
+    missed version. NOTE: the (N, A) bookkeeping planes are node-sharded
+    (engine/sharding.py), so 50k nodes wants a multi-device mesh
+    (~20 GB of heads+windows); pass a smaller ``nodes`` for one chip.
+    """
+    import numpy as np_
+
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule
+
+    cfg = SimConfig(
+        num_nodes=nodes, num_rows=128, num_cols=2, log_capacity=256,
+        write_rate=0.2, swim_enabled=False, sync_interval=4,
+        sync_actor_topk=64, sync_cap_per_actor=8,
+    )
+    down = np_.arange(nodes) < int(nodes * outage_frac)
+
+    def alive_fn(r, num):
+        if r < write_rounds:
+            return ~down
+        return np_.ones(num, bool)
+
+    return _sim_report(
+        cfg, Schedule(write_rounds=write_rounds, alive_fn=alive_fn),
+        f"config5_{nodes}_node_outage_catchup_rounds",
+        min_rounds=write_rounds + 1,
+    )
+
+
+CONFIGS = {1: run_config_1, 2: run_config_2, 3: run_config_3,
+           4: run_config_4, 5: run_config_5}
+
+
+def main(config: int | None = None, **kw) -> int:
+    fn = CONFIGS.get(config or 4, run_headline_bench)
+    print(json.dumps(fn(**kw)))
     return 0

@@ -71,10 +71,14 @@ def _cmd_run(args: argparse.Namespace) -> int:
     return 0 if res.converged_round is not None else 3
 
 
-def _cmd_bench(_args: argparse.Namespace) -> int:
+def _cmd_bench(args: argparse.Namespace) -> int:
     from corro_sim.benchmarks import main as bench_main
 
-    return bench_main() or 0
+    kw = {}
+    if args.bench_nodes is not None:
+        kw["n" if (args.bench_config or 4) == 4 else "nodes"] = \
+            args.bench_nodes
+    return bench_main(config=args.bench_config, **kw) or 0
 
 
 def _cmd_agent(args: argparse.Namespace) -> int:
@@ -324,7 +328,17 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--seed", type=int, default=0)
     pr.set_defaults(fn=_cmd_run)
 
-    pb = sub.add_parser("bench", help="run the headline benchmark")
+    pb = sub.add_parser(
+        "bench",
+        help="run a BASELINE benchmark config (default: 4, the headline)",
+    )
+    pb.add_argument(
+        "--config", dest="bench_config", type=int, choices=[1, 2, 3, 4, 5],
+        help="1=devcluster 2=64-node slice 3=1k zipf 4=10k headline "
+             "5=50k outage catch-up",
+    )
+    pb.add_argument("--nodes", dest="bench_nodes", type=int,
+                    help="override the config's cluster size")
     pb.set_defaults(fn=_cmd_bench)
 
     pa = sub.add_parser("agent", help="run a live cluster (HTTP API + admin)")
@@ -610,7 +624,13 @@ def _cmd_db_lock(args) -> int:
         argv = shlex.split(args.cmd)
         exit_code = subprocess.run(argv).returncode
     finally:
-        rel = admin.call("db_lock_release", token=token)
+        from corro_sim.admin import AdminError
+
+        try:
+            rel = admin.call("db_lock_release", token=token)
+        except AdminError:
+            # the holder pruned the token itself: the hold expired
+            rel = {"expired": True}
     if rel.get("expired"):
         print(
             "WARNING: the lock auto-released (timeout "

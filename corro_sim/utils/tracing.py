@@ -61,7 +61,7 @@ def parse_traceparent(header: str | None) -> TraceContext | None:
     if len(trace_id) != 32 or len(span_id) != 16 or version == "ff":
         return None
     try:
-        int(trace_id, 16), int(span_id, 16)
+        int(version, 16), int(trace_id, 16), int(span_id, 16)
         f = int(flags, 16)
     except ValueError:
         return None

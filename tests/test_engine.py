@@ -278,3 +278,19 @@ def test_compaction_clears_versions_and_converges():
     live = np.asarray(st.log.live)
     assert (live >= 0).all()
     assert (live <= np.asarray(st.log.ncells)).all()
+
+
+def test_baseline_bench_configs_smoke():
+    """All five BASELINE configs run end to end (tiny sizes)."""
+    from corro_sim import benchmarks as b
+
+    r1 = b.run_config_1(inserts=24, nodes=3)
+    assert r1["converged"] and r1["value"] > 0
+    r2 = b.run_config_2(nodes=16)
+    assert r2["converged"]
+    r3 = b.run_config_3(nodes=32)
+    assert r3["converged"]
+    r5 = b.run_config_5(nodes=32, write_rounds=8)
+    assert r5["converged"]
+    # the outage victims (30%) caught up strictly after the write phase
+    assert r5["value"] > 8
