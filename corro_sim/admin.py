@@ -191,17 +191,22 @@ class AdminServer:
 
         th = threading.Thread(target=hold, name=f"db-lock-{token}",
                               daemon=True)
+        # register BEFORE starting: the holder's expiry-prune must always
+        # find the entry, however small the timeout
+        self._db_locks[token] = (th, release, expired)
         th.start()
         if not acquired.wait(10):
             release.set()
+            self._db_locks.pop(token, None)
             raise AdminError("could not acquire the write lock in 10s")
-        self._db_locks[token] = (th, release, expired)
         return {"token": token, "timeout": timeout}
 
     def _cmd_db_lock_release(self, req):
         token = req.get("token")
         entry = self._db_locks.pop(token, None)
         if entry is None:
+            # distinguishable error text: the CLI treats an unknown token
+            # as "the hold expired and self-pruned"
             raise AdminError(f"unknown db lock token {token!r}")
         th, release, expired = entry
         release.set()

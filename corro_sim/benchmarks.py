@@ -109,25 +109,6 @@ def run_headline_bench(
 # (BASELINE.md: devcluster CPU baseline; 64-node slice; 1k realism;
 # 10k headline; 50k outage catch-up.)
 
-CONSUL_SCHEMA = """
-CREATE TABLE consul_services (
-    node TEXT NOT NULL,
-    id TEXT NOT NULL,
-    name TEXT NOT NULL DEFAULT '',
-    port INTEGER NOT NULL DEFAULT 0,
-    meta TEXT NOT NULL DEFAULT '{}',
-    PRIMARY KEY (node, id)
-);
-CREATE TABLE consul_checks (
-    node TEXT NOT NULL,
-    id TEXT NOT NULL,
-    status TEXT NOT NULL DEFAULT '',
-    output TEXT NOT NULL DEFAULT '',
-    PRIMARY KEY (node, id)
-);
-"""
-
-
 def run_config_1(inserts: int = 1000, nodes: int = 3) -> dict:
     """Config 1 — devcluster analog: N live agents, single-table schema,
     1k INSERTs through the real write path, then convergence."""
@@ -157,22 +138,14 @@ def run_config_1(inserts: int = 1000, nodes: int = 3) -> dict:
         )
         stmts.append(f"INSERT INTO t (id, v) VALUES {values}")
     t0 = time.perf_counter()
-    # one concurrent client per agent (the devcluster shape): each sends
-    # its whole statement list in one transactions call; the queues drain
-    # together, one changeset per node per round
-    import threading
-
-    def drive(node):
+    # the devcluster shape: every agent has its statement queue loaded
+    # (wait=False plans + enqueues without draining), then all queues
+    # drain together — one changeset per node per round, like N real
+    # agents committing concurrently
+    for node in range(nodes):
         batch = stmts[node::nodes]
         if batch:
-            cluster.execute(batch, node=node)
-
-    threads = [threading.Thread(target=drive, args=(i,))
-               for i in range(nodes)]
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join()
+            cluster.execute(batch, node=node, wait=False)
     converged = cluster.run_until_converged(max_rounds=4096)
     wall = time.perf_counter() - t0
     return {
@@ -224,12 +197,16 @@ def run_config_3(nodes: int = 1000) -> dict:
     tensor layout, Zipf-skewed hot-row contention."""
     from corro_sim.config import SimConfig
     from corro_sim.engine.driver import Schedule
-    from corro_sim.schema import TableLayout, parse_and_constrain
+    from corro_sim.schema import (
+        TableLayout,
+        consul_schema_sql,
+        parse_and_constrain,
+    )
 
     # size the row/column planes from the REAL Consul schema the consul
     # integration writes into (two tables, composite pks, value columns)
     layout = TableLayout(
-        parse_and_constrain(CONSUL_SCHEMA), default_capacity=256
+        parse_and_constrain(consul_schema_sql()), default_capacity=256
     )
     cfg = SimConfig(
         num_nodes=nodes, num_rows=layout.num_rows,

@@ -628,7 +628,9 @@ def _cmd_db_lock(args) -> int:
 
         try:
             rel = admin.call("db_lock_release", token=token)
-        except AdminError:
+        except AdminError as e:
+            if "unknown db lock token" not in str(e):
+                raise  # a real admin failure, not an expired hold
             # the holder pruned the token itself: the hold expired
             rel = {"expired": True}
     if rel.get("expired"):

@@ -175,11 +175,16 @@ class LiveCluster:
             m.rebind(old, new)
 
     # ------------------------------------------------------------ write path
-    def execute(self, statements, node: int = 0) -> dict:
+    def execute(self, statements, node: int = 0, wait: bool = True) -> dict:
         """POST /v1/transactions analog: one changeset per statement batch.
 
         Returns the ``ExecResponse`` shape (``corro-api-types:209-214``):
-        per-statement results plus the committed version."""
+        per-statement results plus the committed version.
+
+        ``wait=False`` plans and enqueues without draining: the caller
+        ticks later (or lets the background ticker run), and queues of
+        SEVERAL nodes drain together — one changeset per node per round,
+        the true concurrent-clients shape. ``version`` is then None."""
         self._check_node(node)
         import time as _time
 
@@ -215,12 +220,14 @@ class LiveCluster:
                 self._staging_overlay = None
             for cs in changesets:
                 self._pending[node].append(cs)
-            # Commit synchronously: tick until this node's queue drains —
-            # the API returns only after its transaction is durable, like
-            # the reference's in-tx HTTP handler.
-            while self._pending[node]:
-                self._tick_locked(1)
-            version = int(np.asarray(self.state.book.head)[node, node])
+            version = None
+            if wait:
+                # Commit synchronously: tick until this node's queue
+                # drains — the API returns only after its transaction is
+                # durable, like the reference's in-tx HTTP handler.
+                while self._pending[node]:
+                    self._tick_locked(1)
+                version = int(np.asarray(self.state.book.head)[node, node])
         return {
             "results": results,
             "time": _time.perf_counter() - t0,

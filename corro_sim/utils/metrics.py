@@ -95,4 +95,130 @@ def render_prometheus(cluster) -> str:
         "queued uncommitted changesets (SplitPool write queue analog)",
         pending,
     )
+
+    # ---- per-table live rows per node (agent/metrics.rs per-table rows).
+    # Per-node breakdown only below a cardinality cap: tables x N series
+    # at simulator scale (10k+) would be a classic Prometheus explosion;
+    # corro_db_table_rows (max over nodes) always covers the signal.
+    if cluster.cfg.num_nodes <= 64:
+        lines.append(
+            "# HELP corro_db_table_rows_node live rows per table per node"
+        )
+        lines.append("# TYPE corro_db_table_rows_node gauge")
+        for t, s in stats.items():
+            for node, rows in enumerate(s["live_rows_per_node"]):
+                lines.append(
+                    f'corro_db_table_rows_node'
+                    f'{{table="{t}",node="{node}"}} {rows}'
+                )
+
+    # ---- versioning / bookkeeping gauges (agent/metrics.rs:18-108)
+    emit(
+        "corro_db_versions_written", "gauge",
+        "changeset versions written across all actors (log heads sum)",
+        int(head.sum()),
+    )
+    emit(
+        "corro_db_versions_applied", "gauge",
+        "applied (node, actor) version count (booked heads sum)",
+        int(book.sum()),
+    )
+    cleared = int(np.asarray(cluster.state.log.cleared).sum())
+    emit(
+        "corro_db_cleared_versions", "gauge",
+        "versions fully superseded (empty changesets; compaction analog)",
+        cleared,
+    )
+    emit(
+        "corro_db_log_capacity", "gauge",
+        "change-log ring capacity per actor", cluster.state.log.capacity,
+    )
+
+    # ---- gossip ring occupancy (broadcast buffer gauges analog)
+    pend_tx = np.asarray(cluster.state.gossip.pend_tx)
+    emit(
+        "corro_broadcast_pending_slots", "gauge",
+        "live pending-broadcast ring slots across the cluster",
+        int((pend_tx > 0).sum()),
+    )
+    emit(
+        "corro_broadcast_ring_capacity", "gauge",
+        "pending-broadcast ring slots total", int(pend_tx.size),
+    )
+
+    # ---- value universe / layout (sqlite freelist + db size analog)
+    uni = cluster.universe
+    emit(
+        "corro_db_interned_values", "gauge",
+        "distinct interned SQLite values (rank universe size)", len(uni),
+    )
+    layout = cluster.layout
+    used = sum(layout._used.values())
+    cap = sum(c for _, c in layout._ranges.values())
+    emit(
+        "corro_db_row_slots_used", "gauge",
+        "allocated row slots across tables", used,
+    )
+    emit(
+        "corro_db_row_slots_capacity", "gauge",
+        "row slot capacity across tables", cap,
+    )
+
+    # ---- lock registry (lock queue timing gauges, agent.rs:716-723)
+    snap = cluster.locks.snapshot()
+    emit(
+        "corro_lock_registry_active", "gauge",
+        "currently tracked lock acquisitions", len(snap),
+    )
+
+    # ---- subscription queue depths (channel capacity gauges analog)
+    qdepth = sum(
+        len(q) for qs in cluster._sub_queues.values() for q in qs
+    )
+    emit(
+        "corro_subs_queued_events", "gauge",
+        "events buffered in subscriber queues", qdepth,
+    )
+    lines.append(
+        "# HELP corro_subs_change_id latest change id per matcher"
+    )
+    lines.append("# TYPE corro_subs_change_id gauge")
+    for sub_id, m in cluster.subs._by_id.items():
+        lines.append(
+            f'corro_subs_change_id{{id="{sub_id}"}} {m.change_id}'
+        )
+
+    # ---- SWIM state breakdown (gossip/SWIM counts, broadcast/mod.rs)
+    if cluster.cfg.swim_enabled:
+        status = np.asarray(cluster.state.swim.status)
+        emit(
+            "corro_swim_suspected_entries", "gauge",
+            "suspect beliefs across all (observer, member) pairs",
+            int((status == 1).sum()),
+        )
+        emit(
+            "corro_swim_down_entries", "gauge",
+            "down beliefs across all (observer, member) pairs",
+            int((status >= 2).sum()),
+        )
+        emit(
+            "corro_swim_incarnation_max", "gauge",
+            "highest self-incarnation (refutation count)",
+            int(np.asarray(cluster.state.swim.inc).diagonal().max()),
+        )
+
+    # ---- tracing (tokio-metrics / runtime introspection analog)
+    from corro_sim.utils.tracing import tracer as _tracer
+
+    spans = _tracer.recent(10**9)
+    emit(
+        "corro_trace_spans_buffered", "gauge",
+        "finished spans held in the tracer ring", len(spans),
+    )
+    if spans:
+        emit(
+            "corro_trace_span_max_ms", "gauge",
+            "slowest buffered span duration (ms)",
+            round(max(s.duration for s in spans) * 1000, 3),
+        )
     return "\n".join(lines) + "\n"
