@@ -1,11 +1,26 @@
 """`corro-sim` command line — the analog of the reference's `corrosion` CLI.
 
-The reference binary exposes Agent/Backup/Restore/Cluster/Query/Exec/Sync/…
-subcommands (``crates/corrosion/src/main.rs:626-801``). The simulator's
-command surface grows toward that inventory; current subcommands:
+Command surface vs the reference's Command enum
+(``crates/corrosion/src/main.rs:626-801``):
 
-  run     — run a simulation config to convergence, print a report
-  bench   — the headline benchmark (same as bench.py)
+  run          — run a simulation config to convergence, print a report
+  bench        — BASELINE benchmark configs 1-5 (default: 10k headline)
+  agent        — live cluster: HTTP API + admin socket (+ --pg-addr
+                 pgwire, + --tls-* for TLS/mTLS)      [Command::Agent]
+  devcluster   — run an `A -> B` topology file        [corro-devcluster]
+  query / exec — SELECT / DML against a running agent [Query/Exec]
+  backup / restore — actor-neutral snapshots          [Backup/Restore]
+  reload       — re-apply schema files                [Command::Reload]
+  cluster      — members / membership-states / rejoin / set-id
+  sync         — generate / reconcile-gaps            [Command::Sync]
+  actor        — version bookkeeping introspection    [Command::Actor]
+  subs         — list / inspect subscriptions         [Command::Subs]
+  locks        — lock registry dump                   [Command::Locks]
+  traces       — recent tracer spans                  [telemetry analog]
+  db lock      — hold the write lock around a command [DbCommand::Lock]
+  tls          — ca / server / client cert generation [Command::Tls]
+  template     — render templates w/ live re-render   [Command::Template]
+  consul-sync  — mirror Consul services/checks        [Command::Consul]
 """
 
 from __future__ import annotations
