@@ -19,11 +19,11 @@ mirroring "which agent did you connect to".
 
 Transaction semantics: ``BEGIN … COMMIT`` buffers DML and commits it as
 ONE changeset batch (atomic, like the reference's single SQLite tx);
-autocommit statements are one transaction each. Two documented
-divergences from a real Postgres: reads inside an open transaction see
-the committed snapshot (not the tx's own buffered writes), and the
-rows-affected counts reported *inside* an open transaction are planned
-against the committed snapshot.
+autocommit statements are one transaction each. Reads and rows-affected
+counts inside an open transaction observe the transaction's own buffered
+writes via the cluster's staged-write overlay (``plan_overlay`` — the
+same mechanism ``execute()`` uses for in-batch visibility, matching the
+reference's single-SQLite-tx semantics, api/public/mod.rs:104-131).
 """
 
 from __future__ import annotations
@@ -431,6 +431,10 @@ class _Session:
         self.portals: dict[str, _Portal] = {}
         self.tx_writes: list | None = None  # None = autocommit
         self.tx_failed = False
+        # incrementally-built staged-write overlay of the open tx + its
+        # validity key (universe/layout generations, planned count)
+        self._tx_ov = None
+        self._tx_ov_key = None
         self.params = {
             "server_version": "14.0 (corro-sim)",
             "server_encoding": "UTF8",
@@ -545,7 +549,9 @@ class _Session:
             fields = [(c, all_oids[idx[c]]) for c in cols]
             return fields, rows
         try:
-            cols, rows = self.cluster.query_rows(sql, node=self.node)
+            cols, rows = self.cluster.query_rows(
+                sql, node=self.node, overlay=self._tx_overlay()
+            )
         except (QueryError, SchemaError) as e:
             msg = str(e)
             cond = ("undefined_table" if "no such table" in msg
@@ -568,48 +574,58 @@ class _Session:
             cols = want
         return self._fields_for_select(select, cols), rows
 
-    def _planned_rows_affected(self, sql: str) -> int:
-        """Rows a buffered UPDATE/DELETE would touch, against the committed
-        snapshot (see module docstring on in-tx count semantics)."""
-        from corro_sim.api.statements import parse_dml
-        try:
-            op = parse_dml(sql)
-        except (StatementError, QueryError) as e:
-            raise PgError("syntax_error", str(e)) from None
-        if op.kind == "upsert":
-            return len(op.rows)
-        where = op.where
-        t = self.cluster.layout.schema.tables.get(op.table)
-        if t is None:
-            raise PgError("undefined_table",
-                          f'relation "{op.table}" does not exist')
-        names, all_rows = self.cluster.query_rows(
-            f"SELECT * FROM {op.table}", node=self.node)
-        if where is None:
-            return len(all_rows)
-        from corro_sim.subs.query import predicate_columns
-        known = {c.name for c in t.columns}
-        for c in predicate_columns(where):
-            if c not in known:
-                raise PgError(
-                    "undefined_column",
-                    f"no such column {op.table}.{c}")
-        col_pos = {c: i for i, c in enumerate(names)}
-        n = 0
-        for r in all_rows:
-            get = lambda name: (  # noqa: E731
-                r[col_pos[name]] if name in col_pos else None)
-            if eval_predicate_py(where, get):
-                n += 1
-        return n
+    def _ov_key(self, n_planned: int):
+        cl = self.cluster
+        return (
+            n_planned,
+            getattr(cl.universe, "version", 0),
+            cl.layout.generation,
+        )
+
+    def _tx_overlay(self):
+        """Staged-write overlay of the open transaction, or None.
+
+        Built incrementally as statements buffer (O(1) planning per
+        statement — replanning the whole buffer per use made transactions
+        quadratic) and replanned wholesale only when a rank respace or a
+        schema migration invalidated the staged coordinates. The overlay
+        is a snapshot of committed state as of each statement's planning,
+        the same visibility a reference SQLite transaction has."""
+        if not self.tx_writes:
+            return None
+        if (
+            self._tx_ov is None
+            or self._tx_ov_key != self._ov_key(len(self.tx_writes))
+        ):
+            try:
+                self._tx_ov, _ = self.cluster.plan_overlay(
+                    self.tx_writes, node=self.node
+                )
+            except Exception as e:
+                self._tx_ov = None
+                raise PgError(self._write_cond(e), str(e)) from None
+            self._tx_ov_key = self._ov_key(len(self.tx_writes))
+        return self._tx_ov
 
     def run_write(self, sql: str) -> int:
         """Execute (autocommit) or buffer (explicit tx) one DML. Returns
-        rows affected."""
+        rows affected (in-tx: counted against the tx's own overlay, so a
+        row inserted earlier in the tx is visible to a later UPDATE)."""
         if self.tx_writes is not None:
-            n = self._planned_rows_affected(sql)
+            base = self._tx_overlay()  # ({}, {}) when first statement
+            if base is None:
+                base = ({}, {})
+            try:
+                overlay, counts = self.cluster.plan_overlay(
+                    [sql], node=self.node, base=base
+                )
+            except Exception as e:
+                self._tx_ov = None  # base may be half-mutated
+                raise PgError(self._write_cond(e), str(e)) from None
             self.tx_writes.append(sql)
-            return n
+            self._tx_ov = overlay
+            self._tx_ov_key = self._ov_key(len(self.tx_writes))
+            return counts[-1]
         try:
             resp = self.cluster.execute([sql], node=self.node)
         except Exception as e:  # ExecError and friends
@@ -630,6 +646,7 @@ class _Session:
     def commit_tx(self) -> None:
         writes, self.tx_writes = self.tx_writes, None
         failed, self.tx_failed = self.tx_failed, False
+        self._tx_ov = self._tx_ov_key = None
         if failed or not writes:
             return
         try:
@@ -656,6 +673,7 @@ class _Session:
                         msg_command_complete("BEGIN")]
             self.tx_writes = []
             self.tx_failed = False
+            self._tx_ov = self._tx_ov_key = None
             return [msg_command_complete("BEGIN")]
         if kind == "COMMIT":
             was_failed = self.tx_failed
@@ -665,6 +683,7 @@ class _Session:
         if kind == "ROLLBACK":
             self.tx_writes = None
             self.tx_failed = False
+            self._tx_ov = self._tx_ov_key = None
             return [msg_command_complete("ROLLBACK")]
         if kind == "SET":
             return [msg_command_complete("SET")]
@@ -822,7 +841,9 @@ class _Session:
                 if len(sql.split(None, 1)) > 1 else "all"
             if name == "all":
                 return [("name", OID_TEXT), ("setting", OID_TEXT)]
-            return [("setting", OID_TEXT)]
+            # real Postgres names the column after the parameter, and
+            # _exec_show's data path does too — Describe must agree
+            return [(name, OID_TEXT)]
         return None
 
     def handle_describe(self, body: bytes) -> list[bytes]:
@@ -1141,7 +1162,8 @@ class SimplePgClient:
     def extended(self, sql: str, params=(), param_oids=(), max_rows=0,
                  binary_results=False):
         """Parse/Bind/Describe/Execute/Sync round. Returns
-        (fields, rows, tags, errors)."""
+        (fields, rows, tags, errors, suspended) — ``suspended`` is True
+        when a row-limited Execute left the portal resumable."""
         msgs = []
         oids = list(param_oids)
         msgs.append(_msg(b"P", _cstr("") + _cstr(sql)

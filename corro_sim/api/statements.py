@@ -96,12 +96,27 @@ def bind_params(sql: str, params) -> str:
     out = []
     last = 0
     idx = 0
-    quotes = 0  # incremental quote parity: odd = inside a string literal
+    # incremental quote scan: a quote of one kind inside the other kind's
+    # span is literal text (e.g. a '"' inside a 'string' must not open an
+    # identifier), so independent parity counts are wrong — track both
+    # states sequentially. SQL's '' / "" doubling self-corrects at the
+    # character level (close + immediately reopen).
+    in_str = in_ident = False
     for m in _PARAM.finditer(sql):
         prefix = sql[last:m.start()]
         out.append(prefix)
-        quotes += prefix.count("'")
-        if quotes % 2 == 1:  # inside a string literal
+        for ch in prefix:
+            if in_str:
+                in_str = ch != "'"
+            elif in_ident:
+                in_ident = ch != '"'
+            elif ch == "'":
+                in_str = True
+            elif ch == '"':
+                in_ident = True
+        if in_str or in_ident:
+            # inside a string literal or a "quoted identifier" — e.g.
+            # SELECT "a$1" names a column, it does not bind a parameter
             out.append(m.group(0))
             last = m.end()
             continue

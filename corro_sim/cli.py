@@ -4,7 +4,7 @@ Command surface vs the reference's Command enum
 (``crates/corrosion/src/main.rs:626-801``):
 
   run          — run a simulation config to convergence, print a report
-  bench        — BASELINE benchmark configs 1-5 (default: 10k headline)
+  bench        — BASELINE benchmark configs 0-5 (default: 0, north star)
   agent        — live cluster: HTTP API + admin socket (+ --pg-addr
                  pgwire, + --tls-* for TLS/mTLS)      [Command::Agent]
   devcluster   — run an `A -> B` topology file        [corro-devcluster]
@@ -91,7 +91,7 @@ def _cmd_bench(args: argparse.Namespace) -> int:
 
     kw = {}
     if args.bench_nodes is not None:
-        kw["n" if (args.bench_config or 4) == 4 else "nodes"] = \
+        kw["n" if args.bench_config in (None, 0, 4) else "nodes"] = \
             args.bench_nodes
     return bench_main(config=args.bench_config, **kw) or 0
 
@@ -345,12 +345,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     pb = sub.add_parser(
         "bench",
-        help="run a BASELINE benchmark config (default: 4, the headline)",
+        help="run a BASELINE benchmark config (default: 0, the north star)",
     )
     pb.add_argument(
-        "--config", dest="bench_config", type=int, choices=[1, 2, 3, 4, 5],
-        help="1=devcluster 2=64-node slice 3=1k zipf 4=10k headline "
-             "5=50k outage catch-up",
+        "--config", dest="bench_config", type=int,
+        choices=[0, 1, 2, 3, 4, 5],
+        help="0=north-star (10k sim convergence wall vs 64-agent "
+             "devcluster wall) 1=devcluster 2=64-node slice 3=1k zipf "
+             "4=10k headline 5=50k outage catch-up",
     )
     pb.add_argument("--nodes", dest="bench_nodes", type=int,
                     help="override the config's cluster size")

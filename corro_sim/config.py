@@ -48,8 +48,17 @@ class SimConfig:
     sync_interval: int = 8  # rounds between sync sweeps (1-15 s backoff analog)
     sync_candidates: int = 10  # RANDOM_NODES_CHOICES (agent/mod.rs:38)
     sync_server_cap: int = 3  # inbound sync semaphore (corro-types/agent.rs:132)
-    sync_actor_topk: int = 32  # actors repaired per sync round
+    sync_peers: int | None = None  # concurrent sync peers per node per sweep;
+    # None = the reference's max(min(n/100, 10), 3) (handlers.rs:1008-1015)
+    sync_actor_topk: int = 32  # actors repaired per node per PEER per sweep
+    # (a per-connection chunk budget, peer.rs:1207 — parallel peers each
+    # carry a full budget, so sweep bandwidth scales with sync_peers)
     sync_cap_per_actor: int = 8  # versions per actor per sync round
+    sync_req_actors: int | None = None  # total request lanes (actors) a
+    # node schedules per sweep across all its peers; None = 2× the
+    # per-connection budget (parallel headroom without paying full P×
+    # lane memory/compute every sweep — lanes are padded to this shape
+    # whether needed or not). Clamped to sync_actor_topk × peers.
     sync_need_sample: int = 256  # actors sampled for need estimation
 
     # --- SWIM membership (foca analog) ---
@@ -59,15 +68,37 @@ class SimConfig:
     swim_gossip_peers: int = 3  # view-exchange peers per round
     swim_announce_interval: int = 4  # belief-independent announce cadence
     # (ANNOUNCE_INTERVAL analog, agent/mod.rs:32 — heals mutual-down splits)
+    swim_payload_members: int = 64  # member entries per exchange datagram —
+    # the ≤1178-byte SWIM packet bound (broadcast/mod.rs:743) at ~18 B per
+    # piggybacked update; >= num_nodes disables the bound (full views)
 
     # --- timing model ---
     round_ms: float = 200.0  # simulated wall-clock per round (broadcast
     # flush cadence is 500 ms in the reference, broadcast/mod.rs:378; one
     # sim round ≈ one flush+delivery hop)
 
+    # --- link latency + RTT rings (members.rs:40,140-188) ---
+    latency_regions: int = 1  # >1 enables the delay model (contiguous
+    # node-id regions; think racks/DCs)
+    latency_intra: int = 1  # rounds-to-deliver within a region
+    latency_inter: int = 4  # rounds-to-deliver across regions
+    rtt_rings: bool = False  # measure per-edge RTT on delivery and
+    # recompute ring0 from observations (else ring0 stays static)
+    ring_update_interval: int = 8  # rounds between ring recomputations
+
     @property
     def num_actors(self) -> int:
         return self.num_nodes
+
+    @property
+    def resolved_sync_peers(self) -> int:
+        """Concurrent sync peers per sweep — max(min(n/100, 10), 3), the
+        reference's parallel_sync peer count (``handlers.rs:1008-1015``),
+        clamped to the candidate pool."""
+        p = self.sync_peers
+        if p is None:
+            p = max(min(self.num_nodes // 100, 10), 3)
+        return max(1, min(p, self.sync_candidates, self.num_nodes - 1))
 
     def validate(self) -> "SimConfig":
         assert self.num_nodes >= 2

@@ -95,14 +95,23 @@ def run_sim(
     stop_on_convergence: bool = True,
     donate: bool = False,
     min_rounds: int | None = None,
+    mesh=None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
     "converged among the living" while an outage victim still has to catch
-    up). Defaults to the write phase length."""
+    up). Defaults to the write phase length.
+
+    ``mesh``: shard the cluster state over this device mesh before running
+    (node-axis DP + actor-sharded log at scale, :mod:`engine.sharding`);
+    jit propagates the input shardings through the scan."""
     schedule = schedule or Schedule()
     if min_rounds is None:
         min_rounds = schedule.write_rounds
+    if mesh is not None:
+        from corro_sim.engine.sharding import shard_state
+
+        state = shard_state(state, mesh, cfg.num_nodes)
     runner = _chunk_runner(cfg, donate=donate)
     root = jax.random.PRNGKey(seed)
 

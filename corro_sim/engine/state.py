@@ -20,6 +20,7 @@ from corro_sim.core.changelog import ChangeLog, make_changelog
 from corro_sim.core.compaction import CellOwnership, make_ownership
 from corro_sim.core.crdt import TableState, make_table_state
 from corro_sim.gossip.broadcast import GossipState, make_gossip_state
+from corro_sim.membership.rtt import make_rtt
 from corro_sim.membership.swim import SwimState, make_swim_state
 
 
@@ -34,9 +35,16 @@ class SimState:
     ring0: jnp.ndarray  # (N, ring0_size) int32 static eager-peer table
     row_cdf: jnp.ndarray  # (R,) float32 cumulative row-sampling distribution
     round: jnp.ndarray  # () int32
-    hlc: jnp.ndarray  # (N,) int32 — per-node HLC tick (uhlc analog)
-    last_cleared: jnp.ndarray  # (N,) int32 — round of last emptyset applied
-    # (last_cleared_ts analog, corro-types/src/sync.rs:80-87)
+    hlc: jnp.ndarray  # (N,) int32 — per-node HLC (uhlc analog: merged
+    # max+tick on every gossip delivery and sync contact, setup.rs:91-96,
+    # api/peer.rs:1502-1521; physical component = the round counter)
+    last_cleared: jnp.ndarray  # (N,) int32 — HLC ts of the newest emptyset
+    # a node applied (last_cleared_ts analog, corro-types/src/sync.rs:80-87);
+    # monotone max, so a stale-clock sender can never regress it
+    cleared_hlc: jnp.ndarray  # (A,) int32 — HLC stamp of each actor's
+    # latest cleared-version event (the ts carried by its EmptySet)
+    rtt: jnp.ndarray  # (N, N) uint8 observed edge delay [receiver, sender]
+    # ((1,1) placeholder when rtt_rings is off — members.rs:140-179 analog)
 
 
 def _row_cdf(cfg: SimConfig) -> np.ndarray:
@@ -86,4 +94,6 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
         round=jnp.zeros((), jnp.int32),
         hlc=jnp.zeros((n,), jnp.int32),
         last_cleared=jnp.full((n,), -1, jnp.int32),
+        cleared_hlc=jnp.full((cfg.num_actors,), -1, jnp.int32),
+        rtt=make_rtt(n, cfg.rtt_rings),
     )

@@ -29,6 +29,7 @@ from corro_sim.core.compaction import update_ownership
 from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
+from corro_sim.membership.rtt import link_open, observe_rtt, recompute_ring0
 from corro_sim.membership.swim import swim_step, view_alive
 from corro_sim.sync.sync import sync_round
 
@@ -151,6 +152,7 @@ def sim_step(
         writers[:, None]
         & (jnp.arange(s, dtype=jnp.int32)[None, :] < w_ncells[:, None])
     )
+    pre_cleared = log.cleared
     own, log = update_ownership(
         state.own,
         log,
@@ -166,6 +168,22 @@ def sim_step(
         ch_cl.reshape(-1),
         w_cell_live.reshape(-1),
         jnp.broadcast_to(w_del[:, None], (n, s)).reshape(-1),
+    )
+    # Stamp each actor whose version(s) were newly cleared this round with
+    # the round's write-phase clock (max HLC over this round's live
+    # writers) — the ts an EmptySet carries (store_empty_changeset,
+    # change.rs:267-389), at round granularity: attributing each cleared
+    # version to the exact clearing writer would mean threading per-lane
+    # clocks through the ownership fold; the round-max is an upper bound
+    # minted by SOME live writer this round, and the monotone-max gate on
+    # last_cleared (the correctness property) is unaffected. A down/stale
+    # writer cannot mint a fresh ts: only live writers contribute.
+    newly_cleared = (log.cleared & ~pre_cleared).any(axis=1)  # (A,)
+    writer_ts = jnp.max(jnp.where(writers, state.hlc, -1))
+    cleared_hlc = jnp.where(
+        newly_cleared,
+        jnp.maximum(state.cleared_hlc, writer_ts),
+        state.cleared_hlc,
     )
 
     # ------------------------------------------------- eager ring-0 messages
@@ -193,8 +211,11 @@ def sim_step(
     chunk = jnp.concatenate([e_chunk, g_chunk])
     valid = jnp.concatenate([e_valid, g_valid])
 
-    # Ground truth: the packet only lands if the link is actually up.
-    delivered = valid & reach(src, dst)
+    # Ground truth: the packet only lands if the link is actually up AND
+    # this round matches the link's delay phase (a delay-d link is open on
+    # 1-of-d phases; the miss is repaired by retransmission/sync — see
+    # membership/rtt.py for why latency reads as loss to a gossip deadline).
+    delivered = valid & reach(src, dst) & link_open(cfg, src, dst, state.round)
 
     # ONE lane sort for the whole delivery pipeline: bookkeeping dedupe
     # (deliver_versions presorted path), changeset gathers, the merge
@@ -208,10 +229,39 @@ def sim_step(
     else:
         order = jnp.lexsort((chunk, ver, actor, sort_dst))
     dst = dst[order]
+    src = src[order]
     actor = actor[order]
     ver = ver[order]
     chunk = chunk[order]
     delivered = delivered[order]
+
+    # ------------------------------------------------------------ HLC merge
+    # Every delivered message carries the sender's clock; the receiver
+    # merges max(local, remote) and ticks at end of round — the uhlc
+    # exchange the reference performs on every contact (broadcast
+    # timestamps, sync Clock messages; setup.rs:91-96, peer.rs:1502-1521).
+    hlc_recv = (
+        jnp.zeros((n,), jnp.int32)
+        .at[jnp.where(delivered, dst, n)]
+        .max(state.hlc[src], mode="drop")
+    )
+
+    # ------------------------------------------------- RTT samples + rings
+    # Every delivery is an RTT sample (transport.rs:199-233); rings
+    # recompute from observations every ring_update_interval rounds
+    # (members.rs:140-188). Static config → both fully traced out when off.
+    if cfg.rtt_rings:
+        rtt = observe_rtt(cfg, state.rtt, dst, src, delivered)
+        ring0 = jax.lax.cond(
+            (state.round % cfg.ring_update_interval)
+            == (cfg.ring_update_interval - 1),
+            lambda args: recompute_ring0(*args),
+            lambda args: args[1],
+            (rtt, state.ring0),
+        )
+    else:
+        rtt = state.rtt
+        ring0 = state.ring0
 
     # ------------------------------------- delivery: bookkeeping + merge
     book, fresh_chunk, complete, dropped = deliver_versions(
@@ -279,39 +329,40 @@ def sim_step(
             "swim_probe_failures": jnp.int32(0),
         }
 
+    # last_cleared_ts analog, HLC-gated (handlers.rs:524-719): applying an
+    # emptied version advances the node's last-cleared ts to the EmptySet's
+    # HLC stamp via max — never backwards, so a sender with a stale clock
+    # cannot regress it.
+    last_cleared = state.last_cleared.at[
+        jnp.where(complete & c_cleared, dst, n)
+    ].max(cleared_hlc[actor], mode="drop")
+
     # ----------------------------------------------------------------- sync
     is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
 
     def do_sync(args):
-        book, table = args
+        book, table, hlc, lc = args
         return sync_round(
-            cfg, book, log, table, k_sync, alive,
+            cfg, book, log, table, hlc, lc, cleared_hlc, k_sync, alive,
             view if cfg.swim_enabled else jnp.ones((1, n), bool),
             # reachability as a matrix-free pair of masks: same-partition
             # check happens inside via gathered part ids
             _pairwise_mask(alive, part),
+            rtt=rtt if cfg.rtt_rings else None,
         )
 
     def no_sync(args):
-        book, table = args
+        book, table, hlc, lc = args
         zero = jnp.int32(0)
-        return book, table, {
+        return book, table, hlc, lc, {
             "sync_pairs": zero,
             "sync_versions": zero,
             "sync_empties": zero,
         }
 
-    book, table, sync_metrics = jax.lax.cond(
-        is_sync, do_sync, no_sync, (book, table)
+    book, table, hlc_s, last_cleared, sync_metrics = jax.lax.cond(
+        is_sync, do_sync, no_sync, (book, table, state.hlc, last_cleared)
     )
-
-    # last_cleared_ts analog: the round a node last applied an emptied
-    # version (gossip-delivered here; sync empties update it via the
-    # sync_empties path next sweep — observability, not correctness).
-    applied_empty = jnp.zeros((n,), bool).at[
-        jnp.where(complete & c_cleared, dst, n)
-    ].set(True, mode="drop")
-    last_cleared = jnp.where(applied_empty, state.round, state.last_cleared)
 
     # -------------------------------------------------------------- metrics
     # float32 sum: magnitudes can exceed int32 at 10k×10k scale, and the
@@ -320,6 +371,20 @@ def sim_step(
     gap = jnp.where(
         alive[:, None], (log.head[None, :] - book.head).astype(jnp.float32), 0.0
     ).sum()
+    # uhlc max+tick: merged clocks from this round's deliveries + sync
+    # contacts, physical floor = the round counter. Down nodes freeze.
+    hlc = jnp.where(
+        alive,
+        jnp.maximum(jnp.maximum(hlc_s, hlc_recv), state.round) + 1,
+        hlc_s,
+    )
+    int_min = jnp.int32(-(2**31) + 1)
+    int_max = jnp.int32(2**31 - 1)
+    skew = jnp.maximum(
+        jnp.max(jnp.where(alive, hlc, int_min))
+        - jnp.min(jnp.where(alive, hlc, int_max)),
+        0,
+    )
     metrics = {
         "writes": writers.sum(dtype=jnp.int32),
         "deletes": w_del.sum(dtype=jnp.int32),
@@ -333,6 +398,7 @@ def sim_step(
         "queue_overflow": gossip.overflow,
         "cleared_versions": log.cleared.sum(dtype=jnp.int32),
         "gap": gap,
+        "clock_skew": skew,
         **swim_metrics,
         **sync_metrics,
     }
@@ -345,8 +411,11 @@ def sim_step(
         gossip=gossip,
         swim=swim,
         round=state.round + 1,
-        hlc=jnp.where(alive, jnp.maximum(state.hlc, state.round) + 1, state.hlc),
+        hlc=hlc,
         last_cleared=last_cleared,
+        cleared_hlc=cleared_hlc,
+        rtt=rtt,
+        ring0=ring0,
     )
     return new_state, metrics
 

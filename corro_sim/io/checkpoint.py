@@ -196,8 +196,13 @@ def _permute_actors(sd: dict, a: int, b: int) -> dict:
     own = sd["own"]
     for f in ("site", "actor", "ractor", "rsite"):
         own[f] = _relabel_values(own[f], a, b)
-    for f in ("hlc", "last_cleared"):
+    for f in ("hlc", "last_cleared", "cleared_hlc"):
         sd[f] = _swap_axis(sd[f], a, b, 0)
+    # volatile fields may already be filtered out (scrub/restore paths)
+    if "rtt" in sd and sd["rtt"].shape[0] > 1:
+        sd["rtt"] = _swap_axis(_swap_axis(sd["rtt"], a, b, 0), a, b, 1)
+    if "ring0" in sd:
+        sd["ring0"] = _relabel_values(_swap_axis(sd["ring0"], a, b, 0), a, b)
     return sd
 
 
@@ -215,7 +220,7 @@ def save_checkpoint(cluster, path, *, scrub: bool = False,
             # gossip + swim state do not travel in a portable backup
             flat = {
                 k: v for k, v in flat.items()
-                if not (k.startswith("gossip/") or k.startswith("swim/"))
+                if not k.startswith(("gossip/", "swim/", "rtt"))
             }
             if origin_node != 0:
                 nested = _unflatten(flat)
@@ -360,7 +365,7 @@ def restore(path, node: int = 0, tripwire=None):
     meta = {**meta, "subs": []}
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "ring0", "row_cdf"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "ring0", "row_cdf"))
     }
     cluster = _cluster_from_meta(meta, tripwire)
     if node >= cluster.cfg.num_nodes:
@@ -392,7 +397,7 @@ def restore_into(cluster, path, node: int = 0) -> None:
     # restore()): the running cluster keeps its own topology + membership
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "ring0", "row_cdf"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "ring0", "row_cdf"))
     }
     with cluster.locks.tracked(cluster._lock, "restore", "write"):
         new_layout = _rebuild_layout(meta)

@@ -147,6 +147,69 @@ class LiveUniverse:
         self._by_value[v] = r
         return r
 
+    def intern_many(self, values) -> None:
+        """Bulk-intern with at most ONE re-space for the whole batch.
+
+        ``rank()`` re-spaces whenever a midpoint gap is exhausted; a batch
+        of fresh values (a /v1/transactions body) inserted one at a time
+        can exhaust dozens of gaps → dozens of remap notifications, each of
+        which rewrites every rank-typed device tensor. Here: group the new
+        values by insertion gap, midpoint-insert when every group fits, and
+        otherwise merge + re-space ONCE (one listener fire)."""
+        import bisect
+        from collections import defaultdict
+
+        new = sorted(
+            {_hashable(v) for v in values} - self._by_value.keys(),
+            key=sqlite_sort_key,
+        )
+        if not new:
+            return
+        groups: dict[int, list] = defaultdict(list)
+        for v in new:
+            groups[bisect.bisect_left(self._keys, sqlite_sort_key(v))].append(v)
+        fits = all(
+            (self._gap_bounds(i, len(g))[1] - self._gap_bounds(i, len(g))[0] - 1)
+            >= len(g)
+            for i, g in groups.items()
+        )
+        if fits:
+            # evenly spread each group inside its gap; insert descending by
+            # index so earlier indices stay valid
+            for i in sorted(groups, reverse=True):
+                g = groups[i]
+                lo, hi = self._gap_bounds(i, len(g))
+                step = (hi - lo) // (len(g) + 1)
+                for j, v in enumerate(g):
+                    r = lo + step * (j + 1)
+                    self._values.insert(i + j, v)
+                    self._keys.insert(i + j, sqlite_sort_key(v))
+                    self._ranks.insert(i + j, r)
+                    self._by_value[v] = r
+            return
+        # merge + single re-space
+        old_values = list(self._values)
+        old_ranks = list(self._ranks)
+        merged = sorted(old_values + new, key=sqlite_sort_key)
+        self._values = merged
+        self._keys = [sqlite_sort_key(v) for v in merged]
+        self._ranks = [(i + 1) * self.GAP for i in range(len(merged))]
+        self._by_value = dict(zip(merged, self._ranks))
+        self.version += 1
+        new_ranks = [self._by_value[v] for v in old_values]
+        for fn in self._remap_listeners:
+            fn(old_ranks, new_ranks)
+
+    def _gap_bounds(self, i: int, count: int) -> tuple[int, int]:
+        """(lo, hi) open rank interval available at insertion index i; the
+        end-append gap is sized to fit ``count`` new ranks."""
+        lo = self._ranks[i - 1] if i > 0 else 0
+        if i < len(self._ranks):
+            hi = self._ranks[i]
+        else:
+            hi = lo + (count + 1) * self.GAP
+        return lo, hi
+
     def _respace(self) -> None:
         old = list(self._ranks)
         self._ranks = [(i + 1) * self.GAP for i in range(len(self._values))]

@@ -1,0 +1,109 @@
+"""Link latency model + measured-RTT rings — the members.rs analog.
+
+Reference behavior (``corro-types/src/members.rs:40,140-188``): every QUIC
+contact pushes an RTT sample into a 20-sample circular buffer per peer;
+samples bucket into ``RING_BUCKETS`` = {0-6, 6-15, 15-50, 50-100, 100-200,
+200-300} ms; a member's ring is recomputed from its bucketed average, and
+ring-0 (lowest latency) gets the eager broadcast path
+(``broadcast/mod.rs:489-499``) and preferential sync peer choice
+(``handlers.rs:1018-1042``).
+
+TPU shape, three pieces:
+
+- **Delay model**: nodes belong to ``latency_regions`` contiguous regions
+  (think racks/DCs). A link's delay in rounds is ``latency_intra`` within
+  a region and ``latency_inter`` across. Rather than buffering in-flight
+  messages per delay bucket (ragged, memory-hungry), a delay-d link is
+  *open on 1-of-d round phases* (edge-hashed): messages attempted on a
+  closed phase are lost to the gossip path and repaired by sync — to a
+  deadline-driven gossip protocol, a laggy link IS indistinguishable from
+  a lossy one, and the expected extra delivery latency works out to the
+  modeled delay.
+- **Measurement**: every successful delivery writes the observed edge
+  delay into the receiver's ``rtt[dst, src]`` plane (the sample the
+  reference takes on connection reuse, ``transport.rs:199-233``).
+- **Ring recomputation**: every ``ring_update_interval`` rounds each node
+  re-picks its ``ring0_size`` lowest-RTT peers from observations
+  (unobserved edges rank last) — ``add_rtt`` → ``recalculate_rings``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from corro_sim.config import SimConfig
+
+UNOBSERVED = jnp.uint8(255)
+
+
+def region_of(cfg: SimConfig, node: jnp.ndarray) -> jnp.ndarray:
+    return (node * cfg.latency_regions) // cfg.num_nodes
+
+
+def link_delay(cfg: SimConfig, src: jnp.ndarray, dst: jnp.ndarray):
+    """Delay in rounds for each (src, dst) lane."""
+    same = region_of(cfg, src) == region_of(cfg, dst)
+    return jnp.where(
+        same,
+        jnp.int32(cfg.latency_intra),
+        jnp.int32(cfg.latency_inter),
+    )
+
+
+def link_open(cfg: SimConfig, src, dst, round_):
+    """Whether the (src, dst) link delivers on this round's phase.
+
+    Edge-hashed phase so a given link reopens every ``delay`` rounds —
+    the memoryless form of "this hop takes delay rounds".
+    """
+    if cfg.latency_regions <= 1:
+        return jnp.ones(src.shape, bool)
+    d = link_delay(cfg, src, dst)
+    h = (
+        src.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+        ^ dst.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+    ).astype(jnp.int32) & jnp.int32(0x7FFFFFFF)
+    return ((round_ + h) % d) == 0
+
+
+def make_rtt(num_nodes: int, enabled: bool) -> jnp.ndarray:
+    n = num_nodes if enabled else 1
+    return jnp.full((n, n), UNOBSERVED, jnp.uint8)
+
+
+def observe_rtt(
+    cfg: SimConfig,
+    rtt: jnp.ndarray,  # (N, N) uint8 — receiver's table, [dst, src]
+    dst: jnp.ndarray,
+    src: jnp.ndarray,
+    delivered: jnp.ndarray,
+) -> jnp.ndarray:
+    """Record the observed delay of every delivered lane.
+
+    The model's delay is deterministic per edge, so duplicate lanes carry
+    equal samples and a plain scatter-set is race-free."""
+    n = rtt.shape[0]
+    sample = jnp.clip(link_delay(cfg, src, dst), 0, 254).astype(jnp.uint8)
+    return rtt.at[jnp.where(delivered, dst, n), src].set(sample, mode="drop")
+
+
+def recompute_ring0(
+    rtt: jnp.ndarray, ring0: jnp.ndarray
+) -> jnp.ndarray:
+    """Each node's ``ring0_size`` lowest-observed-RTT peers.
+
+    Unobserved peers rank behind every observed one; self is excluded.
+    Ties (and the all-unobserved cold start) break toward the previous
+    ring's members so an informationless update is a no-op."""
+    import jax
+
+    n, k = ring0.shape[0], ring0.shape[1]
+    score = rtt.astype(jnp.int32)  # (N, N), 255 = unobserved
+    iota = jnp.arange(n, dtype=jnp.int32)
+    score = score.at[iota, iota].set(jnp.int32(1000))  # never pick self
+    # prefer incumbents on ties: tiny bonus to current ring members
+    bonus = jnp.zeros((n, n), jnp.int32).at[
+        iota[:, None], ring0
+    ].set(1, mode="drop")
+    _, new_ring = jax.lax.top_k(-(score * 4 - bonus), k)
+    return new_ring.astype(jnp.int32)

@@ -141,16 +141,35 @@ def swim_step(
     # Two directions per sub-round:
     #  * pull — i merges a random peer's view, but only contacts peers it
     #    believes are up;
-    #  * push — every node pushes its view to one target (a random
-    #    permutation, so each target receives exactly one push and the
-    #    scatter-merge degenerates into a gather). The *pusher's* belief
-    #    gates the contact, which is what lets a refuted/rejoined node
-    #    re-enter views that had written it off — the reference's SWIM
-    #    announcer + identity renew path (handlers.rs:188-232,
-    #    actor.rs:199-210). Pull alone deadlocks: nobody polls a member
-    #    they believe is DOWN.
+    #  * push — every node pushes to a uniformly random target. Fan-in is
+    #    whatever the sampling produces (~Poisson(1): some nodes receive
+    #    several pushes, some none — real SWIM fan-in statistics, not the
+    #    round-1 permutation's exactly-one). Concurrent pushes into one
+    #    receiver combine via a scatter-max on the packed (incarnation,
+    #    severity) precedence key — the same winner foca's sequential
+    #    update application would pick. The *pusher's* belief gates the
+    #    contact, which is what lets a refuted/rejoined node re-enter views
+    #    that had written it off (handlers.rs:188-232, actor.rs:199-210).
+    #    Pull alone deadlocks: nobody polls a member they believe is DOWN.
+    #
+    # Payload bound: each datagram carries at most swim_payload_members
+    # member entries (the ≤1178 B packet, broadcast/mod.rs:743) — a
+    # contiguous block of the member space at a per-sender random phase,
+    # like foca cycling its piggyback backlog. >= n means full views.
+    cols = jnp.arange(n, dtype=jnp.int32)
+    bounded = cfg.swim_payload_members < n
+
+    def payload_block(key_b):
+        """(N, N) bool — which member columns each sender's datagram carries."""
+        if not bounded:
+            return None
+        off = jax.random.randint(key_b, (n,), 0, n, dtype=jnp.int32)
+        return ((cols[None, :] - off[:, None]) % n) < cfg.swim_payload_members
+
     for g in range(cfg.swim_gossip_peers):
-        kg_pull, kg_push = jax.random.split(jax.random.fold_in(k_ex, g))
+        kg_pull, kg_push, kg_bl1, kg_bl2 = jax.random.split(
+            jax.random.fold_in(k_ex, g), 4
+        )
         peer = jax.random.randint(kg_pull, (n,), 0, n, dtype=jnp.int32)
         can = (
             alive
@@ -159,6 +178,9 @@ def swim_step(
             & (peer != rows)
             & (swim.status[rows, peer] < DOWN)
         )[:, None]
+        block = payload_block(kg_bl1)
+        if block is not None:
+            can = can & block[peer]  # responder picks the datagram contents
         ps, pi, pse = swim.status[peer], swim.inc[peer], swim.since[peer]
         ms, mi, mse = _merge_views(
             swim.status, swim.inc, swim.since, ps, pi, pse
@@ -169,22 +191,39 @@ def swim_step(
             since=jnp.where(can, mse, swim.since),
         )
 
-        pusher = jax.random.permutation(kg_push, n).astype(jnp.int32)
-        can_push = (
-            alive[pusher]
-            & alive
-            & reachable(pusher, rows)
-            & (pusher != rows)
-            & (swim.status[pusher, rows] < DOWN)  # pusher believes us up
-        )[:, None]
-        ps, pi, pse = swim.status[pusher], swim.inc[pusher], swim.since[pusher]
-        ms, mi, mse = _merge_views(
-            swim.status, swim.inc, swim.since, ps, pi, pse
+        push_tgt = jax.random.randint(kg_push, (n,), 0, n, dtype=jnp.int32)
+        ok_push = (
+            alive
+            & alive[push_tgt]
+            & reachable(rows, push_tgt)
+            & (push_tgt != rows)
+            & (swim.status[rows, push_tgt] < DOWN)  # pusher believes tgt up
         )
+        # packed precedence key: higher incarnation wins, then severity —
+        # exactly _merge_views' "better" ordering as one int
+        key_pl = swim.inc * 4 + swim.status.astype(jnp.int32)
+        contrib = jnp.where(ok_push[:, None], key_pl, -1)
+        block = payload_block(kg_bl2)
+        if block is not None:
+            contrib = jnp.where(block, contrib, -1)
+        best = jnp.full((n, n), -1, jnp.int32).at[
+            jnp.where(ok_push, push_tgt, n)
+        ].max(contrib, mode="drop")
+        # winner's `since` rides along: among key-tied winners take the max
+        # (equal (inc, severity); a later suspicion start is conservative)
+        at_tgt = best[jnp.where(ok_push, push_tgt, 0)]
+        s_contrib = jnp.where(
+            (contrib >= 0) & (contrib == at_tgt), swim.since, -1
+        )
+        since_best = jnp.full((n, n), -1, jnp.int32).at[
+            jnp.where(ok_push, push_tgt, n)
+        ].max(s_contrib, mode="drop")
+        own_key = swim.inc * 4 + swim.status.astype(jnp.int32)
+        take = (best > own_key) & alive[:, None]
         swim = swim.replace(
-            status=jnp.where(can_push, ms, swim.status),
-            inc=jnp.where(can_push, mi, swim.inc),
-            since=jnp.where(can_push, mse, swim.since),
+            status=jnp.where(take, (best % 4).astype(jnp.int8), swim.status),
+            inc=jnp.where(take, best // 4, swim.inc),
+            since=jnp.where(take, since_best, swim.since),
         )
 
     # --- periodic announce (belief-independent) ----------------------------

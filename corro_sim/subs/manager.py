@@ -29,7 +29,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from corro_sim.core.crdt import NEG
+from corro_sim.io.values import sqlite_sort_key
 from corro_sim.subs.query import (
+    And,
     QueryError,
     RankUniverse,
     Select,
@@ -37,6 +39,7 @@ from corro_sim.subs.query import (
     eval_predicate_py,
     parse_query,
     predicate_columns,
+    rewrite_columns,
     split_host_predicate,
     split_pk_predicate,
 )
@@ -96,7 +99,49 @@ def _predicate_literals(pred):
         yield from _predicate_literals(pred.inner)
 
 
-class Matcher:
+class _EventStream:
+    """Shared change-feed machinery: monotone change ids, bounded event
+    buffer (the reference prunes changes > last N, ``pubsub.rs:1275``),
+    and catch-up-or-404 semantics. Matcher and JoinMatcher must never
+    diverge on these — both inherit."""
+
+    def _init_events(self, max_buffer: int) -> None:
+        self.max_buffer = max_buffer
+        self._change_id = 0
+        self._events: list[SubEvent] = []
+        self._primed = False
+
+    @property
+    def change_id(self) -> int:
+        """Latest change id this matcher has emitted (feed position)."""
+        return self._change_id
+
+    def _emit(self, events: list, kind: str, rowid: int, cells: list) -> None:
+        self._change_id += 1
+        events.append(SubEvent(kind=kind, rowid=rowid, cells=cells,
+                               change_id=self._change_id))
+
+    def _buffer_events(self, events: list) -> None:
+        self._events.extend(events)
+        if len(self._events) > self.max_buffer:
+            # not [-max_buffer:] — for max_buffer == 0 that keeps ALL
+            self._events = self._events[len(self._events) - self.max_buffer:]
+
+    def catch_up(self, from_change_id: int):
+        """Buffered events with id > from; None if compacted past it
+        (subscriber must re-subscribe — the reference 404s the range)."""
+        if self._events and self._events[0].change_id > from_change_id + 1:
+            return None
+        if not self._events and from_change_id < self._change_id:
+            # buffer gone (warm-boot restore / purge) but ids advanced past
+            # `from` — the gap is unservable, same 404 as compaction
+            return None
+        if from_change_id > self._change_id:
+            return None
+        return [e for e in self._events if e.change_id > from_change_id]
+
+
+class Matcher(_EventStream):
     """One registered query; owns its compiled eval + diff state."""
 
     def __init__(self, sub_id, select: Select, node: int, layout, universe,
@@ -105,7 +150,6 @@ class Matcher:
         self.select = select
         self.node = node
         self.universe = universe
-        self.max_buffer = max_buffer
         self._layout_ref = layout
 
         start, cap = layout.table_range(select.table)
@@ -156,9 +200,7 @@ class Matcher:
         self._eval = self._build_eval()
         self._prev_match = np.zeros((cap,), bool)
         self._prev_proj = np.zeros((cap, len(self._proj_idx)), np.int32)
-        self._change_id = 0
-        self._events: list[SubEvent] = []
-        self._primed = False
+        self._init_events(max_buffer)
 
     def _build_eval(self):
         """Compile the value-column WHERE terms to the current rank space."""
@@ -220,11 +262,6 @@ class Matcher:
             return match, prj
 
         return evaluate
-
-    @property
-    def change_id(self) -> int:
-        """Latest change id this matcher has emitted (feed position)."""
-        return self._change_id
 
     def rebind(self, old_ranks, new_ranks) -> None:
         """Adopt a re-spaced rank universe (LiveUniverse remap).
@@ -348,34 +385,224 @@ class Matcher:
         )
         for kind, mask in (("insert", ins), ("update", upd), ("delete", dele)):
             for s in np.nonzero(mask)[0]:
-                self._change_id += 1
-                events.append(
-                    SubEvent(
-                        kind=kind,
-                        rowid=int(s) + self._start,
-                        cells=self._decode_row(s, proj[s]),
-                        change_id=self._change_id,
-                    )
-                )
+                self._emit(events, kind, int(s) + self._start,
+                           self._decode_row(s, proj[s]))
         self._prev_match, self._prev_proj = match, proj
-        self._events.extend(events)
-        # purge like the reference (changes > last N pruned, pubsub.rs:1275)
-        if len(self._events) > self.max_buffer:
-            self._events = self._events[-self.max_buffer:]
+        self._buffer_events(events)
         return events
 
-    def catch_up(self, from_change_id: int):
-        """Buffered events with id > from; None if compacted past it
-        (subscriber must re-subscribe — the reference 404s the range)."""
-        if self._events and self._events[0].change_id > from_change_id + 1:
-            return None
-        if not self._events and from_change_id < self._change_id:
-            # buffer gone (warm-boot restore / purge) but ids advanced past
-            # `from` — the gap is unservable, same 404 as compaction
-            return None
-        if from_change_id > self._change_id:
-            return None
-        return [e for e in self._events if e.change_id > from_change_id]
+
+class JoinMatcher(_EventStream):
+    """A registered two-table equi-join query (VERDICT r1 next #5).
+
+    The reference's Matcher rewrites arbitrary multi-table SELECTs into
+    per-table queries with pk-alias injection and temp-table constraints
+    (``pubsub.rs:697-832``). The tensor shape: each side is a regular
+    single-table :class:`Matcher` (device rank-space predicate → match
+    mask + projected ranks); the equi-join then pairs the two matched row
+    sets by join-key *value* (ranks decode through the shared universe, so
+    rank equality IS value equality across columns), and the diff-to-events
+    machinery runs over the joined pairs. LEFT joins emit unmatched left
+    rows with NULL right cells.
+    """
+
+    def __init__(self, sub_id, select: Select, node: int, layout, universe,
+                 max_buffer: int = 512):
+        self.id = sub_id
+        self.select = select
+        self.node = node
+        self.universe = universe
+        j = select.join
+        self._kind = j.kind
+        left_alias = select.alias or select.table
+        right_alias = j.alias
+        if left_alias == right_alias:
+            raise QueryError("join sides need distinct aliases")
+        self._alias_tables = {left_alias: select.table, right_alias: j.table}
+
+        def split_q(name, what):
+            if "." not in name:
+                raise QueryError(
+                    f"{what} must be alias-qualified in a JOIN: {name!r}"
+                )
+            a, c = name.split(".", 1)
+            if a not in self._alias_tables:
+                raise QueryError(f"unknown alias {a!r} in {name!r}")
+            return a, c
+
+        self._on = {}
+        for q, side_alias, what in (
+            (j.on_left, left_alias, "ON left"),
+            (j.on_right, right_alias, "ON right"),
+        ):
+            a, c = split_q(q, what)
+            if a != side_alias:
+                raise QueryError(
+                    f"{what} column {q!r} must reference {side_alias!r}"
+                )
+            self._on[a] = c
+
+        # ---- selected output columns, in SELECT order -------------------
+        def side_schema(alias):
+            t = self._alias_tables[alias]
+            return (tuple(layout.pk_columns(t)), list(layout.table_columns(t)))
+
+        if select.columns:
+            out_cols = [split_q(c, "a selected column")
+                        for c in select.columns]
+        else:
+            out_cols = []
+            for alias in (left_alias, right_alias):
+                pks, vals = side_schema(alias)
+                out_cols.extend((alias, c) for c in (*pks, *vals))
+        self._out_cols = out_cols
+        self.columns = [f"{a}.{c}" for a, c in out_cols]
+
+        # ---- WHERE routing: each conjunct goes to exactly one side ------
+        side_where = {left_alias: [], right_alias: []}
+        parts = (select.where.parts if isinstance(select.where, And)
+                 else (select.where,)) if select.where is not None else ()
+        for p in parts:
+            aliases = {split_q(c, "a WHERE column")[0]
+                       for c in predicate_columns(p)}
+            if len(aliases) != 1:
+                raise QueryError(
+                    "each WHERE conjunct in a JOIN must reference exactly "
+                    "one side (the reference rewrites per-table queries "
+                    "the same way)"
+                )
+            side_where[aliases.pop()].append(p)
+
+        # ---- per-side single-table matchers -----------------------------
+        self._sides = {}
+        for alias in (left_alias, right_alias):
+            tbl = self._alias_tables[alias]
+            pks, vals = side_schema(alias)
+            need = [c for a, c in out_cols if a == alias and c in vals]
+            on_c = self._on[alias]
+            if on_c in vals and on_c not in need:
+                need.append(on_c)
+            for c in (c for a, c in out_cols if a == alias):
+                if c not in vals and c not in pks:
+                    raise QueryError(f"no such column {alias}.{c}")
+            if on_c not in vals and on_c not in pks:
+                raise QueryError(f"no such join column {alias}.{on_c}")
+            ps = side_where[alias]
+            w = None if not ps else (ps[0] if len(ps) == 1 else And(tuple(ps)))
+            w = rewrite_columns(w, lambda c: c.split(".", 1)[1])
+            self._sides[alias] = Matcher(
+                f"{sub_id}:{alias}",
+                Select(table=tbl, columns=tuple(need), where=w),
+                node, layout, universe, max_buffer=0,
+            )
+        self._left_alias, self._right_alias = left_alias, right_alias
+        self._rowspan = getattr(layout, "total_rows", 1 << 20)
+
+        self._prev: dict[int, list] = {}
+        self._init_events(max_buffer)
+
+    # ------------------------------------------------------------ plumbing
+    def rebind(self, old_ranks, new_ranks) -> None:
+        for m in self._sides.values():
+            m.rebind(old_ranks, new_ranks)
+        # self._prev holds DECODED values, not ranks — nothing to translate
+
+    def is_candidate(self, touched) -> bool:
+        if touched is None:
+            return True
+        tables = set(self._alias_tables.values())
+        return any(t in tables for t, _ in touched)
+
+    def _cell_pos(self, alias, col):
+        """Index of ``col`` in the side matcher's decoded row."""
+        m = self._sides[alias]
+        if col in m._pk_names:
+            return m._pk_names.index(col)
+        return len(m._pk_names) + m.columns.index(col)
+
+    def _side_rows(self, alias, table_state):
+        """{global slot: decoded [pk…, cols…]} of the side's matched rows."""
+        m = self._sides[alias]
+        match, proj = m._evaluate(table_state)
+        out = {}
+        for s in np.nonzero(match)[0]:
+            out[int(s) + m._start] = m._decode_row(s, proj[s])
+        return out
+
+    def _join(self, table_state) -> dict:
+        """{rowid: output cells} of the current join result."""
+        L = self._side_rows(self._left_alias, table_state)
+        R = self._side_rows(self._right_alias, table_state)
+        lpos = self._cell_pos(self._left_alias, self._on[self._left_alias])
+        rpos = self._cell_pos(self._right_alias, self._on[self._right_alias])
+        ridx: dict = {}
+        for rs, cells in R.items():
+            v = cells[rpos]
+            if v is None:
+                continue  # SQL: NULL join keys never match
+            ridx.setdefault(sqlite_sort_key(v), []).append(rs)
+
+        n_right_cells = sum(
+            1 for a, _ in self._out_cols if a == self._right_alias
+        )
+        out = {}
+        for ls, lcells in L.items():
+            v = lcells[lpos]
+            matches = ridx.get(sqlite_sort_key(v), []) if v is not None else []
+            if matches:
+                for rs in matches:
+                    cells = self._project(lcells, R[rs])
+                    out[ls * (self._rowspan + 1) + rs + 1] = cells
+            elif self._kind == "left":
+                cells = self._project(lcells, None)
+                out[ls * (self._rowspan + 1)] = cells
+        return out
+
+    def _project(self, lcells, rcells) -> list:
+        out = []
+        for a, c in self._out_cols:
+            if a == self._left_alias:
+                out.append(lcells[self._cell_pos(a, c)])
+            elif rcells is None:
+                out.append(None)
+            else:
+                out.append(rcells[self._cell_pos(a, c)])
+        return out
+
+    # ------------------------------------------------------------- surface
+    def prime(self, table_state):
+        cur = self._join(table_state)
+        self._prev = cur
+        self._primed = True
+        header = {"columns": list(self.columns)}
+        rows = [
+            {"row": [rid, cur[rid]]} for rid in sorted(cur)
+        ]
+        eoq = {"eoq": {"change_id": self._change_id}}
+        return [header, *rows, eoq]
+
+    def step(self, table_state) -> list:
+        if not self._primed:
+            raise RuntimeError("matcher not primed — call prime() first")
+        cur = self._join(table_state)
+        events: list = []
+        for rid in sorted(cur.keys() - self._prev.keys()):
+            self._emit(events, "insert", rid, cur[rid])
+        for rid in sorted(cur.keys() & self._prev.keys()):
+            if cur[rid] != self._prev[rid]:
+                self._emit(events, "update", rid, cur[rid])
+        for rid in sorted(self._prev.keys() - cur.keys()):
+            self._emit(events, "delete", rid, self._prev[rid])
+        self._prev = cur
+        self._buffer_events(events)
+        return events
+
+
+def make_matcher(sub_id, select: Select, node: int, layout, universe,
+                 max_buffer: int = 512):
+    """Matcher factory: single-table or equi-join, same public surface."""
+    cls = JoinMatcher if select.join is not None else Matcher
+    return cls(sub_id, select, node, layout, universe, max_buffer=max_buffer)
 
 
 class LayoutAdapter:
@@ -453,6 +680,13 @@ class LayoutAdapter:
         return self._layout.generation if self._layout is not None else 0
 
     @property
+    def total_rows(self) -> int:
+        """Global row-slot bound (joined-row id span)."""
+        if self._layout is not None:
+            return self._layout.num_rows
+        return len(self._trace.row_keys)
+
+    @property
     def row_key(self):
         if self._layout is not None:
             lay = self._layout
@@ -494,7 +728,7 @@ class SubsManager:
             return self._by_id[sub_id], None
         sub_id = f"sub-{self._next_id}"
         self._next_id += 1
-        m = Matcher(
+        m = make_matcher(
             sub_id, select, node, self.layout, self.universe,
             max_buffer=self.max_buffer,
         )
@@ -513,7 +747,7 @@ class SubsManager:
         whose ``from`` predates the restart re-subscribe), but the change
         id continues from where it was so ids never regress."""
         select = parse_query(sql)
-        m = Matcher(
+        m = make_matcher(
             sub_id, select, node, self.layout, self.universe,
             max_buffer=self.max_buffer,
         )

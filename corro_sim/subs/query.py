@@ -3,14 +3,20 @@
 The reference subscribes arbitrary SELECTs: ``Matcher::new`` parses the
 statement, extracts the involved table/columns, and rewrites per-table
 queries (``corro-types/src/pubsub.rs:640-832,1899-1993``). The simulator's
-query surface is the single-table core of that:
+query surface:
 
-    SELECT <col[, col…] | *> FROM <table>
+    SELECT <col[, col…] | *> FROM <table> [AS] [alias]
+      [ [INNER|LEFT [OUTER]] JOIN <table2> [AS] [alias2]
+        ON <q.col> = <q.col> ]
       [WHERE <predicate>]
 
 with predicates over value columns: ``=, !=, <>, <, <=, >, >=``,
 ``IS [NOT] NULL``, ``AND``, ``OR``, ``NOT``, parentheses, and literals
-(integers, floats, 'strings', NULL).
+(integers, floats, 'strings', NULL). With a JOIN, column references must
+be alias-qualified (``s.name``) and each WHERE conjunct must reference a
+single side (the reference rewrites per-table queries the same way,
+``pubsub.rs:697-832``); LEFT joins emit unmatched left rows with NULL
+right cells.
 
 Compilation, not interpretation: cell values live on device as
 order-preserving interned ranks (:mod:`corro_sim.io.values`), so every
@@ -87,14 +93,36 @@ class JsonContains:
 
 
 @dataclasses.dataclass(frozen=True)
+class Join:
+    """Two-table equi-join clause (``a JOIN b ON a.x = b.y``)."""
+
+    table: str  # right table
+    alias: str  # right alias (defaults to table name)
+    on_left: str  # qualified "alias.col" on the left side
+    on_right: str  # qualified "alias.col" on the right side
+    kind: str = "inner"  # 'inner' | 'left'
+
+
+@dataclasses.dataclass(frozen=True)
 class Select:
     table: str
     columns: tuple  # () = *
     where: object  # predicate AST or None
+    alias: str | None = None  # left-table alias (join queries)
+    join: Join | None = None
 
     def normalized(self) -> str:
         cols = ", ".join(self.columns) if self.columns else "*"
         sql = f"SELECT {cols} FROM {self.table}"
+        if self.alias is not None and self.alias != self.table:
+            sql += f" AS {self.alias}"
+        if self.join is not None:
+            j = self.join
+            kw = "LEFT JOIN" if j.kind == "left" else "JOIN"
+            sql += f" {kw} {j.table}"
+            if j.alias != j.table:
+                sql += f" AS {j.alias}"
+            sql += f" ON {j.on_left} = {j.on_right}"
         if self.where is not None:
             sql += f" WHERE {_render(self.where)}"
         return sql
@@ -155,7 +183,7 @@ _TOKEN = re.compile(
     r"|(?P<str>'(?:[^']|'')*')"
     r"|(?P<num>-?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)"
     r"|(?P<op><=|>=|!=|<>|=|<|>)"
-    r"|(?P<punct>[(),*])"
+    r"|(?P<punct>[(),*.])"
     r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
     r")"
 )
@@ -188,6 +216,7 @@ def _tokenize(sql: str):
             kw = w.upper()
             if kw in (
                 "SELECT", "FROM", "WHERE", "AND", "OR", "NOT", "IS", "NULL",
+                "JOIN", "INNER", "LEFT", "OUTER", "ON", "AS",
             ):
                 out.append((kw, kw))
             elif kw == "TRUE":  # SQLite boolean keywords are 1/0 literals
@@ -219,25 +248,77 @@ class _Parser:
             raise QueryError(f"expected {kind}, got {k} {v!r}")
         return v
 
+    def qual_ident(self) -> str:
+        """``col`` or ``alias.col`` → one (possibly dotted) name string."""
+        name = self.expect("ident")
+        if self.peek()[0] == ".":
+            self.next()
+            name = f"{name}.{self.expect('ident')}"
+        return name
+
+    def _opt_alias(self, table: str) -> str:
+        if self.peek()[0] == "AS":
+            self.next()
+            return self.expect("ident")
+        if self.peek()[0] == "ident":
+            return self.expect("ident")
+        return table
+
     def parse_select(self) -> Select:
         self.expect("SELECT")
         cols = []
         if self.peek()[0] == "*":
             self.next()
         else:
-            cols.append(self.expect("ident"))
+            cols.append(self.qual_ident())
             while self.peek()[0] == ",":
                 self.next()
-                cols.append(self.expect("ident"))
+                cols.append(self.qual_ident())
         self.expect("FROM")
         table = self.expect("ident")
+        alias = self._opt_alias(table)
+        join = None
+        k = self.peek()[0]
+        if k in ("JOIN", "INNER", "LEFT"):
+            kind = "inner"
+            if k == "INNER":
+                self.next()
+            elif k == "LEFT":
+                self.next()
+                kind = "left"
+                if self.peek()[0] == "OUTER":
+                    self.next()
+            self.expect("JOIN")
+            jt = self.expect("ident")
+            jalias = self._opt_alias(jt)
+            if jalias == alias:
+                raise QueryError(
+                    f"join sides need distinct aliases, both are {alias!r}"
+                )
+            self.expect("ON")
+            lhs = self.qual_ident()
+            op = self.next()
+            if op != ("op", "="):
+                raise QueryError("JOIN ON supports equality only")
+            rhs = self.qual_ident()
+            # normalize: on_left belongs to the FROM side
+            def side(q):
+                return q.split(".", 1)[0] if "." in q else None
+            if side(lhs) == jalias and side(rhs) == alias:
+                lhs, rhs = rhs, lhs
+            join = Join(table=jt, alias=jalias, on_left=lhs, on_right=rhs,
+                        kind=kind)
         where = None
         if self.peek()[0] == "WHERE":
             self.next()
             where = self.parse_or()
         if self.peek()[0] != "eof":
             raise QueryError(f"trailing tokens at {self.peek()!r}")
-        return Select(table=table, columns=tuple(cols), where=where)
+        return Select(
+            table=table, columns=tuple(cols), where=where,
+            alias=(alias if (alias != table or join is not None) else None),
+            join=join,
+        )
 
     def parse_or(self):
         parts = [self.parse_and()]
@@ -262,7 +343,7 @@ class _Parser:
             inner = self.parse_or()
             self.expect(")")
             return inner
-        col = self.expect("ident")
+        col = self.qual_ident()
         if col.lower() == "corro_json_contains" and self.peek()[0] == "(":
             return self._parse_json_contains()
         k, v = self.next()
@@ -318,6 +399,26 @@ class _Parser:
 
 def parse_query(sql: str) -> Select:
     return _Parser(_tokenize(sql)).parse_select()
+
+
+def rewrite_columns(p, fn):
+    """Predicate AST with every column name mapped through ``fn`` (used to
+    strip alias qualifiers when routing join conjuncts to one side)."""
+    if p is None:
+        return None
+    if isinstance(p, Cmp):
+        return dataclasses.replace(p, col=fn(p.col))
+    if isinstance(p, IsNull):
+        return dataclasses.replace(p, col=fn(p.col))
+    if isinstance(p, JsonContains):
+        return dataclasses.replace(p, col=fn(p.col))
+    if isinstance(p, And):
+        return And(tuple(rewrite_columns(q, fn) for q in p.parts))
+    if isinstance(p, Or):
+        return Or(tuple(rewrite_columns(q, fn) for q in p.parts))
+    if isinstance(p, Not):
+        return Not(rewrite_columns(p.inner, fn))
+    raise QueryError(f"bad predicate node {p!r}")
 
 
 def predicate_columns(p) -> frozenset:

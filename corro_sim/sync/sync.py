@@ -5,20 +5,24 @@ Reference protocol (``corro-agent/src/agent/handlers.rs:974-1085``,
 
 1. every 1-15 s a node generates its ``SyncStateV1`` (per-actor heads +
    needed gap ranges) and picks ``max(min(n/100, 10), 3)`` peers out of 10
-   random candidates, preferring peers it needs the most from;
+   random candidates, preferring peers it needs the most from
+   (``handlers.rs:1008-1042``);
 2. servers reject beyond 3 concurrent inbound syncs (``Semaphore(3)``,
    ``corro-types/src/agent.rs:132``);
 3. the client computes *needs* — set-difference of their haves minus ours —
-   and requests version ranges in bounded chunks; the server re-reads
-   ``crsql_changes`` and streams them back with adaptive chunk sizing.
+   and an interleaved request scheduler chunks them across the chosen
+   peers with GLOBAL dedupe maps so only one peer serves each range
+   (``api/peer.rs:1179-1372``).
 
 TPU shape: "their haves minus ours" over interval sets becomes plain
 arithmetic on the (N, A) head matrix: ``delta = relu(head[peer] - head)``.
-Need-based peer scoring is estimated over a sampled actor subset (exact
-need would be an (N, candidates, A) tensor — the sample plays the role of
-the reference's chunked requests). The transfer itself is a budgeted gather
-from the global change log: top-K needy actors × ≤cap versions each — the
-analog of ``chunk_range(…, 10)`` + per-round request caps
+The multi-peer scheduler becomes an argmax *assignment*: each needed actor
+is assigned to exactly one of the node's admitted peers (the one whose
+head is furthest ahead), so no version range transfers twice — the tensor
+equivalent of the reference's ``req_full``/``req_partials`` dedupe maps.
+The transfer itself is a budgeted gather from the global change log:
+``sync_actor_topk`` total actors split across peers × ≤cap versions each —
+the analog of ``chunk_range(…, 10)`` + ≤10 reqs/peer/turn
 (``peer.rs:1207,1241-1372``).
 """
 
@@ -42,16 +46,23 @@ def choose_sync_peers(
     alive: jnp.ndarray,
     view_alive: jnp.ndarray,  # (N, N) or (1, N) believed-alive
     reachable: jnp.ndarray,  # (N, N) or (1, N) ground-truth link mask
+    rtt: jnp.ndarray | None = None,  # (N, N) uint8 observed edge delays
 ):
-    """Pick one sync peer per node; enforce the server-side semaphore.
+    """Pick up to ``resolved_sync_peers`` peers per node; enforce the
+    server-side semaphore across every (node, peer-slot) request.
 
-    Returns ``(peer, granted)`` — peer id per node and whether the pair was
-    admitted (need > 0, both ends up, reachable, and within the server's
-    3-inbound cap; rejects model ``SyncRejectionV1::MaxConcurrencyReached``,
+    Candidate ranking is (need desc, ring asc) — the reference sorts sync
+    candidates by need count then ring (``handlers.rs:1018-1042``); with
+    ``rtt`` provided, lower-latency peers win ties.
+
+    Returns ``(peer, granted)`` — (N, P) peer ids and admission mask
+    (need > 0, both ends up, reachable, and within the server's 3-inbound
+    cap; rejects model ``SyncRejectionV1::MaxConcurrencyReached``,
     ``api/peer.rs:1525-1542``).
     """
     n, a = book.head.shape
-    k_cand, k_samp, k_tie = jax.random.split(key, 3)
+    p_cnt = cfg.resolved_sync_peers
+    k_cand, k_samp, k_adm = jax.random.split(key, 3)
     c = cfg.sync_candidates
 
     cand = jax.random.randint(k_cand, (n, c), 0, n, dtype=jnp.int32)
@@ -70,29 +81,84 @@ def choose_sync_peers(
         believed = view_alive[0][cand]
     else:
         believed = view_alive[rows[:, None], cand]
-    ok = believed & (cand != rows[:, None])
-    need = jnp.where(ok, need, -1)
+    # a candidate repeated in the sample must not be chosen twice (the
+    # reference's candidate set is a sample of *distinct* members)
+    dup = (cand[:, :, None] == cand[:, None, :]) & jnp.tril(
+        jnp.ones((c, c), bool), k=-1
+    )[None]
+    ok = believed & (cand != rows[:, None]) & ~dup.any(axis=2)
+    if rtt is not None:
+        # ring ascending as the secondary sort key: score = need · 64 +
+        # (63 - rtt) keeps need dominant and prefers close peers on ties
+        rtt_c = jnp.minimum(rtt[rows[:, None], cand].astype(jnp.int32), 63)
+        score = jnp.minimum(need, 1 << 24) * 64 + (63 - rtt_c)
+    else:
+        score = need
+    score = jnp.where(ok, score, -1)
 
-    j = jnp.argmax(need, axis=1)
-    peer = cand[rows, j]
-    has_need = need[rows, j] > 0
+    topv, topi = jax.lax.top_k(score, p_cnt)  # (N, P)
+    peer = cand[rows[:, None], topi]
+    # The reference syncs on CADENCE, not on estimated need — sync_loop
+    # fires every 1-15 s and the need computation happens inside the
+    # exchange with exact per-actor state (util.rs:327-371). The sampled
+    # need only RANKS candidates here; a zero sample must not veto the
+    # sweep, or the convergence tail (few missing versions outside the
+    # sample) never gets served.
+    valid_slot = topv >= 0
 
     # Ground truth: both ends actually up and connected.
     if reachable.shape[0] == 1:
         link = reachable[0][peer]
     else:
-        link = reachable[rows, peer]
-    want = has_need & alive & alive[peer] & link
+        link = reachable[rows[:, None], peer]
+    want = valid_slot & alive[:, None] & alive[peer] & link
 
-    # Server semaphore: at most sync_server_cap inbound syncs per peer.
+    # Server semaphore: at most sync_server_cap inbound syncs per peer,
+    # counted across every (node, slot) request in the sweep. Which
+    # requests win is RANDOM per sweep — the reference's semaphore is
+    # first-come-first-served over network arrival order
+    # (api/peer.rs:1525-1542); a deterministic rank would starve the same
+    # requesters every sweep.
     big = jnp.int32(n + 1)
-    req = jnp.where(want, peer, big)
-    order = jnp.argsort(req)
+    m = n * p_cnt
+    req = jnp.where(want, peer, big).reshape(-1)
+    prio = jax.random.randint(k_adm, (m,), 0, 1 << 30, dtype=jnp.int32)
+    order = jnp.lexsort((prio, req))
     rank = ranks_within_group(req[order])
     admitted_sorted = rank < cfg.sync_server_cap
-    inv = jnp.zeros((n,), jnp.int32).at[order].set(rows)
-    granted = want & admitted_sorted[inv]
+    inv = jnp.zeros((m,), jnp.int32).at[order].set(
+        jnp.arange(m, dtype=jnp.int32)
+    )
+    granted = want & admitted_sorted[inv].reshape(n, p_cnt)
     return peer, granted
+
+
+def choose_serving_slots(
+    delta_p: jnp.ndarray, topa: jnp.ndarray, phase
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(slot, best) — one serving peer slot per requested (node, actor)
+    lane, the global range dedupe of ``api/peer.rs:1179-1372``: no two
+    peers ever serve the same range. The furthest-ahead granted peer wins;
+    TIES round-robin across the eligible slots (actor id + sweep phase mod
+    eligible count) — the reference shuffles chunked needs and deals them
+    round-robin across peers, so equally-capable peers share the load
+    rather than funneling through slot 0.
+
+    ``delta_p``: (N, P, K') versions each granted peer could serve of each
+    requested actor (0 where not granted / not ahead). Returns (N, K')
+    slot ids and the winning delta (0 = nobody can serve the lane).
+    """
+    n, p_cnt, kprime = delta_p.shape
+    best = delta_p.max(axis=1)  # (N, K')
+    elig = (delta_p == best[:, None, :]) & (best[:, None, :] > 0)
+    elig_cnt = elig.sum(axis=1)  # (N, K')
+    k_tie = (topa + phase) % jnp.maximum(elig_cnt, 1)
+    cum = jnp.zeros((n, kprime), jnp.int32)
+    slot = jnp.zeros((n, kprime), jnp.int32)
+    for p in range(p_cnt):
+        slot = jnp.where(elig[:, p] & (cum == k_tie), p, slot)
+        cum += elig[:, p].astype(jnp.int32)
+    return slot, best
 
 
 def sync_round(
@@ -100,48 +166,155 @@ def sync_round(
     book: Bookkeeping,
     log: ChangeLog,
     table: TableState,
+    hlc: jnp.ndarray,  # (N,) node clocks — exchanged on every contact
+    last_cleared: jnp.ndarray,  # (N,) last-applied EmptySet ts (monotone)
+    cleared_hlc: jnp.ndarray,  # (A,) ts of each actor's latest clearing
     key: jax.Array,
     alive: jnp.ndarray,
     view_alive: jnp.ndarray,
     reachable: jnp.ndarray,
+    rtt: jnp.ndarray | None = None,
 ):
-    """One anti-entropy sweep. Returns (book, table, metrics dict)."""
+    """One anti-entropy sweep (multi-peer).
+
+    Returns (book, table, hlc, last_cleared, metrics).
+
+    Each admitted peer slot carries a FULL per-connection budget
+    (``sync_actor_topk`` actors × ``sync_cap_per_actor`` versions), so a
+    node with P granted peers repairs up to P× per sweep — the parallel
+    bandwidth of ``parallel_sync``. The request schedule is one joint
+    top-K' + a per-slot budget rank; gather and merge run as a single
+    pass over the combined lanes."""
     n, a = book.head.shape
-    k_peer, _ = jax.random.split(key)
+    k_peer, k_phase = jax.random.split(key)
     peer, granted = choose_sync_peers(cfg, book, key=k_peer, alive=alive,
-                                      view_alive=view_alive, reachable=reachable)
+                                      view_alive=view_alive,
+                                      reachable=reachable, rtt=rtt)
+    p_cnt = peer.shape[1]
 
-    # Exact per-actor needs vs the chosen peer (their haves minus ours —
-    # compute_available_needs, sync.rs:127-249 — on the head matrix).
-    delta = jnp.maximum(book.head[peer] - book.head, 0)  # (N, A)
-    delta = jnp.where(granted[:, None], delta, 0)
+    # Clock exchange, both directions (SyncMessage::Clock is sent by client
+    # AND server on every sync contact, api/peer.rs:1074-1126,1502-1521):
+    # client merges each granted server's clock; the server merges the
+    # client's. The +tick happens in sim_step's end-of-round HLC update.
+    client_merge = hlc
+    for p in range(p_cnt):
+        client_merge = jnp.maximum(
+            client_merge, jnp.where(granted[:, p], hlc[peer[:, p]], -1)
+        )
+    flat_ok = granted.reshape(-1)
+    hlc = client_merge.at[
+        jnp.where(flat_ok, peer.reshape(-1), n)
+    ].max(
+        jnp.broadcast_to(hlc[:, None], peer.shape).reshape(-1), mode="drop"
+    )
 
-    k = min(cfg.sync_actor_topk, a)
-    topv, topa = jax.lax.top_k(delta, k)  # (N, K) values + actor ids
-    take = jnp.minimum(topv, cfg.sync_cap_per_actor)  # versions per actor
-
-    # Build flat gather lanes: (N, K, cap) → versions head+1 … head+take.
+    kp = min(cfg.sync_actor_topk, a)
+    req = cfg.sync_req_actors or 2 * kp
+    kprime = min(req, kp * p_cnt, a)
     cap = cfg.sync_cap_per_actor
-    base = book.head[jnp.arange(n)[:, None], topa]  # (N, K)
+    bpv = cfg.chunks_per_version
+    vwin = WINDOW_BITS // bpv
+    group_mask = jnp.uint32((1 << bpv) - 1)
+    rows = jnp.arange(n, dtype=jnp.int32)
+    s = log.seqs
     offs = jnp.arange(1, cap + 1, dtype=jnp.int32)  # (cap,)
-    ver = base[:, :, None] + offs[None, None, :]  # (N, K, cap)
+
+    # Request schedule, built WITHOUT any (N, A)-sized gather — peer-head
+    # row gathers (P·N·A elements) dominated the sweep at 10k nodes:
+    #
+    # 1. Each node selects up to K' actors it still needs (its own
+    #    bookkeeping vs the written heads — the needs side of
+    #    compute_available_needs, sync.rs:127-249) by scanning the actor
+    #    axis from a random per-sweep phase and keeping the first K'
+    #    positives. Rotated round-robin is what the reference's shuffled
+    #    request scheduler does anyway (chunked needs are SHUFFLED and
+    #    dealt round-robin, peer.rs:1241-1372 — not served largest-first).
+    #    cumsum + one scatter, all linear in N·A, zero gathers.
+    phase = jax.random.randint(k_phase, (), 0, a, dtype=jnp.int32)
+    my_need = jnp.maximum(log.head[None, :] - book.head, 0)  # (N, A)
+    rolled = jnp.roll(my_need, -phase, axis=1)
+    pos = rolled > 0
+    prank = jnp.cumsum(pos.astype(jnp.int32), axis=1) - 1  # (N, A)
+    sel = pos & (prank < kprime)
+    actor_ids = (jnp.arange(a, dtype=jnp.int32) + phase) % a  # (A,)
+    dest = jnp.where(sel, prank, kprime)  # OOB-drop for unselected
+    # ONE (N, A)-update scatter (they cost ~0.5 s each at 10k): pack
+    # actor id + validity as id+1, 0 = unfilled slot. Unfilled slots MUST
+    # be masked or they all alias actor 0 and serve its range many times
+    # over (inflating sync_versions up to kp×).
+    packed = jnp.zeros((n, kprime), jnp.int32).at[
+        rows[:, None], dest
+    ].set(jnp.broadcast_to(actor_ids[None, :] + 1, (n, a)), mode="drop")
+    lane_ok = packed > 0
+    topa = jnp.maximum(packed - 1, 0)
+
+    # 2. Peer availability for ONLY the selected lanes: what each granted
+    #    peer can actually serve of each requested actor (their haves
+    #    minus ours) — an (N, P, K') gather, thousands of times smaller
+    #    than the full head-plane exchange.
+    my_head = book.head[rows[:, None], topa]  # (N, K')
+    ph = book.head[peer[:, :, None], topa[:, None, :]]  # (N, P, K')
+    delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
+    delta_p = jnp.where(granted[:, :, None], delta_p, 0)
+
+    # 3. One serving slot per lane (global range dedupe, with round-robin
+    #    tie-breaking across equally-capable peers). Dead lanes (unfilled,
+    #    or no granted peer can serve them) get the sentinel slot p_cnt so
+    #    they sort into their own budget group — defaulting them to slot 0
+    #    would consume that connection's kp budget and crowd out lanes the
+    #    slot-0 peer could actually serve.
+    slot, topv = choose_serving_slots(delta_p, topa, phase)
+    slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
+
+    # rank of each lane within its slot group (lanes are in rotated scan
+    # order; the budget keeps the first kp per slot — round-robin service)
+    order = jnp.argsort(slot, axis=1, stable=True)
+    s_sorted = jnp.take_along_axis(slot, order, 1)
+    idx = jnp.broadcast_to(
+        jnp.arange(kprime, dtype=jnp.int32)[None, :], (n, kprime)
+    )
+    newgrp = jnp.concatenate(
+        [jnp.ones((n, 1), bool), s_sorted[:, 1:] != s_sorted[:, :-1]], axis=1
+    )
+    grp_start = jax.lax.cummax(jnp.where(newgrp, idx, 0), axis=1)
+    rank_in_slot = jnp.zeros((n, kprime), jnp.int32).at[
+        rows[:, None], order
+    ].set(idx - grp_start)
+    within_budget = rank_in_slot < kp
+
+    # adaptive chunk sizing (peer.rs:345-349): the reference halves its
+    # send buffer 8 KiB → ≥1 KiB as a link slows; here a slow (high
+    # measured-RTT) connection serves halved per-actor caps, floored at 1
+    # — same 8× dynamic range. Unobserved (255) starts at the full buffer,
+    # like the reference before any slow send is seen.
+    if rtt is not None:
+        raw = rtt[rows[:, None], peer].astype(jnp.int32)  # (N, P)
+        delay = jnp.where(raw == 255, 1, jnp.minimum(raw, 4))
+        cap_slot = jnp.maximum(cap >> jnp.maximum(delay - 1, 0), 1)
+        cap_lane = cap_slot[rows[:, None], slot]  # (N, K')
+    else:
+        cap_lane = cap
+    take = jnp.where(
+        lane_ok & within_budget, jnp.minimum(topv, cap_lane), 0
+    )
+
+    # Flat gather lanes: (N, K', cap) → versions head+1 … head+take.
+    base = book.head[rows[:, None], topa]  # (N, K')
+    ver = base[:, :, None] + offs[None, None, :]  # (N, K', cap)
     lane_valid = offs[None, None, :] <= take[:, :, None]
 
     actor_l = jnp.broadcast_to(topa[:, :, None], ver.shape).reshape(-1)
     ver_l = ver.reshape(-1)
     valid_l = lane_valid.reshape(-1)
-    dst_l = jnp.broadcast_to(
-        jnp.arange(n, dtype=jnp.int32)[:, None, None], ver.shape
-    ).reshape(-1)
+    dst_l = jnp.broadcast_to(rows[:, None, None], ver.shape).reshape(-1)
 
     row, col, vr, cv, cl, ncells = gather_changesets(
         log, jnp.where(valid_l, actor_l, 0), jnp.maximum(ver_l, 1)
     )
-    s = log.seqs
     m = dst_l.shape[0]
-    # Cleared versions are served as empties: bookkeeping fast-forwards but
-    # no rows transfer (handle_need cleared → SyncMessage Empty/EmptySet,
-    # api/peer.rs:716-758).
+    # Cleared versions are served as empties: bookkeeping fast-forwards
+    # but no rows transfer (handle_need cleared → SyncMessage
+    # Empty/EmptySet, api/peer.rs:716-758).
     cleared_l = log.cleared[
         jnp.where(valid_l, actor_l, 0),
         (jnp.maximum(ver_l, 1) - 1) % log.capacity,
@@ -167,29 +340,32 @@ def sync_round(
         cell_live.reshape(-1),
     )
 
-    # Raise heads: floor[i, topa] = head + take, absorb window bits above.
-    floor = book.head.at[
-        jnp.arange(n, dtype=jnp.int32)[:, None], topa
-    ].max(base + take)
+    # Raise heads: floor[i, topa] = head + take (max-combine; slots serve
+    # disjoint actors, so duplicate topa entries only occur at take == 0).
+    floor = book.head.at[rows[:, None], topa].max(base + take)
 
     # Newly-applied count: versions in head+1..head+take that were already
-    # seq-complete in the window arrived earlier via gossip and were counted
-    # then — don't count the re-transfer again.
-    bpv = cfg.chunks_per_version
-    vwin = WINDOW_BITS // bpv
-    win_g = book.win[jnp.arange(n, dtype=jnp.int32)[:, None], topa]
-    group_mask = jnp.uint32((1 << bpv) - 1)
+    # seq-complete in the window arrived earlier via gossip and were
+    # counted then — don't count the re-transfer again.
+    win_g = book.win[rows[:, None], topa]
     already = jnp.zeros(take.shape, jnp.int32)
     for o in range(min(cap, vwin)):
         g = (win_g >> jnp.uint32(o * bpv)) & group_mask
         already = already + ((g == group_mask) & (o < take)).astype(jnp.int32)
     new_versions = (take - already).sum(dtype=jnp.int32)
+    empties = (valid_l & cleared_l).sum(dtype=jnp.int32)
+
+    # Served empties advance the receiver's last-cleared ts to the
+    # EmptySet's stamp — monotone max, HLC-gated like the gossip path.
+    last_cleared = last_cleared.at[
+        jnp.where(valid_l & cleared_l, dst_l, n)
+    ].max(cleared_hlc[actor_l], mode="drop")
 
     book = advance_heads(book, floor, bpv)
 
     metrics = {
         "sync_pairs": granted.sum(dtype=jnp.int32),
         "sync_versions": new_versions,
-        "sync_empties": (valid_l & cleared_l).sum(dtype=jnp.int32),
+        "sync_empties": empties,
     }
-    return book, table, metrics
+    return book, table, hlc, last_cleared, metrics

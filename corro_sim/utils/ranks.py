@@ -10,7 +10,13 @@ through unchanged.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
+
+# Sorted-pad sentinel: larger than any real rank ((i+1)*GAP < 2^31), so a
+# padded slot can never win the `o[idx] == values` match for a real rank.
+_PAD = np.int32(2**31 - 1)
 
 
 def translate_ranks(values, old, new, xp=np):
@@ -18,14 +24,47 @@ def translate_ranks(values, old, new, xp=np):
 
     ``xp`` is the array namespace (numpy or jax.numpy); `values` may be any
     integer dtype/shape. Elements not present in ``old`` are unchanged.
+
+    The jax path pads the rank tables to a power-of-two bucket and runs a
+    module-level jitted kernel: every remap grows the tables, and unbucketed
+    shapes would force a fresh XLA compile per remap per tensor (~0.6 s each
+    through the TPU tunnel — the dominant cost of a live remap otherwise).
     """
     if len(old) == 0:
         return values
-    o = xp.asarray(old, values.dtype)
-    nw = xp.asarray(new, values.dtype)
-    idx = xp.clip(xp.searchsorted(o, values), 0, len(old) - 1)
-    found = (values >= 0) & (o[idx] == values)
-    return xp.where(found, nw[idx], values)
+    if xp is np:
+        o = np.asarray(old, values.dtype)
+        nw = np.asarray(new, values.dtype)
+        idx = np.clip(np.searchsorted(o, values), 0, len(old) - 1)
+        found = (values >= 0) & (o[idx] == values)
+        return np.where(found, nw[idx], values)
+    n = len(old)
+    # floor of 4096: one compiled kernel serves every universe up to 4k
+    # values (a warmed-up kernel stays warm as the universe grows)
+    bucket = max(4096, 1 << (n - 1).bit_length())
+    o = np.full((bucket,), _PAD, np.int32)
+    o[:n] = old
+    nw = np.full((bucket,), _PAD, np.int32)
+    nw[:n] = new
+    return _translate_jit(values, xp.asarray(o), xp.asarray(nw))
+
+
+@functools.cache
+def _get_translate_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def kernel(values, o, nw):
+        idx = jnp.clip(jnp.searchsorted(o, values), 0, o.shape[0] - 1)
+        found = (values >= 0) & (o[idx] == values)
+        return jnp.where(found, nw[idx], values)
+
+    return kernel
+
+
+def _translate_jit(values, o, nw):
+    return _get_translate_jit()(values, o, nw)
 
 
 def rank_map(old, new) -> dict:

@@ -1,0 +1,167 @@
+"""Randomized equivalence of the hoisted-sort fast paths (ADVICE r1 #1).
+
+The step function hoists ONE lane sort and promises its order to
+``deliver_versions(presorted=True)`` and ``enqueue_broadcasts(grouped=True)``.
+That cross-module contract (sort key here == lane ordering assumed there)
+was unguarded; these tests pin it with randomized checks against the
+self-sorting slow paths, so a future sort-key edit fails loudly instead of
+silently corrupting dedupe or ring allocation."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.core.bookkeeping import Bookkeeping, deliver_versions
+from corro_sim.gossip.broadcast import enqueue_broadcasts, make_gossip_state
+
+
+def _step_sort(n, dst, actor, ver, chunk, valid, cpv):
+    """EXACTLY the step function's hoisted lane sort (engine/step.py)."""
+    big = np.int32(n + 1)
+    sort_dst = np.where(valid, dst, big)
+    if cpv == 1 and (n + 2) * (n + 2) < 2**31:
+        order = np.lexsort((ver, sort_dst * np.int32(n + 2) + actor))
+    else:
+        order = np.lexsort((chunk, ver, actor, sort_dst))
+    return order
+
+
+def _random_lanes(rng, n, m, max_ver, cpv):
+    dst = rng.integers(0, n, m).astype(np.int32)
+    actor = rng.integers(0, n, m).astype(np.int32)
+    ver = rng.integers(1, max_ver, m).astype(np.int32)
+    chunk = rng.integers(0, cpv, m).astype(np.int32)
+    valid = rng.random(m) < 0.7
+    return dst, actor, ver, chunk, valid
+
+
+def test_deliver_versions_presorted_matches_slow_path():
+    rng = np.random.default_rng(0)
+    n = 12
+    for trial in range(8):
+        cpv = [1, 2, 4][trial % 3]
+        book = Bookkeeping(
+            head=jnp.asarray(rng.integers(0, 6, (n, n)).astype(np.int32)),
+            win=jnp.zeros((n, n), jnp.uint32),
+        )
+        dst, actor, ver, chunk, valid = _random_lanes(rng, n, 96, 12, cpv)
+        b_slow, fresh_s, comp_s, drop_s = deliver_versions(
+            book, jnp.asarray(dst), jnp.asarray(actor), jnp.asarray(ver),
+            jnp.asarray(valid), chunk=jnp.asarray(chunk),
+            bits_per_version=cpv, presorted=False,
+        )
+        order = _step_sort(n, dst, actor, ver, chunk, valid, cpv)
+        b_fast, fresh_f, comp_f, drop_f = deliver_versions(
+            book, jnp.asarray(dst[order]), jnp.asarray(actor[order]),
+            jnp.asarray(ver[order]), jnp.asarray(valid[order]),
+            chunk=jnp.asarray(chunk[order]), bits_per_version=cpv,
+            presorted=True,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(b_slow.head), np.asarray(b_fast.head),
+            err_msg=f"trial {trial}: heads diverged",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(b_slow.win), np.asarray(b_fast.win)
+        )
+        # masks come back in caller order (slow) vs sorted order (fast):
+        # compare through the permutation
+        for slow, fast, what in (
+            (fresh_s, fresh_f, "fresh"),
+            (comp_s, comp_f, "complete"),
+            (drop_s, drop_f, "dropped"),
+        ):
+            np.testing.assert_array_equal(
+                np.asarray(slow)[order], np.asarray(fast),
+                err_msg=f"trial {trial}: {what} mask diverged",
+            )
+
+
+def test_enqueue_broadcasts_grouped_matches_slow_path():
+    rng = np.random.default_rng(1)
+    n, p = 10, 8
+    for trial in range(8):
+        gossip = make_gossip_state(n, p)
+        # pre-rotate cursors so slot arithmetic is exercised
+        gossip = gossip.replace(
+            cursor=jnp.asarray(rng.integers(0, p, n).astype(np.int32))
+        )
+        m = 48
+        dst = rng.integers(0, n, m).astype(np.int32)
+        actor = rng.integers(0, n, m).astype(np.int32)
+        ver = rng.integers(1, 9, m).astype(np.int32)
+        chunk = rng.integers(0, 2, m).astype(np.int32)
+        valid = rng.random(m) < 0.6
+        # cap per-dst appends at P: the grouped path's overflow handling
+        # (phase-rotated keep window) intentionally differs
+        for d in range(n):
+            idx = np.nonzero(valid & (dst == d))[0]
+            valid[idx[p:]] = False
+
+        g_slow = enqueue_broadcasts(
+            gossip, jnp.asarray(dst), jnp.asarray(actor), jnp.asarray(ver),
+            jnp.asarray(chunk), jnp.asarray(valid), 4, grouped=False,
+        )
+        order = _step_sort(n, dst, actor, ver, chunk, valid, cpv=2)
+        g_fast = enqueue_broadcasts(
+            gossip, jnp.asarray(dst[order]), jnp.asarray(actor[order]),
+            jnp.asarray(ver[order]), jnp.asarray(chunk[order]),
+            jnp.asarray(valid[order]), 4, grouped=True,
+        )
+        # The ring is an unordered pool (broadcast_step treats slots
+        # uniformly): within-node slot ORDER may differ between the two
+        # paths (caller order vs step-sort order), the slot MULTISET,
+        # cursor and overflow count must not.
+        np.testing.assert_array_equal(
+            np.asarray(g_slow.cursor), np.asarray(g_fast.cursor),
+            err_msg=f"trial {trial}: cursor diverged",
+        )
+        assert int(g_slow.overflow) == int(g_fast.overflow), (
+            f"trial {trial}: overflow diverged"
+        )
+        for node in range(n):
+            def slots(g):
+                tx = np.asarray(g.pend_tx[node])
+                live = tx > 0
+                return sorted(zip(
+                    np.asarray(g.pend_actor[node])[live],
+                    np.asarray(g.pend_ver[node])[live],
+                    np.asarray(g.pend_chunk[node])[live],
+                    tx[live],
+                ))
+            assert slots(g_slow) == slots(g_fast), (
+                f"trial {trial}: node {node} ring multiset diverged"
+            )
+
+
+def test_enqueue_grouped_overflow_conserves_slots():
+    """Past ring capacity the two paths pick different victims by design
+    (grouped rotates its keep window); both must still fill exactly P slots
+    and count the same number of overflow drops."""
+    rng = np.random.default_rng(2)
+    n, p = 4, 3
+    gossip = make_gossip_state(n, p)
+    m = 40
+    dst = rng.integers(0, n, m).astype(np.int32)
+    actor = rng.integers(0, n, m).astype(np.int32)
+    ver = rng.integers(1, 9, m).astype(np.int32)
+    chunk = np.zeros(m, np.int32)
+    valid = np.ones(m, bool)
+
+    g_slow = enqueue_broadcasts(
+        gossip, jnp.asarray(dst), jnp.asarray(actor), jnp.asarray(ver),
+        jnp.asarray(chunk), jnp.asarray(valid), 4, grouped=False,
+    )
+    order = _step_sort(n, dst, actor, ver, chunk, valid, cpv=1)
+    g_fast = enqueue_broadcasts(
+        gossip, jnp.asarray(dst[order]), jnp.asarray(actor[order]),
+        jnp.asarray(ver[order]), jnp.asarray(chunk[order]),
+        jnp.asarray(valid[order]), 4, grouped=True,
+    )
+    np.testing.assert_array_equal(
+        (np.asarray(g_slow.pend_tx) > 0).sum(axis=1),
+        (np.asarray(g_fast.pend_tx) > 0).sum(axis=1),
+    )
+    assert int(g_slow.overflow) == int(g_fast.overflow)
+    np.testing.assert_array_equal(
+        np.asarray(g_slow.cursor), np.asarray(g_fast.cursor)
+    )

@@ -276,6 +276,40 @@ def test_in_tx_planned_counts(client):
     assert rows == [["post"]]
 
 
+def test_in_tx_read_your_writes(server, client):
+    """Reads inside an open tx see the tx's own buffered writes (the
+    reference's single-SQLite-tx visibility); other connections don't."""
+    client.query("BEGIN")
+    client.query("INSERT INTO users (id, name) VALUES (80, 'mine')")
+    _, rows, _, errors = client.query(
+        "SELECT name FROM users WHERE id = 80")
+    assert not errors and rows == [["mine"]]
+    # an UPDATE later in the same tx counts the tx-inserted row
+    _, _, tags, errors = client.query(
+        "UPDATE users SET name = 'mine2' WHERE id = 80")
+    assert not errors and tags == ["UPDATE 1"]
+    _, rows, _, _ = client.query("SELECT name FROM users WHERE id = 80")
+    assert rows == [["mine2"]]
+    # isolation: a second connection sees nothing until COMMIT
+    c2 = SimplePgClient(*server.addr)
+    _, rows, _, _ = c2.query("SELECT name FROM users WHERE id = 80")
+    assert rows == []
+    client.query("COMMIT")
+    _, rows, _, _ = c2.query("SELECT name FROM users WHERE id = 80")
+    assert rows == [["mine2"]]
+    c2.close()
+
+
+def test_in_tx_rollback_discards_overlay(client):
+    client.query("BEGIN")
+    client.query("INSERT INTO users (id, name) VALUES (81, 'phantom')")
+    _, rows, _, _ = client.query("SELECT id FROM users WHERE id = 81")
+    assert rows == [[81]]
+    client.query("ROLLBACK")
+    _, rows, _, _ = client.query("SELECT id FROM users WHERE id = 81")
+    assert rows == []
+
+
 def test_select_star_describe_matches_row_order(server):
     """pk-last-in-declaration schema: Describe and DataRow must agree
     (the matcher emits pk row-key columns first)."""

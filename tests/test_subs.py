@@ -45,7 +45,8 @@ def test_parse_rejects_garbage():
         "SELECT a FROM",
         "SELECT a FROM t WHERE",
         "SELECT a FROM t WHERE a ==",
-        "SELECT a FROM t extra",
+        # ("FROM t extra" now parses: `extra` is a table alias, real SQL)
+        "SELECT a FROM t extra stuff",
         "DELETE FROM t",
     ):
         with pytest.raises(QueryError):
