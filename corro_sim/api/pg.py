@@ -561,6 +561,9 @@ class _Session:
         except KeyError as e:
             raise PgError("undefined_table",
                           f"relation {e} does not exist") from None
+        if select.aggregates:
+            # post-processed output: cols are already the final labels
+            return self._agg_fields(select), rows
         if select.columns:
             # the matcher prepends pk row-key columns (like the reference's
             # injected __corro_pk_* aliases); a pg client gets exactly its
@@ -573,6 +576,29 @@ class _Session:
             rows = [[r[i] for i in idx] for r in rows]
             cols = want
         return self._fields_for_select(select, cols), rows
+
+    def _agg_fields(self, select) -> list:
+        """Result fields for an aggregate query, by SQLite type rules:
+        COUNT → int8, AVG → float8, SUM/MIN/MAX and group columns → the
+        argument column's affinity."""
+        t = self.cluster.layout.schema.tables.get(select.table)
+        by_name = {c.name: c for c in t.columns} if t else {}
+
+        def col_oid(name):
+            c = by_name.get(name)
+            return _affinity_oid(c.type) if c else OID_TEXT
+
+        fields = []
+        for kind, item in select.items:
+            if kind == "col":
+                fields.append((item, col_oid(item)))
+            elif item.fn == "COUNT":
+                fields.append((item.label(), OID_INT8))
+            elif item.fn == "AVG":
+                fields.append((item.label(), OID_FLOAT8))
+            else:  # SUM / MIN / MAX
+                fields.append((item.label(), col_oid(item.col)))
+        return fields
 
     def _ov_key(self, n_planned: int):
         cl = self.cluster
@@ -829,6 +855,8 @@ class _Session:
             if t is None:
                 raise PgError("undefined_table",
                               f'relation "{select.table}" does not exist')
+            if select.aggregates:
+                return self._agg_fields(select)
             if select.columns:
                 cols = list(select.columns)
             else:

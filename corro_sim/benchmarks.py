@@ -53,6 +53,9 @@ def run_headline_bench(
         sync_interval=8,
         sync_actor_topk=32,
         sync_cap_per_actor=8,
+        sync_req_actors=32,  # throughput scenario: lean request lanes +
+        sync_need_sample=64,  # cheap candidate scoring keep the sweep off
+        # the hot path (its job here is repair, not bulk catch-up)
     )
     state = init_state(cfg, seed=0)
     runner = _chunk_runner(cfg)

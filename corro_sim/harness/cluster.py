@@ -51,7 +51,7 @@ from corro_sim.subs.manager import (
     SubsManager,
     make_matcher,
 )
-from corro_sim.subs.query import QueryError, parse_query
+from corro_sim.subs.query import QueryError, parse_query, post_process
 from corro_sim.utils.ranks import rank_map, translate_ranks
 from corro_sim.utils.runtime import LockRegistry, Tripwire
 
@@ -508,12 +508,17 @@ class LiveCluster:
         self._check_node(node)
         with self.locks.tracked(self._lock, f"query node={node}", "read"):
             select = parse_query(sql)
-            m = self._matcher_for(select, node)
+            # matcher evaluates the match+project core; GROUP BY /
+            # aggregates / ORDER BY / LIMIT post-process host-side
+            m = self._matcher_for(select.base(), node)
             table = (
                 self.state.table if overlay is None
                 else self._overlaid_table(node, overlay)
             )
-            return m.prime(table)
+            events = m.prime(table)
+            if select.has_extras():
+                events = post_process(select, events)
+            return events
 
     def query_rows(
         self, sql: str, node: int = 0, overlay=None

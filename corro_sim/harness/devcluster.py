@@ -124,16 +124,36 @@ def build_cluster(
     )
     cluster.set_partition([comp[n] for n in names])
     if state_dir:
+        from corro_sim.membership.bootstrap import generate_bootstrap
+
+        # every named node gets a deterministic gossip address, and each
+        # node's bootstrap edges resolve through the same pipeline a real
+        # agent uses (generate_bootstrap: parse → resolve → ≤10), exactly
+        # how the reference devcluster writes per-node config bootstrap
+        # lists (corro-devcluster/src/main.rs:104-216)
+        gossip_addr = {
+            n: ("127.0.0.1", 9000 + ordinal[n]) for n in names
+        }
+
+        def name_resolver(host, port, dns):
+            return [gossip_addr[host]] if host in gossip_addr else []
+
         for name in names:
             node_state = os.path.join(state_dir, name)
             os.makedirs(node_state, exist_ok=True)
+            boots = generate_bootstrap(
+                [f"{b}:{gossip_addr[b][1]}" for b in adj.get(name, [])],
+                resolve=name_resolver,
+            )
             with open(os.path.join(node_state, "node.json"), "w") as f:
                 json.dump(
                     {
                         "name": name,
                         "node": ordinal[name],
                         "component": comp[name],
+                        "gossip_addr": list(gossip_addr[name]),
                         "bootstrap": adj.get(name, []),
+                        "bootstrap_addrs": [list(a) for a in boots],
                     },
                     f,
                     indent=2,

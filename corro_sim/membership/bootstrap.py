@@ -1,0 +1,126 @@
+"""Bootstrap address resolution — ``agent/bootstrap.rs:14-150`` analog.
+
+The reference's ``generate_bootstrap``:
+
+1. parses each configured bootstrap string — ``host:port`` or
+   ``host:port@dns_server`` (resolve through that specific DNS server);
+2. literal IPs pass straight through; names resolve via trust-dns;
+3. if NOTHING resolved, falls back to 5 random rows of the persisted
+   ``__corro_members`` table (peers seen in a previous life);
+4. returns at most 10 distinct addresses.
+
+The simulator keeps the same contract for its deployment tooling: the
+devcluster harness writes per-node bootstrap lists, and a warm-booted
+agent falls back to the member addresses recorded in its checkpoint.
+Name resolution uses the host resolver (``socket.getaddrinfo``); a
+``@dns_server`` suffix is parsed and carried but custom-server lookups
+degrade to the host resolver (no raw-DNS client in a zero-egress image —
+the entry still validates and the server string is surfaced to the
+caller for diagnostics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import ipaddress
+import random
+import socket
+
+BOOTSTRAP_LIMIT = 10  # reference: choose at most 10 (bootstrap.rs:139-148)
+MEMBER_FALLBACK = 5  # random member rows when nothing resolves (:96-118)
+
+
+class BootstrapError(ValueError):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BootstrapEntry:
+    host: str
+    port: int
+    dns_server: str | None = None  # "host:port@dns" form
+
+
+def parse_entry(s: str) -> BootstrapEntry:
+    """``host:port`` / ``host:port@dns_server`` / ``[v6]:port`` forms."""
+    s = s.strip()
+    if not s:
+        raise BootstrapError("empty bootstrap entry")
+    addr, _, dns = s.partition("@")
+    dns_server = dns.strip() or None
+    addr = addr.strip()
+    if addr.startswith("["):  # [v6]:port
+        host, bracket, rest = addr[1:].partition("]")
+        if not bracket or not rest.startswith(":"):
+            raise BootstrapError(f"malformed bootstrap address {addr!r}")
+        port_s = rest[1:]
+    else:
+        host, colon, port_s = addr.rpartition(":")
+        if not colon:
+            raise BootstrapError(
+                f"bootstrap entry {addr!r} needs a port (host:port)"
+            )
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise BootstrapError(f"bad port in bootstrap entry {addr!r}") from None
+    if not (0 < port < 65536):
+        raise BootstrapError(f"port {port} out of range in {addr!r}")
+    if not host:
+        raise BootstrapError(f"empty host in bootstrap entry {addr!r}")
+    return BootstrapEntry(host=host, port=port, dns_server=dns_server)
+
+
+def _default_resolve(host: str, port: int, dns_server: str | None):
+    """Name → addresses via the host resolver (trust-dns stand-in)."""
+    try:
+        infos = socket.getaddrinfo(host, port, type=socket.SOCK_DGRAM)
+    except socket.gaierror:
+        return []
+    return [(info[4][0], port) for info in infos]
+
+
+def generate_bootstrap(
+    entries,
+    member_addrs=(),
+    limit: int = BOOTSTRAP_LIMIT,
+    fallback_n: int = MEMBER_FALLBACK,
+    resolve=_default_resolve,
+    rng: random.Random | None = None,
+):
+    """Resolve bootstrap strings to at most ``limit`` distinct addresses.
+
+    ``entries``: strings or :class:`BootstrapEntry`; ``member_addrs``:
+    (host, port) pairs from persisted membership (``__corro_members``),
+    used as the fallback pool when nothing resolves. Returns a list of
+    (host, port) tuples, first-seen order, deduplicated.
+    """
+    rng = rng or random.Random()
+    out: list = []
+    seen = set()
+
+    def add(pair):
+        if pair not in seen:
+            seen.add(pair)
+            out.append(pair)
+
+    for e in entries:
+        entry = parse_entry(e) if isinstance(e, str) else e
+        try:
+            ipaddress.ip_address(entry.host)
+            add((entry.host, entry.port))
+            continue
+        except ValueError:
+            pass
+        for pair in resolve(entry.host, entry.port, entry.dns_server):
+            add(pair)
+
+    if not out:
+        # nothing configured or resolvable: fall back to a random sample
+        # of previously-seen members (bootstrap.rs:96-118)
+        pool = list(member_addrs)
+        rng.shuffle(pool)
+        for pair in pool[:fallback_n]:
+            add(tuple(pair))
+
+    return out[:limit]

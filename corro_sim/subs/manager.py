@@ -79,10 +79,12 @@ class SubEvent:
     change_id: int
 
     def as_json(self):
-        # QueryEvent::Change serde shape: [type, rowid, cells, change_id]
+        # QueryEvent::Change serde shape: [type, rowid, cells, change_id];
+        # ChangeType serializes snake_case-lowercase ("insert"/"update"/
+        # "delete") — corro-api-types/src/sqlite.rs:11-17, and the
+        # documented ND-JSON stream (doc/api/subscriptions.md:61-65)
         return {
-            "change": [self.kind.upper(), self.rowid, self.cells,
-                       self.change_id]
+            "change": [self.kind, self.rowid, self.cells, self.change_id]
         }
 
 
@@ -722,6 +724,12 @@ class SubsManager:
         """Returns (matcher, initial_events | None) — None when deduped to
         an existing matcher (subscriber catches up from its buffer)."""
         select = parse_query(sql)
+        if select.has_extras():
+            raise QueryError(
+                "GROUP BY / aggregates / ORDER BY / LIMIT are not "
+                "supported in subscriptions (a diff-engine cannot "
+                "maintain them incrementally); use a one-shot query"
+            )
         key = (select.normalized(), node)
         sub_id = self._by_query.get(key)
         if sub_id is not None:

@@ -104,15 +104,76 @@ class Join:
 
 
 @dataclasses.dataclass(frozen=True)
+class Agg:
+    """Aggregate select item: ``fn(col)`` or ``COUNT(*)`` (col=None)."""
+
+    fn: str  # COUNT | SUM | AVG | MIN | MAX
+    col: str | None
+
+    def label(self) -> str:
+        return f"{self.fn.lower()}({self.col if self.col else '*'})"
+
+
+@dataclasses.dataclass(frozen=True)
 class Select:
     table: str
-    columns: tuple  # () = *
+    columns: tuple  # () = * (plain selected column names)
     where: object  # predicate AST or None
     alias: str | None = None  # left-table alias (join queries)
     join: Join | None = None
+    items: tuple = ()  # SELECT-list order: ('col', name) | ('agg', Agg)
+    group_by: tuple = ()  # column names
+    order_by: tuple = ()  # ((name, descending: bool), ...)
+    limit: int | None = None
+    offset: int = 0
+
+    def has_extras(self) -> bool:
+        """Anything beyond the matcher's match+project core — evaluated by
+        :func:`post_process` on the query path, rejected for live
+        subscriptions (a diff-engine has no incremental GROUP BY)."""
+        return bool(
+            self.aggregates or self.group_by or self.order_by
+            or self.limit is not None or self.offset
+        )
+
+    @property
+    def aggregates(self) -> tuple:
+        return tuple(a for k, a in self.items if k == "agg")
+
+    def base(self) -> "Select":
+        """The matcher-facing core: plain columns + every column the
+        aggregates/grouping/ordering need, no post-processing clauses."""
+        if not self.has_extras():
+            return self
+        if not self.columns and not self.aggregates:
+            cols = ()  # SELECT *: everything (order keys included) is there
+        else:
+            need = list(self.columns)
+            for c in (
+                *self.group_by,
+                *(a.col for a in self.aggregates if a.col is not None),
+                *(c for c, _ in self.order_by),
+            ):
+                if c not in need:
+                    need.append(c)
+            cols = tuple(need)
+        return Select(
+            table=self.table,
+            columns=cols,
+            where=self.where,
+            alias=self.alias,
+            join=self.join,
+        )
 
     def normalized(self) -> str:
-        cols = ", ".join(self.columns) if self.columns else "*"
+        if self.items:
+            parts = [
+                (name if kind == "col" else name.label())
+                for kind, name in self.items
+            ]
+            cols = ", ".join(parts)
+        else:
+            cols = ", ".join(self.columns) if self.columns else "*"
         sql = f"SELECT {cols} FROM {self.table}"
         if self.alias is not None and self.alias != self.table:
             sql += f" AS {self.alias}"
@@ -125,6 +186,16 @@ class Select:
             sql += f" ON {j.on_left} = {j.on_right}"
         if self.where is not None:
             sql += f" WHERE {_render(self.where)}"
+        if self.group_by:
+            sql += " GROUP BY " + ", ".join(self.group_by)
+        if self.order_by:
+            sql += " ORDER BY " + ", ".join(
+                f"{c} DESC" if d else c for c, d in self.order_by
+            )
+        if self.limit is not None:
+            sql += f" LIMIT {self.limit}"
+        if self.offset:
+            sql += f" OFFSET {self.offset}"
         return sql
 
     def referenced_columns(self) -> frozenset:
@@ -217,6 +288,7 @@ def _tokenize(sql: str):
             if kw in (
                 "SELECT", "FROM", "WHERE", "AND", "OR", "NOT", "IS", "NULL",
                 "JOIN", "INNER", "LEFT", "OUTER", "ON", "AS",
+                "GROUP", "ORDER", "BY", "ASC", "DESC", "LIMIT", "OFFSET",
             ):
                 out.append((kw, kw))
             elif kw == "TRUE":  # SQLite boolean keywords are 1/0 literals
@@ -264,16 +336,34 @@ class _Parser:
             return self.expect("ident")
         return table
 
+    _AGG_FNS = ("COUNT", "SUM", "AVG", "MIN", "MAX")
+
+    def _select_item(self):
+        name = self.qual_ident()
+        if name.upper() in self._AGG_FNS and self.peek()[0] == "(":
+            self.next()
+            if self.peek()[0] == "*":
+                self.next()
+                col = None
+                if name.upper() != "COUNT":
+                    raise QueryError(f"{name}(*) is not valid SQL")
+            else:
+                col = self.qual_ident()
+            self.expect(")")
+            return ("agg", Agg(fn=name.upper(), col=col))
+        return ("col", name)
+
     def parse_select(self) -> Select:
         self.expect("SELECT")
-        cols = []
+        items = []
         if self.peek()[0] == "*":
             self.next()
         else:
-            cols.append(self.qual_ident())
+            items.append(self._select_item())
             while self.peek()[0] == ",":
                 self.next()
-                cols.append(self.qual_ident())
+                items.append(self._select_item())
+        cols = [n for k, n in items if k == "col"]
         self.expect("FROM")
         table = self.expect("ident")
         alias = self._opt_alias(table)
@@ -312,12 +402,70 @@ class _Parser:
         if self.peek()[0] == "WHERE":
             self.next()
             where = self.parse_or()
+        group_by: list = []
+        if self.peek()[0] == "GROUP":
+            self.next()
+            self.expect("BY")
+            group_by.append(self.qual_ident())
+            while self.peek()[0] == ",":
+                self.next()
+                group_by.append(self.qual_ident())
+        order_by: list = []
+        if self.peek()[0] == "ORDER":
+            self.next()
+            self.expect("BY")
+            while True:
+                c = self.qual_ident()
+                desc = False
+                if self.peek()[0] in ("ASC", "DESC"):
+                    desc = self.next()[0] == "DESC"
+                order_by.append((c, desc))
+                if self.peek()[0] != ",":
+                    break
+                self.next()
+        limit = None
+        offset = 0
+        if self.peek()[0] == "LIMIT":
+            self.next()
+            k, v = self.next()
+            if k != "lit" or not isinstance(v, int) or v < 0:
+                raise QueryError("LIMIT takes a non-negative integer")
+            limit = v
+            if self.peek()[0] == "OFFSET":
+                self.next()
+                k, v = self.next()
+                if k != "lit" or not isinstance(v, int) or v < 0:
+                    raise QueryError("OFFSET takes a non-negative integer")
+                offset = v
         if self.peek()[0] != "eof":
             raise QueryError(f"trailing tokens at {self.peek()!r}")
+
+        aggs = [a for k, a in items if k == "agg"]
+        if group_by and not aggs:
+            raise QueryError("GROUP BY requires an aggregate in the "
+                             "SELECT list")
+        if aggs:
+            stray = [c for c in cols if c not in group_by]
+            if stray:
+                raise QueryError(
+                    f"column(s) {stray} must appear in GROUP BY when "
+                    "aggregates are selected"
+                )
+            stray = [c for c, _ in order_by if c not in group_by]
+            if stray:
+                raise QueryError(
+                    f"ORDER BY column(s) {stray} must appear in GROUP BY "
+                    "in an aggregate query"
+                )
         return Select(
             table=table, columns=tuple(cols), where=where,
             alias=(alias if (alias != table or join is not None) else None),
             join=join,
+            items=tuple(items),
+            group_by=tuple(group_by),
+            order_by=tuple(order_by),
+            limit=limit,
+            offset=offset,
         )
 
     def parse_or(self):
@@ -399,6 +547,128 @@ class _Parser:
 
 def parse_query(sql: str) -> Select:
     return _Parser(_tokenize(sql)).parse_select()
+
+
+_NUM_PREFIX = re.compile(r"^\s*[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
+
+
+def _sql_number(v):
+    """SQLite numeric coercion for SUM/AVG: numbers pass through, text and
+    blobs contribute their leading numeric prefix (else 0) — ``SUM(name)``
+    over TEXT is 0, not a type error."""
+    if isinstance(v, bool):
+        return int(v)
+    if isinstance(v, (int, float)):
+        return v
+    if isinstance(v, (bytes, bytearray)):
+        v = bytes(v).decode("utf-8", "replace")
+    m = _NUM_PREFIX.match(v) if isinstance(v, str) else None
+    if not m:
+        return 0
+    s = m.group(0)
+    try:
+        return int(s)
+    except ValueError:
+        return float(s)
+
+
+def post_process(select: Select, events: list) -> list:
+    """Apply GROUP BY / aggregates / ORDER BY / LIMIT to a matcher's
+    one-shot query events (host-side — the reference gets these for free
+    from SQLite; a diff-engine can't maintain them incrementally, so
+    subscriptions reject them and the query path evaluates them here).
+
+    SQLite semantics: grouping compares values with SQL equality (1 and
+    1.0 share a group, NULLs group together); SUM/AVG/MIN/MAX of an empty
+    or all-NULL set are NULL; COUNT never is; ORDER BY sorts NULLs first
+    ascending; without ORDER BY, groups keep first-seen order.
+    """
+    header = next(e["columns"] for e in events if "columns" in e)
+    rows = [e["row"][1] for e in events if "row" in e]
+    rowids = [e["row"][0] for e in events if "row" in e]
+    eoq = [e for e in events if "eoq" in e]
+
+    def pos(name):
+        try:
+            return header.index(name)
+        except ValueError:
+            raise QueryError(f"no such column {name!r}") from None
+
+    if select.aggregates:
+        gpos = [pos(c) for c in select.group_by]
+        groups: dict = {}
+        for r in rows:
+            key = tuple(sqlite_sort_key(r[i]) for i in gpos)
+            groups.setdefault(key, []).append(r)
+        if not select.group_by and not groups:
+            groups[()] = []  # aggregates over an empty table yield one row
+
+        def agg_value(a: Agg, grp: list):
+            if a.col is None:  # COUNT(*)
+                return len(grp)
+            vals = [r[pos(a.col)] for r in grp]
+            vals = [v for v in vals if v is not None]
+            if a.fn == "COUNT":
+                return len(vals)
+            if not vals:
+                return None
+            if a.fn == "SUM":
+                nums = [_sql_number(v) for v in vals]
+                total = sum(nums)
+                # SQLite SUM: integer iff every addend was integral
+                return total if any(
+                    isinstance(x, float) for x in nums
+                ) else int(total)
+            if a.fn == "AVG":
+                return sum(_sql_number(v) for v in vals) / len(vals)
+            key = sqlite_sort_key
+            return min(vals, key=key) if a.fn == "MIN" else max(vals, key=key)
+
+        out_cols = [
+            (n if k == "col" else n.label()) for k, n in select.items
+        ]
+        out_rows = []
+        for grp in groups.values():
+            cells = []
+            for k, item in select.items:
+                if k == "col":
+                    cells.append(grp[0][pos(item)] if grp else None)
+                else:
+                    cells.append(agg_value(item, grp))
+            out_rows.append(cells)
+        order_pos = {c: out_cols.index(c) for c, _ in select.order_by}
+        rows, header = out_rows, out_cols
+        rowids = list(range(len(rows)))
+
+        def sort_key_of(c):
+            i = order_pos[c]
+            return lambda rc: sqlite_sort_key(rc[0][i])
+    else:
+        def sort_key_of(c):
+            i = pos(c)
+            return lambda rc: sqlite_sort_key(rc[0][i])
+
+    paired = list(zip(rows, rowids))
+    for c, desc in reversed(select.order_by):  # stable multi-key sort
+        paired.sort(key=sort_key_of(c), reverse=desc)
+    if select.offset or select.limit is not None:
+        end = None if select.limit is None else select.offset + select.limit
+        paired = paired[select.offset:end]
+
+    # helper columns base() added for ORDER BY must not leak into the
+    # result: project back to the pk prefix + the requested columns
+    if not select.aggregates and select.columns:
+        drop = {c for c, _ in select.order_by} - set(select.columns)
+        if drop:
+            keep = [i for i, c in enumerate(header) if c not in drop]
+            header = [header[i] for i in keep]
+            paired = [([cells[i] for i in keep], rid)
+                      for cells, rid in paired]
+
+    out = [{"columns": header}]
+    out.extend({"row": [rid, cells]} for cells, rid in paired)
+    out.extend(eoq)
+    return out
 
 
 def rewrite_columns(p, fn):

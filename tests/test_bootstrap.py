@@ -1,0 +1,73 @@
+"""Bootstrap address resolution (agent/bootstrap.rs:14-150 analog):
+host:port[@dns] parsing, literal-IP passthrough, name resolution,
+member-table fallback, ≤10 choice, dedupe."""
+
+import random
+
+import pytest
+
+from corro_sim.membership.bootstrap import (
+    BootstrapError,
+    generate_bootstrap,
+    parse_entry,
+)
+
+
+def test_parse_forms():
+    e = parse_entry("10.0.0.1:8787")
+    assert (e.host, e.port, e.dns_server) == ("10.0.0.1", 8787, None)
+    e = parse_entry("gossip.internal:8787@10.0.0.53:53")
+    assert e.host == "gossip.internal"
+    assert e.dns_server == "10.0.0.53:53"
+    e = parse_entry("[::1]:9000")
+    assert (e.host, e.port) == ("::1", 9000)
+    for bad in ("", "hostonly", "h:notaport", "h:0", "h:99999", ":8787",
+                "[::1]9000"):
+        with pytest.raises(BootstrapError):
+            parse_entry(bad)
+
+
+def test_literal_ips_pass_through_and_dedupe():
+    out = generate_bootstrap(
+        ["10.0.0.1:8787", "10.0.0.2:8787", "10.0.0.1:8787"]
+    )
+    assert out == [("10.0.0.1", 8787), ("10.0.0.2", 8787)]
+
+
+def test_names_resolve():
+    def fake_resolve(host, port, dns):
+        assert host == "seed.cluster" and dns == "1.1.1.1"
+        return [("10.1.0.1", port), ("10.1.0.2", port)]
+
+    out = generate_bootstrap(
+        ["seed.cluster:9000@1.1.1.1"], resolve=fake_resolve
+    )
+    assert out == [("10.1.0.1", 9000), ("10.1.0.2", 9000)]
+
+
+def test_localhost_resolves_via_host_resolver():
+    out = generate_bootstrap(["localhost:8787"])
+    assert ("127.0.0.1", 8787) in out
+
+
+def test_member_table_fallback_samples_five():
+    members = [(f"10.2.0.{i}", 8787) for i in range(20)]
+    out = generate_bootstrap(
+        [], member_addrs=members, rng=random.Random(1)
+    )
+    assert len(out) == 5
+    assert set(out) <= set(members)
+    # unresolvable names also trigger the fallback
+    out2 = generate_bootstrap(
+        ["no-such-host.invalid:1@9.9.9.9"],
+        member_addrs=members,
+        resolve=lambda h, p, d: [],
+        rng=random.Random(2),
+    )
+    assert len(out2) == 5
+
+
+def test_limit_ten():
+    out = generate_bootstrap([f"10.3.0.{i}:8787" for i in range(30)])
+    assert len(out) == 10
+    assert out[0] == ("10.3.0.0", 8787)  # first-seen order preserved

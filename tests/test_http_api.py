@@ -114,7 +114,8 @@ def test_subscription_live_stream(server, client):
         t.join()
         assert "change" in ev
         kind, _rowid, cells, change_id = ev["change"]
-        assert kind == "INSERT"
+        # snake_case-lowercase like the reference's ChangeType serde
+        assert kind == "insert"
         assert cells[0] == 200 and cells[-1] == 150
         assert sub.last_change_id == change_id
     finally:

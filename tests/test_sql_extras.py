@@ -1,0 +1,146 @@
+"""Aggregates, GROUP BY, ORDER BY, LIMIT/OFFSET on the query path.
+
+The reference serves arbitrary SELECTs straight from SQLite; the tensor
+engine's matcher covers match+project, and these clauses post-process
+host-side (``corro_sim/subs/query.py:post_process``). Subscriptions
+reject them (a diff-engine cannot maintain GROUP BY incrementally)."""
+
+import pytest
+
+from corro_sim.harness.cluster import LiveCluster
+from corro_sim.subs.query import QueryError, parse_query
+
+SCHEMA = """
+CREATE TABLE orders (
+    id INTEGER NOT NULL PRIMARY KEY,
+    customer TEXT NOT NULL DEFAULT '',
+    amount INTEGER NOT NULL DEFAULT 0
+);
+"""
+
+
+def _cluster():
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=32)
+    c.execute([
+        "INSERT INTO orders (id, customer, amount) VALUES (1, 'ana', 10)",
+        "INSERT INTO orders (id, customer, amount) VALUES (2, 'bob', 30)",
+        "INSERT INTO orders (id, customer, amount) VALUES (3, 'ana', 20)",
+        "INSERT INTO orders (id, customer, amount) VALUES (4, 'cat', 5)",
+    ])
+    return c
+
+
+def test_parse_and_normalize_extras():
+    s = parse_query(
+        "SELECT customer, COUNT(*), SUM(amount) FROM orders "
+        "GROUP BY customer ORDER BY customer DESC LIMIT 2 OFFSET 1"
+    )
+    assert s.aggregates[0].fn == "COUNT" and s.aggregates[1].col == "amount"
+    assert s.group_by == ("customer",)
+    assert s.order_by == (("customer", True),)
+    assert s.limit == 2 and s.offset == 1
+    assert "GROUP BY customer" in s.normalized()
+    # base() strips extras and carries every needed column
+    b = s.base()
+    assert not b.has_extras()
+    assert set(b.columns) >= {"customer", "amount"}
+    with pytest.raises(QueryError):
+        parse_query("SELECT amount FROM orders GROUP BY customer")
+    with pytest.raises(QueryError):
+        parse_query("SELECT customer, SUM(amount) FROM orders")  # no GROUP BY
+    with pytest.raises(QueryError):
+        parse_query("SELECT SUM(*) FROM orders")
+
+
+def test_order_by_and_limit():
+    c = _cluster()
+    cols, rows = c.query_rows(
+        "SELECT id, amount FROM orders ORDER BY amount DESC LIMIT 2"
+    )
+    assert [r[cols.index("amount")] for r in rows] == [30, 20]
+    cols, rows = c.query_rows(
+        "SELECT id FROM orders ORDER BY amount LIMIT 2 OFFSET 1"
+    )
+    assert [r[cols.index("id")] for r in rows] == [1, 3]
+    # multi-key: customer asc then amount desc
+    cols, rows = c.query_rows(
+        "SELECT customer, amount FROM orders ORDER BY customer, amount DESC"
+    )
+    got = [(r[cols.index("customer")], r[cols.index("amount")]) for r in rows]
+    assert got == [("ana", 20), ("ana", 10), ("bob", 30), ("cat", 5)]
+
+
+def test_group_by_aggregates():
+    c = _cluster()
+    cols, rows = c.query_rows(
+        "SELECT customer, COUNT(*), SUM(amount), MIN(amount), MAX(amount), "
+        "AVG(amount) FROM orders GROUP BY customer ORDER BY customer"
+    )
+    assert cols == ["customer", "count(*)", "sum(amount)", "min(amount)",
+                    "max(amount)", "avg(amount)"]
+    assert rows == [
+        ["ana", 2, 30, 10, 20, 15.0],
+        ["bob", 1, 30, 30, 30, 30.0],
+        ["cat", 1, 5, 5, 5, 5.0],
+    ]
+
+
+def test_global_aggregates_and_empty_table():
+    c = _cluster()
+    _, rows = c.query_rows("SELECT COUNT(*), SUM(amount) FROM orders")
+    assert rows == [[4, 65]]
+    _, rows = c.query_rows(
+        "SELECT COUNT(*), SUM(amount) FROM orders WHERE amount > 100"
+    )
+    # SQLite: COUNT of nothing is 0, SUM of nothing is NULL
+    assert rows == [[0, None]]
+
+
+def test_sum_over_text_coerces_like_sqlite():
+    c = _cluster()
+    # SQLite coerces non-numeric text to 0 (leading numeric prefix counts)
+    _, rows = c.query_rows("SELECT SUM(customer), AVG(customer) FROM orders")
+    assert rows == [[0, 0.0]]
+    c.execute(["INSERT INTO orders (id, customer, amount) "
+               "VALUES (9, '12abc', 1)"])
+    _, rows = c.query_rows("SELECT SUM(customer) FROM orders")
+    assert rows == [[12]]
+
+
+def test_order_by_unselected_column_does_not_leak():
+    c = _cluster()
+    cols, rows = c.query_rows(
+        "SELECT customer FROM orders ORDER BY amount DESC LIMIT 2"
+    )
+    assert "amount" not in cols
+    assert cols == ["id", "customer"]  # pk prefix + requested projection
+    assert [r[1] for r in rows] == ["bob", "ana"]
+
+
+def test_subscriptions_reject_extras():
+    c = _cluster()
+    for bad in (
+        "SELECT COUNT(*) FROM orders",
+        "SELECT id FROM orders ORDER BY id",
+        "SELECT id FROM orders LIMIT 1",
+    ):
+        with pytest.raises(Exception):
+            c.subscribe(bad)
+
+
+def test_pgwire_aggregate_fields():
+    from corro_sim.api.pg import PgServer, SimplePgClient
+
+    c = _cluster()
+    with PgServer(c) as srv:
+        cl = SimplePgClient(*srv.addr)
+        fields, rows, tags, errors = cl.query(
+            "SELECT customer, COUNT(*), AVG(amount) FROM orders "
+            "GROUP BY customer ORDER BY customer"
+        )
+        assert not errors
+        assert [f for f, _ in fields] == ["customer", "count(*)",
+                                          "avg(amount)"]
+        assert rows[0] == ["ana", 2, 15.0]
+        cl.close()
+    c.tripwire.trip()

@@ -1,0 +1,53 @@
+"""QueryEvent wire parity with the reference's documented ND-JSON stream.
+
+The shapes are transcribed from the reference's serde definitions and its
+subscription docs (``corro-api-types/src/lib.rs:24-38`` TypedQueryEvent,
+``sqlite.rs:11-17`` ChangeType snake_case, ``doc/api/subscriptions.md``):
+
+    { "columns": ["sandwich"] }
+    { "row":     [1, ["shiitake"]] }
+    { "eoq":     { "time": 8e-8, "change_id": 0 } }
+    { "change":  ["update", 2, ["smoked meat"], 1] }
+
+A client written against a real corrosion agent must be able to consume
+this framework's streams unchanged."""
+
+from corro_sim.harness.cluster import LiveCluster
+
+SCHEMA = """
+CREATE TABLE sw (
+    pk TEXT NOT NULL PRIMARY KEY,
+    sandwich TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def test_query_event_stream_shapes():
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    c.execute(["INSERT INTO sw (pk, sandwich) VALUES ('a', 'shiitake')"])
+    sub_id, initial, q = c.subscribe_attached("SELECT sandwich FROM sw")
+
+    # initial scan: columns header, rows as [rowid, cells], eoq w/change_id
+    assert initial[0] == {"columns": ["pk", "sandwich"]}
+    row = initial[1]["row"]
+    assert isinstance(row[0], int) and row[1] == ["a", "shiitake"]
+    assert initial[-1]["eoq"]["change_id"] == 0
+
+    # live changes: ["<kind lowercase>", rowid, cells, change_id]
+    c.execute(["INSERT INTO sw (pk, sandwich) VALUES ('b', 'ham')"])
+    c.run_until_converged()
+    c.execute(["UPDATE sw SET sandwich = 'smoked meat' WHERE pk = 'b'"])
+    c.run_until_converged()
+    c.execute(["DELETE FROM sw WHERE pk = 'a'"])
+    c.run_until_converged()
+    kinds = []
+    for ev in q:
+        j = ev.as_json()
+        (kind, rowid, cells, change_id) = j["change"]
+        kinds.append(kind)
+        assert isinstance(rowid, int) and isinstance(change_id, int)
+        assert isinstance(cells, list)
+    assert kinds == ["insert", "update", "delete"]
+    # change ids are monotone from 1, exactly like ChangeId
+    ids = [e.change_id for e in q]
+    assert ids == [1, 2, 3]
