@@ -95,3 +95,53 @@ def test_view_alive_excludes_only_down():
     )
     v = np.asarray(view_alive(swim))
     assert v[0, 0] and v[0, 1] and not v[0, 2]
+
+
+def test_bounded_payload_exchange_still_converges():
+    """With swim_payload_members < n (the ≤1178-byte datagram bound,
+    broadcast/mod.rs:743) each exchange carries a partial view, yet a
+    dead node's DOWN state must still disseminate cluster-wide — just
+    over more rounds than full-view exchange."""
+    n = 24
+    cfg = SimConfig(
+        num_nodes=n, swim_enabled=True, swim_suspect_rounds=3,
+        swim_payload_members=6,  # 1/4 of the member space per datagram
+    )
+    swim = make_swim_state(n)
+    alive = np.ones(n, bool)
+    alive[5] = False
+    part = np.zeros(n, np.int32)
+    swim, m = run_swim(cfg, swim, alive, part, rounds=48)
+    status = np.asarray(swim.status)
+    believers = (status[alive, 5] == DOWN).sum()
+    assert believers >= (n - 1) * 0.9, (
+        f"only {believers}/{n-1} learned node 5 is down with bounded "
+        "payloads"
+    )
+
+
+def test_concurrent_pushes_merge_by_precedence():
+    """Several pushers landing on one receiver in the same round must
+    combine exactly like sequential foca updates: highest incarnation
+    wins, then severity — the scatter-max precedence key."""
+    n = 12
+    cfg = SimConfig(num_nodes=n, swim_enabled=True, swim_suspect_rounds=3)
+    swim = make_swim_state(n)
+    # node 3 refuted at incarnation 2 (ALIVE beats any inc-1 suspicion)
+    swim = swim.replace(
+        inc=swim.inc.at[:, 3].set(1),
+        status=swim.status.at[0, 3].set(SUSPECT),
+    )
+    swim = swim.replace(
+        inc=swim.inc.at[3, 3].set(2),
+        status=swim.status.at[3, 3].set(ALIVE),
+    )
+    alive = np.ones(n, bool)
+    part = np.zeros(n, np.int32)
+    swim, _ = run_swim(cfg, swim, alive, part, rounds=24, seed=4)
+    status = np.asarray(swim.status)
+    inc = np.asarray(swim.inc)
+    # the incarnation-2 refutation must have displaced every stale
+    # suspicion of node 3
+    assert (inc[:, 3] >= 2).all()
+    assert (status[:, 3] == ALIVE).all()
