@@ -82,7 +82,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "sim_seconds_per_round": cfg.round_ms / 1000.0,
         "final_gap": float(np.asarray(res.metrics["gap"])[-1]),
     }
+    if res.poisoned:
+        # ring-wrap tripwire (engine/step.py): state may be silently wrong —
+        # distinct from an ordinary round-budget miss (exit 3)
+        report["poisoned"] = True
     print(json.dumps(report, indent=2))
+    if res.poisoned:
+        return 4
     return 0 if res.converged_round is not None else 3
 
 

@@ -62,6 +62,8 @@ class RunResult:
     wall_seconds: float  # steady-state only (first chunk excluded)
     compile_seconds: float  # first chunk: compile + execute
     timed_rounds: int = 0
+    poisoned: bool = False  # change-log ring wrapped past a live laggard —
+    # state may be silently wrong; convergence is never reported
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -117,6 +119,7 @@ def run_sim(
 
     metrics_chunks = []
     converged_round = None
+    poisoned = False
     rounds = 0
     timed_rounds = 0
     compile_seconds = 0.0
@@ -144,6 +147,12 @@ def run_sim(
         metrics_chunks.append(m)
         rounds += chunk
         ci += 1
+        if m["log_wrapped"].any():
+            # Ring-wrap tripwire fired: a live node lagged some actor past
+            # log_capacity, so gathers may have read overwritten slots.
+            # Convergence can no longer be trusted — stop and poison.
+            poisoned = True
+            break
         # Strictly greater: at rounds == min_rounds the round numbered
         # min_rounds (e.g. a scheduled rejoin) has not executed yet.
         if stop_on_convergence and rounds > min_rounds:
@@ -166,8 +175,9 @@ def run_sim(
         state=state,
         metrics=metrics,
         rounds=rounds,
-        converged_round=converged_round,
+        converged_round=None if poisoned else converged_round,
         wall_seconds=wall,
         compile_seconds=compile_seconds,
         timed_rounds=timed_rounds,
+        poisoned=poisoned,
     )

@@ -126,6 +126,8 @@ class ReplayResult:
     converged_round: int | None
     metrics: dict
     wall_seconds: float
+    poisoned: bool = False  # log ring wrapped (engine/step.py tripwire) —
+    # convergence is never reported once this latches
 
 
 def replay(
@@ -173,6 +175,7 @@ def replay(
     t0 = time.perf_counter()
     metrics_rounds = []
     converged = None
+    poisoned = False
     r = 0
     while r < max_rounds:
         if r < trace.rounds:
@@ -189,6 +192,12 @@ def replay(
             )
         state, m = step(state, jax.random.fold_in(root, r))
         r += 1
+        if int(m["log_wrapped"]) > 0:
+            # ring-wrap tripwire (engine/step.py): state may be silently
+            # wrong — stop; never report convergence
+            poisoned = True
+            metrics_rounds.append(jax.tree.map(np.asarray, m))
+            break
         if r >= trace.rounds:
             gap = float(m["gap"])
             if gap == 0.0:
@@ -205,9 +214,10 @@ def replay(
     return ReplayResult(
         state=state,
         rounds=r,
-        converged_round=converged,
+        converged_round=None if poisoned else converged,
         metrics=metrics,
         wall_seconds=wall,
+        poisoned=poisoned,
     )
 
 

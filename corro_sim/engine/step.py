@@ -145,6 +145,19 @@ def sim_step(
             writers.astype(jnp.int32)
         )
     )
+    # Ring-wrap tripwire (changelog.py ring invariant): a live node lagging
+    # an actor by more than the log capacity would gather *new* cells under
+    # *old* version numbers and mark them applied — silently-wrong state.
+    # Evaluated from the post-write log heads against the PRE-delivery
+    # bookkeeping — the precondition of every stale gather this round can
+    # perform — so a same-round sync repair cannot mask the violation. The
+    # reference keeps its overload drops visible (handlers.rs:866-884);
+    # here the violation poisons the run: the driver refuses to report
+    # convergence once this fires (engine/driver.py, harness/cluster.py).
+    log_wrapped = (
+        ((log.head[None, :] - state.book.head) > log.capacity)
+        & alive[:, None]
+    ).sum(dtype=jnp.int32)
 
     # Global ownership fold: which versions lost cells to this round's
     # writes (find_overwritten_versions → store_empty_changeset).
@@ -398,6 +411,7 @@ def sim_step(
         "queue_overflow": gossip.overflow,
         "cleared_versions": log.cleared.sum(dtype=jnp.int32),
         "gap": gap,
+        "log_wrapped": log_wrapped,
         "clock_skew": skew,
         **swim_metrics,
         **sync_metrics,

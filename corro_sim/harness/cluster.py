@@ -127,6 +127,7 @@ class LiveCluster:
         self._rounds_ticked = 0
         self._totals: dict[str, float] = {}
         self._gap = 0.0  # last round's convergence gap (metrics reuse)
+        self._log_poisoned = False  # ring-wrap tripwire latched
         self._partials = 0.0  # last round's buffered-partial gauge
         self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
 
@@ -666,6 +667,10 @@ class LiveCluster:
             self._totals[k] = self._totals.get(k, 0.0) + float(v)
         self._gap = float(packed[names.index("gap"), -1])
         self._partials = float(packed[names.index("buffered_partials"), -1])
+        if "log_wrapped" in names and packed[names.index("log_wrapped")].any():
+            # ring-wrap tripwire (engine/step.py): state may be silently
+            # wrong from here on — convergence must never be reported
+            self._log_poisoned = True
         self._totals["rounds"] = self._rounds_ticked
 
     def _tick_locked(self, rounds: int) -> None:
@@ -787,6 +792,8 @@ class LiveCluster:
                     done += 1
                 # the step already computed the gap/partial metrics —
                 # reuse the packed transfer instead of re-reading state
+                if self._log_poisoned:
+                    return None  # permanent: check .log_poisoned, don't retry
                 if (
                     self._gap == 0.0
                     and self._partials == 0.0
@@ -796,6 +803,13 @@ class LiveCluster:
         return None
 
     # ------------------------------------------------------- introspection
+    @property
+    def log_poisoned(self) -> bool:
+        """Ring-wrap tripwire latched (engine/step.py): state may be
+        silently wrong; ``run_until_converged`` will return None forever.
+        Distinguishes a corrupt run from one that needs more rounds."""
+        return self._log_poisoned
+
     def table_stats(self) -> dict:
         """GET /v1/table_stats analog (``api/public/mod.rs:535-590``)."""
         cl = np.asarray(self.state.table.cl)

@@ -1,7 +1,10 @@
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from corro_sim.utils.bits import absorb, trailing_ones_u32, window_shift_right
+
+pytestmark = pytest.mark.quick
 
 
 def oracle_trailing_ones(x: int) -> int:

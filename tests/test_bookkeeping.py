@@ -1,5 +1,8 @@
 """Bookkeeping window vs. a Python set-based oracle of BookedVersions."""
 
+import pytest
+
+pytestmark = pytest.mark.quick
 import jax.numpy as jnp
 import numpy as np
 

@@ -5,6 +5,9 @@ store_empty_changeset (change.rs:267-389), EmptySet sync serving
 (api/peer.rs:716-758).
 """
 
+import pytest
+
+pytestmark = pytest.mark.quick
 import jax.numpy as jnp
 import numpy as np
 

@@ -4,6 +4,9 @@ Oracle rule (reference ``doc/crdts.md:15-17,237``): incoming change wins iff
 (col_version, value, site_id) is lexicographically larger than stored.
 """
 
+import pytest
+
+pytestmark = pytest.mark.quick
 import jax.numpy as jnp
 import numpy as np
 

@@ -28,6 +28,7 @@ def assert_converged_state(cfg, result):
         )
 
 
+@pytest.mark.quick
 def test_small_cluster_converges_broadcast_only():
     # config-2 shape in miniature: no sync needed when nothing drops
     cfg = SimConfig(
@@ -51,6 +52,7 @@ def test_small_cluster_converges_broadcast_only():
     assert res.metrics["writes"].sum() > 0
 
 
+@pytest.mark.quick
 def test_convergence_with_lossy_broadcast_needs_sync():
     # Starve the gossip path (fanout 1, tiny queue, 1 transmission) so the
     # anti-entropy path has to repair — mirrors the reference's drop→sync
@@ -339,3 +341,51 @@ def test_baseline_bench_configs_smoke():
     assert r5["converged"]
     # the outage victims (30%) caught up strictly after the write phase
     assert r5["value"] > 8
+
+
+def test_log_ring_wrap_poisons_the_run():
+    """A sleeper that lags past log_capacity must poison the run — the ring
+    has wrapped and gathers could serve new cells under old version numbers
+    (changelog.py ring invariant). Convergence must never be reported."""
+    cfg = SimConfig(
+        num_nodes=4,
+        num_rows=8,
+        num_cols=1,
+        log_capacity=8,  # writers produce ~24 versions: sleeper wraps
+        write_rate=1.0,
+        sync_interval=4,
+        sync_actor_topk=8,
+    )
+
+    def alive_fn(r, n):
+        a = np.ones(n, bool)
+        if r < 24:
+            a[0] = False
+        return a
+
+    res = run_sim(
+        cfg,
+        init_state(cfg, seed=5),
+        Schedule(write_rounds=24, alive_fn=alive_fn),
+        max_rounds=128,
+        chunk=8,
+        seed=5,
+        min_rounds=24,
+    )
+    assert res.poisoned
+    assert res.converged_round is None
+    assert res.metrics["log_wrapped"].sum() > 0
+
+
+def test_log_ring_wrap_quiet_on_healthy_run():
+    cfg = SimConfig(
+        num_nodes=8, num_rows=16, num_cols=2, log_capacity=64,
+        write_rate=0.5, sync_interval=4,
+    )
+    res = run_sim(
+        cfg, init_state(cfg, seed=1), Schedule(write_rounds=8),
+        max_rounds=256, chunk=8, seed=1,
+    )
+    assert not res.poisoned
+    assert res.metrics["log_wrapped"].sum() == 0
+    assert res.converged_round is not None

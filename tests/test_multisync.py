@@ -9,6 +9,7 @@ catch-up than the single-peer sweep."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping
@@ -28,6 +29,7 @@ def test_resolved_sync_peers_matches_reference_formula():
     assert SimConfig(num_nodes=64, sync_peers=1).resolved_sync_peers == 1
 
 
+@pytest.mark.quick
 def test_choose_serving_slots_dedupes_and_spreads():
     """Each lane gets exactly one slot; equal-capability ties spread
     round-robin instead of funneling through slot 0."""
@@ -54,6 +56,7 @@ def test_choose_serving_slots_dedupes_and_spreads():
     assert (np.asarray(best3) == 0).all()
 
 
+@pytest.mark.quick
 def test_sync_round_accounting_no_duplicate_transfers():
     """One sync_round on a crafted lagging cluster: head advancement must
     equal the reported sync_versions exactly — a duplicated range would

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from corro_sim.config import SimConfig
 from corro_sim.membership.swim import (
@@ -35,6 +36,7 @@ def run_swim(cfg, swim, alive_np, part_np, rounds, seed=0, start_round=0):
     return swim, jax.tree.map(lambda x: x[-1], metrics)
 
 
+@pytest.mark.quick
 def test_dead_node_gets_suspected_then_down():
     cfg = SimConfig(num_nodes=8, swim_enabled=True, swim_suspect_rounds=3)
     swim = make_swim_state(8)
@@ -50,6 +52,7 @@ def test_dead_node_gets_suspected_then_down():
         assert (status[live, j] == int(ALIVE)).all(), (j, status[:, j])
 
 
+@pytest.mark.quick
 def test_rejoin_refutes_and_recovers():
     cfg = SimConfig(num_nodes=8, swim_enabled=True, swim_suspect_rounds=3)
     swim = make_swim_state(8)

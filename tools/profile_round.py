@@ -1,0 +1,363 @@
+"""Per-stage wall-clock breakdown of the 10k-node simulation round.
+
+Times each stage of :func:`corro_sim.engine.step.sim_step` in isolation on
+the real device: SWIM tick, gossip emit, the hoisted lane sort, delivery
+bookkeeping, changeset gather+merge, ring enqueue, the local-write path and
+the anti-entropy sweep — plus the full step (sync / non-sync / no-SWIM
+variants) as ground truth that the parts sum to the whole.
+
+Methodology: every stage runs ``iters`` times inside ONE jitted
+``lax.fori_loop`` whose carry chains iteration inputs to the previous
+iteration's outputs (so XLA cannot hoist loop-invariant work, and the
+per-dispatch tunnel overhead — ~100 ms on the axon platform — amortizes
+away). Reported time = min over ``reps`` dispatches / iters.
+
+Usage::
+
+    python tools/profile_round.py [--nodes 10000] [--stage swim,sort,...]
+    python tools/profile_round.py --json   # machine-readable line per stage
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.core.bookkeeping import deliver_versions
+from corro_sim.core.changelog import append_changesets, gather_changesets
+from corro_sim.core.compaction import update_ownership
+from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
+from corro_sim.engine.driver import Schedule, _chunk_runner
+from corro_sim.engine.state import init_state
+from corro_sim.engine.step import _tile_chunks, sim_step
+from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
+from corro_sim.membership.swim import swim_step
+from corro_sim.sync.sync import sync_round
+
+
+def bench_cfg(n: int) -> SimConfig:
+    """The config-4 headline shape (benchmarks.run_headline_bench)."""
+    return SimConfig(
+        num_nodes=n, num_rows=256, num_cols=4, log_capacity=512,
+        write_rate=0.5, zipf_alpha=0.8, swim_enabled=True,
+        swim_suspect_rounds=6, sync_interval=8, sync_actor_topk=32,
+        sync_cap_per_actor=8, sync_req_actors=32, sync_need_sample=64,
+    )
+
+
+def warm_state(cfg: SimConfig, rounds: int = 16):
+    """Run the real step for a few rounds so queues/logs/heads are populated."""
+    state = init_state(cfg, seed=0)
+    runner = _chunk_runner(cfg)
+    sched = Schedule(write_rounds=10**9)
+    alive, part, we = sched.slice(0, rounds, cfg.num_nodes)
+    keys = jax.random.split(jax.random.PRNGKey(0), rounds)
+    state, _ = runner(
+        state, keys, jnp.asarray(alive), jnp.asarray(part), jnp.asarray(we)
+    )
+    jax.block_until_ready(state.round)
+    return state
+
+
+def timeit(name, jit_fn, carry, iters, reps, results):
+    out = jit_fn(carry)
+    jax.block_until_ready(out)  # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jit_fn(carry)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    results[name] = best / iters * 1000.0
+    return out
+
+
+def build_lanes(cfg: SimConfig, state, key):
+    """Reproduce the step's message-lane construction (step.py lane block)."""
+    n = cfg.num_nodes
+    cpv = cfg.chunks_per_version
+    rows_idx = jnp.arange(n, dtype=jnp.int32)
+    view = jnp.ones((1, n), bool)
+    # pretend every node wrote this round (worst case for the eager lanes)
+    writers = jnp.ones((n,), bool)
+    w_ver = state.log.head + 1
+    r0 = state.ring0.shape[1]
+    e_dst, e_src, e_ver, e_valid, e_chunk = _tile_chunks(
+        cpv,
+        state.ring0.reshape(-1),
+        jnp.repeat(rows_idx, r0),
+        jnp.repeat(w_ver, r0),
+        jnp.repeat(writers, r0),
+    )
+    _, g_dst, g_src, g_actor, g_ver, g_chunk, g_valid = broadcast_step(
+        state.gossip, key, jnp.ones((n,), bool), view, cfg.fanout
+    )
+    dst = jnp.concatenate([e_dst, g_dst])
+    src = jnp.concatenate([e_src, g_src])
+    actor = jnp.concatenate([e_src, g_actor])
+    ver = jnp.concatenate([e_ver, g_ver])
+    chunk = jnp.concatenate([e_chunk, g_chunk])
+    valid = jnp.concatenate([e_valid, g_valid])
+    return dst, src, actor, ver, chunk, valid
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10000)
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--stage", type=str, default="")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    want = set(args.stage.split(",")) if args.stage else None
+
+    def on(s):
+        return want is None or s in want
+
+    n = args.nodes
+    cfg = bench_cfg(n)
+    iters, reps = args.iters, args.reps
+    results: dict[str, float] = {}
+
+    print(f"# warming state ({n} nodes, 16 rounds)...", flush=True)
+    state = warm_state(cfg)
+    alive = jnp.ones((n,), bool)
+    part = jnp.zeros((n,), jnp.int32)
+    rows_idx = jnp.arange(n, dtype=jnp.int32)
+
+    def reach(s_, d_):
+        return alive[s_] & alive[d_] & (part[s_] == part[d_])
+
+    # ------------------------------------------------------- full step legs
+    def step_at(round_val, cfg_=cfg):
+        def body(i, carry):
+            st, key = carry
+            key, sub = jax.random.split(key)
+            st = st.replace(round=jnp.int32(round_val))
+            st, _ = sim_step(cfg_, st, sub, alive, part, jnp.bool_(True))
+            return st, key
+        return jax.jit(lambda c: jax.lax.fori_loop(0, iters, body, c))
+
+    if on("step_nosync"):
+        timeit("step_nosync", step_at(0), (state, jax.random.PRNGKey(1)),
+               iters, reps, results)
+        print(f"step_nosync           {results['step_nosync']:9.1f} ms", flush=True)
+    if on("step_sync"):
+        timeit("step_sync", step_at(cfg.sync_interval - 1),
+               (state, jax.random.PRNGKey(2)), iters, reps, results)
+        print(f"step_sync             {results['step_sync']:9.1f} ms", flush=True)
+    if on("step_noswim"):
+        import dataclasses
+        cfg_ns = dataclasses.replace(bench_cfg(n), swim_enabled=False)
+        state_ns = state.replace(
+            swim=jax.tree.map(lambda x: x[:1, :1], state.swim)
+        )
+        timeit("step_noswim", step_at(0, cfg_ns),
+               (state_ns, jax.random.PRNGKey(3)), iters, reps, results)
+        print(f"step_noswim           {results['step_noswim']:9.1f} ms", flush=True)
+
+    # ------------------------------------------------------------ SWIM tick
+    if on("swim"):
+        def swim_body(i, carry):
+            sw, key = carry
+            key, sub = jax.random.split(key)
+            sw, _ = swim_step(cfg, sw, sub, alive, reach, i)
+            return sw, key
+        timeit("swim", jax.jit(lambda c: jax.lax.fori_loop(0, iters, swim_body, c)),
+               (state.swim, jax.random.PRNGKey(4)), iters, reps, results)
+        print(f"swim                  {results['swim']:9.1f} ms", flush=True)
+
+    # --------------------------------------------------------- gossip emit
+    if on("emit"):
+        view1 = jnp.ones((1, n), bool)
+        def emit_body(i, carry):
+            g, key, acc = carry
+            key, sub = jax.random.split(key)
+            g2, dst, src, a, v, c, ok = broadcast_step(
+                g, sub, alive, view1, cfg.fanout
+            )
+            # keep queues live across iterations; consume outputs
+            g2 = g2.replace(pend_tx=g.pend_tx)
+            return g2, key, acc + jnp.where(ok, dst, 0).sum()
+        timeit("emit", jax.jit(lambda c: jax.lax.fori_loop(0, iters, emit_body, c)),
+               (state.gossip, jax.random.PRNGKey(5), jnp.int32(0)),
+               iters, reps, results)
+        print(f"emit                  {results['emit']:9.1f} ms", flush=True)
+
+    # lanes for the sort/deliver/gather/enqueue stages
+    lanes = jax.jit(lambda st, k: build_lanes(cfg, st, k))(
+        state, jax.random.PRNGKey(6)
+    )
+    dst0, src0, actor0, ver0, chunk0, valid0 = jax.block_until_ready(lanes)
+    m = int(dst0.shape[0])
+    print(f"# lane count: {m}", flush=True)
+
+    # -------------------------------------------------------------- the sort
+    if on("sort"):
+        big = jnp.int32(n + 1)
+        def sort_body(i, carry):
+            dst, actor, ver, ok = carry
+            sort_dst = jnp.where(ok, dst, big)
+            order = jnp.lexsort((ver, sort_dst * jnp.int32(n + 2) + actor))
+            # sorted outputs feed the next iteration, rolled so the input
+            # ordering differs each time (sort cost is data-oblivious anyway)
+            return (jnp.roll(dst[order], 7), jnp.roll(actor[order], 7),
+                    jnp.roll(ver[order], 7), jnp.roll(ok[order], 7))
+        timeit("sort", jax.jit(lambda c: jax.lax.fori_loop(0, iters, sort_body, c)),
+               (dst0, actor0, ver0, valid0), iters, reps, results)
+        print(f"sort                  {results['sort']:9.1f} ms", flush=True)
+
+    # presorted lanes for the delivery stages
+    @jax.jit
+    def presort(dst, src, actor, ver, chunk, ok):
+        sort_dst = jnp.where(ok, dst, jnp.int32(n + 1))
+        order = jnp.lexsort((ver, sort_dst * jnp.int32(n + 2) + actor))
+        return (dst[order], src[order], actor[order], ver[order],
+                chunk[order], ok[order])
+    sdst, ssrc, sactor, sver, schunk, svalid = jax.block_until_ready(
+        presort(dst0, src0, actor0, ver0, chunk0, valid0)
+    )
+
+    # ------------------------------------------------- delivery bookkeeping
+    if on("deliver"):
+        def del_body(i, carry):
+            book = carry
+            book, fresh, complete, dropped = deliver_versions(
+                book, sdst, sactor, sver, svalid, chunk=schunk,
+                bits_per_version=cfg.chunks_per_version, presorted=True,
+            )
+            return book
+        timeit("deliver", jax.jit(lambda c: jax.lax.fori_loop(0, iters, del_body, c)),
+               state.book, iters, reps, results)
+        print(f"deliver               {results['deliver']:9.1f} ms", flush=True)
+
+    # ------------------------------------------------ changeset gather+merge
+    if on("gather_apply"):
+        s = cfg.seqs_per_version
+        def ga_body(i, carry):
+            table, acc = carry
+            ver_i = jnp.maximum(sver, 1) + (acc & 1)  # chain => no hoisting
+            complete = svalid
+            c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
+                state.log, jnp.where(complete, sactor, 0), ver_i
+            )
+            cell_live = (
+                complete[:, None]
+                & (jnp.arange(s, dtype=jnp.int32)[None, :] < c_n[:, None])
+            )
+            c_site = jnp.where(
+                c_vr == NEG, NEG,
+                jnp.broadcast_to(sactor[:, None], (m, s)),
+            )
+            table = apply_cell_changes(
+                table,
+                jnp.broadcast_to(sdst[:, None], (m, s)).reshape(-1),
+                c_row.reshape(-1), c_col.reshape(-1), c_cv.reshape(-1),
+                c_vr.reshape(-1), c_site.reshape(-1), c_cl.reshape(-1),
+                cell_live.reshape(-1),
+            )
+            return table, table.cv[0, 0, 0]
+        timeit("gather_apply",
+               jax.jit(lambda c: jax.lax.fori_loop(0, iters, ga_body, c)),
+               (state.table, jnp.int32(0)), iters, reps, results)
+        print(f"gather_apply          {results['gather_apply']:9.1f} ms", flush=True)
+
+    # ------------------------------------------------------------- enqueue
+    if on("enqueue"):
+        cpv = cfg.chunks_per_version
+        w_ver = state.log.head + 1
+        writers = jnp.ones((n,), bool)
+        wq = _tile_chunks(cpv, rows_idx, rows_idx, w_ver, writers)
+        def enq_body(i, carry):
+            g = carry
+            g = enqueue_broadcasts(
+                g, wq[0], wq[1], wq[2], wq[4], wq[3] > -1,
+                cfg.max_transmissions, grouped=True,
+            )
+            g = enqueue_broadcasts(
+                g, sdst, sactor, sver, schunk, svalid,
+                cfg.rebroadcast_transmissions, grouped=True,
+            )
+            return g
+        timeit("enqueue", jax.jit(lambda c: jax.lax.fori_loop(0, iters, enq_body, c)),
+               state.gossip, iters, reps, results)
+        print(f"enqueue               {results['enqueue']:9.1f} ms", flush=True)
+
+    # ---------------------------------------------------- local write path
+    if on("writes"):
+        s = cfg.seqs_per_version
+        def wr_body(i, carry):
+            table, log, own, key = carry
+            key, k_row, k_col, k_val = jax.random.split(key, 4)
+            writers = jnp.ones((n,), bool)
+            u = jax.random.uniform(k_row, (n,))
+            w_row = jnp.searchsorted(state.row_cdf, u).astype(jnp.int32).clip(
+                0, cfg.num_rows - 1
+            )
+            w_col = jax.random.randint(k_col, (n, 1), 0, cfg.num_cols, jnp.int32)
+            w_val = jax.random.randint(
+                k_val, (n, s), 0, cfg.value_universe, jnp.int32
+            )
+            w_del = jnp.zeros((n,), bool)
+            w_ncells = jnp.ones((n,), jnp.int32)
+            w_row_s = jnp.broadcast_to(w_row[:, None], (n, s))
+            table, ch_cv, ch_cl, ch_vr = local_write(
+                table, rows_idx, w_row_s, w_col, w_val, w_del, w_ncells, writers
+            )
+            log, w_ver = append_changesets(
+                log, rows_idx, w_row_s, w_col, ch_vr, ch_cv, ch_cl,
+                w_ncells, writers,
+            )
+            w_cell_live = writers[:, None] & (
+                jnp.arange(s, dtype=jnp.int32)[None, :] < w_ncells[:, None]
+            )
+            own, log = update_ownership(
+                own, log,
+                jnp.broadcast_to(rows_idx[:, None], (n, s)).reshape(-1),
+                jnp.broadcast_to(w_ver[:, None], (n, s)).reshape(-1),
+                w_row_s.reshape(-1), w_col.reshape(-1),
+                ch_cv.reshape(-1), ch_vr.reshape(-1),
+                jnp.broadcast_to(rows_idx[:, None], (n, s)).reshape(-1),
+                ch_cl.reshape(-1), w_cell_live.reshape(-1),
+                jnp.zeros((n * s,), bool),
+            )
+            return table, log, own, key
+        timeit("writes", jax.jit(lambda c: jax.lax.fori_loop(0, iters, wr_body, c)),
+               (state.table, state.log, state.own, jax.random.PRNGKey(7)),
+               iters, reps, results)
+        print(f"writes                {results['writes']:9.1f} ms", flush=True)
+
+    # ----------------------------------------------------------- sync sweep
+    if on("sync"):
+        view1 = jnp.ones((1, n), bool)
+        reach1 = jnp.ones((1, n), bool)
+        def sync_body(i, carry):
+            book, table, hlc, lc, key = carry
+            key, sub = jax.random.split(key)
+            book, table, hlc, lc, _ = sync_round(
+                cfg, book, state.log, table, hlc, lc, state.cleared_hlc,
+                sub, alive, view1, reach1, rtt=None,
+            )
+            return book, table, hlc, lc, key
+        timeit("sync", jax.jit(lambda c: jax.lax.fori_loop(0, iters, sync_body, c)),
+               (state.book, state.table, state.hlc, state.last_cleared,
+                jax.random.PRNGKey(8)), iters, reps, results)
+        print(f"sync                  {results['sync']:9.1f} ms", flush=True)
+
+    print()
+    for k, v in sorted(results.items(), key=lambda kv: -kv[1]):
+        print(f"{k:22s}{v:9.1f} ms")
+    if args.json:
+        print(json.dumps({"nodes": n, "stages_ms":
+                          {k: round(v, 2) for k, v in results.items()}}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
