@@ -35,6 +35,8 @@ from corro_sim.subs.query import (
     QueryError,
     RankUniverse,
     Select,
+    _sql_number,
+    avg_cell,
     compile_predicate,
     eval_predicate_py,
     parse_query,
@@ -42,6 +44,7 @@ from corro_sim.subs.query import (
     rewrite_columns,
     split_host_predicate,
     split_pk_predicate,
+    sum_cell,
 )
 
 
@@ -89,16 +92,12 @@ class SubEvent:
 
 
 def _predicate_literals(pred):
-    from corro_sim.subs.query import And, Cmp, Not, Or
+    """Values the compiled predicate bakes rank constants for — Cmp/IN
+    literals plus compilable-LIKE range endpoints (query.py owns the walk
+    so new node types can't silently skip interning)."""
+    from corro_sim.subs.query import predicate_intern_values
 
-    if isinstance(pred, Cmp):
-        if pred.lit is not None:
-            yield pred.lit
-    elif isinstance(pred, (And, Or)):
-        for q in pred.parts:
-            yield from _predicate_literals(q)
-    elif isinstance(pred, Not):
-        yield from _predicate_literals(pred.inner)
+    yield from predicate_intern_values(pred)
 
 
 class _EventStream:
@@ -600,9 +599,292 @@ class JoinMatcher(_EventStream):
         return events
 
 
+class AggregateMatcher(Matcher):
+    """Live GROUP BY / aggregate subscription (VERDICT r2 next #5).
+
+    The reference's Matcher maintains ANY SELECT — aggregates included —
+    by re-running rewritten SQL and diffing its query table
+    (``pubsub.rs:697-832,1518-1793``). Here aggregates are maintained
+    *incrementally* from the row-level diff the inner matcher already
+    computes: COUNT/SUM/AVG retract-and-add per-group accumulators;
+    MIN/MAX additionally keep the group's member set and rescan it when
+    the current extremum retracts (a removed non-extremum never needs a
+    scan). Each group is one feed row with a stable synthetic rowid;
+    events are the same INSERT/UPDATE/DELETE stream row subscriptions
+    emit, with group state changes coalesced per round.
+
+    Aggregate state is kept in decoded VALUE space (not ranks), so a
+    LiveUniverse respace only translates the inherited row snapshot —
+    accumulators survive rebind untouched.
+    """
+
+    def __init__(self, sub_id, select: Select, node: int, layout, universe,
+                 max_buffer: int = 512):
+        self._agg_select = select
+        base = select.base()
+        super().__init__(sub_id, base, node, layout, universe,
+                         max_buffer=max_buffer)
+        # the registry keys dedupe/removal on the FULL aggregate SQL —
+        # self.select must normalize back to it, not to the base form
+        # (which could collide with a plain subscription's key)
+        self.select = select
+        # decoded-row positions: pk prefix, then the base visible columns
+        pk_cols = list(self._pk_cols() or ())
+        pos = {c: i for i, c in enumerate(pk_cols + self.columns)}
+
+        def need(col):
+            if col not in pos:
+                raise QueryError(
+                    f"no such column {select.table}.{col}"
+                )
+            return pos[col]
+
+        self._gpos = [need(c) for c in select.group_by]
+        self._items = []  # ('col', pos) | ('agg', Agg, pos|None)
+        for kind, it in select.items:
+            if kind == "col":
+                self._items.append(("col", need(it)))
+            else:
+                self._items.append(
+                    ("agg", it, None if it.col is None else need(it.col))
+                )
+        # group key -> state; slot -> key; key -> member slot set
+        self._groups: dict = {}
+        self._grp_of_slot: dict = {}
+        self._next_rid = 0
+
+    # ---- group accumulator plumbing -----------------------------------
+    def _new_group(self, key, disp):
+        rid = self._next_rid
+        self._next_rid += 1
+        g = {
+            "key": key,
+            "disp": disp,  # first-seen display values of the group cols
+            "rid": rid,
+            "count": 0,
+            "members": set(),
+            # per aggregate item: [total, nonnull, floats] for
+            # COUNT/SUM/AVG; [extremum | None] for MIN/MAX
+            "acc": [
+                ([None] if it[1].fn in ("MIN", "MAX") else [0.0, 0, 0])
+                for it in self._items if it[0] == "agg"
+            ],
+            "mmdirty": set(),  # agg indices whose extremum retracted
+            "emitted": None,  # cells last sent to subscribers
+        }
+        self._groups[key] = g
+        return g
+
+    def _row_vals(self, slot, proj_row):
+        return self._decode_row(slot, proj_row)
+
+    def _key_of(self, vals):
+        return tuple(sqlite_sort_key(vals[i]) for i in self._gpos)
+
+    def _apply(self, g, vals, sign):
+        """Add (+1) or retract (-1) one member row's contribution.
+
+        MIN/MAX keep the current extremum cached: an add is one
+        comparison; a retract rescans the member set ONLY when the
+        retracted value ties the cached extremum (rescan-on-retract,
+        deferred to :meth:`_agg_cells` via ``mmdirty``)."""
+        g["count"] += sign
+        ai = 0
+        for item in self._items:
+            if item[0] != "agg":
+                continue
+            agg, p = item[1], item[2]
+            acc = g["acc"][ai]
+            ai += 1
+            if agg.fn == "COUNT":
+                if p is None or vals[p] is not None:
+                    acc[1] += sign
+                continue
+            v = vals[p]
+            if v is None:
+                continue
+            if agg.fn in ("SUM", "AVG"):
+                n = _sql_number(v)
+                acc[0] += sign * n
+                acc[1] += sign
+                if isinstance(n, float):
+                    acc[2] += sign
+                continue
+            # MIN | MAX
+            cur = acc[0]
+            if sign > 0:
+                if (ai - 1) in g["mmdirty"]:
+                    continue  # stale cache; rescan already pending
+                kv = sqlite_sort_key(v)
+                if cur is None or (
+                    kv < sqlite_sort_key(cur) if agg.fn == "MIN"
+                    else kv > sqlite_sort_key(cur)
+                ):
+                    acc[0] = v
+            elif cur is not None and (
+                sqlite_sort_key(v) == sqlite_sort_key(cur)
+            ):
+                g["mmdirty"].add(ai - 1)
+
+    def _agg_cells(self, g):
+        """Output cells for a group; MIN/MAX rescan members only when
+        their cached extremum retracted (``mmdirty``)."""
+        cells = []
+        ai = 0
+        scanned: dict = {}
+        for item in self._items:
+            if item[0] == "col":
+                # the parser guarantees plain cols appear in GROUP BY
+                cells.append(g["disp"][self._gpos.index(item[1])])
+                continue
+            agg, p = item[1], item[2]
+            acc = g["acc"][ai]
+            ai += 1
+            if agg.fn == "COUNT":
+                cells.append(g["count"] if p is None else acc[1])
+            elif agg.fn == "SUM":
+                cells.append(sum_cell(acc[0], acc[1], acc[2]))
+            elif agg.fn == "AVG":
+                cells.append(avg_cell(acc[0], acc[1]))
+            else:  # MIN | MAX
+                if (ai - 1) in g["mmdirty"]:
+                    if p not in scanned:
+                        scanned[p] = [
+                            v for v in (
+                                self._member_val(s, p) for s in g["members"]
+                            ) if v is not None
+                        ]
+                    vals = scanned[p]
+                    if not vals:
+                        acc[0] = None
+                    elif agg.fn == "MIN":
+                        acc[0] = min(vals, key=sqlite_sort_key)
+                    else:
+                        acc[0] = max(vals, key=sqlite_sort_key)
+                    g["mmdirty"].discard(ai - 1)
+                cells.append(acc[0])
+        return cells
+
+    def _member_val(self, slot, pos):
+        row = self._row_vals(slot, self._prev_proj[slot])
+        return row[pos]
+
+    # ---- surface -------------------------------------------------------
+    def prime(self, table_state):
+        """Initial (or re-attach) snapshot. Idempotent: accumulators are
+        rebuilt from scratch, but a persisting group keeps its rowid and
+        last-emitted cells so earlier subscribers' diffs stay coherent
+        (the dedupe path re-primes a live matcher)."""
+        match, proj = self._evaluate(table_state)
+        self._prev_match, self._prev_proj = match, proj
+        self._primed = True
+        old_groups = self._groups
+        self._groups = {}
+        self._grp_of_slot = {}
+        for s in np.nonzero(match)[0]:
+            s = int(s)
+            vals = self._row_vals(s, proj[s])
+            key = self._key_of(vals)
+            g = self._groups.get(key)
+            if g is None:
+                g = self._new_group(
+                    key, [vals[i] for i in self._gpos] or [None]
+                )
+                prev = old_groups.get(key)
+                if prev is not None:
+                    g["rid"] = prev["rid"]
+                    g["emitted"] = prev["emitted"]
+            g["members"].add(s)
+            self._grp_of_slot[s] = key
+            self._apply(g, vals, +1)
+        if not self._agg_select.group_by and not self._groups:
+            # SQLite: an ungrouped aggregate query yields exactly one row
+            # even over zero matches (COUNT 0, SUM/MIN/MAX NULL)
+            g = self._new_group((), [None])
+            prev = old_groups.get(())
+            if prev is not None:
+                g["rid"] = prev["rid"]
+                g["emitted"] = prev["emitted"]
+        header = {"columns": [
+            (name if kind == "col" else name.label())
+            for kind, name in self._agg_select.items
+        ]}
+        rows = []
+        for g in sorted(self._groups.values(), key=lambda g: g["rid"]):
+            g["emitted"] = self._agg_cells(g)
+            rows.append({"row": [g["rid"], g["emitted"]]})
+        eoq = {"eoq": {"change_id": self._change_id}}
+        return [header, *rows, eoq]
+
+    def step(self, table_state) -> list:
+        if not self._primed:
+            raise RuntimeError("matcher not primed — call prime() first")
+        match, proj = self._evaluate(table_state)
+        prev_match, prev_proj = self._prev_match, self._prev_proj
+        n = self._n_vis
+        ins = match & ~prev_match
+        dele = ~match & prev_match
+        upd = (
+            match & prev_match
+            & (proj[:, :n] != prev_proj[:, :n]).any(axis=1)
+        )
+        touched: set = set()
+        # retract old contributions FIRST (an update may move groups)
+        for s in np.nonzero(dele | upd)[0]:
+            s = int(s)
+            old = self._row_vals(s, prev_proj[s])
+            key = self._grp_of_slot.pop(s)
+            g = self._groups[key]
+            g["members"].discard(s)
+            self._apply(g, old, -1)
+            touched.add(key)
+        # the inherited snapshot feeds _member_val — update it between
+        # retract (old ranks) and add/rescan (new ranks)
+        self._prev_match, self._prev_proj = match, proj
+        for s in np.nonzero(ins | upd)[0]:
+            s = int(s)
+            vals = self._row_vals(s, proj[s])
+            key = self._key_of(vals)
+            g = self._groups.get(key) or self._new_group(
+                key, [vals[i] for i in self._gpos] or [None]
+            )
+            g["members"].add(s)
+            self._grp_of_slot[s] = key
+            self._apply(g, vals, +1)
+            touched.add(key)
+        events: list = []
+        for key in sorted(
+            touched, key=lambda k: self._groups[k]["rid"]
+        ):
+            g = self._groups[key]
+            if g["count"] <= 0 and self._agg_select.group_by:
+                # group vanished (with GROUP BY; the ungrouped single row
+                # stays and reads COUNT 0 / NULL aggregates)
+                del self._groups[key]
+                if g["emitted"] is not None:
+                    self._emit(events, "delete", g["rid"], g["emitted"])
+                continue
+            cells = self._agg_cells(g)
+            if g["emitted"] is None:
+                self._emit(events, "insert", g["rid"], cells)
+            elif cells != g["emitted"]:
+                self._emit(events, "update", g["rid"], cells)
+            g["emitted"] = cells
+        self._buffer_events(events)
+        return events
+
+
 def make_matcher(sub_id, select: Select, node: int, layout, universe,
                  max_buffer: int = 512):
-    """Matcher factory: single-table or equi-join, same public surface."""
+    """Matcher factory: single-table, equi-join or aggregate — same
+    public surface."""
+    if select.aggregates:
+        if select.join is not None:
+            raise QueryError(
+                "aggregates over JOIN subscriptions are unsupported"
+            )
+        return AggregateMatcher(sub_id, select, node, layout, universe,
+                                max_buffer=max_buffer)
     cls = JoinMatcher if select.join is not None else Matcher
     return cls(sub_id, select, node, layout, universe, max_buffer=max_buffer)
 
@@ -724,11 +1006,11 @@ class SubsManager:
         """Returns (matcher, initial_events | None) — None when deduped to
         an existing matcher (subscriber catches up from its buffer)."""
         select = parse_query(sql)
-        if select.has_extras():
+        if select.order_by or select.limit is not None or select.offset:
             raise QueryError(
-                "GROUP BY / aggregates / ORDER BY / LIMIT are not "
-                "supported in subscriptions (a diff-engine cannot "
-                "maintain them incrementally); use a one-shot query"
+                "ORDER BY / LIMIT / OFFSET are not supported in "
+                "subscriptions (events are a diff stream, not an ordered "
+                "page); use a one-shot query"
             )
         key = (select.normalized(), node)
         sub_id = self._by_query.get(key)

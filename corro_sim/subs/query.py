@@ -61,6 +61,32 @@ class IsNull:
 
 
 @dataclasses.dataclass(frozen=True)
+class InList:
+    """``col [NOT] IN (lit, …)``. Carries its own negation (rather than a
+    ``Not`` wrapper) for SQL three-valued logic: a NULL column — and, for
+    NOT IN, a NULL in the list — yields UNKNOWN, which collapses to False
+    under both polarities; plain ``Not`` would flip it to True."""
+
+    col: str
+    lits: tuple
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Like:
+    """``col [NOT] LIKE 'pattern'`` — SQLite semantics: ``%`` any run,
+    ``_`` any one char, ASCII-case-insensitive. A pure prefix pattern
+    (``abc%``) compiles to rank ranges on device (one range per ASCII case
+    variant of the prefix); anything else evaluates host-side over decoded
+    values (split_host_predicate routes it). Negation lives on the node for
+    the same three-valued-logic reason as :class:`InList`."""
+
+    col: str
+    pattern: str
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class And:
     parts: tuple
 
@@ -204,7 +230,7 @@ class Select:
         out = set()
 
         def walk(p):
-            if isinstance(p, (Cmp, IsNull, JsonContains)):
+            if isinstance(p, (Cmp, IsNull, JsonContains, InList, Like)):
                 out.add(p.col)
             elif isinstance(p, (And, Or)):
                 for q in p.parts:
@@ -220,6 +246,12 @@ class Select:
 def _render(p) -> str:
     if isinstance(p, Cmp):
         return f"{p.col} {p.op} {_render_lit(p.lit)}"
+    if isinstance(p, InList):
+        lits = ", ".join(_render_lit(v) for v in p.lits)
+        return f"{p.col}{' NOT' if p.negated else ''} IN ({lits})"
+    if isinstance(p, Like):
+        neg = " NOT" if p.negated else ""
+        return f"{p.col}{neg} LIKE {_render_lit(p.pattern)}"
     if isinstance(p, JsonContains):
         lit = _render_lit(p.selector)
         if p.col_is_object:
@@ -289,6 +321,7 @@ def _tokenize(sql: str):
                 "SELECT", "FROM", "WHERE", "AND", "OR", "NOT", "IS", "NULL",
                 "JOIN", "INNER", "LEFT", "OUTER", "ON", "AS",
                 "GROUP", "ORDER", "BY", "ASC", "DESC", "LIMIT", "OFFSET",
+                "IN", "LIKE", "BETWEEN",
             ):
                 out.append((kw, kw))
             elif kw == "TRUE":  # SQLite boolean keywords are 1/0 literals
@@ -494,6 +527,40 @@ class _Parser:
         col = self.qual_ident()
         if col.lower() == "corro_json_contains" and self.peek()[0] == "(":
             return self._parse_json_contains()
+        negated = False
+        if self.peek()[0] == "NOT":
+            self.next()
+            negated = True
+            if self.peek()[0] not in ("IN", "LIKE", "BETWEEN"):
+                raise QueryError(
+                    f"expected IN / LIKE / BETWEEN after {col!r} NOT"
+                )
+        k0 = self.peek()[0]
+        if k0 == "IN":
+            self.next()
+            self.expect("(")
+            lits = [self._lit_or_null()]
+            while self.peek()[0] == ",":
+                self.next()
+                lits.append(self._lit_or_null())
+            self.expect(")")
+            return InList(col=col, lits=tuple(lits), negated=negated)
+        if k0 == "LIKE":
+            self.next()
+            lk, lv = self.next()
+            if lk != "lit" or not isinstance(lv, str):
+                raise QueryError("LIKE takes a string pattern literal")
+            return Like(col=col, pattern=lv, negated=negated)
+        if k0 == "BETWEEN":
+            # desugar: BETWEEN → >= AND <=; NOT BETWEEN → < OR > (both
+            # collapse NULL operands to False like plain comparisons)
+            self.next()
+            lo = self._lit_or_null()
+            self.expect("AND")
+            hi = self._lit_or_null()
+            if negated:
+                return Or((Cmp("<", col, lo), Cmp(">", col, hi)))
+            return And((Cmp(">=", col, lo), Cmp("<=", col, hi)))
         k, v = self.next()
         if k == "IS":
             negated = False
@@ -510,6 +577,14 @@ class _Parser:
         elif lk != "lit":
             raise QueryError(f"expected literal, got {lk} {lv!r}")
         return Cmp(op=v, col=col, lit=lv)
+
+    def _lit_or_null(self):
+        k, v = self.next()
+        if k == "NULL":
+            return None
+        if k != "lit":
+            raise QueryError(f"expected literal, got {k} {v!r}")
+        return v
 
     def _parse_json_contains(self):
         import json as _json
@@ -549,6 +624,117 @@ def parse_query(sql: str) -> Select:
     return _Parser(_tokenize(sql)).parse_select()
 
 
+# ------------------------------------------------------------ LIKE helpers
+
+_LIKE_RE_CACHE: dict = {}
+
+
+def _ascii_alpha(ch: str) -> bool:
+    return "a" <= ch <= "z" or "A" <= ch <= "Z"
+
+
+def _like_regex(pattern: str):
+    """SQLite LIKE pattern → compiled regex (``%`` any run, ``_`` any one
+    char). Case folding is ASCII-ONLY, exactly like SQLite's default LIKE
+    — built as per-char ``[aA]`` classes, NOT re.IGNORECASE (which folds
+    non-ASCII pairs and even multi-char expansions like 'ß'→'SS', diverging
+    from both SQLite and the compiled rank-range form)."""
+    rx = _LIKE_RE_CACHE.get(pattern)
+    if rx is None:
+        parts = []
+        for ch in pattern:
+            if ch == "%":
+                parts.append(".*")
+            elif ch == "_":
+                parts.append(".")
+            elif _ascii_alpha(ch):
+                parts.append(f"[{ch.lower()}{ch.upper()}]")
+            else:
+                parts.append(re.escape(ch))
+        rx = re.compile("".join(parts) + r"\Z", re.DOTALL)
+        _LIKE_RE_CACHE[pattern] = rx
+    return rx
+
+
+def like_match(pattern: str, value) -> bool:
+    """SQLite LIKE: numbers match via their TEXT rendering; a BLOB operand
+    never matches (``x'616263' LIKE 'a%'`` is 0)."""
+    if value is None or isinstance(value, (bytes, bytearray)):
+        return False
+    if isinstance(value, (int, float)):
+        value = str(value)
+    return _like_regex(pattern).match(value) is not None
+
+
+_MAX_LIKE_VARIANTS = 16
+
+
+def like_prefix_ranges(pattern: str) -> list[tuple[str, str]] | None:
+    """For a pure prefix pattern (``abc%``): the half-open string intervals
+    ``[lo, hi)`` whose union is exactly the match set under binary
+    collation — one interval per ASCII case variant of the prefix (LIKE is
+    case-insensitive, the rank order is not). None = not compilable
+    (wildcards beyond the trailing ``%``, empty prefix, too many alpha
+    chars, or a prefix ending at the top codepoint)."""
+    if not pattern.endswith("%"):
+        return None
+    prefix = pattern[:-1]
+    if not prefix or any(c in "%_" for c in prefix):
+        return None
+    # A rank interval lives in STRING key space, but LIKE also matches the
+    # text rendering of numeric values ('1%' matches the integer 12). Any
+    # prefix that could begin a numeric rendering (digits, '-', inf, nan)
+    # must take the host path or the compiled form under-matches numerics.
+    fold = prefix.lower()
+    if (
+        fold[0] in "0123456789-+."
+        or "inf".startswith(fold) or fold.startswith("inf")
+        or "nan".startswith(fold) or fold.startswith("nan")
+    ):
+        return None
+    variants = [""]
+    for ch in prefix:
+        # ASCII-only case folding (SQLite LIKE default; also keeps each
+        # variant the same length — str.upper() can expand 'ß' to 'SS',
+        # which would cover strings the pattern does not match)
+        opts = (ch.lower(), ch.upper()) if _ascii_alpha(ch) else (ch,)
+        if len(variants) * len(opts) > _MAX_LIKE_VARIANTS:
+            return None
+        variants = [v + o for v in variants for o in opts]
+    out = []
+    for v in variants:
+        last = v[-1]
+        if ord(last) >= 0x10FFFF:
+            return None
+        out.append((v, v[:-1] + chr(ord(last) + 1)))
+    return out
+
+
+def predicate_intern_values(p):
+    """Every value the compiled form bakes a rank constant for: Cmp/InList
+    literals plus the string endpoints of compilable LIKE prefix ranges.
+    Live universes must intern these BEFORE compiling so the baked
+    constants survive later inserts (see Matcher._build_eval)."""
+    if isinstance(p, Cmp):
+        if p.lit is not None:
+            yield p.lit
+    elif isinstance(p, InList):
+        for v in p.lits:
+            if v is not None:
+                yield v
+    elif isinstance(p, Like):
+        ranges = like_prefix_ranges(p.pattern)
+        if ranges:
+            for lo, hi in ranges:
+                yield lo
+                yield hi
+    elif isinstance(p, (And, Or)):
+        for q in p.parts:
+            yield from predicate_intern_values(q)
+    elif isinstance(p, Not):
+        yield from predicate_intern_values(p.inner)
+
+
 _NUM_PREFIX = re.compile(r"^\s*[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?")
 
 
@@ -570,6 +756,20 @@ def _sql_number(v):
         return int(s)
     except ValueError:
         return float(s)
+
+
+def sum_cell(total, nonnull: int, floats: int):
+    """SQLite SUM output rule, shared by the one-shot query path and the
+    incremental AggregateMatcher so the two can never drift: NULL over an
+    empty/all-NULL set; integer iff every addend was integral."""
+    if nonnull == 0:
+        return None
+    return total if floats > 0 else int(total)
+
+
+def avg_cell(total, nonnull: int):
+    """SQLite AVG output rule (always REAL; NULL over empty/all-NULL)."""
+    return None if nonnull == 0 else total / nonnull
 
 
 def post_process(select: Select, events: list) -> list:
@@ -612,15 +812,12 @@ def post_process(select: Select, events: list) -> list:
                 return len(vals)
             if not vals:
                 return None
-            if a.fn == "SUM":
+            if a.fn in ("SUM", "AVG"):
                 nums = [_sql_number(v) for v in vals]
-                total = sum(nums)
-                # SQLite SUM: integer iff every addend was integral
-                return total if any(
-                    isinstance(x, float) for x in nums
-                ) else int(total)
-            if a.fn == "AVG":
-                return sum(_sql_number(v) for v in vals) / len(vals)
+                floats = sum(isinstance(x, float) for x in nums)
+                if a.fn == "SUM":
+                    return sum_cell(sum(nums), len(nums), floats)
+                return avg_cell(sum(nums), len(nums))
             key = sqlite_sort_key
             return min(vals, key=key) if a.fn == "MIN" else max(vals, key=key)
 
@@ -676,11 +873,7 @@ def rewrite_columns(p, fn):
     strip alias qualifiers when routing join conjuncts to one side)."""
     if p is None:
         return None
-    if isinstance(p, Cmp):
-        return dataclasses.replace(p, col=fn(p.col))
-    if isinstance(p, IsNull):
-        return dataclasses.replace(p, col=fn(p.col))
-    if isinstance(p, JsonContains):
+    if isinstance(p, (Cmp, IsNull, JsonContains, InList, Like)):
         return dataclasses.replace(p, col=fn(p.col))
     if isinstance(p, And):
         return And(tuple(rewrite_columns(q, fn) for q in p.parts))
@@ -696,7 +889,7 @@ def predicate_columns(p) -> frozenset:
     out = set()
 
     def walk(q):
-        if isinstance(q, (Cmp, IsNull, JsonContains)):
+        if isinstance(q, (Cmp, IsNull, JsonContains, InList, Like)):
             out.add(q.col)
         elif isinstance(q, (And, Or)):
             for r in q.parts:
@@ -709,31 +902,36 @@ def predicate_columns(p) -> frozenset:
     return frozenset(out)
 
 
-def _has_json_contains(p) -> bool:
+def _needs_host(p) -> bool:
+    """True when a predicate subtree cannot compile to rank space:
+    ``corro_json_contains`` (no rank-interval form) or a LIKE whose
+    pattern has no prefix-range compilation."""
     if isinstance(p, JsonContains):
         return True
+    if isinstance(p, Like):
+        return like_prefix_ranges(p.pattern) is None
     if isinstance(p, (And, Or)):
-        return any(_has_json_contains(q) for q in p.parts)
+        return any(_needs_host(q) for q in p.parts)
     if isinstance(p, Not):
-        return _has_json_contains(p.inner)
+        return _needs_host(p.inner)
     return False
 
 
 def split_host_predicate(where):
     """Partition a (value-column) WHERE AST into (host_pred, dev_pred).
 
-    Terms containing ``corro_json_contains`` evaluate host-side over
-    decoded values — containment has no rank-interval form, and values
-    interned after compilation would miss a baked rank mask. Top-level
-    AND parts split independently; a part is host as soon as it contains
-    a containment call anywhere (OR/NOT mixing is fine: host evaluation
+    Terms containing ``corro_json_contains`` or a non-prefix LIKE evaluate
+    host-side over decoded values — they have no rank-interval form, and
+    values interned after compilation would miss a baked rank mask.
+    Top-level AND parts split independently; a part is host as soon as it
+    contains such a term anywhere (OR/NOT mixing is fine: host evaluation
     handles the full predicate grammar).
     """
     if where is None:
         return None, None
     parts = where.parts if isinstance(where, And) else (where,)
-    host_parts = [p for p in parts if _has_json_contains(p)]
-    dev_parts = [p for p in parts if not _has_json_contains(p)]
+    host_parts = [p for p in parts if _needs_host(p)]
+    dev_parts = [p for p in parts if not _needs_host(p)]
 
     def join(ps):
         if not ps:
@@ -803,6 +1001,23 @@ def eval_predicate_py(p, get) -> bool:
         raise QueryError(f"bad op {p.op!r}")
     if isinstance(p, IsNull):
         return (get(p.col) is not None) if p.negated else (get(p.col) is None)
+    if isinstance(p, InList):
+        v = get(p.col)
+        if v is None:
+            return False
+        kv = sqlite_sort_key(v)
+        hit = any(
+            l is not None and sqlite_sort_key(l) == kv for l in p.lits
+        )
+        if p.negated:
+            # x NOT IN (…, NULL) is UNKNOWN when x misses → False
+            return not hit and not any(l is None for l in p.lits)
+        return hit
+    if isinstance(p, Like):
+        v = get(p.col)
+        if v is None:
+            return False
+        return like_match(p.pattern, v) != p.negated
     if isinstance(p, JsonContains):
         import json as _json
 
@@ -893,6 +1108,55 @@ def compile_predicate(pred, universe: RankUniverse, col_index):
             def f(vr, unset, ci=ci, lo=lo, hi=hi, neg=p.negated):
                 isnull = unset[:, ci] | ((vr[:, ci] >= lo) & (vr[:, ci] < hi))
                 return ~isnull if neg else isnull
+
+            return f
+        if isinstance(p, InList):
+            ci = col_index(p.col)
+            bounds = [
+                universe.rank_of(v) for v in p.lits if v is not None
+            ]
+            nlo, nhi = universe.rank_of(None)
+            has_null = any(v is None for v in p.lits)
+
+            def f(vr, unset, ci=ci, bounds=tuple(bounds), neg=p.negated,
+                  nlo=nlo, nhi=nhi, has_null=has_null):
+                r = vr[:, ci]
+                known = ~unset[:, ci] & ~((r >= nlo) & (r < nhi))
+                hit = jnp.zeros(r.shape, bool)
+                for lo, hi in bounds:
+                    hit = hit | ((r >= lo) & (r < hi))
+                if neg:
+                    if has_null:  # NOT IN over a NULL-bearing list: UNKNOWN
+                        return jnp.zeros(r.shape, bool)
+                    return known & ~hit
+                return known & hit
+
+            return f
+        if isinstance(p, Like):
+            ranges = like_prefix_ranges(p.pattern)
+            if ranges is None:
+                raise QueryError(
+                    f"LIKE {p.pattern!r} cannot compile to rank space — "
+                    "split it host-side first (split_host_predicate)"
+                )
+            ci = col_index(p.col)
+            # [lo, hi) rank interval per case variant of the prefix; only
+            # the low edges matter (rank_of of an un-stored string returns
+            # a collapsed edge, which is exactly the cut point we need)
+            edges = [
+                (universe.rank_of(lo)[0], universe.rank_of(hi)[0])
+                for lo, hi in ranges
+            ]
+            nlo, nhi = universe.rank_of(None)
+
+            def f(vr, unset, ci=ci, edges=tuple(edges), neg=p.negated,
+                  nlo=nlo, nhi=nhi):
+                r = vr[:, ci]
+                known = ~unset[:, ci] & ~((r >= nlo) & (r < nhi))
+                hit = jnp.zeros(r.shape, bool)
+                for lo, hi in edges:
+                    hit = hit | ((r >= lo) & (r < hi))
+                return known & (~hit if neg else hit)
 
             return f
         if isinstance(p, And):
