@@ -46,6 +46,12 @@ class SimConfig:
 
     # --- anti-entropy sync (reference api/peer.rs, agent/handlers.rs) ---
     sync_interval: int = 8  # rounds between sync sweeps (1-15 s backoff analog)
+    sync_adaptive: bool = False  # activity-reset cadence (util.rs:327-371):
+    # the reference's sync backoff RESETS to 1 s whenever changes flow, and
+    # decays to the lean cadence when idle — so repair accelerates exactly
+    # when gossip quiesces. Model: a round with zero cluster-wide writes
+    # and a nonzero gap syncs IMMEDIATELY (every round), while write-phase
+    # rounds keep the lean sync_interval cadence.
     sync_candidates: int = 10  # RANDOM_NODES_CHOICES (agent/mod.rs:38)
     sync_server_cap: int = 3  # inbound sync semaphore (corro-types/agent.rs:132)
     sync_peers: int | None = None  # concurrent sync peers per node per sweep;
@@ -63,6 +69,12 @@ class SimConfig:
 
     # --- SWIM membership (foca analog) ---
     swim_enabled: bool = False
+    swim_interval: int = 1  # rounds between SWIM ticks. foca's probe
+    # period (1-5 s) is several broadcast flushes long (broadcast flush =
+    # 500 ms, mod.rs:378) — ticking SWIM every gossip round is FASTER
+    # failure detection than the reference's; >1 restores the ratio and
+    # cuts the (N, N)-plane traffic proportionally. Suspicion timeouts
+    # (swim_suspect_rounds) count gossip rounds either way.
     swim_indirect_probes: int = 3  # num_indirect_probes
     swim_suspect_rounds: int = 6  # suspicion timeout, in rounds
     swim_gossip_peers: int = 3  # view-exchange peers per round
@@ -80,8 +92,11 @@ class SimConfig:
     # --- link latency + RTT rings (members.rs:40,140-188) ---
     latency_regions: int = 1  # >1 enables the delay model (contiguous
     # node-id regions; think racks/DCs)
-    latency_intra: int = 1  # rounds-to-deliver within a region
-    latency_inter: int = 4  # rounds-to-deliver across regions
+    latency_intra: int = 1  # rounds-to-deliver within a region (must be 1
+    # while the in-flight ring buffers only the inter class)
+    latency_inter: int = 4  # rounds-to-deliver across regions: a message
+    # emitted in round r is DELIVERED in round r + latency_inter - 1 via
+    # the in-flight ring (real delay, not loss — transport.rs:199-233)
     rtt_rings: bool = False  # measure per-edge RTT on delivery and
     # recompute ring0 from observations (else ring0 stays static)
     ring_update_interval: int = 8  # rounds between ring recomputations
@@ -89,6 +104,21 @@ class SimConfig:
     @property
     def num_actors(self) -> int:
         return self.num_nodes
+
+    @property
+    def lanes_per_round(self) -> int:
+        """Message lanes one round emits: eager ring-0 chunks + gossip."""
+        return self.num_nodes * (
+            self.ring0_size * self.chunks_per_version
+            + self.pend_slots * self.fanout
+        )
+
+    @property
+    def inflight_slots(self) -> int:
+        """Ring depth of the in-flight delay buffer (0 = disabled)."""
+        if self.latency_regions > 1 and self.latency_inter > 1:
+            return self.latency_inter - 1
+        return 0
 
     @property
     def resolved_sync_peers(self) -> int:
@@ -108,5 +138,9 @@ class SimConfig:
         assert self.seqs_per_version >= 1
         assert self.chunks_per_version in (1, 2, 4, 8, 16, 32), (
             "chunks_per_version must divide the 32-bit version window"
+        )
+        assert self.latency_regions <= 1 or self.latency_intra == 1, (
+            "the in-flight delay ring buffers the inter-region class only; "
+            "intra-region delivery is same-round (latency_intra must be 1)"
         )
         return self

@@ -87,6 +87,9 @@ def state_shardings(
             if state.rtt.shape[0] == num_nodes
             else replicated  # (1, 1) placeholder when rtt_rings is off
         ),
+        # in-flight delay ring: lane-axis blocks are src-major but mixed
+        # (eager + gossip), and the whole ring is ~tens of MB — replicate
+        inflight=replicated,
     )
 
 

@@ -29,7 +29,7 @@ from corro_sim.core.compaction import update_ownership
 from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
-from corro_sim.membership.rtt import link_open, observe_rtt, recompute_ring0
+from corro_sim.membership.rtt import link_delay, observe_rtt, recompute_ring0
 from corro_sim.membership.swim import swim_step, view_alive
 from corro_sim.sync.sync import sync_round
 
@@ -154,10 +154,12 @@ def sim_step(
     # reference keeps its overload drops visible (handlers.rs:866-884);
     # here the violation poisons the run: the driver refuses to report
     # convergence once this fires (engine/driver.py, harness/cluster.py).
-    log_wrapped = (
-        ((log.head[None, :] - state.book.head) > log.capacity)
-        & alive[:, None]
-    ).sum(dtype=jnp.int32)
+    lag_pre = log.head[None, :] - state.book.head
+    log_wrapped = ((lag_pre > log.capacity) & alive[:, None]).sum(
+        dtype=jnp.int32
+    )
+    # pre-delivery repair signal for the adaptive sync cadence (below)
+    behind_pre = ((lag_pre > 0) & alive[:, None]).any()
 
     # Global ownership fold: which versions lost cells to this round's
     # writes (find_overwritten_versions → store_empty_changeset).
@@ -223,12 +225,37 @@ def sim_step(
     ver = jnp.concatenate([e_ver, g_ver])
     chunk = jnp.concatenate([e_chunk, g_chunk])
     valid = jnp.concatenate([e_valid, g_valid])
+    msgs_sent = valid.sum(dtype=jnp.int32)  # emissions, pre-delay split
 
-    # Ground truth: the packet only lands if the link is actually up AND
-    # this round matches the link's delay phase (a delay-d link is open on
-    # 1-of-d phases; the miss is repaired by retransmission/sync — see
-    # membership/rtt.py for why latency reads as loss to a gossip deadline).
-    delivered = valid & reach(src, dst) & link_open(cfg, src, dst, state.round)
+    # ------------------------------------------------ in-flight latency
+    # A slow link DELAYS delivery instead of dropping (VERDICT r2 next #6;
+    # reference per-conn RTT, transport.rs:199-233): lanes whose link
+    # delay d > 1 park in a ring slot and re-enter the delivery pipeline
+    # at round + d - 1. Reachability is evaluated AT DELIVERY — a message
+    # in flight when a partition lands is lost with it. Matured lanes
+    # merge the sender's CURRENT clock (hlc_recv below): clocks are
+    # monotone, so a newer-than-emission stamp is still a clock the
+    # sender reached — the uhlc max-merge is unaffected.
+    if cfg.inflight_slots:
+        d = link_delay(cfg, src, dst)
+        slot = state.round % cfg.inflight_slots
+        mat = state.inflight[slot]  # (6, L) — lanes maturing this round
+        inflight = state.inflight.at[slot].set(
+            jnp.stack([dst, src, actor, ver, chunk,
+                       (valid & (d > 1)).astype(jnp.int32)])
+        )
+        dst = jnp.concatenate([dst, mat[0]])
+        src = jnp.concatenate([src, mat[1]])
+        actor = jnp.concatenate([actor, mat[2]])
+        ver = jnp.concatenate([ver, mat[3]])
+        chunk = jnp.concatenate([chunk, mat[4]])
+        valid = jnp.concatenate([valid & (d <= 1), mat[5].astype(bool)])
+    else:
+        inflight = state.inflight
+
+    # Ground truth: the packet lands iff the link is actually up at
+    # delivery time (same round for near lanes, d-1 rounds later for far).
+    delivered = valid & reach(src, dst)
 
     # ONE lane sort for the whole delivery pipeline: bookkeeping dedupe
     # (deliver_versions presorted path), changeset gathers, the merge
@@ -331,9 +358,37 @@ def sim_step(
 
     # ----------------------------------------------------------------- SWIM
     if cfg.swim_enabled:
-        swim, swim_metrics = swim_step(
-            cfg, state.swim, k_swim, alive, reach, state.round
-        )
+        if cfg.swim_interval > 1:
+            # foca probes every 1-5 s vs the 500 ms broadcast flush — SWIM
+            # ticking every k-th gossip round is the faithful ratio AND
+            # cuts the (N, N) plane traffic k-fold (config.swim_interval)
+            def tick_swim(args):
+                sw, k = args
+                return swim_step(cfg, sw, k, alive, reach, state.round)
+
+            def skip_swim(args):
+                sw, _ = args
+                st = sw.status
+                return sw, {
+                    "swim_suspects": (
+                        (st == 1) & alive[:, None]
+                    ).sum(dtype=jnp.int32),
+                    "swim_down": (
+                        (st == 2) & alive[:, None]
+                    ).sum(dtype=jnp.int32),
+                    "swim_probe_failures": jnp.int32(0),
+                }
+
+            swim, swim_metrics = jax.lax.cond(
+                (state.round % cfg.swim_interval) == 0,
+                tick_swim,
+                skip_swim,
+                (state.swim, k_swim),
+            )
+        else:
+            swim, swim_metrics = swim_step(
+                cfg, state.swim, k_swim, alive, reach, state.round
+            )
     else:
         swim = state.swim
         swim_metrics = {
@@ -352,6 +407,13 @@ def sim_step(
 
     # ----------------------------------------------------------------- sync
     is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
+    if cfg.sync_adaptive:
+        # activity-reset backoff (util.rs:327-371): when the cluster
+        # quiesces (zero writes this round) but somebody is still behind,
+        # sync EVERY round — repair accelerates exactly when gossip stops
+        # carrying new data. Write-phase rounds keep the lean cadence.
+        quiesced = writers.sum(dtype=jnp.int32) == 0
+        is_sync = is_sync | (quiesced & behind_pre)
 
     def do_sync(args):
         book, table, hlc, lc = args
@@ -402,7 +464,7 @@ def sim_step(
         "writes": writers.sum(dtype=jnp.int32),
         "deletes": w_del.sum(dtype=jnp.int32),
         "cells_written": jnp.where(writers, w_ncells, 0).sum(dtype=jnp.int32),
-        "msgs_sent": valid.sum(dtype=jnp.int32),
+        "msgs_sent": msgs_sent,
         "delivered": delivered.sum(dtype=jnp.int32),
         "fresh": complete.sum(dtype=jnp.int32),
         "fresh_chunks": fresh_chunk.sum(dtype=jnp.int32),
@@ -430,6 +492,7 @@ def sim_step(
         cleared_hlc=cleared_hlc,
         rtt=rtt,
         ring0=ring0,
+        inflight=inflight,
     )
     return new_state, metrics
 

@@ -885,15 +885,18 @@ class LiveCluster:
             self._alive[node] = True
             inc = None
             if self.cfg.swim_enabled:
+                from corro_sim.membership.swim import INC_MAX, pack_swim
+
                 swim = self.state.swim
-                new_inc = swim.inc[node, node] + 1
+                # saturate like swim_step's refutation — wrapping the
+                # 14-bit packed field would reset precedence to zero
+                new_inc = min(int(swim.inc[node, node]) + 1, INC_MAX)
+                # packed self-entry: ALIVE at the bumped incarnation
                 swim = swim.replace(
-                    inc=swim.inc.at[node, node].set(new_inc),
-                    status=swim.status.at[node, node].set(0),  # ALIVE
-                    since=swim.since.at[node, node].set(0),
+                    p=swim.p.at[node, node].set(pack_swim(0, new_inc, 0))
                 )
                 self.state = self.state.replace(swim=swim)
-                inc = int(new_inc)
+                inc = new_inc
             return {"node": node, "alive": True, "incarnation": inc}
 
     def set_cluster_id(self, node: int, cluster_id: int) -> dict:

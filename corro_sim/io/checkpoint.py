@@ -220,7 +220,7 @@ def save_checkpoint(cluster, path, *, scrub: bool = False,
             # gossip + swim state do not travel in a portable backup
             flat = {
                 k: v for k, v in flat.items()
-                if not k.startswith(("gossip/", "swim/", "rtt"))
+                if not k.startswith(("gossip/", "swim/", "rtt", "inflight"))
             }
             if origin_node != 0:
                 nested = _unflatten(flat)
@@ -365,7 +365,7 @@ def restore(path, node: int = 0, tripwire=None):
     meta = {**meta, "subs": []}
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "ring0", "row_cdf"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf"))
     }
     cluster = _cluster_from_meta(meta, tripwire)
     if node >= cluster.cfg.num_nodes:
@@ -397,7 +397,7 @@ def restore_into(cluster, path, node: int = 0) -> None:
     # restore()): the running cluster keeps its own topology + membership
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "ring0", "row_cdf"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf"))
     }
     with cluster.locks.tracked(cluster._lock, "restore", "write"):
         new_layout = _rebuild_layout(meta)

@@ -11,14 +11,12 @@ ring-0 (lowest latency) gets the eager broadcast path
 TPU shape, three pieces:
 
 - **Delay model**: nodes belong to ``latency_regions`` contiguous regions
-  (think racks/DCs). A link's delay in rounds is ``latency_intra`` within
-  a region and ``latency_inter`` across. Rather than buffering in-flight
-  messages per delay bucket (ragged, memory-hungry), a delay-d link is
-  *open on 1-of-d round phases* (edge-hashed): messages attempted on a
-  closed phase are lost to the gossip path and repaired by sync — to a
-  deadline-driven gossip protocol, a laggy link IS indistinguishable from
-  a lossy one, and the expected extra delivery latency works out to the
-  modeled delay.
+  (think racks/DCs). A link's delay in rounds is ``latency_intra`` (= 1,
+  same-round) within a region and ``latency_inter`` across. Delayed lanes
+  park in the engine's in-flight ring (``SimState.inflight``) and deliver
+  ``latency_inter - 1`` rounds after emission — real latency, not loss
+  (the r2 phase-gated ``link_open`` model read a delay-4 link as 75%
+  loss, distorting convergence-round counts; VERDICT r2 next #6).
 - **Measurement**: every successful delivery writes the observed edge
   delay into the receiver's ``rtt[dst, src]`` plane (the sample the
   reference takes on connection reuse, ``transport.rs:199-233``).
@@ -48,22 +46,6 @@ def link_delay(cfg: SimConfig, src: jnp.ndarray, dst: jnp.ndarray):
         jnp.int32(cfg.latency_intra),
         jnp.int32(cfg.latency_inter),
     )
-
-
-def link_open(cfg: SimConfig, src, dst, round_):
-    """Whether the (src, dst) link delivers on this round's phase.
-
-    Edge-hashed phase so a given link reopens every ``delay`` rounds —
-    the memoryless form of "this hop takes delay rounds".
-    """
-    if cfg.latency_regions <= 1:
-        return jnp.ones(src.shape, bool)
-    d = link_delay(cfg, src, dst)
-    h = (
-        src.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
-        ^ dst.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
-    ).astype(jnp.int32) & jnp.int32(0x7FFFFFFF)
-    return ((round_ + h) % d) == 0
 
 
 def make_rtt(num_nodes: int, enabled: bool) -> jnp.ndarray:

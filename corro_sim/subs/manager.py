@@ -41,6 +41,7 @@ from corro_sim.subs.query import (
     eval_predicate_py,
     parse_query,
     predicate_columns,
+    predicate_intern_values,
     rewrite_columns,
     split_host_predicate,
     split_pk_predicate,
@@ -89,15 +90,6 @@ class SubEvent:
         return {
             "change": [self.kind, self.rowid, self.cells, self.change_id]
         }
-
-
-def _predicate_literals(pred):
-    """Values the compiled predicate bakes rank constants for — Cmp/IN
-    literals plus compilable-LIKE range endpoints (query.py owns the walk
-    so new node types can't silently skip interning)."""
-    from corro_sim.subs.query import predicate_intern_values
-
-    yield from predicate_intern_values(pred)
 
 
 class _EventStream:
@@ -222,7 +214,7 @@ class Matcher(_EventStream):
         col_defaults = []
         if hasattr(self.universe, "rank"):
             self.universe.rank(None)
-            for lit in _predicate_literals(self._dev_where):
+            for lit in predicate_intern_values(self._dev_where):
                 self.universe.rank(lit)
             for c in layout.table_columns(select.table):
                 d = layout.column_default(select.table, c)
@@ -663,13 +655,19 @@ class AggregateMatcher(Matcher):
             "rid": rid,
             "count": 0,
             "members": set(),
-            # per aggregate item: [total, nonnull, floats] for
-            # COUNT/SUM/AVG; [extremum | None] for MIN/MAX
+            # per aggregate item: [int_total, float_total, nonnull,
+            # floats] for COUNT/SUM/AVG — the int part is an exact Python
+            # int so integer sums never round; [extremum | None] for
+            # MIN/MAX
             "acc": [
-                ([None] if it[1].fn in ("MIN", "MAX") else [0.0, 0, 0])
+                ([None] if it[1].fn in ("MIN", "MAX") else [0, 0.0, 0, 0])
                 for it in self._items if it[0] == "agg"
             ],
-            "mmdirty": set(),  # agg indices whose extremum retracted
+            "mmdirty": set(),  # agg indices needing a member rescan:
+            # a MIN/MAX whose extremum retracted, or a SUM/AVG that
+            # retracted a FLOAT contribution (float subtraction leaves
+            # residue — 1e100 + 1 - 1e100 is 0.0, not 1 — so parity with
+            # the one-shot path needs a recompute; int retraction is exact)
             "emitted": None,  # cells last sent to subscribers
         }
         self._groups[key] = g
@@ -698,17 +696,24 @@ class AggregateMatcher(Matcher):
             ai += 1
             if agg.fn == "COUNT":
                 if p is None or vals[p] is not None:
-                    acc[1] += sign
+                    acc[2] += sign
                 continue
             v = vals[p]
             if v is None:
                 continue
             if agg.fn in ("SUM", "AVG"):
+                if (ai - 1) in g["mmdirty"]:
+                    continue  # rescan pending — it recomputes everything
                 n = _sql_number(v)
-                acc[0] += sign * n
-                acc[1] += sign
+                if isinstance(n, float) and sign < 0:
+                    g["mmdirty"].add(ai - 1)  # inexact: rescan
+                    continue
+                acc[2] += sign
                 if isinstance(n, float):
-                    acc[2] += sign
+                    acc[1] += n
+                    acc[3] += 1
+                else:
+                    acc[0] += sign * n  # exact Python-int arithmetic
                 continue
             # MIN | MAX
             cur = acc[0]
@@ -741,11 +746,29 @@ class AggregateMatcher(Matcher):
             acc = g["acc"][ai]
             ai += 1
             if agg.fn == "COUNT":
-                cells.append(g["count"] if p is None else acc[1])
-            elif agg.fn == "SUM":
-                cells.append(sum_cell(acc[0], acc[1], acc[2]))
-            elif agg.fn == "AVG":
-                cells.append(avg_cell(acc[0], acc[1]))
+                cells.append(g["count"] if p is None else acc[2])
+            elif agg.fn in ("SUM", "AVG"):
+                if (ai - 1) in g["mmdirty"]:
+                    # recompute from members in slot order (the same
+                    # order the one-shot path folds rows)
+                    acc[0], acc[1], acc[2], acc[3] = 0, 0.0, 0, 0
+                    for s in sorted(g["members"]):
+                        v = self._member_val(s, p)
+                        if v is None:
+                            continue
+                        nv = _sql_number(v)
+                        acc[2] += 1
+                        if isinstance(nv, float):
+                            acc[1] += nv
+                            acc[3] += 1
+                        else:
+                            acc[0] += nv
+                    g["mmdirty"].discard(ai - 1)
+                total = acc[0] + acc[1] if acc[3] else acc[0]
+                if agg.fn == "SUM":
+                    cells.append(sum_cell(total, acc[2], acc[3]))
+                else:
+                    cells.append(avg_cell(total, acc[2]))
             else:  # MIN | MAX
                 if (ai - 1) in g["mmdirty"]:
                     if p not in scanned:

@@ -219,8 +219,10 @@ def sync_round(
     s = log.seqs
     offs = jnp.arange(1, cap + 1, dtype=jnp.int32)  # (cap,)
 
-    # Request schedule, built WITHOUT any (N, A)-sized gather — peer-head
-    # row gathers (P·N·A elements) dominated the sweep at 10k nodes:
+    # Request schedule, built WITHOUT any (N, A)-sized gather OR scatter —
+    # the r2 form packed lanes with an (N, A)-update scatter, and 1e8
+    # scatter update lanes dominated the whole sweep on the real chip
+    # (~0.9 s of the 971 ms sync stage in tools/profile_sync.py):
     #
     # 1. Each node selects up to K' actors it still needs (its own
     #    bookkeeping vs the written heads — the needs side of
@@ -229,24 +231,30 @@ def sync_round(
     #    positives. Rotated round-robin is what the reference's shuffled
     #    request scheduler does anyway (chunked needs are SHUFFLED and
     #    dealt round-robin, peer.rs:1241-1372 — not served largest-first).
-    #    cumsum + one scatter, all linear in N·A, zero gathers.
+    #    The k-th selected actor is recovered by a batched binary search
+    #    of k in the per-row inclusive cumsum of the need mask: N·K'·log A
+    #    gathered elements (~4.5M at 10k) instead of N·A scatter lanes.
     phase = jax.random.randint(k_phase, (), 0, a, dtype=jnp.int32)
     my_need = jnp.maximum(log.head[None, :] - book.head, 0)  # (N, A)
     rolled = jnp.roll(my_need, -phase, axis=1)
     pos = rolled > 0
-    prank = jnp.cumsum(pos.astype(jnp.int32), axis=1) - 1  # (N, A)
-    sel = pos & (prank < kprime)
-    actor_ids = (jnp.arange(a, dtype=jnp.int32) + phase) % a  # (A,)
-    dest = jnp.where(sel, prank, kprime)  # OOB-drop for unselected
-    # ONE (N, A)-update scatter (they cost ~0.5 s each at 10k): pack
-    # actor id + validity as id+1, 0 = unfilled slot. Unfilled slots MUST
-    # be masked or they all alias actor 0 and serve its range many times
-    # over (inflating sync_versions up to kp×).
-    packed = jnp.zeros((n, kprime), jnp.int32).at[
-        rows[:, None], dest
-    ].set(jnp.broadcast_to(actor_ids[None, :] + 1, (n, a)), mode="drop")
-    lane_ok = packed > 0
-    topa = jnp.maximum(packed - 1, 0)
+    csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A) inclusive
+    targets = jnp.arange(1, kprime + 1, dtype=jnp.int32)  # (K',)
+    # manual batched binary search: first index with csum >= k, unrolled
+    # ceil(log2 A) halvings of (N, K') bounds with one small
+    # take_along_axis gather each — vmapped jnp.searchsorted lowers to a
+    # broadcast compare over (N, K', A) (~100 ms at 10k; this is <5 ms)
+    lo = jnp.zeros((n, kprime), jnp.int32)
+    hi = jnp.full((n, kprime), a, jnp.int32)
+    for _ in range(a.bit_length()):  # search space is a+1 values
+        mid = (lo + hi) >> 1
+        cm = jnp.take_along_axis(csum, jnp.minimum(mid, a - 1), axis=1)
+        ge = cm >= targets[None, :]
+        hi = jnp.where(ge, mid, hi)
+        lo = jnp.where(ge, lo, mid + 1)
+    idx = hi  # (N, K') — rotated index of the k-th positive; a = unfilled
+    lane_ok = idx < a
+    topa = (jnp.where(lane_ok, idx, 0) + phase) % a
 
     # 2. Peer availability for ONLY the selected lanes: what each granted
     #    peer can actually serve of each requested actor (their haves

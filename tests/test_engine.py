@@ -389,3 +389,53 @@ def test_log_ring_wrap_quiet_on_healthy_run():
     assert not res.poisoned
     assert res.metrics["log_wrapped"].sum() == 0
     assert res.converged_round is not None
+
+
+def test_adaptive_sync_cadence_accelerates_quiesce():
+    """sync_adaptive (util.rs:327-371 analog): once writes quiesce with a
+    gap open, sweeps fire every round — convergence must come no later
+    than (and typically well before) the lean fixed cadence."""
+    base = dict(
+        num_nodes=48, num_rows=32, num_cols=2, log_capacity=128,
+        write_rate=0.8, pend_slots=4, fanout=2, max_transmissions=1,
+        rebroadcast_transmissions=1, sync_interval=8, sync_actor_topk=8,
+    )
+
+    def run(**kw):
+        cfg = SimConfig(**base, **kw)
+        return run_sim(
+            cfg, init_state(cfg, seed=7), Schedule(write_rounds=8),
+            max_rounds=256, chunk=4, seed=7,
+        )
+
+    lean = run()
+    adaptive = run(sync_adaptive=True)
+    assert adaptive.converged_round is not None
+    assert lean.converged_round is not None
+    assert adaptive.converged_round <= lean.converged_round
+    assert_converged_state(None, adaptive)
+
+
+def test_swim_interval_still_detects_and_converges():
+    cfg = SimConfig(
+        num_nodes=16, num_rows=16, num_cols=2, log_capacity=64,
+        write_rate=0.5, swim_enabled=True, swim_interval=2,
+        swim_suspect_rounds=6, sync_interval=4,
+    )
+
+    def alive_fn(r, n):
+        a = np.ones(n, bool)
+        if r >= 4:
+            a[5] = False  # node 5 dies mid-run and stays down
+        return a
+
+    res = run_sim(
+        cfg, init_state(cfg, seed=9),
+        Schedule(write_rounds=8, alive_fn=alive_fn),
+        max_rounds=256, chunk=8, seed=9, min_rounds=8,
+    )
+    assert res.converged_round is not None
+    # SWIM (ticking every 2nd round) still concluded node 5 is down
+    status = np.asarray(res.state.swim.status)
+    live = [i for i in range(16) if i != 5]
+    assert (status[live, 5] == 2).all()

@@ -11,6 +11,7 @@ from corro_sim.membership.swim import (
     DOWN,
     SUSPECT,
     make_swim_state,
+    pack_swim,
     swim_step,
     view_alive,
 )
@@ -91,10 +92,9 @@ def test_partition_suspects_other_side():
 
 def test_view_alive_excludes_only_down():
     swim = make_swim_state(3)
+    status = np.array([[0, 1, 2], [0, 0, 0], [0, 0, 0]], np.int8)
     swim = swim.replace(
-        status=jnp.asarray(
-            np.array([[0, 1, 2], [0, 0, 0], [0, 0, 0]], np.int8)
-        )
+        p=pack_swim(jnp.asarray(status), np.zeros((3, 3)), np.zeros((3, 3)))
     )
     v = np.asarray(view_alive(swim))
     assert v[0, 0] and v[0, 1] and not v[0, 2]
@@ -131,14 +131,13 @@ def test_concurrent_pushes_merge_by_precedence():
     cfg = SimConfig(num_nodes=n, swim_enabled=True, swim_suspect_rounds=3)
     swim = make_swim_state(n)
     # node 3 refuted at incarnation 2 (ALIVE beats any inc-1 suspicion)
-    swim = swim.replace(
-        inc=swim.inc.at[:, 3].set(1),
-        status=swim.status.at[0, 3].set(SUSPECT),
-    )
-    swim = swim.replace(
-        inc=swim.inc.at[3, 3].set(2),
-        status=swim.status.at[3, 3].set(ALIVE),
-    )
+    status = np.zeros((n, n), np.int8)
+    inc = np.zeros((n, n), np.int32)
+    inc[:, 3] = 1
+    status[0, 3] = int(SUSPECT)
+    inc[3, 3] = 2
+    status[3, 3] = int(ALIVE)
+    swim = swim.replace(p=pack_swim(status, inc, np.zeros((n, n))))
     alive = np.ones(n, bool)
     part = np.zeros(n, np.int32)
     swim, _ = run_swim(cfg, swim, alive, part, rounds=24, seed=4)

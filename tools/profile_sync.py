@@ -1,0 +1,146 @@
+"""Sub-stage timing inside the anti-entropy sweep at 10k nodes.
+
+The round profile (tools/profile_round.py) shows the sweep at ~970 ms;
+this breaks it into: peer choice, the request schedule (roll + cumsum +
+the (N,A)-update scatter), the per-lane availability gathers, and the
+transfer+merge tail — so the rewrite targets the right kernel.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from corro_sim.engine.driver import Schedule, _chunk_runner
+from corro_sim.engine.state import init_state
+from corro_sim.sync.sync import choose_serving_slots, choose_sync_peers
+import sys, os
+sys.path.insert(0, os.path.dirname(__file__))
+from profile_round import bench_cfg, warm_state
+
+
+def timeit(name, fn, carry, iters=8, reps=3):
+    jf = jax.jit(lambda c: jax.lax.fori_loop(0, iters, fn, c))
+    out = jf(carry)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(jf(carry))
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:22s}{best / iters * 1000.0:9.1f} ms", flush=True)
+
+
+def main():
+    n = 10000
+    cfg = bench_cfg(n)
+    state = warm_state(cfg)
+    alive = jnp.ones((n,), bool)
+    view1 = jnp.ones((1, n), bool)
+    reach1 = jnp.ones((1, n), bool)
+    book, log = state.book, state.log
+    a = book.head.shape[1]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    kp = min(cfg.sync_actor_topk, a)
+    p_cnt = cfg.resolved_sync_peers
+    req = cfg.sync_req_actors or 2 * kp
+    kprime = min(req, kp * p_cnt, a)
+
+    # ---- stage: peer choice (book rides in the carry: closure constants
+    # of this size overflow the tunnel's compile-request body limit)
+    def peers_body(i, carry):
+        bk, key, acc = carry
+        key, sub = jax.random.split(key)
+        peer, granted = choose_sync_peers(
+            cfg, bk, sub, alive, view1, reach1, rtt=None
+        )
+        return bk, key, acc + peer.sum() + granted.sum()
+    timeit("choose_peers", peers_body,
+           (book, jax.random.PRNGKey(0), jnp.int32(0)))
+
+    # ---- stage: need plane (my_need + roll + cumsum)
+    def need_body(i, carry):
+        bk, key, acc = carry
+        key, sub = jax.random.split(key)
+        phase = jax.random.randint(sub, (), 0, a, dtype=jnp.int32)
+        my_need = jnp.maximum(log.head[None, :] - bk.head, 0)
+        rolled = jnp.roll(my_need, -phase, axis=1)
+        pos = rolled > 0
+        prank = jnp.cumsum(pos.astype(jnp.int32), axis=1) - 1
+        return bk, key, acc + prank[0, -1]
+    timeit("need+roll+cumsum", need_body,
+           (book, jax.random.PRNGKey(1), jnp.int32(0)))
+
+    # ---- stage: the (N,A)-update packed scatter
+    def scatter_body(i, carry):
+        bk, key, acc = carry
+        key, sub = jax.random.split(key)
+        phase = jax.random.randint(sub, (), 0, a, dtype=jnp.int32)
+        my_need = jnp.maximum(log.head[None, :] - bk.head, 0)
+        rolled = jnp.roll(my_need, -phase, axis=1)
+        pos = rolled > 0
+        prank = jnp.cumsum(pos.astype(jnp.int32), axis=1) - 1
+        actor_ids = (jnp.arange(a, dtype=jnp.int32) + phase) % a
+        sel = pos & (prank < kprime)
+        dest = jnp.where(sel, prank, kprime)
+        packed = jnp.zeros((n, kprime), jnp.int32).at[
+            rows[:, None], dest
+        ].set(jnp.broadcast_to(actor_ids[None, :] + 1, (n, a)), mode="drop")
+        return bk, key, acc + packed[0, 0]
+    timeit("schedule+scatter", scatter_body,
+           (book, jax.random.PRNGKey(2), jnp.int32(0)))
+
+    # ---- stage: searchsorted alternative (cumsum + batched binsearch)
+    def ss_body(i, carry):
+        bk, key, acc = carry
+        key, sub = jax.random.split(key)
+        phase = jax.random.randint(sub, (), 0, a, dtype=jnp.int32)
+        my_need = jnp.maximum(log.head[None, :] - bk.head, 0)
+        rolled = jnp.roll(my_need, -phase, axis=1)
+        pos = rolled > 0
+        csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A)
+        targets = jnp.arange(1, kprime + 1, dtype=jnp.int32)
+        idx = jax.vmap(
+            lambda c: jnp.searchsorted(c, targets, side="left")
+        )(csum).astype(jnp.int32)  # (N, K')
+        lane_ok = idx < a
+        topa = (jnp.where(lane_ok, idx, 0) + phase) % a
+        return bk, key, acc + topa[0, 0] + lane_ok[0, 0]
+    timeit("schedule+searchsort", ss_body,
+           (book, jax.random.PRNGKey(3), jnp.int32(0)))
+
+    # ---- stage: per-lane availability + slots + budget rank
+    key0 = jax.random.PRNGKey(4)
+    peer, granted = jax.jit(
+        lambda k: choose_sync_peers(cfg, book, k, alive, view1, reach1)
+    )(key0)
+    topa0 = jax.random.randint(jax.random.PRNGKey(5), (n, kprime), 0, a,
+                               dtype=jnp.int32)
+    def avail_body(i, carry):
+        bk, topa, acc = carry
+        my_head = bk.head[rows[:, None], topa]
+        ph = bk.head[peer[:, :, None], topa[:, None, :]]
+        delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
+        delta_p = jnp.where(granted[:, :, None], delta_p, 0)
+        slot, topv = choose_serving_slots(delta_p, topa, jnp.int32(i))
+        order = jnp.argsort(slot, axis=1, stable=True)
+        return bk, (topa + 1) % a, acc + slot[0, 0] + order[0, 0] + topv[0, 0]
+    timeit("avail+slots", avail_body, (book, topa0, jnp.int32(0)))
+
+    # ---- stage: advance_heads (floor scatter + absorb)
+    from corro_sim.core.bookkeeping import advance_heads
+    take0 = jnp.full((n, kprime), 2, jnp.int32)
+    def adv_body(i, carry):
+        bk = carry
+        base = bk.head[rows[:, None], topa0]
+        floor = bk.head.at[rows[:, None], topa0].max(base + take0)
+        return advance_heads(bk, floor, cfg.chunks_per_version)
+    timeit("advance_heads", adv_body, book)
+
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
