@@ -240,9 +240,13 @@ def sim_step(
         d = link_delay(cfg, src, dst)
         slot = state.round % cfg.inflight_slots
         mat = state.inflight[slot]  # (6, L) — lanes maturing this round
+        # A lane only parks if the link is up AT EMISSION — a send into a
+        # live partition fails immediately (the reference transport errors
+        # at send time); reach() is then re-checked at delivery below, so a
+        # partition landing mid-flight loses the lane too.
         inflight = state.inflight.at[slot].set(
             jnp.stack([dst, src, actor, ver, chunk,
-                       (valid & (d > 1)).astype(jnp.int32)])
+                       (valid & (d > 1) & reach(src, dst)).astype(jnp.int32)])
         )
         dst = jnp.concatenate([dst, mat[0]])
         src = jnp.concatenate([src, mat[1]])

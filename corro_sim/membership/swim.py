@@ -259,8 +259,14 @@ def swim_step(
             p = jnp.where(can, jnp.maximum(p, p[partner]), p)
         return p
 
+    # With swim_interval > 1 this step only runs on every k-th gossip
+    # round; gating on `round % announce_interval == 0` there would fire
+    # every lcm(k, announce_interval) rounds — up to k× rarer than
+    # configured, stretching the only partition-heal path. Fire instead on
+    # the one tick inside each announce window: exactly one firing per
+    # window while swim_interval <= announce_interval, every tick beyond.
     p = jax.lax.cond(
-        (round_idx % cfg.swim_announce_interval) == 0,
+        (round_idx % cfg.swim_announce_interval) < cfg.swim_interval,
         do_announce,
         lambda q: q,
         p,

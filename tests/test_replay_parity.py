@@ -1,0 +1,98 @@
+"""End-to-end replay parity fixture (VERDICT r2 next #7).
+
+``tests/fixtures/replay_parity.ndjson`` is a static changeset trace in the
+reference's broadcast wire shapes (``corro-types/src/broadcast.rs:113-132``,
+``Change`` per ``corro-api-types/src/lib.rs:235-245``) whose scenario and
+final-state expectations are transcribed from the reference's own agent
+tests and apply semantics:
+
+- two agents writing ``tests``/``tests3`` rows through their API, gossiping
+  and converging (``corro-agent/src/agent/tests.rs:49-270``
+  ``insert_rows_and_gossip``; schema ``corro-tests/src/lib.rs:13-30``);
+- a newer ``col_version`` beating an older write, and an equal-col_version
+  conflict resolved "biggest value wins" (``doc/crdts.md:15-17,237``);
+- a 4-cell transaction delivered as chunked partials that must buffer until
+  seq-complete (``process_incomplete_version``, ``agent/util.rs:1065-1180``);
+- a causal-length DELETE (cl 1 → 2) erasing a row despite concurrent
+  stale-generation cells (``doc/crdts.md:13``);
+- an ``Changeset::Empty`` compacting a fully-overwritten version
+  (``store_empty_changeset``, ``corro-types/src/change.rs:267-389``), which
+  must fast-forward bookkeeping without delivering cells.
+
+Every pk in the fixture is genuine ``pack_columns`` bytes
+(``corro-types/src/pubsub.rs:2388-2536``), so the replay exercises the
+native pk codec on its way to row slots.
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from corro_sim.engine.replay import read_table, replay
+from corro_sim.io.traces import ingest_file
+
+pytestmark = pytest.mark.quick
+
+FIXTURE = pathlib.Path(__file__).parent / "fixtures" / "replay_parity.ndjson"
+
+TA1 = "6b9f1a2e-0001-4000-8000-000000000001"
+TA2 = "6b9f1a2e-0002-4000-8000-000000000002"
+
+# Final converged state, hand-derived from the reference semantics above.
+EXPECTED = {
+    # ta2's update carried col_version=2 > ta1's insert at col_version=1
+    ("tests", (1,)): {"text": "hello world 1 bis"},
+    # equal col_version=2 on both writers -> biggest value wins
+    ("tests", (2,)): {"text": "zzz"},
+    # ta1 v4 ('three') was compacted by the EmptySet; v5 survives
+    ("tests", (3,)): {"text": "three v2"},
+    # tests3 row 1 was deleted (cl=2, even) -> absent entirely
+}
+
+
+def _trace():
+    return ingest_file(FIXTURE)
+
+
+def test_fixture_shape():
+    tr = _trace()
+    assert tr.actors == [TA1, TA2]
+    assert tr.rounds == 5  # ta1 head=5, ta2 head=4
+    assert tr.seqs_per_version == 4  # the 4-cell tests3 transaction
+    # ta2 v4 is a pure row delete
+    assert bool(tr.delete[3, 1])
+    # ta1 v4 arrives as a Full changeset but the later EmptySet clears it
+    assert bool(tr.empty[3, 0])
+
+
+def test_fixture_pk_bytes_are_reference_packed_format():
+    # Spot-check the raw fixture bytes against the pack_columns layout
+    # (pubsub.rs:2388-2536): [ncols][type_byte=(len<<3)|INTEGER][payload].
+    first = json.loads(FIXTURE.read_text().splitlines()[0])
+    assert first["changes"][0]["pk"] == [1, (1 << 3) | 1, 1]  # (1,)
+
+
+def test_replay_parity_final_state():
+    tr = _trace()
+    cfg = tr.suggest_config(
+        seqs_per_version=4,
+        chunks_per_version=2,  # 2 cells per gossip chunk -> partial buffering
+        fanout=2,
+        sync_interval=2,
+        pend_slots=8,
+    )
+    res = replay(tr, cfg, max_rounds=256)
+    assert not res.poisoned
+    assert res.converged_round is not None
+
+    for node in range(tr.num_actors):
+        assert read_table(res.state, tr, node) == EXPECTED, f"node {node}"
+
+    # Bookkeeping parity: the compacted version is cleared on the log,
+    # exactly one version slot (ta1 v4); the delete's ownership clearing
+    # compacted ta1 v2 (all four tests3 cells lost to the tombstone).
+    cleared = np.asarray(res.state.log.cleared)
+    assert bool(cleared[0, 3])  # ta1 v4 (slot = (4-1) % capacity)
+    assert bool(cleared[0, 1])  # ta1 v2 -> overwritten by the delete

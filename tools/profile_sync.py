@@ -13,8 +13,6 @@ import time
 import jax
 import jax.numpy as jnp
 
-from corro_sim.engine.driver import Schedule, _chunk_runner
-from corro_sim.engine.state import init_state
 from corro_sim.sync.sync import choose_serving_slots, choose_sync_peers
 import sys, os
 sys.path.insert(0, os.path.dirname(__file__))
