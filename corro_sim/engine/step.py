@@ -437,6 +437,7 @@ def sim_step(
             "sync_pairs": zero,
             "sync_versions": zero,
             "sync_empties": zero,
+            "sync_cells": zero,
         }
 
     book, table, hlc_s, last_cleared, sync_metrics = jax.lax.cond(
@@ -472,6 +473,9 @@ def sim_step(
         "delivered": delivered.sum(dtype=jnp.int32),
         "fresh": complete.sum(dtype=jnp.int32),
         "fresh_chunks": fresh_chunk.sum(dtype=jnp.int32),
+        # cell lanes merged off the gossip path — broadcast byte-volume
+        # signal (corro.broadcast.recv.bytes analog, metrics.rs)
+        "gossip_cells": cell_live.sum(dtype=jnp.int32),
         "buffered_partials": partial_versions(book, cpv),
         "dropped_window": dropped.sum(dtype=jnp.int32),
         "queue_overflow": gossip.overflow,

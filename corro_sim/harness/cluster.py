@@ -24,6 +24,7 @@ import collections
 import dataclasses
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -126,6 +127,11 @@ class LiveCluster:
         self._staging_overlay: tuple[dict, dict] | None = None
         self._rounds_ticked = 0
         self._totals: dict[str, float] = {}
+        # per-stage wall-clock (ms): {stage: (ewma, last)} — the live
+        # analog of tools/profile_round.py, cheap enough to always keep on
+        # (one perf_counter pair per stage per tick). Exposed on /metrics
+        # so BENCH regressions are explainable without re-profiling.
+        self._stage_ms: dict[str, tuple[float, float]] = {}
         self._gap = 0.0  # last round's convergence gap (metrics reuse)
         self._log_poisoned = False  # ring-wrap tripwire latched
         self._partials = 0.0  # last round's buffered-partial gauge
@@ -673,8 +679,22 @@ class LiveCluster:
             self._log_poisoned = True
         self._totals["rounds"] = self._rounds_ticked
 
+    def _observe_stage(self, stage: str, seconds: float, per: int = 1) -> None:
+        ms = seconds * 1000.0 / max(per, 1)
+        ewma, _ = self._stage_ms.get(stage, (ms, ms))
+        self._stage_ms[stage] = (ewma + 0.2 * (ms - ewma), ms)
+
+    def stage_timings(self) -> dict:
+        """{stage: {"ewma_ms": .., "last_ms": ..}} per-round wall by stage."""
+        with self._lock:
+            return {
+                k: {"ewma_ms": round(e, 3), "last_ms": round(l, 3)}
+                for k, (e, l) in self._stage_ms.items()
+            }
+
     def _tick_locked(self, rounds: int) -> None:
         for _ in range(rounds):
+            t0 = time.perf_counter()
             w = self._dequeue_writes()
             if w is None:
                 n, s = self.cfg.num_nodes, self.cfg.seqs_per_version
@@ -686,6 +706,8 @@ class LiveCluster:
                     np.zeros((n,), bool),
                     np.zeros((n,), np.int32),
                 )
+            self._observe_stage("dequeue", time.perf_counter() - t0)
+            t0 = time.perf_counter()
             key = jax.random.fold_in(self._root_key, self._rounds_ticked)
             self.state, metrics = self._step(
                 self.state,
@@ -702,8 +724,11 @@ class LiveCluster:
             packed = np.asarray(
                 jnp.stack([metrics[k].astype(jnp.float32) for k in names])
             )
+            self._observe_stage("step", time.perf_counter() - t0)
             self._record_metrics(packed[:, None], names)
+            t0 = time.perf_counter()
             self._notify_subs()
+            self._observe_stage("subs", time.perf_counter() - t0)
 
     def _tick_chunk_locked(self) -> None:
         """Advance _CHUNK rounds in ONE jitted dispatch (`lax.scan`).
@@ -715,7 +740,10 @@ class LiveCluster:
         candidate batching (1000 rows / 600 ms, ``pubsub.rs:1154-1296``) —
         but callers gate on _subs_active() to preserve per-round event
         granularity whenever someone is actually watching."""
+        t0 = time.perf_counter()
         w = self._dequeue_writes_chunk(_CHUNK)
+        self._observe_stage("dequeue", time.perf_counter() - t0, per=_CHUNK)
+        t0 = time.perf_counter()
         self.state, ms = self._multi_step(
             self.state,
             self._root_key,
@@ -729,8 +757,11 @@ class LiveCluster:
         packed = np.asarray(
             jnp.stack([ms[k].astype(jnp.float32) for k in names])
         )  # (num_metrics, _CHUNK) — still one transfer
+        self._observe_stage("chunk_step", time.perf_counter() - t0, per=_CHUNK)
         self._record_metrics(packed, names)
+        t0 = time.perf_counter()
         self._notify_subs()
+        self._observe_stage("subs", time.perf_counter() - t0)
 
     def _subs_active(self) -> bool:
         return len(self.subs) > 0 or bool(self._sub_queues)

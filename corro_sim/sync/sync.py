@@ -375,5 +375,8 @@ def sync_round(
         "sync_pairs": granted.sum(dtype=jnp.int32),
         "sync_versions": new_versions,
         "sync_empties": empties,
+        # cell lanes shipped by this sweep — the byte-volume signal
+        # (corro.sync.chunk.sent.bytes analog, metrics.rs)
+        "sync_cells": cell_live.sum(dtype=jnp.int32),
     }
     return book, table, hlc, last_cleared, metrics

@@ -92,3 +92,29 @@ def test_metrics_endpoint(cluster):
             body = resp.read().decode()
     assert "corro_changes_committed_total" in body
     assert "corro_db_versions_written" in body
+
+
+def test_byte_volume_and_stage_timing(cluster):
+    # VERDICT r2 #9: wire byte counters + per-stage round timing. The
+    # module fixture already committed a write and ran ticks (subscribe's
+    # catch-up), so stage timings exist and gossip moved bytes.
+    cluster.tick(4)
+    text = render_prometheus(cluster)
+    vals = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, _, val = line.rpartition(" ")
+            vals[key] = float(val)
+    assert vals["corro_broadcast_recv_bytes_total"] > 0
+    assert vals["corro_sync_chunk_sent_bytes_total"] >= 0
+    assert vals['corro_round_stage_ms{stage="step"}'] > 0
+    assert vals['corro_round_stage_ms{stage="step",window="last"}'] > 0
+    assert vals['corro_round_stage_ms{stage="dequeue"}'] >= 0
+    assert vals['corro_round_stage_ms{stage="subs"}'] >= 0
+    # counters survive the generic path too
+    assert vals["corro_broadcast_recv_cells_total"] >= 0
+
+    timings = cluster.stage_timings()
+    assert set(timings) >= {"step", "dequeue", "subs"}
+    for t in timings.values():
+        assert t["ewma_ms"] >= 0 and t["last_ms"] >= 0
