@@ -340,6 +340,45 @@ def strip_comments(sql: str) -> str:
     return "".join(out)
 
 
+_COPY_RE = re.compile(
+    r"COPY\s+(?:\(\s*(?P<query>.+?)\s*\)|"
+    r"(?P<table>[A-Za-z_]\w*)\s*(?:\(\s*(?P<cols>[^)]*?)\s*\))?)"
+    r"\s+TO\s+STDOUT"
+    r"(?:\s+(?:WITH\s+)?\(\s*(?P<opts>[^)]*?)\s*\))?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+
+
+def _copy_text_field(v, delim: str) -> str:
+    """COPY text-format cell: ``\\N`` for NULL, backslash escapes for
+    backslash/newline/CR/tab plus the delimiter (the format psql's
+    \\copy parses back)."""
+    if v is None:
+        return r"\N"
+    if isinstance(v, (bytes, bytearray)):
+        s = "\\x" + bytes(v).hex()
+    else:
+        s = str(v)
+    s = (s.replace("\\", "\\\\").replace("\n", "\\n")
+          .replace("\r", "\\r").replace("\t", "\\t"))
+    if delim not in ("\t", "\\"):  # tab/backslash already escaped above
+        s = s.replace(delim, "\\" + delim)
+    return s
+
+
+def _copy_csv_field(v, delim: str) -> str:
+    """COPY csv-format cell: empty for NULL, RFC-4180 quoting."""
+    if v is None:
+        return ""
+    if isinstance(v, (bytes, bytearray)):
+        s = "\\x" + bytes(v).hex()
+    else:
+        s = str(v)
+    if any(c in s for c in (delim, '"', "\n", "\r")):
+        return '"' + s.replace('"', '""') + '"'
+    return s
+
+
 _TAGS = {
     "INSERT": lambda n: f"INSERT 0 {n}",
     "UPDATE": lambda n: f"UPDATE {n}",
@@ -353,7 +392,7 @@ _TAGS = {
 
 _CATALOG_NAMES = frozenset(
     ("pg_type", "pg_class", "pg_namespace", "pg_database", "pg_attribute",
-     "pg_range"))
+     "pg_range", "pg_index", "pg_constraint"))
 
 
 def _catalog_tables(cluster) -> dict[str, tuple[list, list, list]]:
@@ -378,18 +417,48 @@ def _catalog_tables(cluster) -> dict[str, tuple[list, list, list]]:
     pg_namespace = (["oid", "nspname"],
                     [[11, "pg_catalog"], [2200, "public"]], [I8, TX])
     pg_database = (["oid", "datname"], [[1, "corro"]], [I8, TX])
+    typlen_of = {oid: tlen for _, oid, tlen in types}
     pg_attribute_rows = []
+    pg_index_rows = []
+    pg_constraint_rows = []
     for i, t in enumerate(tables):
         tbl = cluster.layout.schema.tables[t]
+        attnum = {c.name: j + 1 for j, c in enumerate(tbl.columns)}
+        # pk-declaration order, not column order: composite keys reflect
+        # as PRIMARY KEY (b, a) declared them (Table.pk preserves it)
+        pk_nums = [attnum[name] for name in tbl.pk]
         for j, col in enumerate(tbl.columns):
+            oid = _affinity_oid(col.type)
             pg_attribute_rows.append(
-                [16384 + i, col.name, j + 1, _affinity_oid(col.type)])
-    pg_attribute = (["attrelid", "attname", "attnum", "atttypid"],
-                    pg_attribute_rows, [I8, TX, I8, I8])
+                [16384 + i, col.name, j + 1, oid,
+                 typlen_of.get(oid, -1), -1,
+                 "t" if (col.primary_key or not col.nullable) else "f",
+                 "t" if col.default is not None else "f", "f"])
+        # WITHOUT ROWID pk as both an index and a 'p' constraint — the
+        # two places ORMs look for primary-key columns (corro-pg vtab
+        # analog: pg_index.indisprimary / pg_constraint.contype = 'p')
+        pg_index_rows.append(
+            [24576 + i, 16384 + i, len(pk_nums), "t", "t",
+             " ".join(str(x) for x in pk_nums)])
+        pg_constraint_rows.append(
+            [f"{t}_pkey", 16384 + i, "p", 2200,
+             "{" + ",".join(str(x) for x in pk_nums) + "}"])
+    pg_attribute = (
+        ["attrelid", "attname", "attnum", "atttypid", "attlen",
+         "atttypmod", "attnotnull", "atthasdef", "attisdropped"],
+        pg_attribute_rows, [I8, TX, I8, I8, I8, I8, TX, TX, TX])
+    pg_index = (
+        ["indexrelid", "indrelid", "indnatts", "indisunique",
+         "indisprimary", "indkey"],
+        pg_index_rows, [I8, I8, I8, TX, TX, TX])
+    pg_constraint = (
+        ["conname", "conrelid", "contype", "connamespace", "conkey"],
+        pg_constraint_rows, [TX, I8, TX, I8, TX])
     return {
         "pg_type": pg_type, "pg_class": pg_class,
         "pg_namespace": pg_namespace, "pg_database": pg_database,
         "pg_attribute": pg_attribute, "pg_range": (["rngtypid"], [], [I8]),
+        "pg_index": pg_index, "pg_constraint": pg_constraint,
     }
 
 
@@ -725,6 +794,8 @@ class _Session:
                     for i, v in enumerate(r)]))
             out.append(msg_command_complete(f"SELECT {len(rows)}"))
             return out
+        if kind == "COPY":
+            return self._exec_copy(sql)
         if kind in ("INSERT", "UPDATE", "DELETE"):
             n = self.run_write(sql)
             return [msg_command_complete(_TAGS[kind](n))]
@@ -742,6 +813,77 @@ class _Session:
             return [msg_command_complete("CREATE TABLE")]
         raise PgError("feature_not_supported",
                       f"statement kind {kind} is not supported")
+
+    def _exec_copy(self, sql: str) -> list[bytes]:
+        """``COPY (query) TO STDOUT`` / ``COPY table [(cols)] TO STDOUT``
+        with ``WITH (FORMAT text|csv [, HEADER])`` — the copy-out half of
+        the protocol (CopyOutResponse / CopyData / CopyDone). COPY FROM
+        STDIN is not accepted: writes go through INSERT like the
+        reference's pg surface (`corro-pg` exposes no COPY either; this
+        is the dump/export convenience ORMs and psql's \\copy use)."""
+        m = _COPY_RE.match(sql.rstrip().rstrip(";"))
+        if m is None:
+            if re.search(r"\bFROM\s+STDIN\b", sql, re.IGNORECASE):
+                raise PgError("feature_not_supported",
+                              "COPY FROM STDIN is not supported; use "
+                              "INSERT statements")
+            raise PgError("syntax_error", "invalid COPY syntax")
+        fmt, header, delim = "text", False, None
+        opts_s = (m.group("opts") or "").strip()
+        # quote-aware option scan: a comma inside '…' (e.g. DELIMITER ',')
+        # must not split the list
+        opt_pairs = re.findall(
+            r"([A-Za-z_]+)(?:\s+('(?:[^']|'')*'|[^\s,()]+))?\s*(?:,|$)",
+            opts_s) if opts_s else []
+        if opts_s and sum(
+                len(mm[0]) + len(mm[1]) for mm in opt_pairs) == 0:
+            raise PgError("syntax_error", "invalid COPY options")
+        for k, rawv in opt_pairs:
+            k = k.upper()
+            v = rawv.strip()
+            if v.startswith("'") and v.endswith("'") and len(v) >= 2:
+                v = v[1:-1].replace("''", "'")
+            if k == "FORMAT":
+                if v.lower() not in ("text", "csv"):
+                    raise PgError("feature_not_supported",
+                                  f'COPY format "{v}" not supported')
+                fmt = v.lower()
+            elif k == "HEADER":
+                header = v.lower() in ("", "true", "on", "1")
+            elif k == "DELIMITER":
+                if len(v) != 1:
+                    raise PgError("syntax_error",
+                                  "COPY delimiter must be a single "
+                                  "character")
+                delim = v
+            else:
+                raise PgError("syntax_error",
+                              f'unrecognized COPY option "{k}"')
+        if header and fmt != "csv":
+            raise PgError("feature_not_supported",
+                          "COPY HEADER available only in CSV mode")
+        if m.group("query"):
+            query = m.group("query")
+        else:
+            cols = m.group("cols")
+            cols = ", ".join(c.strip() for c in cols.split(",")) \
+                if cols else "*"
+            query = f"SELECT {cols} FROM {m.group('table')}"
+        fields, rows = self.run_select(query)
+        delim = delim or ("," if fmt == "csv" else "\t")
+        out = [_msg(b"H", struct.pack("!bH", 0, len(fields))
+                    + struct.pack(f"!{len(fields)}H", *([0] * len(fields))))]
+        if fmt == "csv" and header:
+            out.append(_msg(b"d", (delim.join(
+                _copy_csv_field(f[0], delim) for f in fields)
+                + "\n").encode()))
+        enc = _copy_csv_field if fmt == "csv" else _copy_text_field
+        for r in rows:
+            line = delim.join(enc(v, delim) for v in r)
+            out.append(_msg(b"d", (line + "\n").encode()))
+        out.append(_msg(b"c"))  # CopyDone
+        out.append(msg_command_complete(f"COPY {len(rows)}"))
+        return out
 
     def _exec_show(self, sql: str) -> list[bytes]:
         name = sql.split(None, 1)[1].strip().rstrip(";").lower() \
@@ -1158,16 +1300,22 @@ class SimplePgClient:
         return fields
 
     def query(self, sql: str):
-        """Simple protocol. Returns (fields, rows, tags, errors)."""
+        """Simple protocol. Returns (fields, rows, tags, errors).
+
+        COPY TO STDOUT data lines land in ``self.copy_lines`` (one str
+        per CopyData message, trailing newline stripped)."""
         body = _cstr(sql)
         self.sock.sendall(_msg(b"Q", body))
         fields, rows, tags, errors = [], [], [], []
+        self.copy_lines: list[str] = []
         while True:
             tag, b = self.read_msg()
             if tag == b"T":
                 fields = self._parse_fields(b)
             elif tag == b"D":
                 rows.append(self._decode_row(b, fields))
+            elif tag == b"d":  # CopyData
+                self.copy_lines.append(b.decode().rstrip("\n"))
             elif tag == b"C":
                 tags.append(b.rstrip(b"\x00").decode())
             elif tag == b"E":

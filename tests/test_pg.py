@@ -499,3 +499,79 @@ def test_bytea_param_roundtrip(server):
     _, rows, _, _ = c.query("SELECT k FROM blobs WHERE data = X'deadbeef'")
     assert rows == [[2]]
     c.close()
+
+
+# ------------------------------------------------------------- COPY out
+
+def test_copy_table_to_stdout(client):
+    client.query("INSERT INTO users (id, name, score) VALUES (10, 'cp', 1.5)")
+    _, _, tags, errors = client.query("COPY users TO STDOUT")
+    assert not errors
+    assert any(t.startswith("COPY ") for t in tags)
+    assert any(line.split("\t")[0] == "10" for line in client.copy_lines)
+
+
+def test_copy_query_csv_header(client):
+    client.query(
+        "INSERT INTO users (id, name, score) VALUES (11, 'a,b', 2.0)")
+    _, _, tags, errors = client.query(
+        "COPY (SELECT id, name FROM users WHERE id = 11) TO STDOUT "
+        "WITH (FORMAT csv, HEADER)")
+    assert not errors
+    assert tags[-1] == "COPY 1"
+    assert client.copy_lines[0] == "id,name"
+    assert client.copy_lines[1] == '11,"a,b"'  # delimiter forces quoting
+
+
+def test_copy_column_list_and_escapes(client):
+    client.query(
+        "INSERT INTO users (id, name, score) VALUES (12, 'x\ty', 0.0)")
+    _, _, tags, errors = client.query("COPY users (name, id) TO STDOUT")
+    assert not errors
+    lines = [l for l in client.copy_lines if l.endswith("\t12")]
+    assert lines and lines[0] == "x\\ty\t12"  # tab escaped, column order kept
+
+
+def test_copy_from_stdin_rejected(client):
+    _, _, _, errors = client.query("COPY users FROM STDIN")
+    assert errors and errors[0]["C"] == "0A000"  # feature_not_supported
+
+
+# -------------------------------------------- catalog introspection depth
+
+def test_pg_attribute_notnull_and_pk(client):
+    fields, rows, _, errors = client.query(
+        "SELECT attname, attnotnull, atthasdef FROM pg_attribute "
+        "ORDER BY attnum")
+    assert not errors
+    byname = {r[0]: (r[1], r[2]) for r in rows}
+    assert byname["id"][0] == "t"      # pk -> not null
+    assert byname["name"] == ("t", "t")  # NOT NULL DEFAULT ''
+    assert byname["score"][0] == "t"
+
+
+def test_pg_index_and_constraint_pk(client):
+    _, rows, _, errors = client.query(
+        "SELECT indrelid, indisprimary, indkey FROM pg_index")
+    assert not errors
+    assert rows and rows[0][1] == "t" and rows[0][2] == "1"
+
+    _, rows, _, errors = client.query(
+        "SELECT conname, contype, conkey FROM pg_constraint")
+    assert not errors
+    assert rows[0][0] == "users_pkey"
+    assert rows[0][1] == "p"
+    assert rows[0][2] == "{1}"
+
+
+def test_copy_quoted_comma_delimiter_and_text_header(client):
+    client.query("INSERT INTO users (id, name) VALUES (13, 'dl')")
+    _, _, tags, errors = client.query(
+        "COPY (SELECT id, name FROM users WHERE id = 13) TO STDOUT "
+        "WITH (FORMAT csv, DELIMITER ',')")
+    assert not errors and tags[-1] == "COPY 1"
+    assert client.copy_lines == ["13,dl"]
+    # HEADER outside CSV mode is an error, not silently ignored
+    _, _, _, errors = client.query(
+        "COPY users TO STDOUT WITH (FORMAT text, HEADER)")
+    assert errors and errors[0]["C"] == "0A000"

@@ -1,25 +1,47 @@
 """Sub-stage timing inside the anti-entropy sweep at 10k nodes.
 
-The round profile (tools/profile_round.py) shows the sweep at ~970 ms;
-this breaks it into: peer choice, the request schedule (roll + cumsum +
-the (N,A)-update scatter), the per-lane availability gathers, and the
-transfer+merge tail — so the rewrite targets the right kernel.
+The round profile (tools/profile_round.py) shows the sweep as the
+dominant stage of a sync round; this breaks it into the stages of the
+CURRENT scatter-free formulation — peer choice, the request schedule
+(roll + cumsum + batched binary search), the per-lane availability
+gathers + serving slots, the changeset gather + CRDT merge, and
+advance_heads — plus the full sync_round as ground truth that the parts
+sum to the whole.
+
+Large pytrees (book, log, table) always ride in the fori_loop carry:
+closure constants of (N, A) size overflow the axon tunnel's
+compile-request body limit (HTTP 413).
+
+Usage::
+
+    python tools/profile_sync.py [--json]
 """
 
 from __future__ import annotations
 
+import argparse
+import json
 import time
 
 import jax
 import jax.numpy as jnp
 
-from corro_sim.sync.sync import choose_serving_slots, choose_sync_peers
+from corro_sim.core.bookkeeping import advance_heads
+from corro_sim.core.changelog import gather_changesets
+from corro_sim.core.crdt import NEG, apply_cell_changes
+from corro_sim.sync.sync import (
+    choose_serving_slots,
+    choose_sync_peers,
+    sync_round,
+)
 import sys, os
 sys.path.insert(0, os.path.dirname(__file__))
 from profile_round import bench_cfg, warm_state
 
+RESULTS: dict[str, float] = {}
 
-def timeit(name, fn, carry, iters=8, reps=3):
+
+def timeit(name, fn, carry, iters=8, reps=3, quiet=False):
     jf = jax.jit(lambda c: jax.lax.fori_loop(0, iters, fn, c))
     out = jf(carry)
     jax.block_until_ready(out)
@@ -28,26 +50,45 @@ def timeit(name, fn, carry, iters=8, reps=3):
         t0 = time.perf_counter()
         jax.block_until_ready(jf(carry))
         best = min(best, time.perf_counter() - t0)
-    print(f"{name:22s}{best / iters * 1000.0:9.1f} ms", flush=True)
+    RESULTS[name] = best / iters * 1000.0
+    if not quiet:
+        print(f"{name:22s}{RESULTS[name]:9.1f} ms", flush=True)
 
 
 def main():
-    n = 10000
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10000)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    n = args.nodes
     cfg = bench_cfg(n)
     state = warm_state(cfg)
     alive = jnp.ones((n,), bool)
     view1 = jnp.ones((1, n), bool)
     reach1 = jnp.ones((1, n), bool)
-    book, log = state.book, state.log
+    book, log, table = state.book, state.log, state.table
     a = book.head.shape[1]
     rows = jnp.arange(n, dtype=jnp.int32)
     kp = min(cfg.sync_actor_topk, a)
     p_cnt = cfg.resolved_sync_peers
     req = cfg.sync_req_actors or 2 * kp
     kprime = min(req, kp * p_cnt, a)
+    cap = cfg.sync_cap_per_actor
 
-    # ---- stage: peer choice (book rides in the carry: closure constants
-    # of this size overflow the tunnel's compile-request body limit)
+    # ---- ground truth: the whole sweep
+    def sweep_body(i, carry):
+        bk, tbl, key, acc = carry
+        key, sub = jax.random.split(key)
+        bk, tbl, hlc, lc, m = sync_round(
+            cfg, bk, log, tbl, state.hlc, state.last_cleared,
+            state.cleared_hlc, sub, alive, view1, reach1,
+        )
+        return bk, tbl, key, acc + m["sync_versions"]
+    timeit("sync_round_full", sweep_body,
+           (book, table, jax.random.PRNGKey(9), jnp.int32(0)))
+
+    # ---- stage: peer choice
     def peers_body(i, carry):
         bk, key, acc = carry
         key, sub = jax.random.split(key)
@@ -66,31 +107,12 @@ def main():
         my_need = jnp.maximum(log.head[None, :] - bk.head, 0)
         rolled = jnp.roll(my_need, -phase, axis=1)
         pos = rolled > 0
-        prank = jnp.cumsum(pos.astype(jnp.int32), axis=1) - 1
-        return bk, key, acc + prank[0, -1]
+        csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)
+        return bk, key, acc + csum[0, -1]
     timeit("need+roll+cumsum", need_body,
            (book, jax.random.PRNGKey(1), jnp.int32(0)))
 
-    # ---- stage: the (N,A)-update packed scatter
-    def scatter_body(i, carry):
-        bk, key, acc = carry
-        key, sub = jax.random.split(key)
-        phase = jax.random.randint(sub, (), 0, a, dtype=jnp.int32)
-        my_need = jnp.maximum(log.head[None, :] - bk.head, 0)
-        rolled = jnp.roll(my_need, -phase, axis=1)
-        pos = rolled > 0
-        prank = jnp.cumsum(pos.astype(jnp.int32), axis=1) - 1
-        actor_ids = (jnp.arange(a, dtype=jnp.int32) + phase) % a
-        sel = pos & (prank < kprime)
-        dest = jnp.where(sel, prank, kprime)
-        packed = jnp.zeros((n, kprime), jnp.int32).at[
-            rows[:, None], dest
-        ].set(jnp.broadcast_to(actor_ids[None, :] + 1, (n, a)), mode="drop")
-        return bk, key, acc + packed[0, 0]
-    timeit("schedule+scatter", scatter_body,
-           (book, jax.random.PRNGKey(2), jnp.int32(0)))
-
-    # ---- stage: searchsorted alternative (cumsum + batched binsearch)
+    # ---- stage: schedule = need plane + batched binary search (current)
     def ss_body(i, carry):
         bk, key, acc = carry
         key, sub = jax.random.split(key)
@@ -100,35 +122,76 @@ def main():
         pos = rolled > 0
         csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A)
         targets = jnp.arange(1, kprime + 1, dtype=jnp.int32)
-        idx = jax.vmap(
-            lambda c: jnp.searchsorted(c, targets, side="left")
-        )(csum).astype(jnp.int32)  # (N, K')
-        lane_ok = idx < a
-        topa = (jnp.where(lane_ok, idx, 0) + phase) % a
+        lo = jnp.zeros((n, kprime), jnp.int32)
+        hi = jnp.full((n, kprime), a, jnp.int32)
+        for _ in range(a.bit_length()):
+            mid = (lo + hi) >> 1
+            cm = jnp.take_along_axis(csum, jnp.minimum(mid, a - 1), axis=1)
+            ge = cm >= targets[None, :]
+            hi = jnp.where(ge, mid, hi)
+            lo = jnp.where(ge, lo, mid + 1)
+        lane_ok = hi < a
+        topa = (jnp.where(lane_ok, hi, 0) + phase) % a
         return bk, key, acc + topa[0, 0] + lane_ok[0, 0]
-    timeit("schedule+searchsort", ss_body,
+    timeit("schedule+binsearch", ss_body,
            (book, jax.random.PRNGKey(3), jnp.int32(0)))
 
     # ---- stage: per-lane availability + slots + budget rank
-    key0 = jax.random.PRNGKey(4)
-    peer, granted = jax.jit(
-        lambda k: choose_sync_peers(cfg, book, k, alive, view1, reach1)
-    )(key0)
     topa0 = jax.random.randint(jax.random.PRNGKey(5), (n, kprime), 0, a,
                                dtype=jnp.int32)
     def avail_body(i, carry):
-        bk, topa, acc = carry
+        bk, peer, granted, topa, acc = carry
         my_head = bk.head[rows[:, None], topa]
         ph = bk.head[peer[:, :, None], topa[:, None, :]]
         delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
         delta_p = jnp.where(granted[:, :, None], delta_p, 0)
         slot, topv = choose_serving_slots(delta_p, topa, jnp.int32(i))
         order = jnp.argsort(slot, axis=1, stable=True)
-        return bk, (topa + 1) % a, acc + slot[0, 0] + order[0, 0] + topv[0, 0]
-    timeit("avail+slots", avail_body, (book, topa0, jnp.int32(0)))
+        return bk, peer, granted, (topa + 1) % a, \
+            acc + slot[0, 0] + order[0, 0] + topv[0, 0]
 
-    # ---- stage: advance_heads (floor scatter + absorb)
-    from corro_sim.core.bookkeeping import advance_heads
+    def mk_peers(bk, k):
+        return choose_sync_peers(cfg, bk, k, alive, view1, reach1)
+    peer, granted = jax.jit(mk_peers)(book, jax.random.PRNGKey(4))
+    timeit("avail+slots", avail_body,
+           (book, peer, granted, topa0, jnp.int32(0)))
+
+    # ---- stage: changeset gather + CRDT merge over the (N,K',cap) lanes
+    s = log.seqs
+    offs = jnp.arange(1, cap + 1, dtype=jnp.int32)
+    def gather_body(i, carry):
+        bk, tbl, topa, acc = carry
+        base = bk.head[rows[:, None], topa]
+        ver = base[:, :, None] + offs[None, None, :]
+        lane_valid = ver <= log.head[topa][:, :, None]
+        actor_l = jnp.broadcast_to(topa[:, :, None], ver.shape).reshape(-1)
+        ver_l = ver.reshape(-1)
+        valid_l = lane_valid.reshape(-1)
+        dst_l = jnp.broadcast_to(
+            rows[:, None, None], ver.shape).reshape(-1)
+        row, col, vr, cv, cl, ncells = gather_changesets(
+            log, jnp.where(valid_l, actor_l, 0), jnp.maximum(ver_l, 1)
+        )
+        m = dst_l.shape[0]
+        cell_live = (
+            valid_l[:, None]
+            & (jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None])
+        )
+        site_l = jnp.where(
+            vr == NEG, NEG, jnp.broadcast_to(actor_l[:, None], (m, s))
+        )
+        tbl = apply_cell_changes(
+            tbl,
+            jnp.broadcast_to(dst_l[:, None], (m, s)).reshape(-1),
+            row.reshape(-1), col.reshape(-1), cv.reshape(-1),
+            vr.reshape(-1), site_l.reshape(-1), cl.reshape(-1),
+            cell_live.reshape(-1),
+        )
+        return bk, tbl, (topa + 1) % a, acc + ncells.sum()
+    timeit("gather+merge", gather_body,
+           (book, table, topa0, jnp.int32(0)))
+
+    # ---- stage: advance_heads (floor scatter + window absorb)
     take0 = jnp.full((n, kprime), 2, jnp.int32)
     def adv_body(i, carry):
         bk = carry
@@ -137,6 +200,11 @@ def main():
         return advance_heads(bk, floor, cfg.chunks_per_version)
     timeit("advance_heads", adv_body, book)
 
+    if args.json:
+        print(json.dumps({
+            "nodes": n,
+            "stages_ms": {k: round(v, 2) for k, v in RESULTS.items()},
+        }))
     return 0
 
 
