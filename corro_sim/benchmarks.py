@@ -151,12 +151,25 @@ def run_north_star(n: int | None = None) -> dict:
         zipf_alpha=0.8,
         swim_enabled=True,
         swim_suspect_rounds=6,
+        # foca probes every 1-5 s vs the 500 ms broadcast flush; ticking
+        # SWIM every 4th gossip round is inside the faithful ratio and
+        # cuts the (N, N) plane traffic 4x (config.swim_interval)
+        swim_interval=4,
         sync_interval=8,
-        sync_actor_topk=32,
-        sync_cap_per_actor=8,
-        sync_req_actors=32,  # lean request lanes: the 1k-write workload's
-        # needs are sparse; padded lanes are pure overhead at 10k
+        # activity-reset cadence (util.rs:327-371): post-quiesce repair
+        # sweeps run every round instead of every 8th
+        sync_adaptive=True,
+        # version-granular budget: this workload leaves each actor ≤2-3
+        # versions behind, so wide per-actor caps are dead lanes — spend
+        # the same lane budget on MORE actors per sweep instead
+        # (64 actors × 2 versions vs the r2 32 × 8)
+        sync_actor_topk=64,
+        sync_cap_per_actor=2,
+        sync_req_actors=64,
         sync_need_sample=64,
+        # shallow per-actor needs (<=2-3 versions behind) -> probe
+        # dealing matches argmax throughput at a fraction of the cost
+        sync_deal_probes=2,
     )
 
     def part_fn(r, num):

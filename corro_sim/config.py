@@ -65,6 +65,15 @@ class SimConfig:
     # per-connection budget (parallel headroom without paying full P×
     # lane memory/compute every sweep — lanes are padded to this shape
     # whether needed or not). Clamped to sync_actor_topk × peers.
+    sync_deal_probes: int = 0  # serving-slot assignment policy. 0 = exact
+    # argmax over every granted peer's capability per lane (full
+    # (N, P, K') head gather + argsort budget rank — best repair depth,
+    # needed when per-actor backlogs are deep and asymmetric). k >= 1 =
+    # deal lanes round-robin across granted slots (the reference's
+    # shuffled request dealing, api/peer.rs:1241-1372) and probe only k
+    # candidate slots per lane — with shallow per-actor needs (the
+    # convergence-tail regime) k=2 matches argmax throughput at ~1/6 the
+    # sweep-schedule cost on the real chip.
     sync_need_sample: int = 256  # actors sampled for need estimation
 
     # --- SWIM membership (foca analog) ---

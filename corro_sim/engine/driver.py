@@ -59,8 +59,10 @@ class RunResult:
     metrics: dict  # name -> (rounds,) np.ndarray
     rounds: int
     converged_round: int | None
-    wall_seconds: float  # steady-state only (first chunk excluded)
-    compile_seconds: float  # first chunk: compile + execute
+    wall_seconds: float  # execution wall over timed_rounds (all chunks
+    # when AOT compile succeeded; first chunk excluded on fallback)
+    compile_seconds: float  # AOT lower+compile (or chunk-0 mixed on
+    # fallback backends)
     timed_rounds: int = 0
     poisoned: bool = False  # change-log ring wrapped past a live laggard —
     # state may be silently wrong; convergence is never reported
@@ -125,24 +127,46 @@ def run_sim(
     compile_seconds = 0.0
     wall = 0.0
 
-    # The first chunk both compiles and executes — its elapsed time is
-    # recorded as compile_seconds and excluded from the steady-state wall
-    # clock, but its rounds/metrics are real (with donation enabled the
-    # warm-up consumes the input buffers, so it cannot be a throwaway).
+    # Compile is separated from execution by AOT-lowering the chunk
+    # program up front, so EVERY chunk's wall (including the first —
+    # typically the cheap write-phase rounds) counts at its true
+    # execution cost. The old scheme excluded chunk 0 wholesale as
+    # "compile", which over-reported wall/round whenever the first chunk
+    # was the cheapest (wall/round then averaged only the sync-heavy
+    # tail but was multiplied by ALL rounds in wall-clock totals).
+    compiled = None
     ci = 0
     while rounds < max_rounds:
         alive, part, we = schedule.slice(rounds, chunk, cfg.num_nodes)
         keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
-        t0 = time.perf_counter()
-        state, m = runner(
-            state, keys, jnp.asarray(alive), jnp.asarray(part), jnp.asarray(we)
+        args = (
+            state, keys, jnp.asarray(alive), jnp.asarray(part),
+            jnp.asarray(we),
         )
-        m = jax.tree.map(np.asarray, m)  # forces device sync
-        elapsed = time.perf_counter() - t0
         if ci == 0:
-            compile_seconds = elapsed
+            t0 = time.perf_counter()
+            try:
+                compiled = runner.lower(*args).compile()
+                compile_seconds = time.perf_counter() - t0
+            except Exception:  # AOT unsupported on some backend
+                compiled = None
+        if compiled is None:
+            # fallback: chunk 0 pays compile+exec mixed and is excluded
+            # from the steady-state wall (the pre-AOT accounting)
+            t0 = time.perf_counter()
+            state, m = runner(*args)
+            m = jax.tree.map(np.asarray, m)
+            elapsed = time.perf_counter() - t0
+            if ci == 0:
+                compile_seconds = elapsed
+            else:
+                wall += elapsed
+                timed_rounds += chunk
         else:
-            wall += elapsed
+            t0 = time.perf_counter()
+            state, m = compiled(*args)
+            m = jax.tree.map(np.asarray, m)  # forces device sync
+            wall += time.perf_counter() - t0
             timed_rounds += chunk
         metrics_chunks.append(m)
         rounds += chunk

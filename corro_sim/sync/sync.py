@@ -140,9 +140,14 @@ def choose_serving_slots(
     lane, the global range dedupe of ``api/peer.rs:1179-1372``: no two
     peers ever serve the same range. The furthest-ahead granted peer wins;
     TIES round-robin across the eligible slots (actor id + sweep phase mod
-    eligible count) — the reference shuffles chunked needs and deals them
-    round-robin across peers, so equally-capable peers share the load
-    rather than funneling through slot 0.
+    eligible count) — so equally-capable peers share the load rather than
+    funneling through slot 0.
+
+    This is the exact argmax assignment (``sync_deal_probes = 0``): best
+    repair depth per lane, at the cost of the full (N, P, K') capability
+    gather its caller builds. The probe-dealing alternative
+    (:func:`deal_serving_slots`) approximates it at a fraction of the
+    cost when per-actor backlogs are shallow.
 
     ``delta_p``: (N, P, K') versions each granted peer could serve of each
     requested actor (0 where not granted / not ahead). Returns (N, K')
@@ -159,6 +164,39 @@ def choose_serving_slots(
         slot = jnp.where(elig[:, p] & (cum == k_tie), p, slot)
         cum += elig[:, p].astype(jnp.int32)
     return slot, best
+
+
+def deal_serving_slots(
+    granted: jnp.ndarray, phase, kprime: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(slot, rank_in_slot) — deal request lanes round-robin across each
+    node's GRANTED peer slots, the reference's request scheduler: chunked
+    needs are shuffled and dealt round-robin over the sync peers
+    (``api/peer.rs:1241-1372``), and no two peers are ever dealt the same
+    lane (the global range dedupe of ``peer.rs:1179-1372``).
+
+    Lane k goes to the ``(k + phase) mod g``-th granted slot (g = that
+    node's granted count); nodes with nothing granted get the sentinel
+    ``p_cnt`` on every lane. ``rank_in_slot`` is the lane's position
+    among its slot's dealt lanes (``k // g`` under uniform dealing) —
+    the per-connection budget rank, by arithmetic instead of the argsort
+    the argmax assignment needed. Whether the dealt peer can actually
+    serve the lane is the caller's ONE capability gather; a lane whose
+    peer cannot serve dies for this sweep and re-deals next sweep under
+    a new phase (the argmax form picked the furthest-ahead peer per lane
+    but paid a full (N, P, K') head gather + (N, K') argsort — measured
+    ~99 ms of the 376 ms sweep at 10k; this is pure VPU arithmetic)."""
+    n, p_cnt = granted.shape
+    gcount = granted.sum(axis=1, dtype=jnp.int32)  # (N,)
+    grank = jnp.cumsum(granted.astype(jnp.int32), axis=1) - 1  # (N, P)
+    lanes = jnp.arange(kprime, dtype=jnp.int32)[None, :]
+    j = (lanes + phase) % jnp.maximum(gcount, 1)[:, None]  # (N, K')
+    slot = jnp.full((n, kprime), p_cnt, jnp.int32)
+    for p in range(p_cnt):
+        match = granted[:, p:p + 1] & (grank[:, p:p + 1] == j)
+        slot = jnp.where(match, p, slot)
+    rank_in_slot = lanes // jnp.maximum(gcount, 1)[:, None]
+    return slot, rank_in_slot
 
 
 def sync_round(
@@ -231,64 +269,88 @@ def sync_round(
     #    positives. Rotated round-robin is what the reference's shuffled
     #    request scheduler does anyway (chunked needs are SHUFFLED and
     #    dealt round-robin, peer.rs:1241-1372 — not served largest-first).
-    #    The k-th selected actor is recovered by a batched binary search
-    #    of k in the per-row inclusive cumsum of the need mask: N·K'·log A
-    #    gathered elements (~4.5M at 10k) instead of N·A scatter lanes.
+    #    The k-th selected actor is recovered from the per-row inclusive
+    #    cumsum of the need mask by a fused compare-reduce: for monotone
+    #    csum, (first index with csum >= k) == #{j : csum[j] < k}, so ONE
+    #    reduction over the actor axis answers every target at once. XLA
+    #    fuses the (N, A, K') compare into the reduce loop — the csum
+    #    plane streams through once (~26 ms at 10k on the real chip) —
+    #    where a batched binary search pays ceil(log2 A) = 14 rounds of
+    #    per-element take_along_axis gathers (~102 ms measured; TPU
+    #    random gathers are slow, streaming reduces are fast).
     phase = jax.random.randint(k_phase, (), 0, a, dtype=jnp.int32)
     my_need = jnp.maximum(log.head[None, :] - book.head, 0)  # (N, A)
     rolled = jnp.roll(my_need, -phase, axis=1)
     pos = rolled > 0
     csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A) inclusive
     targets = jnp.arange(1, kprime + 1, dtype=jnp.int32)  # (K',)
-    # manual batched binary search: first index with csum >= k, unrolled
-    # ceil(log2 A) halvings of (N, K') bounds with one small
-    # take_along_axis gather each — vmapped jnp.searchsorted lowers to a
-    # broadcast compare over (N, K', A) (~100 ms at 10k; this is <5 ms)
-    lo = jnp.zeros((n, kprime), jnp.int32)
-    hi = jnp.full((n, kprime), a, jnp.int32)
-    for _ in range(a.bit_length()):  # search space is a+1 values
-        mid = (lo + hi) >> 1
-        cm = jnp.take_along_axis(csum, jnp.minimum(mid, a - 1), axis=1)
-        ge = cm >= targets[None, :]
-        hi = jnp.where(ge, mid, hi)
-        lo = jnp.where(ge, lo, mid + 1)
-    idx = hi  # (N, K') — rotated index of the k-th positive; a = unfilled
+    idx = jnp.sum(
+        csum[:, :, None] < targets[None, None, :], axis=1, dtype=jnp.int32
+    )  # (N, K') — rotated index of the k-th positive; a = unfilled
     lane_ok = idx < a
     topa = (jnp.where(lane_ok, idx, 0) + phase) % a
 
-    # 2. Peer availability for ONLY the selected lanes: what each granted
-    #    peer can actually serve of each requested actor (their haves
-    #    minus ours) — an (N, P, K') gather, thousands of times smaller
-    #    than the full head-plane exchange.
+    # 2.+3. One serving slot per lane. Two statically-selected policies
+    #    (cfg.sync_deal_probes; see config.py for the trade-off):
     my_head = book.head[rows[:, None], topa]  # (N, K')
-    ph = book.head[peer[:, :, None], topa[:, None, :]]  # (N, P, K')
-    delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
-    delta_p = jnp.where(granted[:, :, None], delta_p, 0)
-
-    # 3. One serving slot per lane (global range dedupe, with round-robin
-    #    tie-breaking across equally-capable peers). Dead lanes (unfilled,
-    #    or no granted peer can serve them) get the sentinel slot p_cnt so
-    #    they sort into their own budget group — defaulting them to slot 0
-    #    would consume that connection's kp budget and crowd out lanes the
-    #    slot-0 peer could actually serve.
-    slot, topv = choose_serving_slots(delta_p, topa, phase)
-    slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
-
-    # rank of each lane within its slot group (lanes are in rotated scan
-    # order; the budget keeps the first kp per slot — round-robin service)
-    order = jnp.argsort(slot, axis=1, stable=True)
-    s_sorted = jnp.take_along_axis(slot, order, 1)
-    idx = jnp.broadcast_to(
-        jnp.arange(kprime, dtype=jnp.int32)[None, :], (n, kprime)
-    )
-    newgrp = jnp.concatenate(
-        [jnp.ones((n, 1), bool), s_sorted[:, 1:] != s_sorted[:, :-1]], axis=1
-    )
-    grp_start = jax.lax.cummax(jnp.where(newgrp, idx, 0), axis=1)
-    rank_in_slot = jnp.zeros((n, kprime), jnp.int32).at[
-        rows[:, None], order
-    ].set(idx - grp_start)
-    within_budget = rank_in_slot < kp
+    if cfg.sync_deal_probes:
+        # Deal lanes round-robin across granted slots (global range
+        # dedupe: one slot per lane — the reference's shuffled request
+        # dealing, peer.rs:1241-1372), then probe the capability of k
+        # candidate slots per lane and serve from the furthest-ahead —
+        # each probe is one (N, K') gather of the peer's head for the
+        # lane's actor (their haves minus ours,
+        # compute_available_needs sync.rs:127-249, restricted to the
+        # lane). With granted count <= probes this IS the argmax; a
+        # lane no probe can serve dies this sweep and re-deals under a
+        # fresh phase next sweep. Budget rank is arithmetic on the
+        # primary dealing (lane // granted-count): dead lanes consume
+        # budget, and a connection may serve a neighbor-dealt lane, so
+        # a slot's served count is bounded by probes x its chunk
+        # budget — and there is no (N, K') argsort.
+        slot, rank_in_slot = deal_serving_slots(granted, phase, kprime)
+        topv = jnp.zeros((n, kprime), jnp.int32)
+        for i in range(min(cfg.sync_deal_probes, p_cnt)):
+            slot_i, _ = deal_serving_slots(granted, phase + i, kprime)
+            peer_i = peer[rows[:, None], jnp.minimum(slot_i, p_cnt - 1)]
+            tv_i = jnp.where(
+                slot_i < p_cnt,
+                jnp.maximum(book.head[peer_i, topa] - my_head, 0), 0,
+            )
+            slot = jnp.where(tv_i > topv, slot_i, slot)
+            topv = jnp.maximum(tv_i, topv)
+        slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
+        within_budget = rank_in_slot < kp
+    else:
+        # Exact argmax: what each granted peer can serve of each
+        # requested actor — an (N, P, K') gather — then the
+        # furthest-ahead assignment with round-robin tie-breaking.
+        # Dead lanes (unfilled, or no granted peer can serve them) get
+        # the sentinel slot p_cnt so they sort into their own budget
+        # group — defaulting them to slot 0 would consume that
+        # connection's kp budget and crowd out lanes the slot-0 peer
+        # could actually serve.
+        ph = book.head[peer[:, :, None], topa[:, None, :]]  # (N, P, K')
+        delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
+        delta_p = jnp.where(granted[:, :, None], delta_p, 0)
+        slot, topv = choose_serving_slots(delta_p, topa, phase)
+        slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
+        # rank of each lane within its slot group (lanes are in rotated
+        # scan order; the budget keeps the first kp per slot)
+        order = jnp.argsort(slot, axis=1, stable=True)
+        s_sorted = jnp.take_along_axis(slot, order, 1)
+        idx2 = jnp.broadcast_to(
+            jnp.arange(kprime, dtype=jnp.int32)[None, :], (n, kprime)
+        )
+        newgrp = jnp.concatenate(
+            [jnp.ones((n, 1), bool), s_sorted[:, 1:] != s_sorted[:, :-1]],
+            axis=1,
+        )
+        grp_start = jax.lax.cummax(jnp.where(newgrp, idx2, 0), axis=1)
+        rank_in_slot = jnp.zeros((n, kprime), jnp.int32).at[
+            rows[:, None], order
+        ].set(idx2 - grp_start)
+        within_budget = rank_in_slot < kp
 
     # adaptive chunk sizing (peer.rs:345-349): the reference halves its
     # send buffer 8 KiB → ≥1 KiB as a link slows; here a slow (high
@@ -299,7 +361,9 @@ def sync_round(
         raw = rtt[rows[:, None], peer].astype(jnp.int32)  # (N, P)
         delay = jnp.where(raw == 255, 1, jnp.minimum(raw, 4))
         cap_slot = jnp.maximum(cap >> jnp.maximum(delay - 1, 0), 1)
-        cap_lane = cap_slot[rows[:, None], slot]  # (N, K')
+        # sentinel slots clamp to the last peer; harmless — their topv
+        # is 0 so take is 0 regardless of cap
+        cap_lane = cap_slot[rows[:, None], jnp.minimum(slot, p_cnt - 1)]
     else:
         cap_lane = cap
     take = jnp.where(

@@ -17,7 +17,7 @@ from corro_sim.core.changelog import make_changelog
 from corro_sim.core.crdt import make_table_state
 from corro_sim.engine.driver import Schedule, run_sim
 from corro_sim.engine.state import init_state
-from corro_sim.sync.sync import choose_serving_slots, choose_sync_peers, sync_round
+from corro_sim.sync.sync import choose_sync_peers, deal_serving_slots, sync_round
 
 
 def test_resolved_sync_peers_matches_reference_formula():
@@ -30,30 +30,38 @@ def test_resolved_sync_peers_matches_reference_formula():
 
 
 @pytest.mark.quick
-def test_choose_serving_slots_dedupes_and_spreads():
-    """Each lane gets exactly one slot; equal-capability ties spread
-    round-robin instead of funneling through slot 0."""
-    n, p, k = 2, 4, 12
-    delta = jnp.broadcast_to(jnp.int32(5), (n, p, k))  # everyone equal
-    topa = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (n, k))
-    slot, best = choose_serving_slots(delta, topa, jnp.int32(0))
+def test_deal_serving_slots_dedupes_and_spreads():
+    """Each lane is dealt exactly one granted slot, deals are balanced
+    (round-robin, the reference's shuffled request dealing,
+    peer.rs:1241-1372), and budget ranks count up within each slot."""
+    n, p, k = 3, 4, 12
+    granted = jnp.asarray([
+        [True, True, True, True],   # all four slots granted
+        [False, True, False, True],  # two granted
+        [False, False, False, False],  # nothing granted
+    ])
+    slot, rank = deal_serving_slots(granted, jnp.int32(0), k)
     slot = np.asarray(slot)
-    assert (np.asarray(best) == 5).all()
-    # every slot serves some lanes, and lanes rotate across slots
+    rank = np.asarray(rank)
+
+    # node 0: all slots used, balanced within 1
     assert set(slot[0]) == {0, 1, 2, 3}
     counts = np.bincount(slot[0], minlength=p)
     assert counts.max() - counts.min() <= 1, f"unbalanced {counts}"
+    # node 1: only the granted slots are ever dealt
+    assert set(slot[1]) == {1, 3}
+    # node 2: sentinel everywhere
+    assert (slot[2] == p).all()
 
-    # a peer that is ahead wins outright regardless of rotation
-    delta2 = delta.at[:, 2, :].set(9)
-    slot2, best2 = choose_serving_slots(delta2, topa, jnp.int32(0))
-    assert (np.asarray(slot2) == 2).all()
-    assert (np.asarray(best2) == 9).all()
+    # budget rank: k-th lane of a slot has rank k (node 0: g=4 -> k//4)
+    assert (rank[0] == np.arange(k) // 4).all()
+    assert (rank[1] == np.arange(k) // 2).all()
 
-    # nobody-can-serve lanes report best == 0
-    slot3, best3 = choose_serving_slots(jnp.zeros((n, p, k), jnp.int32),
-                                        topa, jnp.int32(0))
-    assert (np.asarray(best3) == 0).all()
+    # a nonzero phase rotates which slot gets lane 0, still balanced
+    slot_p, _ = deal_serving_slots(granted, jnp.int32(1), k)
+    slot_p = np.asarray(slot_p)
+    assert set(slot_p[0]) == {0, 1, 2, 3}
+    assert slot_p[0][0] != slot[0][0]
 
 
 @pytest.mark.quick
@@ -139,3 +147,38 @@ def test_multi_peer_sync_catches_up_faster_than_single():
     assert multi < single, (
         f"multi-peer ({multi} rounds) not faster than single ({single})"
     )
+
+
+@pytest.mark.quick
+def test_sync_round_probe_dealing_matches_argmax_accounting():
+    """sync_deal_probes >= 1: same no-duplicate accounting invariant as
+    the argmax path, and a fully-behind node still gets repaired."""
+    n = 16
+    for probes in (1, 2):
+        cfg = SimConfig(
+            num_nodes=n, num_rows=8, num_cols=2, log_capacity=64,
+            sync_peers=4, sync_actor_topk=8, sync_cap_per_actor=4,
+            sync_server_cap=16, sync_deal_probes=probes,
+        ).validate()
+        written = 10
+        log = make_changelog(n, 64, 1)
+        log = log.replace(head=jnp.full((n,), written, jnp.int32))
+        head = np.full((n, n), written, np.int32)
+        head[0, :] = 0  # node 0 is fully behind
+        book = Bookkeeping(head=jnp.asarray(head),
+                           win=jnp.zeros((n, n), jnp.uint32))
+        table = make_table_state(n, 8, 2)
+        ones = jnp.ones((n,), bool)
+        view = jnp.ones((1, n), bool)
+        book2, _, _, _, metrics = sync_round(
+            cfg, book, log, table,
+            jnp.zeros((n,), jnp.int32), jnp.full((n,), -1, jnp.int32),
+            jnp.full((n,), -1, jnp.int32),
+            jax.random.PRNGKey(0), ones, view, jnp.ones((n, n), bool),
+        )
+        adv = int((np.asarray(book2.head) - head).sum())
+        assert adv > 0, f"probes={probes}: sync transferred nothing"
+        assert adv == int(metrics["sync_versions"]), (
+            f"probes={probes}: head advance {adv} != sync_versions "
+            f"{int(metrics['sync_versions'])}"
+        )

@@ -42,12 +42,13 @@ from corro_sim.sync.sync import sync_round
 
 
 def bench_cfg(n: int) -> SimConfig:
-    """The config-4 headline shape (benchmarks.run_headline_bench)."""
+    """The config-0 north-star shape (benchmarks.run_north_star)."""
     return SimConfig(
         num_nodes=n, num_rows=256, num_cols=4, log_capacity=512,
         write_rate=0.5, zipf_alpha=0.8, swim_enabled=True,
-        swim_suspect_rounds=6, sync_interval=8, sync_actor_topk=32,
-        sync_cap_per_actor=8, sync_req_actors=32, sync_need_sample=64,
+        swim_suspect_rounds=6, swim_interval=4, sync_interval=8,
+        sync_adaptive=True, sync_actor_topk=64, sync_cap_per_actor=2,
+        sync_req_actors=64, sync_need_sample=64, sync_deal_probes=2,
     )
 
 

@@ -30,8 +30,8 @@ from corro_sim.core.bookkeeping import advance_heads
 from corro_sim.core.changelog import gather_changesets
 from corro_sim.core.crdt import NEG, apply_cell_changes
 from corro_sim.sync.sync import (
-    choose_serving_slots,
     choose_sync_peers,
+    deal_serving_slots,
     sync_round,
 )
 import sys, os
@@ -112,7 +112,7 @@ def main():
     timeit("need+roll+cumsum", need_body,
            (book, jax.random.PRNGKey(1), jnp.int32(0)))
 
-    # ---- stage: schedule = need plane + batched binary search (current)
+    # ---- stage: schedule = need plane + fused compare-reduce (current)
     def ss_body(i, carry):
         bk, key, acc = carry
         key, sub = jax.random.split(key)
@@ -122,38 +122,35 @@ def main():
         pos = rolled > 0
         csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A)
         targets = jnp.arange(1, kprime + 1, dtype=jnp.int32)
-        lo = jnp.zeros((n, kprime), jnp.int32)
-        hi = jnp.full((n, kprime), a, jnp.int32)
-        for _ in range(a.bit_length()):
-            mid = (lo + hi) >> 1
-            cm = jnp.take_along_axis(csum, jnp.minimum(mid, a - 1), axis=1)
-            ge = cm >= targets[None, :]
-            hi = jnp.where(ge, mid, hi)
-            lo = jnp.where(ge, lo, mid + 1)
-        lane_ok = hi < a
-        topa = (jnp.where(lane_ok, hi, 0) + phase) % a
+        idx = jnp.sum(
+            csum[:, :, None] < targets[None, None, :], axis=1,
+            dtype=jnp.int32,
+        )
+        lane_ok = idx < a
+        topa = (jnp.where(lane_ok, idx, 0) + phase) % a
         return bk, key, acc + topa[0, 0] + lane_ok[0, 0]
-    timeit("schedule+binsearch", ss_body,
+    timeit("schedule+cmpreduce", ss_body,
            (book, jax.random.PRNGKey(3), jnp.int32(0)))
 
-    # ---- stage: per-lane availability + slots + budget rank
+    # ---- stage: slot dealing + the one capability probe per lane
+    p_cnt_ = p_cnt
     topa0 = jax.random.randint(jax.random.PRNGKey(5), (n, kprime), 0, a,
                                dtype=jnp.int32)
     def avail_body(i, carry):
         bk, peer, granted, topa, acc = carry
+        slot, rank = deal_serving_slots(granted, jnp.int32(i), kprime)
+        peer_lane = peer[rows[:, None], jnp.minimum(slot, p_cnt_ - 1)]
         my_head = bk.head[rows[:, None], topa]
-        ph = bk.head[peer[:, :, None], topa[:, None, :]]
-        delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
-        delta_p = jnp.where(granted[:, :, None], delta_p, 0)
-        slot, topv = choose_serving_slots(delta_p, topa, jnp.int32(i))
-        order = jnp.argsort(slot, axis=1, stable=True)
+        ph_lane = bk.head[peer_lane, topa]
+        topv = jnp.where(slot < p_cnt_,
+                         jnp.maximum(ph_lane - my_head, 0), 0)
         return bk, peer, granted, (topa + 1) % a, \
-            acc + slot[0, 0] + order[0, 0] + topv[0, 0]
+            acc + slot[0, 0] + rank[0, 0] + topv[0, 0]
 
     def mk_peers(bk, k):
         return choose_sync_peers(cfg, bk, k, alive, view1, reach1)
     peer, granted = jax.jit(mk_peers)(book, jax.random.PRNGKey(4))
-    timeit("avail+slots", avail_body,
+    timeit("deal+probe", avail_body,
            (book, peer, granted, topa0, jnp.int32(0)))
 
     # ---- stage: changeset gather + CRDT merge over the (N,K',cap) lanes
