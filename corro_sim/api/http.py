@@ -236,10 +236,28 @@ class _Handler(BaseHTTPRequestHandler):
     def _post_queries(self, params):
         stmt = self._body_json()
         node = self._node(params)
-        sql = _sql_of_body(stmt)
+        structured = not isinstance(stmt, str)
+        if structured:
+            from corro_sim.api.statements import parse_statement
+
+            try:
+                sql, bound = parse_statement(stmt)  # bad wire shape → 400
+            except Exception as e:
+                raise _ApiError(400, str(e)) from None
+        else:
+            sql, bound = stmt, []
         self._start_stream()
         t0 = time.perf_counter()
         try:
+            # binding errors stream as QueryEvent::Error like any other
+            # query failure (the reference's api_v1_queries streams them).
+            # Structured statements always bind — a placeholder with an
+            # empty params list must fail as a binding error, not as a
+            # downstream '?' syntax error.
+            if structured:
+                from corro_sim.api.statements import bind_params
+
+                sql = bind_params(sql, bound)
             events = self.api.cluster.query(sql, node=node)
         except Exception as e:  # streamed QueryEvent::Error, like reference
             self._stream_events([{"error": str(e)}])
@@ -380,13 +398,21 @@ class _Handler(BaseHTTPRequestHandler):
 
 def _sql_of_body(stmt) -> str:
     """A request body as SQL text: bare string or any Statement wire shape
-    (``corro-api-types/src/lib.rs:181-201``); malformed → 400."""
+    (``corro-api-types/src/lib.rs:181-201``); malformed → 400.
+
+    Bound parameters are INLINED as literals — the reference binds them in
+    ``api_v1_queries`` and inlines them for subscriptions via ``expand_sql``
+    (``api/public/pubsub.rs:226-331``); inlining serves both here, and makes
+    subscription dedupe-by-normalized-SQL see the bound values."""
     if isinstance(stmt, str):
         return stmt
-    from corro_sim.api.statements import parse_statement
+    from corro_sim.api.statements import bind_params, parse_statement
 
     try:
-        sql, _ = parse_statement(stmt)
+        sql, params = parse_statement(stmt)
+        # always bind structured statements: a placeholder with an empty
+        # params list is a binding error here, not a '?' syntax error later
+        sql = bind_params(sql, params)
     except Exception as e:
         raise _ApiError(400, str(e)) from None
     return sql

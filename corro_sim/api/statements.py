@@ -71,7 +71,7 @@ def parse_statement(stmt) -> tuple[str, list | dict]:
     raise StatementError(f"bad statement shape: {type(stmt)!r}")
 
 
-_PARAM = re.compile(r"\?|\$\d+|[:$@][A-Za-z_][A-Za-z_0-9]*")
+_PARAM = re.compile(r"\?\d*|\$\d+|[:$@][A-Za-z_][A-Za-z_0-9]*")
 
 
 def bind_params(sql: str, params) -> str:
@@ -126,6 +126,16 @@ def bind_params(sql: str, params) -> str:
                 raise StatementError("not enough positional params")
             out.append(lit(params[idx]))
             idx += 1
+        elif tok[0] == "?":
+            # SQLite ?NNN — 1-based explicit positional; like SQLite, it
+            # also advances the implicit cursor past NNN
+            i = int(tok[1:]) - 1
+            if not isinstance(params, (list, tuple)) or not (
+                0 <= i < len(params)
+            ):
+                raise StatementError(f"missing positional param {tok}")
+            out.append(lit(params[i]))
+            idx = max(idx, i + 1)
         elif tok[0] == "$" and tok[1:].isdigit():
             # Postgres-style 1-based positional (the pg wire API binds these)
             i = int(tok[1:]) - 1

@@ -72,7 +72,7 @@ class RunResult:
         return 1000.0 * self.wall_seconds / max(self.timed_rounds, 1)
 
 
-def _chunk_runner(cfg: SimConfig, donate: bool = False):
+def _chunk_runner(cfg: SimConfig, donate: bool = False, shardings=None):
     def body(state, inp):
         key, alive, part, we = inp
         return sim_step(cfg, state, key, alive, part, we)
@@ -84,7 +84,15 @@ def _chunk_runner(cfg: SimConfig, donate: bool = False):
 
     @functools.partial(jax.jit, **kwargs)
     def run_chunk(state, keys, alive, part, we):
-        return jax.lax.scan(body, state, (keys, alive, part, we))
+        out, m = jax.lax.scan(body, state, (keys, alive, part, we))
+        if shardings is not None:
+            # Pin the carry's output shardings to the input layout so the
+            # AOT-compiled executable accepts chunk N's output as chunk
+            # N+1's input (AOT does not auto-reshard the way jit does; an
+            # unconstrained scan hands some log leaves back node-sharded
+            # and the next compiled call raises a sharding mismatch).
+            out = jax.lax.with_sharding_constraint(out, shardings)
+        return out, m
 
     return run_chunk
 
@@ -112,11 +120,25 @@ def run_sim(
     schedule = schedule or Schedule()
     if min_rounds is None:
         min_rounds = schedule.write_rounds
+    shardings = None
     if mesh is not None:
-        from corro_sim.engine.sharding import shard_state
+        from corro_sim.engine.sharding import shard_state, state_shardings
 
+        shardings = state_shardings(state, mesh, cfg.num_nodes)
         state = shard_state(state, mesh, cfg.num_nodes)
-    runner = _chunk_runner(cfg, donate=donate)
+    else:
+        # The caller may hand in a pre-sharded state (harness, tests). The
+        # AOT path needs the carry's output shardings pinned to the input
+        # layout either way, so recover the constraint from the arrays'
+        # committed shardings.
+        leaf_sh = [
+            getattr(leaf, "sharding", None) for leaf in jax.tree.leaves(state)
+        ]
+        if leaf_sh and all(
+            isinstance(s, jax.sharding.NamedSharding) for s in leaf_sh
+        ):
+            shardings = jax.tree.map(lambda leaf: leaf.sharding, state)
+    runner = _chunk_runner(cfg, donate=donate, shardings=shardings)
     root = jax.random.PRNGKey(seed)
 
     metrics_chunks = []
@@ -147,9 +169,11 @@ def run_sim(
             t0 = time.perf_counter()
             try:
                 compiled = runner.lower(*args).compile()
-                compile_seconds = time.perf_counter() - t0
             except Exception:  # AOT unsupported on some backend
                 compiled = None
+            # On fallback the failed-lowering wall still belongs to
+            # compile accounting (ADVICE r3): chunk 0's mixed run adds on.
+            compile_seconds = time.perf_counter() - t0
         if compiled is None:
             # fallback: chunk 0 pays compile+exec mixed and is excluded
             # from the steady-state wall (the pre-AOT accounting)
@@ -158,7 +182,7 @@ def run_sim(
             m = jax.tree.map(np.asarray, m)
             elapsed = time.perf_counter() - t0
             if ci == 0:
-                compile_seconds = elapsed
+                compile_seconds += elapsed
             else:
                 wall += elapsed
                 timed_rounds += chunk

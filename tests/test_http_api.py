@@ -297,3 +297,45 @@ def test_blob_roundtrip_through_client(client):
     client.execute([["UPDATE blobrt SET data = ? WHERE k = ?", [v, 1]]])
     _, rows = client.query_rows("SELECT data FROM blobrt WHERE k = 1")
     assert rows == [[1, b"\x01\x02"]]  # pk row-key prefix + projection
+
+
+def test_query_params_bound(client):
+    """/v1/queries binds Statement params — positional and named — like the
+    reference's api_v1_queries (api/public/pubsub.rs:226-331)."""
+    client.execute(
+        ["INSERT INTO users (id, name, score) VALUES (800, 'params', 80)"]
+    )
+    _, rows = client.query_rows(
+        ["SELECT id, name FROM users WHERE id = ?", [800]]
+    )
+    assert [800, "params"] in rows
+    _, rows = client.query_rows(
+        {"query": "SELECT id, score FROM users WHERE id = :id",
+         "named_params": {"id": 800}}
+    )
+    assert [800, 80] in rows
+
+
+def test_query_params_missing_is_error(client):
+    """Binding failures stream as QueryEvent errors (one error surface,
+    like the reference's api_v1_queries) — both the dangling-? and the
+    not-enough-params shapes."""
+    events = list(client.query(["SELECT id FROM users WHERE id = ?", []]))
+    assert any("error" in e for e in events)
+    events = list(client.query(
+        ["SELECT id FROM users WHERE id = ? AND score = ?", [1]]
+    ))
+    assert any("error" in e for e in events)
+
+
+def test_subscription_params_inlined_dedupe(client):
+    """Subscriptions inline bound params (expand_sql analog) so the
+    parameterized and literal forms normalize — and dedupe — identically."""
+    lit = client.subscribe("SELECT id FROM users WHERE id > 200000")
+    par = client.subscribe(["SELECT id FROM users WHERE id > ?", [200000]])
+    try:
+        assert par.hash == lit.hash
+        assert par.id == lit.id  # deduped to the same matcher
+    finally:
+        lit.close()
+        par.close()

@@ -119,6 +119,14 @@ def test_bind_params():
     )
     with pytest.raises(StatementError):
         bind_params("VALUES (?)", [])
+    # SQLite ?NNN explicit positionals; a later bare ? continues past the
+    # highest explicit index, like SQLite's binding cursor
+    assert (
+        bind_params("WHERE a = ?2 AND b = ?1 AND c = ?", [1, 2, 3])
+        == "WHERE a = 2 AND b = 1 AND c = 3"
+    )
+    with pytest.raises(StatementError):
+        bind_params("WHERE a = ?9", [1])
 
 
 def test_parse_write_upsert_multi_values():
