@@ -236,16 +236,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _post_queries(self, params):
         stmt = self._body_json()
         node = self._node(params)
-        structured = not isinstance(stmt, str)
-        if structured:
-            from corro_sim.api.statements import parse_statement
-
-            try:
-                sql, bound = parse_statement(stmt)  # bad wire shape → 400
-            except Exception as e:
-                raise _ApiError(400, str(e)) from None
-        else:
-            sql, bound = stmt, []
+        sql, bound, structured = _parse_body_statement(stmt)
         self._start_stream()
         t0 = time.perf_counter()
         try:
@@ -396,26 +387,37 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
 
-def _sql_of_body(stmt) -> str:
-    """A request body as SQL text: bare string or any Statement wire shape
-    (``corro-api-types/src/lib.rs:181-201``); malformed → 400.
-
-    Bound parameters are INLINED as literals — the reference binds them in
-    ``api_v1_queries`` and inlines them for subscriptions via ``expand_sql``
-    (``api/public/pubsub.rs:226-331``); inlining serves both here, and makes
-    subscription dedupe-by-normalized-SQL see the bound values."""
+def _parse_body_statement(stmt):
+    """A request body as ``(sql, params, structured)``; bad wire shapes
+    (``corro-api-types/src/lib.rs:181-201``) → 400. Binding stays with the
+    caller: queries stream binding errors, subscriptions 400 them."""
     if isinstance(stmt, str):
-        return stmt
-    from corro_sim.api.statements import bind_params, parse_statement
+        return stmt, [], False
+    from corro_sim.api.statements import parse_statement
 
     try:
         sql, params = parse_statement(stmt)
-        # always bind structured statements: a placeholder with an empty
-        # params list is a binding error here, not a '?' syntax error later
-        sql = bind_params(sql, params)
     except Exception as e:
         raise _ApiError(400, str(e)) from None
-    return sql
+    return sql, params, True
+
+
+def _sql_of_body(stmt) -> str:
+    """A request body as SQL text with params INLINED as literals — the
+    reference binds them in ``api_v1_queries`` and inlines them for
+    subscriptions via ``expand_sql`` (``api/public/pubsub.rs:226-331``);
+    inlining makes subscription dedupe-by-normalized-SQL see the bound
+    values. Structured statements always bind: a placeholder with an empty
+    params list is a binding error here, not a '?' syntax error later."""
+    sql, params, structured = _parse_body_statement(stmt)
+    if not structured:
+        return sql
+    from corro_sim.api.statements import bind_params
+
+    try:
+        return bind_params(sql, params)
+    except Exception as e:
+        raise _ApiError(400, str(e)) from None
 
 
 

@@ -59,6 +59,7 @@ def sim_step(
     part: jnp.ndarray,  # (N,) int32 partition id (ground truth)
     write_enable: jnp.ndarray,  # () bool — workload phase switch
     writes: tuple | None = None,  # explicit write batch (live agent path)
+    repair: bool = False,  # static: the post-quiesce specialization
 ):
     """Advance the cluster one round.
 
@@ -69,7 +70,21 @@ def sim_step(
     dels (N,) bool, ncells (N,) i32)`` — the single-write-per-node-per-round
     shape mirrors the reference's one write conn + ``Semaphore(1)``
     serialization (``corro-types/src/agent.rs:500-731``).
+
+    ``repair`` — static compile-time switch for the convergence tail: with
+    writes disabled AND every gossip ring drained (``pend_live == 0``, a
+    precondition the driver checks on the host between chunks), the whole
+    write → emit → sort → deliver → merge → enqueue pipeline is provably a
+    no-op, so this variant traces only SWIM + anti-entropy + bookkeeping.
+    Bit-for-bit equivalent to the full step under that precondition (same
+    key split, same HLC/metric arithmetic; requires ``inflight_slots == 0``
+    and ``rtt_rings`` off — the driver gates on both). The reference's
+    agents idle the same way: no local commits and empty broadcast queues
+    leave only the SWIM runtime and the sync loop awake
+    (``agent/handlers.rs``, ``broadcast/mod.rs:532-597``).
     """
+    if repair:
+        return _repair_step(cfg, state, key, alive, part)
     n = cfg.num_nodes
     s = cfg.seqs_per_version
     cpv = cfg.chunks_per_version
@@ -361,45 +376,9 @@ def sim_step(
     )
 
     # ----------------------------------------------------------------- SWIM
-    if cfg.swim_enabled:
-        if cfg.swim_interval > 1:
-            # foca probes every 1-5 s vs the 500 ms broadcast flush — SWIM
-            # ticking every k-th gossip round is the faithful ratio AND
-            # cuts the (N, N) plane traffic k-fold (config.swim_interval)
-            def tick_swim(args):
-                sw, k = args
-                return swim_step(cfg, sw, k, alive, reach, state.round)
-
-            def skip_swim(args):
-                sw, _ = args
-                st = sw.status
-                return sw, {
-                    "swim_suspects": (
-                        (st == 1) & alive[:, None]
-                    ).sum(dtype=jnp.int32),
-                    "swim_down": (
-                        (st == 2) & alive[:, None]
-                    ).sum(dtype=jnp.int32),
-                    "swim_probe_failures": jnp.int32(0),
-                }
-
-            swim, swim_metrics = jax.lax.cond(
-                (state.round % cfg.swim_interval) == 0,
-                tick_swim,
-                skip_swim,
-                (state.swim, k_swim),
-            )
-        else:
-            swim, swim_metrics = swim_step(
-                cfg, state.swim, k_swim, alive, reach, state.round
-            )
-    else:
-        swim = state.swim
-        swim_metrics = {
-            "swim_suspects": jnp.int32(0),
-            "swim_down": jnp.int32(0),
-            "swim_probe_failures": jnp.int32(0),
-        }
+    swim, swim_metrics = _swim_block(
+        cfg, state.swim, k_swim, alive, reach, state.round
+    )
 
     # last_cleared_ts analog, HLC-gated (handlers.rs:524-719): applying an
     # emptied version advances the node's last-cleared ts to the EmptySet's
@@ -419,29 +398,10 @@ def sim_step(
         quiesced = writers.sum(dtype=jnp.int32) == 0
         is_sync = is_sync | (quiesced & behind_pre)
 
-    def do_sync(args):
-        book, table, hlc, lc = args
-        return sync_round(
-            cfg, book, log, table, hlc, lc, cleared_hlc, k_sync, alive,
-            view if cfg.swim_enabled else jnp.ones((1, n), bool),
-            # reachability as a matrix-free pair of masks: same-partition
-            # check happens inside via gathered part ids
-            _pairwise_mask(alive, part),
-            rtt=rtt if cfg.rtt_rings else None,
-        )
-
-    def no_sync(args):
-        book, table, hlc, lc = args
-        zero = jnp.int32(0)
-        return book, table, hlc, lc, {
-            "sync_pairs": zero,
-            "sync_versions": zero,
-            "sync_empties": zero,
-            "sync_cells": zero,
-        }
-
-    book, table, hlc_s, last_cleared, sync_metrics = jax.lax.cond(
-        is_sync, do_sync, no_sync, (book, table, state.hlc, last_cleared)
+    book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
+        cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
+        k_sync, alive, view, part,
+        rtt=rtt if cfg.rtt_rings else None,
     )
 
     # -------------------------------------------------------------- metrics
@@ -451,20 +411,7 @@ def sim_step(
     gap = jnp.where(
         alive[:, None], (log.head[None, :] - book.head).astype(jnp.float32), 0.0
     ).sum()
-    # uhlc max+tick: merged clocks from this round's deliveries + sync
-    # contacts, physical floor = the round counter. Down nodes freeze.
-    hlc = jnp.where(
-        alive,
-        jnp.maximum(jnp.maximum(hlc_s, hlc_recv), state.round) + 1,
-        hlc_s,
-    )
-    int_min = jnp.int32(-(2**31) + 1)
-    int_max = jnp.int32(2**31 - 1)
-    skew = jnp.maximum(
-        jnp.max(jnp.where(alive, hlc, int_min))
-        - jnp.min(jnp.where(alive, hlc, int_max)),
-        0,
-    )
+    hlc, skew = _hlc_tick(alive, hlc_s, hlc_recv, state.round)
     metrics = {
         "writes": writers.sum(dtype=jnp.int32),
         "deletes": w_del.sum(dtype=jnp.int32),
@@ -479,6 +426,9 @@ def sim_step(
         "buffered_partials": partial_versions(book, cpv),
         "dropped_window": dropped.sum(dtype=jnp.int32),
         "queue_overflow": gossip.overflow,
+        # live pending-broadcast slots cluster-wide (drained == 0): the
+        # driver's precondition for switching to the repair-specialized step
+        "pend_live": (gossip.pend_tx > 0).sum(dtype=jnp.int32),
         "cleared_versions": log.cleared.sum(dtype=jnp.int32),
         "gap": gap,
         "log_wrapped": log_wrapped,
@@ -508,3 +458,194 @@ def sim_step(
 def _pairwise_mask(alive: jnp.ndarray, part: jnp.ndarray):
     """(N, N) ground-truth reachability for sync peer choice."""
     return alive[:, None] & alive[None, :] & (part[:, None] == part[None, :])
+
+
+# --- shared blocks ---------------------------------------------------------
+# sim_step and _repair_step MUST stay bit-for-bit equivalent under the
+# repair precondition; the SWIM tick, the sync cond and the end-of-round
+# clock update live here once so the two paths cannot drift.
+
+
+def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
+    """The SWIM cadence: tick every ``swim_interval``-th round.
+
+    foca probes every 1-5 s vs the 500 ms broadcast flush — SWIM ticking
+    every k-th gossip round is the faithful ratio AND cuts the (N, N)
+    plane traffic k-fold (config.swim_interval)."""
+    if not cfg.swim_enabled:
+        return swim_state, {
+            "swim_suspects": jnp.int32(0),
+            "swim_down": jnp.int32(0),
+            "swim_probe_failures": jnp.int32(0),
+        }
+    if cfg.swim_interval <= 1:
+        return swim_step(cfg, swim_state, k_swim, alive, reach, round_)
+
+    def tick_swim(args):
+        sw, k = args
+        return swim_step(cfg, sw, k, alive, reach, round_)
+
+    def skip_swim(args):
+        sw, _ = args
+        st = sw.status
+        return sw, {
+            "swim_suspects": (
+                (st == 1) & alive[:, None]
+            ).sum(dtype=jnp.int32),
+            "swim_down": (
+                (st == 2) & alive[:, None]
+            ).sum(dtype=jnp.int32),
+            "swim_probe_failures": jnp.int32(0),
+        }
+
+    return jax.lax.cond(
+        (round_ % cfg.swim_interval) == 0,
+        tick_swim,
+        skip_swim,
+        (swim_state, k_swim),
+    )
+
+
+def _sync_block(
+    cfg, is_sync, book, log, table, hlc, last_cleared, cleared_hlc,
+    k_sync, alive, view, part, rtt,
+):
+    """The sync cond: one anti-entropy sweep when ``is_sync``."""
+
+    def do_sync(args):
+        book, table, hlc, lc = args
+        return sync_round(
+            cfg, book, log, table, hlc, lc, cleared_hlc, k_sync, alive,
+            view,
+            # reachability as a matrix-free pair of masks: same-partition
+            # check happens inside via gathered part ids
+            _pairwise_mask(alive, part),
+            rtt=rtt,
+        )
+
+    def no_sync(args):
+        book, table, hlc, lc = args
+        zero = jnp.int32(0)
+        return book, table, hlc, lc, {
+            "sync_pairs": zero,
+            "sync_versions": zero,
+            "sync_empties": zero,
+            "sync_cells": zero,
+        }
+
+    return jax.lax.cond(
+        is_sync, do_sync, no_sync, (book, table, hlc, last_cleared)
+    )
+
+
+def _hlc_tick(alive, hlc_s, hlc_recv, round_):
+    """uhlc max+tick: merged clocks from this round's deliveries + sync
+    contacts, physical floor = the round counter. Down nodes freeze.
+    Returns (hlc, skew)."""
+    hlc = jnp.where(
+        alive,
+        jnp.maximum(jnp.maximum(hlc_s, hlc_recv), round_) + 1,
+        hlc_s,
+    )
+    int_min = jnp.int32(-(2**31) + 1)
+    int_max = jnp.int32(2**31 - 1)
+    skew = jnp.maximum(
+        jnp.max(jnp.where(alive, hlc, int_min))
+        - jnp.min(jnp.where(alive, hlc, int_max)),
+        0,
+    )
+    return hlc, skew
+
+
+def _repair_step(
+    cfg: SimConfig,
+    state: SimState,
+    key: jax.Array,
+    alive: jnp.ndarray,
+    part: jnp.ndarray,
+):
+    """The post-quiesce round: SWIM + sync + bookkeeping only.
+
+    Preconditions (driver-checked): no writes this round, every gossip
+    pending ring drained, no in-flight delay ring, no RTT rings. Under
+    those, this is bit-for-bit ``sim_step`` — the same subkeys reach SWIM
+    and sync, the dead pipeline's state updates are all masked no-ops, and
+    each metric either repeats the full step's expression or is the zero
+    the full step would compute.
+    """
+    assert cfg.inflight_slots == 0 and not cfg.rtt_rings
+    n = cfg.num_nodes
+    cpv = cfg.chunks_per_version
+    # same 9-way split as the full step — k_swim/k_sync must match
+    (_k_write, _k_row, _k_col, _k_val, _k_del, _k_ncell, _k_bcast, k_swim,
+     k_sync) = jax.random.split(key, 9)
+    reach = _reachable_fn(alive, part)
+
+    if cfg.swim_enabled:
+        view = view_alive(state.swim)
+    else:
+        view = jnp.ones((1, n), bool)
+
+    log = state.log
+    book = state.book
+    lag_pre = log.head[None, :] - book.head
+    log_wrapped = ((lag_pre > log.capacity) & alive[:, None]).sum(
+        dtype=jnp.int32
+    )
+    behind_pre = ((lag_pre > 0) & alive[:, None]).any()
+
+    zero = jnp.int32(0)
+    hlc_recv = jnp.zeros((n,), jnp.int32)
+
+    # SWIM keeps its tick cadence through the tail (shared block)
+    swim, swim_metrics = _swim_block(
+        cfg, state.swim, k_swim, alive, reach, state.round
+    )
+
+    # ----------------------------------------------------------------- sync
+    is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
+    if cfg.sync_adaptive:
+        # quiesced is identically True here (no writers by precondition)
+        is_sync = is_sync | behind_pre
+
+    book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
+        cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,
+        state.cleared_hlc, k_sync, alive, view, part, rtt=None,
+    )
+
+    # -------------------------------------------------------------- metrics
+    gap = jnp.where(
+        alive[:, None], (log.head[None, :] - book.head).astype(jnp.float32),
+        0.0,
+    ).sum()
+    hlc, skew = _hlc_tick(alive, hlc_s, hlc_recv, state.round)
+    metrics = {
+        "writes": zero,
+        "deletes": zero,
+        "cells_written": zero,
+        "msgs_sent": zero,
+        "delivered": zero,
+        "fresh": zero,
+        "fresh_chunks": zero,
+        "gossip_cells": zero,
+        "buffered_partials": partial_versions(book, cpv),
+        "dropped_window": zero,
+        "queue_overflow": state.gossip.overflow,
+        "pend_live": (state.gossip.pend_tx > 0).sum(dtype=jnp.int32),
+        "cleared_versions": log.cleared.sum(dtype=jnp.int32),
+        "gap": gap,
+        "log_wrapped": log_wrapped,
+        "clock_skew": skew,
+        **swim_metrics,
+        **sync_metrics,
+    }
+
+    new_state = state.replace(
+        table=table,
+        book=book,
+        swim=swim,
+        round=state.round + 1,
+        hlc=hlc,
+        last_cleared=last_cleared,
+    )
+    return new_state, metrics

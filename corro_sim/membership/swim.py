@@ -208,17 +208,29 @@ def swim_step(
             jax.random.fold_in(k_ex, g), 4
         )
         peer = jax.random.randint(kg_pull, (n,), 0, n, dtype=jnp.int32)
-        can = (
+        can1 = (
             alive
             & alive[peer]
             & reachable(rows, peer)
             & (peer != rows)
             & ((p[rows, peer] & _STATUS_MASK) < down_key)
-        )[:, None]
+        )
+        can = can1[:, None]
         block = payload_block(kg_bl1)
         if block is not None:
             can = can & block[peer]  # responder picks the datagram contents
         p = jnp.where(can, jnp.maximum(p, p[peer]), p)
+        # Every SWIM message carries the SENDER'S identity + incarnation
+        # regardless of payload contents (the protocol's message header;
+        # foca refutations ride it) — so a contact always heals the
+        # contacted entry itself. Without this, a refutation waits for the
+        # random payload window to cover the member, which stretches
+        # partition heal far beyond what real SWIM does.
+        if block is not None:
+            self_of_peer = p[peer, peer]
+            p = p.at[rows, peer].max(
+                jnp.where(can1, self_of_peer, jnp.uint32(0))
+            )
 
         push_tgt = jax.random.randint(kg_push, (n,), 0, n, dtype=jnp.int32)
         ok_push = (
@@ -232,6 +244,10 @@ def swim_step(
         block = payload_block(kg_bl2)
         if block is not None:
             contrib = jnp.where(block, contrib, jnp.uint32(0))
+            # sender's own entry always rides the datagram header
+            contrib = contrib.at[rows, rows].set(
+                jnp.where(ok_push, p[rows, rows], jnp.uint32(0))
+            )
         best = jnp.zeros((n, n), jnp.uint32).at[
             jnp.where(ok_push, push_tgt, n)
         ].max(contrib, mode="drop")

@@ -1,0 +1,326 @@
+"""Differential CRDT oracle against the REAL CR-SQLite extension.
+
+The reference does not implement its CRDT in Rust — it ships the actual
+CR-SQLite extension (``crates/corro-types/crsqlite-linux-x86_64.so``,
+loaded at ``corro-types/src/sqlite.rs:23-109``) and every merge rule the
+simulator models (``doc/crdts.md:9-40``) is *that* library's behavior.
+This test loads the very same ``.so`` through Python's sqlite3 and uses it
+as machine ground truth (VERDICT r3 next #3):
+
+- a seeded randomized multi-actor workload (concurrent upserts, updates,
+  deletes, resurrections, multi-cell transactions) runs against K real
+  CR-SQLite databases with randomized partial delivery between them
+  (``INSERT INTO crsql_changes`` — the reference's apply path,
+  ``agent/util.rs:721-1062``);
+- the extracted per-commit changesets become a trace in the broadcast wire
+  shapes (``corro-types/src/broadcast.rs:113-132``) and replay through the
+  simulator's gossip + merge machinery;
+- final table state must match the converged CR-SQLite cluster cell for
+  cell — value ranks, causal lengths, generation wipes, site tie-breaks.
+
+Site-ordinal order is chosen to be ascending raw ``site_id`` bytes, so the
+simulator's "bigger ordinal wins" tie-break mirrors CR-SQLite's "bigger
+site_id wins" (``doc/crdts.md:237``) exactly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sqlite3
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+SO = os.environ.get(
+    "CORRO_CRSQLITE_SO",
+    "/root/reference/crates/corro-types/crsqlite-linux-x86_64",
+)
+SCHEMA = (
+    "CREATE TABLE t (id INTEGER NOT NULL PRIMARY KEY, "
+    "a TEXT NOT NULL DEFAULT '', b INTEGER NOT NULL DEFAULT 0)"
+)
+
+
+def _mk_conn():
+    try:
+        conn = sqlite3.connect(":memory:", isolation_level=None)
+        conn.enable_load_extension(True)
+        conn.load_extension(SO, entrypoint="sqlite3_crsqlite_init")
+    except Exception as e:  # pragma: no cover - platform guard
+        pytest.skip(f"crsqlite extension unavailable: {e}")
+    conn.execute(SCHEMA)
+    conn.execute("SELECT crsql_as_crr('t')")
+    return conn
+
+
+class Site:
+    """One real CR-SQLite database acting as an actor."""
+
+    def __init__(self):
+        self.conn = _mk_conn()
+        self.site_id = bytes(
+            self.conn.execute("SELECT crsql_site_id()").fetchone()[0]
+        )
+        self.commits: list[list[tuple]] = []  # changeset stream, in order
+
+    def tx(self, *stmts: str) -> None:
+        c = self.conn
+        c.execute("BEGIN")
+        for s in stmts:
+            c.execute(s)
+        c.execute("COMMIT")
+        dbv = c.execute("SELECT crsql_db_version()").fetchone()[0]
+        rows = list(
+            c.execute(
+                'SELECT "table", pk, cid, val, col_version, db_version, '
+                "site_id, cl, seq FROM crsql_changes "
+                "WHERE db_version = ? AND site_id = ? ORDER BY seq",
+                (dbv, self.site_id),
+            )
+        )
+        if rows:
+            self.commits.append(rows)
+
+    def apply(self, rows: list[tuple]) -> None:
+        c = self.conn
+        c.execute("BEGIN")
+        for r in rows:
+            c.execute(
+                'INSERT INTO crsql_changes ("table", pk, cid, val, '
+                "col_version, db_version, site_id, cl, seq) "
+                "VALUES (?,?,?,?,?,?,?,?,?)",
+                r,
+            )
+        c.execute("COMMIT")
+
+    def table(self) -> dict:
+        return {
+            ("t", (i,)): {"a": a, "b": b}
+            for (i, a, b) in self.conn.execute(
+                "SELECT id, a, b FROM t ORDER BY id"
+            )
+        }
+
+
+def _run_ground_truth(seed: int, k: int = 4, rounds: int = 20):
+    """Random concurrent workload over k real CR-SQLite sites; returns
+    (sites, converged final table)."""
+    rng = random.Random(seed)
+    sites = [Site() for _ in range(k)]
+    delivered = [[0] * k for _ in range(k)]
+    ids = list(range(1, 7))
+    texts = ["aa", "bb", "zz"]
+
+    for r in range(rounds):
+        for s in sites:
+            if rng.random() >= 0.75:
+                continue
+            op = rng.random()
+            key = rng.choice(ids)
+            if op < 0.50:
+                a = rng.choice(texts + [f"u{r}"])
+                b = rng.choice([0, 1, 7, 42])
+                s.tx(
+                    f"INSERT INTO t (id, a, b) VALUES ({key}, '{a}', {b}) "
+                    "ON CONFLICT (id) DO UPDATE SET "
+                    "a = excluded.a, b = excluded.b"
+                )
+            elif op < 0.70:
+                col, v = rng.choice([("a", "'up'"), ("b", "99"), ("a", "'zz'")])
+                s.tx(f"UPDATE t SET {col} = {v} WHERE id = {key}")
+            elif op < 0.85:
+                s.tx(f"DELETE FROM t WHERE id = {key}")
+            else:
+                # multi-statement transaction: two rows in one changeset
+                k2 = rng.choice([i for i in ids if i != key])
+                s.tx(
+                    f"INSERT INTO t (id, a, b) VALUES ({key}, 'm{r}', 5) "
+                    "ON CONFLICT (id) DO UPDATE SET "
+                    "a = excluded.a, b = excluded.b",
+                    f"UPDATE t SET b = {r} WHERE id = {k2}",
+                )
+        # randomized partial delivery (out-of-order across sites)
+        for i in range(k):
+            for j in range(k):
+                if i == j or rng.random() >= 0.35:
+                    continue
+                done = delivered[i][j]
+                avail = len(sites[i].commits)
+                if avail > done:
+                    take = rng.randint(1, avail - done)
+                    for commit in sites[i].commits[done:done + take]:
+                        sites[j].apply(commit)
+                    delivered[i][j] = done + take
+
+    # flush everything everywhere; CR-SQLite must converge
+    for i in range(k):
+        for j in range(k):
+            if i != j:
+                for commit in sites[i].commits[delivered[i][j]:]:
+                    sites[j].apply(commit)
+    final = sites[0].table()
+    for s in sites[1:]:
+        assert s.table() == final, "CR-SQLite itself failed to converge?!"
+    return sites, final
+
+
+def _trace_lines(sites) -> list[str]:
+    """Extracted changesets → broadcast-wire ND-JSON, actor ordinals in
+    ascending site_id byte order (site tie-break alignment)."""
+    order = sorted(range(len(sites)), key=lambda i: sites[i].site_id)
+    lines = []
+    max_commits = max(len(s.commits) for s in sites)
+    for v in range(max_commits):
+        for oi, i in enumerate(order):
+            s = sites[i]
+            if v >= len(s.commits):
+                continue
+            changes = []
+            for si, (tbl, pk, cid, val, cv, _dbv, _site, cl, _seq) in enumerate(
+                s.commits[v]
+            ):
+                changes.append(
+                    {
+                        "table": tbl,
+                        "pk": list(pk),
+                        "cid": "__crsql_del" if cid == "-1" else cid,
+                        "val": val,
+                        "col_version": cv,
+                        "db_version": v + 1,
+                        "seq": si,
+                        "site_id": list(s.site_id),
+                        "cl": cl,
+                    }
+                )
+            lines.append(
+                json.dumps(
+                    {
+                        "actor_id": f"site-{oi:02d}",
+                        "version": v + 1,
+                        "changes": changes,
+                        "seqs": [0, len(changes) - 1],
+                        "last_seq": len(changes) - 1,
+                        "ts": v + 1,
+                    }
+                )
+            )
+    return lines
+
+
+def _sim_final_state(lines):
+    from corro_sim.engine.replay import read_table, replay
+    from corro_sim.io.traces import ingest
+
+    trace = ingest(lines)
+    res = replay(trace)
+    assert res.converged_round is not None, "simulator failed to converge"
+    node0 = read_table(res.state, trace, node=0)
+    # every node must agree (the sim's own convergence invariant)
+    for node in range(1, trace.num_actors):
+        assert read_table(res.state, trace, node=node) == node0
+    return node0
+
+
+@pytest.mark.parametrize("seed", [7, 23, 101])
+def test_randomized_merge_parity_vs_crsqlite(seed):
+    sites, expected = _run_ground_truth(seed)
+    got = _sim_final_state(_trace_lines(sites))
+    assert got == expected
+
+
+def test_directed_resurrect_generation_wipe_vs_crsqlite():
+    """Delete + resurrect wipes the generation: stale-generation cells die,
+    resurrected cells restart at col_version 1 — checked against the real
+    extension's own output, not hand-derived expectations."""
+    sites = [Site(), Site()]
+    a, b = sites
+    a.tx("INSERT INTO t (id, a, b) VALUES (1, 'x', 7)")
+    b.apply(a.commits[0])
+    # concurrent: b updates the row while a deletes + resurrects it
+    b.tx("UPDATE t SET b = 1000 WHERE id = 1")
+    a.tx("DELETE FROM t WHERE id = 1")
+    a.tx("INSERT INTO t (id, a, b) VALUES (1, 'fresh', 0)")
+    for commit in a.commits[1:]:
+        b.apply(commit)
+    for commit in b.commits:
+        a.apply(commit)
+    assert a.table() == b.table()
+    got = _sim_final_state(_trace_lines(sites))
+    assert got == a.table()
+
+
+def test_replay_parity_fixture_matches_crsqlite():
+    """Machine-check the replay-parity fixture's final-state expectations
+    (previously hand-derived in test_replay_parity.py) by applying the
+    fixture's changesets through the real extension in several orders."""
+    import pathlib
+
+    from tests.test_replay_parity import EXPECTED, TA1, TA2
+
+    fixture = pathlib.Path(__file__).parent / "fixtures" / "replay_parity.ndjson"
+    lines = [json.loads(ln) for ln in fixture.read_text().splitlines()]
+    # distinct site ids preserving actor order (the fixture's site_id field
+    # is a placeholder; actor identity rides actor_id)
+    site_of = {TA1: bytes(15) + b"\x01", TA2: bytes(15) + b"\x02"}
+    all_changes = [
+        (ch, site_of[ln["actor_id"]])
+        for ln in lines
+        if "changes" in ln  # Changeset::Empty lines carry no cells
+        for ch in ln["changes"]
+    ]
+
+    def run(order_seed):
+        conn = sqlite3.connect(":memory:", isolation_level=None)
+        conn.enable_load_extension(True)
+        try:
+            conn.load_extension(SO, entrypoint="sqlite3_crsqlite_init")
+        except Exception as e:  # pragma: no cover
+            pytest.skip(f"crsqlite extension unavailable: {e}")
+        conn.executescript(
+            'CREATE TABLE tests (id INTEGER NOT NULL PRIMARY KEY, '
+            'text TEXT NOT NULL DEFAULT "");\n'
+            'CREATE TABLE tests3 (id INTEGER NOT NULL PRIMARY KEY, '
+            'text TEXT NOT NULL DEFAULT "", text2 TEXT NOT NULL DEFAULT "", '
+            "num INTEGER NOT NULL DEFAULT 0, num2 INTEGER NOT NULL DEFAULT 0);"
+        )
+        conn.execute("SELECT crsql_as_crr('tests')")
+        conn.execute("SELECT crsql_as_crr('tests3')")
+        batch = list(all_changes)
+        if order_seed is not None:
+            random.Random(order_seed).shuffle(batch)
+        conn.execute("BEGIN")
+        for ch, site in batch:
+            conn.execute(
+                'INSERT INTO crsql_changes ("table", pk, cid, val, '
+                "col_version, db_version, site_id, cl, seq) "
+                "VALUES (?,?,?,?,?,?,?,?,?)",
+                (
+                    ch["table"],
+                    bytes(ch["pk"]),
+                    "-1" if ch["cid"] == "__crsql_del" else ch["cid"],
+                    ch["val"],
+                    ch["col_version"],
+                    ch["db_version"],
+                    site,
+                    ch["cl"],
+                    ch["seq"],
+                ),
+            )
+        conn.execute("COMMIT")
+        state = {}
+        for (i, text) in conn.execute("SELECT id, text FROM tests ORDER BY id"):
+            state[("tests", (i,))] = {"text": text}
+        for (i, t1, t2, n1, n2) in conn.execute(
+            "SELECT id, text, text2, num, num2 FROM tests3 ORDER BY id"
+        ):
+            state[("tests3", (i,))] = {
+                "text": t1, "text2": t2, "num": n1, "num2": n2
+            }
+        return state
+
+    for order_seed in (None, 5, 42):
+        got = run(order_seed)
+        assert got == EXPECTED, f"order_seed={order_seed}: {got}"

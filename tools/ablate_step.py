@@ -1,0 +1,104 @@
+"""In-context ablation of the 10k round cost: measure real chunk walls
+under config variants to see what the step actually pays for in situ
+(isolated stage timings have repeatedly disagreed with in-context cost).
+
+Each variant runs `chunks` chunks of `chunk` rounds through the real
+driver after one compile+warm chunk; reports median chunk wall / round.
+
+Usage::
+
+    python tools/ablate_step.py [--nodes 10000] [--variant all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.engine.driver import Schedule, _chunk_runner
+from corro_sim.engine.state import init_state
+import sys, os
+sys.path.insert(0, os.path.dirname(__file__))
+from profile_round import bench_cfg
+
+
+def north_cfg(n: int):
+    """run_north_star's exact config."""
+    write_rounds = 16
+    return dataclasses.replace(
+        bench_cfg(n),
+        write_rate=1000.0 / (n * write_rounds),
+        sync_actor_topk=64,
+        sync_cap_per_actor=2,
+        sync_req_actors=64,
+        sync_need_sample=64,
+        sync_deal_probes=2,
+    )
+
+
+VARIANTS = {
+    "base": lambda c: c,
+    "noswim": lambda c: dataclasses.replace(c, swim_enabled=True,
+                                            swim_interval=10**6),
+    "swimoff": lambda c: dataclasses.replace(c, swim_enabled=False),
+    "nosync": lambda c: dataclasses.replace(
+        c, sync_interval=10**6, sync_adaptive=False),
+    "fanout1": lambda c: dataclasses.replace(c, fanout=1),
+    "pend8": lambda c: dataclasses.replace(c, pend_slots=8),
+    "syncevery": lambda c: dataclasses.replace(
+        c, sync_interval=1, sync_adaptive=False),
+}
+
+
+def run_variant(name, cfg, chunk, chunks, writes=True, seed=0):
+    state = init_state(cfg, seed=seed)
+    runner = _chunk_runner(cfg)
+    sched = Schedule(write_rounds=10**9 if writes else 0)
+    root = jax.random.PRNGKey(seed)
+    walls = []
+    rounds = 0
+    for ci in range(chunks + 1):
+        alive, part, we = sched.slice(rounds, chunk, cfg.num_nodes)
+        keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
+        t0 = time.perf_counter()
+        state, m = runner(
+            state, keys, jnp.asarray(alive), jnp.asarray(part),
+            jnp.asarray(we),
+        )
+        jax.block_until_ready(m["gap"])
+        wall = time.perf_counter() - t0
+        if ci > 0:  # chunk 0 = compile + warm (ring fill)
+            walls.append(wall)
+        rounds += chunk
+    per_round = float(np.median(walls)) / chunk * 1000.0
+    out = {"variant": name, "wall_per_round_ms": round(per_round, 1),
+           "pend_live": int(m["pend_live"][-1]),
+           "msgs": int(m["msgs_sent"][-1])}
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=10000)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--chunks", type=int, default=3)
+    ap.add_argument("--variant", type=str, default="all")
+    args = ap.parse_args()
+
+    base = north_cfg(args.nodes)
+    names = list(VARIANTS) if args.variant == "all" else args.variant.split(",")
+    for name in names:
+        cfg = VARIANTS[name](base)
+        run_variant(name, cfg, args.chunk, args.chunks)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
