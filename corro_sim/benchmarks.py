@@ -22,6 +22,18 @@ import numpy as np
 
 REFERENCE_CHANGES_PER_SEC = 156.04  # doc/quick-start.md:121
 
+# The devcluster stand-in leg, FROZEN (VERDICT r3 weak #4 / next #8): the
+# 64-agent wall recorded in BENCH_r03.json with the config fingerprint it
+# was measured under. vs_baseline is computed against this frozen wall so
+# engine speedups (which accelerate the stand-in too — it shares the step
+# machinery) cannot move the goalposts. The fresh measurement is still
+# taken and reported; drifting >20% from the frozen wall flags the run.
+FROZEN_DEVCLUSTER = {
+    "wall_s": 1.134,
+    "recorded": "BENCH_r03.json",
+    "config": {"nodes": 64, "inserts": 1000},
+}
+
 
 def run_headline_bench(
     n: int | None = None,
@@ -132,7 +144,12 @@ def run_north_star(n: int | None = None) -> dict:
     from corro_sim.engine.state import init_state
 
     # Leg B — devcluster stand-in: 64 live agents, 1k inserts, converge.
-    devc = run_config_1(inserts=1000, nodes=64)
+    # Measured fresh every run but SCORED against the frozen r3 wall.
+    fz = FROZEN_DEVCLUSTER
+    devc = run_config_1(
+        inserts=fz["config"]["inserts"], nodes=fz["config"]["nodes"]
+    )
+    drift = devc["value"] / fz["wall_s"] - 1.0
 
     # Leg A — 10k-node sim doing the SAME total work as leg B (~1k
     # transactions, cluster-wide) plus SWIM churn and a partition window —
@@ -156,9 +173,16 @@ def run_north_star(n: int | None = None) -> dict:
         # cuts the (N, N) plane traffic 4x (config.swim_interval)
         swim_interval=4,
         sync_interval=8,
-        # activity-reset cadence (util.rs:327-371): post-quiesce repair
-        # sweeps run every round instead of every 8th
+        # Measured round-4 config search: full-egress gossip + sync every
+        # round in the tail beats every "leaner" variant — halved rings
+        # (41→105 rounds), the literal 1 s ≈ 5-round backoff floor
+        # (41→85), and an 8-slot egress cap (41→56) all shift bulk
+        # transfer from gossip (full lane utilization) onto sync
+        # (scheduling losses), losing more wall than the cheaper rounds
+        # save. Keep gossip aggressive; spend engineering on cheaper
+        # lanes, not fewer.
         sync_adaptive=True,
+        sync_floor_rounds=1,
         # version-granular budget: this workload leaves each actor ≤2-3
         # versions behind, so wide per-actor caps are dead lanes — spend
         # the same lane budget on MORE actors per sweep instead
@@ -191,16 +215,22 @@ def run_north_star(n: int | None = None) -> dict:
         "value": round(sim_wall, 3),
         "unit": "s",
         # >1 = the sim converges a 10_000-node cluster faster than the
-        # devcluster harness converges 64 agents — the north-star criterion
-        "vs_baseline": round(devc["value"] / sim_wall, 3) if sim_wall else None,
+        # devcluster harness converges 64 agents — the north-star criterion.
+        # Scored against the FROZEN r3 baseline wall, not the fresh
+        # measurement, so the goalposts cannot drift with engine changes.
+        "vs_baseline": round(fz["wall_s"] / sim_wall, 3) if sim_wall else None,
         "sim_rounds_to_convergence": res.converged_round,
         "sim_wall_per_round_ms": round(res.wall_per_round_ms, 3),
         "sim_converged": res.converged_round is not None,
         "devcluster_64_agents_wall_s": devc["value"],
         "devcluster_converged": devc["converged"],
+        "baseline_frozen_wall_s": fz["wall_s"],
+        "baseline_drift_pct": round(100 * drift, 1),
+        "baseline_drift_exceeded": bool(abs(drift) > 0.20),
         "baseline_note": (
             "64-agent leg is this repo's devcluster backend (labeled "
-            "stand-in for corro-devcluster's 64 real agents; conservative)"
+            "stand-in for corro-devcluster's 64 real agents; conservative); "
+            f"scored against the frozen {fz['recorded']} wall"
         ),
     }
 

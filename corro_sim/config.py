@@ -39,6 +39,13 @@ class SimConfig:
 
     # --- gossip (reference broadcast/mod.rs) ---
     pend_slots: int = 16  # pending-broadcast ring per node
+    emit_slots: int = 0  # egress cap: pending slots serviced per node per
+    # round (0 = all of them). The reference bounds egress per flush — 64
+    # KiB or 500 ms, whichever first (broadcast/mod.rs:378,394,446-455) —
+    # so a saturated pending queue DELAYS sends rather than fanning out
+    # unbounded; slots beyond the cap keep their transmission budget and
+    # wait. Also the emission lane count (the dominant per-round compute
+    # at 10k nodes) scales with this, not with ring capacity.
     fanout: int = 3  # random members per dissemination round
     max_transmissions: int = 4  # re-send budget (foca-style)
     rebroadcast_transmissions: int = 2  # budget for relayed changes
@@ -46,12 +53,15 @@ class SimConfig:
 
     # --- anti-entropy sync (reference api/peer.rs, agent/handlers.rs) ---
     sync_interval: int = 8  # rounds between sync sweeps (1-15 s backoff analog)
-    sync_adaptive: bool = False  # activity-reset cadence (util.rs:327-371):
-    # the reference's sync backoff RESETS to 1 s whenever changes flow, and
-    # decays to the lean cadence when idle — so repair accelerates exactly
-    # when gossip quiesces. Model: a round with zero cluster-wide writes
-    # and a nonzero gap syncs IMMEDIATELY (every round), while write-phase
-    # rounds keep the lean sync_interval cadence.
+    sync_adaptive: bool = False  # accelerated repair cadence: a round with
+    # zero cluster-wide writes and a nonzero gap syncs on the FLOOR cadence
+    # below instead of the lean sync_interval, so repair accelerates when
+    # gossip stops carrying new data.
+    sync_floor_rounds: int = 1  # adaptive floor, in rounds. The reference's
+    # sync_loop fires on a growing 1 s → 15 s backoff (util.rs:327-371,
+    # MAX_SYNC_BACKOFF agent/mod.rs:34-36) — at round_ms=200 the 1 s floor
+    # is 5 rounds; 1 keeps the (more aggressive than reference)
+    # sync-every-round tail.
     sync_candidates: int = 10  # RANDOM_NODES_CHOICES (agent/mod.rs:38)
     sync_server_cap: int = 3  # inbound sync semaphore (corro-types/agent.rs:132)
     sync_peers: int | None = None  # concurrent sync peers per node per sweep;

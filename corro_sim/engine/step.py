@@ -231,7 +231,8 @@ def sim_step(
 
     # ------------------------------------------------- gossip dissemination
     gossip, g_dst, g_src, g_actor, g_ver, g_chunk, g_valid = broadcast_step(
-        state.gossip, k_bcast, alive, view, cfg.fanout
+        state.gossip, k_bcast, alive, view, cfg.fanout,
+        emit_slots=cfg.emit_slots, round_idx=state.round,
     )
 
     dst = jnp.concatenate([e_dst, g_dst])
@@ -391,12 +392,15 @@ def sim_step(
     # ----------------------------------------------------------------- sync
     is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
     if cfg.sync_adaptive:
-        # activity-reset backoff (util.rs:327-371): when the cluster
-        # quiesces (zero writes this round) but somebody is still behind,
-        # sync EVERY round — repair accelerates exactly when gossip stops
-        # carrying new data. Write-phase rounds keep the lean cadence.
+        # accelerated repair: when the cluster quiesces (zero writes this
+        # round) but somebody is still behind, sync on the floor cadence
+        # (the reference's 1 s backoff floor, util.rs:327-371) instead of
+        # the lean sync_interval. Write-phase rounds keep the lean cadence.
         quiesced = writers.sum(dtype=jnp.int32) == 0
-        is_sync = is_sync | (quiesced & behind_pre)
+        floor_hit = (state.round % cfg.sync_floor_rounds) == (
+            cfg.sync_floor_rounds - 1
+        )
+        is_sync = is_sync | (quiesced & behind_pre & floor_hit)
 
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
@@ -606,7 +610,10 @@ def _repair_step(
     is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
     if cfg.sync_adaptive:
         # quiesced is identically True here (no writers by precondition)
-        is_sync = is_sync | behind_pre
+        floor_hit = (state.round % cfg.sync_floor_rounds) == (
+            cfg.sync_floor_rounds - 1
+        )
+        is_sync = is_sync | (behind_pre & floor_hit)
 
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,

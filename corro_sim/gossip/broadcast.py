@@ -138,24 +138,52 @@ def broadcast_step(
     sender_alive: jnp.ndarray,  # (N,) bool — node is actually up
     target_alive_view: jnp.ndarray,  # (N, N) bool or (N,1)-broadcastable: sender's belief
     fanout: int,
+    emit_slots: int = 0,
+    round_idx: jnp.ndarray | int = 0,
 ):
     """Emit one round of gossip messages; decrement transmission budgets.
 
-    Every live pending slot is sent to ``fanout`` uniformly sampled members
-    the *sender believes* are alive (membership is the sender's SWIM view,
-    not ground truth — a node will happily gossip at a dead peer until SWIM
-    says otherwise, exactly like the reference sending into QUIC connections
-    that have not yet errored).
+    Every serviced live pending slot is sent to ``fanout`` uniformly
+    sampled members the *sender believes* are alive (membership is the
+    sender's SWIM view, not ground truth — a node will happily gossip at a
+    dead peer until SWIM says otherwise, exactly like the reference sending
+    into QUIC connections that have not yet errored).
+
+    ``emit_slots`` (0 = all): egress cap per node per round — the
+    reference's bounded flush (≤64 KiB per 500 ms tick,
+    ``broadcast/mod.rs:378,394,446-455``). A round-rotating window picks
+    which slots are serviced; unserviced slots keep their transmission
+    budget and wait, so saturation DELAYS dissemination instead of fanning
+    out unboundedly. The emission lane count drops from N*P*fanout to
+    N*emit_slots*fanout.
 
     Returns ``(gossip, dst, src, actor, ver, chunk, valid)`` flat message
-    arrays of length N * P * fanout.
+    arrays of length N * serviced_slots * fanout.
     """
     n, p = gossip.pend_tx.shape
-    live = (gossip.pend_tx > 0) & sender_alive[:, None]  # (N, P)
+    e = p if not emit_slots or emit_slots >= p else emit_slots
+    if e < p:
+        # rotate the serviced window every round so every slot is serviced
+        # within ceil(P/E) rounds (FIFO-fair under saturation); a per-node
+        # phase from the ring cursor decorrelates nodes
+        base = (jnp.asarray(round_idx, jnp.int32) * e) % p
+        slot_ids = (base + gossip.cursor[:, None]
+                    + jnp.arange(e, dtype=jnp.int32)[None, :]) % p  # (N, E)
+        rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+        pend_tx = gossip.pend_tx[rows, slot_ids]
+        pend_actor = gossip.pend_actor[rows, slot_ids]
+        pend_ver = gossip.pend_ver[rows, slot_ids]
+        pend_chunk = gossip.pend_chunk[rows, slot_ids]
+    else:
+        pend_tx = gossip.pend_tx
+        pend_actor = gossip.pend_actor
+        pend_ver = gossip.pend_ver
+        pend_chunk = gossip.pend_chunk
+    live = (pend_tx > 0) & sender_alive[:, None]  # (N, E)
 
     tkey = jax.random.fold_in(key, 7)
     targets = jax.random.randint(
-        tkey, (n, p, fanout), 0, n, dtype=jnp.int32
+        tkey, (n, e, fanout), 0, n, dtype=jnp.int32
     )
     src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None, None], targets.shape)
     # Sender's belief about the target (gather per (src, target)). A shared
@@ -169,14 +197,19 @@ def broadcast_step(
 
     dst = targets.reshape(-1)
     valid = ok.reshape(-1)
-    actor = jnp.broadcast_to(gossip.pend_actor[:, :, None], targets.shape).reshape(-1)
-    ver = jnp.broadcast_to(gossip.pend_ver[:, :, None], targets.shape).reshape(-1)
+    actor = jnp.broadcast_to(pend_actor[:, :, None], targets.shape).reshape(-1)
+    ver = jnp.broadcast_to(pend_ver[:, :, None], targets.shape).reshape(-1)
     chunk = jnp.broadcast_to(
-        gossip.pend_chunk[:, :, None], targets.shape
+        pend_chunk[:, :, None], targets.shape
     ).reshape(-1)
     src_flat = src.reshape(-1)
 
-    new_tx = jnp.where(live, gossip.pend_tx - 1, gossip.pend_tx)
+    if e < p:
+        new_tx = gossip.pend_tx.at[rows, slot_ids].add(
+            -live.astype(jnp.int32)
+        )
+    else:
+        new_tx = jnp.where(live, gossip.pend_tx - 1, gossip.pend_tx)
     return (
         gossip.replace(pend_tx=new_tx),
         dst,

@@ -280,10 +280,27 @@ def sync_round(
     #    random gathers are slow, streaming reduces are fast).
     phase = jax.random.randint(k_phase, (), 0, a, dtype=jnp.int32)
     my_need = jnp.maximum(log.head[None, :] - book.head, 0)  # (N, A)
-    rolled = jnp.roll(my_need, -phase, axis=1)
-    pos = rolled > 0
-    csum = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A) inclusive
-    targets = jnp.arange(1, kprime + 1, dtype=jnp.int32)  # (K',)
+    pos = my_need > 0
+    # Rolled-order inclusive cumsum WITHOUT materializing a rolled (N, A)
+    # plane: for original column o, the prefix count in the rotated scan
+    # is c[o] - c[phase-1] (+ total when o < phase wraps to the tail).
+    # The k-th-positive recovery below only needs the MULTISET of prefix
+    # counts (it counts entries < k), which a permutation preserves.
+    c = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A) original order
+    total = c[:, -1:]
+    cpm1 = jnp.where(
+        phase > 0,
+        jnp.take(c, jnp.maximum(phase - 1, 0), axis=1)[:, None],
+        0,
+    )
+    wraps = jnp.arange(a, dtype=jnp.int32)[None, :] < phase  # (1, A)
+    csum = (c - cpm1 + jnp.where(wraps, total, 0)).astype(jnp.int16)
+    # int16 halves the (N, A, K') compare-reduce's bandwidth; counts are
+    # bounded by A (sync is exercised far below 32k actors per shard —
+    # the guard keeps a larger future config from silently wrapping)
+    if a >= (1 << 15):  # not an assert: must survive python -O
+        raise ValueError("actor axis exceeds int16 prefix-count range")
+    targets = jnp.arange(1, kprime + 1, dtype=jnp.int16)  # (K',)
     idx = jnp.sum(
         csum[:, :, None] < targets[None, None, :], axis=1, dtype=jnp.int32
     )  # (N, K') — rotated index of the k-th positive; a = unfilled
