@@ -332,6 +332,7 @@ class _Handler(BaseHTTPRequestHandler):
                 batch = []
                 while q:
                     batch.append(q.popleft())
+                self.api.cluster.channels.on_recv("subs_events", len(batch))
                 try:
                     self._stream_events(batch)
                 except (BrokenPipeError, ConnectionResetError, OSError):

@@ -855,6 +855,15 @@ class _Session:
                     raise PgError("syntax_error",
                                   "COPY delimiter must be a single "
                                   "character")
+                # Postgres copy.c rejects these outright: an alphanumeric
+                # delimiter would collide with backslash escapes in text
+                # format (e.g. data 'n' escaping to \n reads back as a
+                # newline), and \r \n \\ . are structurally reserved.
+                if v.isalnum() or v in "\\\r\n.":
+                    raise PgError(
+                        "feature_not_supported",
+                        f'COPY delimiter cannot be "{v}"',
+                    )
                 delim = v
             else:
                 raise PgError("syntax_error",

@@ -75,6 +75,12 @@ class SimConfig:
     # per-connection budget (parallel headroom without paying full P×
     # lane memory/compute every sweep — lanes are padded to this shape
     # whether needed or not). Clamped to sync_actor_topk × peers.
+    # NOTE (per-connection budget bound under probing): with probes >= 1,
+    # a lane's budget rank comes from the PRIMARY dealing while its slot
+    # may be reassigned by a probe, so one connection can serve up to
+    # probes x sync_actor_topk lanes (vs exactly sync_actor_topk under
+    # the exact-argmax policy) — a deliberate fidelity trade for the
+    # cheaper schedule; size server-side budgets accordingly.
     sync_deal_probes: int = 0  # serving-slot assignment policy. 0 = exact
     # argmax over every granted peer's capability per lane (full
     # (N, P, K') head gather + argsort budget rank — best repair depth,

@@ -164,10 +164,15 @@ def broadcast_step(
     e = p if not emit_slots or emit_slots >= p else emit_slots
     if e < p:
         # rotate the serviced window every round so every slot is serviced
-        # within ceil(P/E) rounds (FIFO-fair under saturation); a per-node
-        # phase from the ring cursor decorrelates nodes
+        # within ceil(P/E) rounds (FIFO-fair under saturation). The phase
+        # must advance by exactly e per round independent of ring state —
+        # folding the (enqueue-advanced) cursor in can cancel the rotation
+        # and starve slots; a STATIC per-node offset decorrelates nodes.
         base = (jnp.asarray(round_idx, jnp.int32) * e) % p
-        slot_ids = (base + gossip.cursor[:, None]
+        node_phase = (
+            jnp.arange(n, dtype=jnp.int32) * jnp.int32(0x9E37)
+        ) % p
+        slot_ids = (base + node_phase[:, None]
                     + jnp.arange(e, dtype=jnp.int32)[None, :]) % p  # (N, E)
         rows = jnp.arange(n, dtype=jnp.int32)[:, None]
         pend_tx = gossip.pend_tx[rows, slot_ids]

@@ -127,6 +127,7 @@ class LiveCluster:
         self._staging_overlay: tuple[dict, dict] | None = None
         self._rounds_ticked = 0
         self._totals: dict[str, float] = {}
+        self._lasts: dict[str, float] = {}  # last-round gauge snapshots
         # per-stage wall-clock (ms): {stage: (ewma, last)} — the live
         # analog of tools/profile_round.py, cheap enough to always keep on
         # (one perf_counter pair per stage per tick). Exposed on /metrics
@@ -136,6 +137,12 @@ class LiveCluster:
         self._log_poisoned = False  # ring-wrap tripwire latched
         self._partials = 0.0  # last round's buffered-partial gauge
         self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
+        # per-queue health counters (corro.runtime.channel.* analog)
+        from corro_sim.utils.metrics import ChannelMetrics
+
+        self.channels = ChannelMetrics()
+        self.channels.set_capacity("write_queue", 0)  # unbounded deques
+        self.channels.set_capacity("subs_events", 0)
 
         self.subs = SubsManager(
             LayoutAdapter(layout=self.layout), self.universe
@@ -263,6 +270,7 @@ class LiveCluster:
                 self._staging_overlay = None
             for cs in changesets:
                 self._pending[node].append(cs)
+                self.channels.on_send("write_queue")
             version = None
             if wait:
                 # Commit synchronously: tick until this node's queue
@@ -632,6 +640,7 @@ class LiveCluster:
             if not self._pending[i]:
                 continue
             cs: _PendingChangeset = self._pending[i].popleft()
+            self.channels.on_recv("write_queue")
             writers[i] = True
             dels[i] = cs.is_delete
             ncells[i] = len(cs.cells)
@@ -657,6 +666,7 @@ class LiveCluster:
             take = min(k, len(q))
             for r in range(take):
                 cs: _PendingChangeset = q.popleft()
+                self.channels.on_recv("write_queue")
                 writers[r, i] = True
                 dels[r, i] = cs.is_delete
                 ncells[r, i] = len(cs.cells)
@@ -671,6 +681,9 @@ class LiveCluster:
         sums = packed.sum(axis=1)
         for k, v in zip(names, sums):
             self._totals[k] = self._totals.get(k, 0.0) + float(v)
+        for k in ("pend_live", "queue_overflow"):
+            if k in names:
+                self._lasts[k] = float(packed[names.index(k), -1])
         self._gap = float(packed[names.index("gap"), -1])
         self._partials = float(packed[names.index("buffered_partials"), -1])
         if "log_wrapped" in names and packed[names.index("log_wrapped")].any():
@@ -794,8 +807,22 @@ class LiveCluster:
     def _notify_subs(self) -> None:
         events = self.subs.step(self.state.table)
         for sub_id, evs in events.items():
-            for q in self._sub_queues.get(sub_id, ()):  # live streams
+            queues = self._sub_queues.get(sub_id, ())
+            for q in queues:  # live streams
                 q.extend(evs)
+            if queues:
+                self.channels.on_send("subs_events", len(evs) * len(queues))
+                # depth from ground truth: attached consumers drain their
+                # deques directly, so the running send-recv difference
+                # would report a phantom backlog
+                self.channels.set_depth(
+                    "subs_events",
+                    sum(
+                        len(q)
+                        for qs in self._sub_queues.values()
+                        for q in qs
+                    ),
+                )
 
     def run_until_converged(self, max_rounds: int = 512) -> int | None:
         """Tick until every live node caught up; returns the round count.
@@ -886,6 +913,11 @@ class LiveCluster:
                 }
             )
         return out
+
+    def metrics_lasts(self) -> dict:
+        """Last-round gauge snapshots (ring depth, cumulative overflow)."""
+        with self._lock:
+            return dict(self._lasts)
 
     def metrics_totals(self) -> dict:
         with self._lock:

@@ -386,17 +386,19 @@ class Matcher(_EventStream):
 
 
 class JoinMatcher(_EventStream):
-    """A registered two-table equi-join query (VERDICT r1 next #5).
+    """A registered equi-join-chain query (VERDICT r1 next #5, widened to
+    N-way chains in r4 per VERDICT r3 next #7).
 
     The reference's Matcher rewrites arbitrary multi-table SELECTs into
     per-table queries with pk-alias injection and temp-table constraints
     (``pubsub.rs:697-832``). The tensor shape: each side is a regular
     single-table :class:`Matcher` (device rank-space predicate → match
-    mask + projected ranks); the equi-join then pairs the two matched row
-    sets by join-key *value* (ranks decode through the shared universe, so
+    mask + projected ranks); the chain then pairs matched row sets link by
+    link on join-key *value* (ranks decode through the shared universe, so
     rank equality IS value equality across columns), and the diff-to-events
-    machinery runs over the joined pairs. LEFT joins emit unmatched left
-    rows with NULL right cells.
+    machinery runs over the joined tuples. A LEFT link keeps unmatched
+    earlier-side rows with NULL cells for its side; each ON may reference
+    any earlier alias (``a JOIN b ON a.x=b.x JOIN c ON a.y=c.y``).
     """
 
     def __init__(self, sub_id, select: Select, node: int, layout, universe,
@@ -405,13 +407,14 @@ class JoinMatcher(_EventStream):
         self.select = select
         self.node = node
         self.universe = universe
-        j = select.join
-        self._kind = j.kind
         left_alias = select.alias or select.table
-        right_alias = j.alias
-        if left_alias == right_alias:
-            raise QueryError("join sides need distinct aliases")
-        self._alias_tables = {left_alias: select.table, right_alias: j.table}
+        self._aliases = [left_alias]
+        self._alias_tables = {left_alias: select.table}
+        for j in select.joins:
+            if j.alias in self._alias_tables:
+                raise QueryError("join sides need distinct aliases")
+            self._alias_tables[j.alias] = j.table
+            self._aliases.append(j.alias)
 
         def split_q(name, what):
             if "." not in name:
@@ -423,17 +426,23 @@ class JoinMatcher(_EventStream):
                 raise QueryError(f"unknown alias {a!r} in {name!r}")
             return a, c
 
-        self._on = {}
-        for q, side_alias, what in (
-            (j.on_left, left_alias, "ON left"),
-            (j.on_right, right_alias, "ON right"),
-        ):
-            a, c = split_q(q, what)
-            if a != side_alias:
+        # per join link: ((earlier_alias, col), (new_alias, col), kind)
+        self._links = []
+        on_need: dict = {a: set() for a in self._aliases}
+        for i, j in enumerate(select.joins):
+            la, lc = split_q(j.on_left, "ON left")
+            ra, rc = split_q(j.on_right, "ON right")
+            if ra != j.alias and la == j.alias:
+                (la, lc), (ra, rc) = (ra, rc), (la, lc)
+            earlier = set(self._aliases[: i + 1])
+            if ra != j.alias or la not in earlier:
                 raise QueryError(
-                    f"{what} column {q!r} must reference {side_alias!r}"
+                    f"JOIN ON must link {j.alias!r} to an earlier side: "
+                    f"{j.on_left!r} = {j.on_right!r}"
                 )
-            self._on[a] = c
+            self._links.append(((la, lc), (ra, rc), j.kind))
+            on_need[la].add(lc)
+            on_need[ra].add(rc)
 
         # ---- selected output columns, in SELECT order -------------------
         def side_schema(alias):
@@ -445,14 +454,14 @@ class JoinMatcher(_EventStream):
                         for c in select.columns]
         else:
             out_cols = []
-            for alias in (left_alias, right_alias):
+            for alias in self._aliases:
                 pks, vals = side_schema(alias)
                 out_cols.extend((alias, c) for c in (*pks, *vals))
         self._out_cols = out_cols
         self.columns = [f"{a}.{c}" for a, c in out_cols]
 
         # ---- WHERE routing: each conjunct goes to exactly one side ------
-        side_where = {left_alias: [], right_alias: []}
+        side_where: dict = {a: [] for a in self._aliases}
         parts = (select.where.parts if isinstance(select.where, And)
                  else (select.where,)) if select.where is not None else ()
         for p in parts:
@@ -468,18 +477,20 @@ class JoinMatcher(_EventStream):
 
         # ---- per-side single-table matchers -----------------------------
         self._sides = {}
-        for alias in (left_alias, right_alias):
+        for alias in self._aliases:
             tbl = self._alias_tables[alias]
             pks, vals = side_schema(alias)
             need = [c for a, c in out_cols if a == alias and c in vals]
-            on_c = self._on[alias]
-            if on_c in vals and on_c not in need:
-                need.append(on_c)
+            for on_c in sorted(on_need[alias]):
+                if on_c in vals and on_c not in need:
+                    need.append(on_c)
+                if on_c not in vals and on_c not in pks:
+                    raise QueryError(
+                        f"no such join column {alias}.{on_c}"
+                    )
             for c in (c for a, c in out_cols if a == alias):
                 if c not in vals and c not in pks:
                     raise QueryError(f"no such column {alias}.{c}")
-            if on_c not in vals and on_c not in pks:
-                raise QueryError(f"no such join column {alias}.{on_c}")
             ps = side_where[alias]
             w = None if not ps else (ps[0] if len(ps) == 1 else And(tuple(ps)))
             w = rewrite_columns(w, lambda c: c.split(".", 1)[1])
@@ -488,7 +499,6 @@ class JoinMatcher(_EventStream):
                 Select(table=tbl, columns=tuple(need), where=w),
                 node, layout, universe, max_buffer=0,
             )
-        self._left_alias, self._right_alias = left_alias, right_alias
         self._rowspan = getattr(layout, "total_rows", 1 << 20)
 
         self._prev: dict[int, list] = {}
@@ -523,43 +533,59 @@ class JoinMatcher(_EventStream):
         return out
 
     def _join(self, table_state) -> dict:
-        """{rowid: output cells} of the current join result."""
-        L = self._side_rows(self._left_alias, table_state)
-        R = self._side_rows(self._right_alias, table_state)
-        lpos = self._cell_pos(self._left_alias, self._on[self._left_alias])
-        rpos = self._cell_pos(self._right_alias, self._on[self._right_alias])
-        ridx: dict = {}
-        for rs, cells in R.items():
-            v = cells[rpos]
-            if v is None:
-                continue  # SQL: NULL join keys never match
-            ridx.setdefault(sqlite_sort_key(v), []).append(rs)
+        """{rowid: output cells} of the current join-chain result.
 
-        n_right_cells = sum(
-            1 for a, _ in self._out_cols if a == self._right_alias
-        )
+        Tuples build link by link: each link probes its side's matched
+        rows (indexed by decoded ON-key value) from every partial tuple;
+        a LEFT link keeps keyless/matchless tuples with a NULL side. The
+        synthetic rowid is the mixed-radix (slot+1) tuple over rowspan —
+        stable for a given combination of source rows."""
+        side_rows = {
+            a: self._side_rows(a, table_state) for a in self._aliases
+        }
+        a0 = self._aliases[0]
+        parts = [
+            ((ls,), {a0: cells}) for ls, cells in side_rows[a0].items()
+        ]
+        for (la, lc), (ra, rc), kind in self._links:
+            rpos = self._cell_pos(ra, rc)
+            ridx: dict = {}
+            for rs, cells in side_rows[ra].items():
+                v = cells[rpos]
+                if v is None:
+                    continue  # SQL: NULL join keys never match
+                ridx.setdefault(sqlite_sort_key(v), []).append(rs)
+            lpos = self._cell_pos(la, lc)
+            nxt = []
+            for slots, sides in parts:
+                lcells = sides.get(la)
+                v = None if lcells is None else lcells[lpos]
+                matches = (
+                    ridx.get(sqlite_sort_key(v), []) if v is not None else []
+                )
+                if matches:
+                    for rs in matches:
+                        nxt.append(
+                            (slots + (rs + 1,),
+                             {**sides, ra: side_rows[ra][rs]})
+                        )
+                elif kind == "left":
+                    nxt.append((slots + (0,), {**sides, ra: None}))
+            parts = nxt
+
         out = {}
-        for ls, lcells in L.items():
-            v = lcells[lpos]
-            matches = ridx.get(sqlite_sort_key(v), []) if v is not None else []
-            if matches:
-                for rs in matches:
-                    cells = self._project(lcells, R[rs])
-                    out[ls * (self._rowspan + 1) + rs + 1] = cells
-            elif self._kind == "left":
-                cells = self._project(lcells, None)
-                out[ls * (self._rowspan + 1)] = cells
+        for slots, sides in parts:
+            rid = slots[0]
+            for s in slots[1:]:
+                rid = rid * (self._rowspan + 1) + s
+            out[rid] = self._project(sides)
         return out
 
-    def _project(self, lcells, rcells) -> list:
+    def _project(self, sides) -> list:
         out = []
         for a, c in self._out_cols:
-            if a == self._left_alias:
-                out.append(lcells[self._cell_pos(a, c)])
-            elif rcells is None:
-                out.append(None)
-            else:
-                out.append(rcells[self._cell_pos(a, c)])
+            cells = sides.get(a)
+            out.append(None if cells is None else cells[self._cell_pos(a, c)])
         return out
 
     # ------------------------------------------------------------- surface
@@ -897,18 +923,138 @@ class AggregateMatcher(Matcher):
         return events
 
 
+class JoinAggregateMatcher(JoinMatcher):
+    """Live aggregates / GROUP BY over a join chain (VERDICT r3 next #7).
+
+    Strategy: recompute-and-diff — the joined row set is re-derived per
+    step (it already is, for plain join subscriptions) and folded into
+    groups whose output cells are diffed against the last emitted state.
+    This is the reference's own approach for arbitrary SELECTs: it re-runs
+    the rewritten SQL and diffs the query table
+    (``pubsub.rs:697-832,1518-1793``). Single-table aggregates keep the
+    cheaper incremental :class:`AggregateMatcher` path.
+    """
+
+    def __init__(self, sub_id, select: Select, node: int, layout, universe,
+                 max_buffer: int = 512):
+        self._agg_select = select
+        super().__init__(sub_id, select.base(), node, layout, universe,
+                         max_buffer=max_buffer)
+        # dedupe/removal keys on the full aggregate SQL, not the base form
+        self.select = select
+        pos = {c: i for i, c in enumerate(self.columns)}
+
+        def need(col):
+            if col not in pos:
+                raise QueryError(f"no such column {col!r} in join output")
+            return pos[col]
+
+        self._gpos = [need(c) for c in select.group_by]
+        self._items = []  # ('col', pos) | ('agg', Agg, pos|None)
+        for kind, it in select.items:
+            if kind == "col":
+                self._items.append(("col", need(it)))
+            else:
+                self._items.append(
+                    ("agg", it, None if it.col is None else need(it.col))
+                )
+        self.columns = [
+            (name if kind == "col" else name.label())
+            for kind, name in select.items
+        ]
+        self._rid_of_key: dict = {}
+        self._next_rid = 0
+
+    def _groups_of(self, table_state) -> dict:
+        """{group key: output cells} — full recompute from the join."""
+        joined = self._join(table_state)
+        groups: dict = {}
+        for _rid, cells in sorted(joined.items()):
+            key = tuple(sqlite_sort_key(cells[i]) for i in self._gpos)
+            groups.setdefault(key, []).append(cells)
+        if not self._agg_select.group_by and not groups:
+            groups[()] = []  # SQLite: ungrouped aggregate = exactly one row
+        out = {}
+        for key, rows in groups.items():
+            out_cells = []
+            for item in self._items:
+                if item[0] == "col":
+                    out_cells.append(rows[0][item[1]] if rows else None)
+                    continue
+                agg, p = item[1], item[2]
+                vals = (
+                    [r[p] for r in rows if r[p] is not None]
+                    if p is not None else rows
+                )
+                if agg.fn == "COUNT":
+                    out_cells.append(len(vals))
+                elif agg.fn in ("SUM", "AVG"):
+                    nums = [_sql_number(v) for v in vals]
+                    floats = sum(1 for v in nums if isinstance(v, float))
+                    total = sum(nums) if nums else 0
+                    if agg.fn == "SUM":
+                        out_cells.append(sum_cell(total, len(nums), floats))
+                    else:
+                        out_cells.append(avg_cell(total, len(nums)))
+                elif not vals:
+                    out_cells.append(None)
+                elif agg.fn == "MIN":
+                    out_cells.append(min(vals, key=sqlite_sort_key))
+                else:
+                    out_cells.append(max(vals, key=sqlite_sort_key))
+            out[key] = out_cells
+        return out
+
+    def _rid(self, key) -> int:
+        rid = self._rid_of_key.get(key)
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+            self._rid_of_key[key] = rid
+        return rid
+
+    def prime(self, table_state):
+        cur = self._groups_of(table_state)
+        self._prev = cur
+        self._primed = True
+        header = {"columns": list(self.columns)}
+        rows = [
+            {"row": [self._rid(key), cur[key]]}
+            for key in sorted(cur, key=self._rid)
+        ]
+        eoq = {"eoq": {"change_id": self._change_id}}
+        return [header, *rows, eoq]
+
+    def step(self, table_state) -> list:
+        if not self._primed:
+            raise RuntimeError("matcher not primed — call prime() first")
+        cur = self._groups_of(table_state)
+        events: list = []
+        changed = [
+            key for key in (cur.keys() | self._prev.keys())
+            if cur.get(key) != self._prev.get(key)
+        ]
+        for key in sorted(changed, key=self._rid):
+            if key not in cur:
+                self._emit(events, "delete", self._rid(key), self._prev[key])
+            elif key not in self._prev:
+                self._emit(events, "insert", self._rid(key), cur[key])
+            else:
+                self._emit(events, "update", self._rid(key), cur[key])
+        self._prev = cur
+        self._buffer_events(events)
+        return events
+
+
 def make_matcher(sub_id, select: Select, node: int, layout, universe,
                  max_buffer: int = 512):
-    """Matcher factory: single-table, equi-join or aggregate — same
-    public surface."""
+    """Matcher factory: single-table, join chain, or aggregate (incremental
+    single-table / recompute-and-diff over joins) — same public surface."""
     if select.aggregates:
-        if select.join is not None:
-            raise QueryError(
-                "aggregates over JOIN subscriptions are unsupported"
-            )
-        return AggregateMatcher(sub_id, select, node, layout, universe,
-                                max_buffer=max_buffer)
-    cls = JoinMatcher if select.join is not None else Matcher
+        cls = JoinAggregateMatcher if select.joins else AggregateMatcher
+        return cls(sub_id, select, node, layout, universe,
+                   max_buffer=max_buffer)
+    cls = JoinMatcher if select.joins else Matcher
     return cls(sub_id, select, node, layout, universe, max_buffer=max_buffer)
 
 

@@ -120,12 +120,16 @@ class JsonContains:
 
 @dataclasses.dataclass(frozen=True)
 class Join:
-    """Two-table equi-join clause (``a JOIN b ON a.x = b.y``)."""
+    """One equi-join link in a join chain (``… JOIN b ON a.x = b.y``).
+
+    ``on_left`` may reference ANY earlier alias in the chain (the FROM
+    table or a previous join's alias); ``on_right`` references this
+    join's own alias."""
 
     table: str  # right table
     alias: str  # right alias (defaults to table name)
-    on_left: str  # qualified "alias.col" on the left side
-    on_right: str  # qualified "alias.col" on the right side
+    on_left: str  # qualified "alias.col" on an earlier side
+    on_right: str  # qualified "alias.col" on this join's side
     kind: str = "inner"  # 'inner' | 'left'
 
 
@@ -146,17 +150,23 @@ class Select:
     columns: tuple  # () = * (plain selected column names)
     where: object  # predicate AST or None
     alias: str | None = None  # left-table alias (join queries)
-    join: Join | None = None
+    joins: tuple = ()  # join chain, left to right (Join instances)
     items: tuple = ()  # SELECT-list order: ('col', name) | ('agg', Agg)
     group_by: tuple = ()  # column names
     order_by: tuple = ()  # ((name, descending: bool), ...)
     limit: int | None = None
     offset: int = 0
 
+    @property
+    def join(self) -> Join | None:
+        """First join of the chain (compat accessor; prefer ``joins``)."""
+        return self.joins[0] if self.joins else None
+
     def has_extras(self) -> bool:
         """Anything beyond the matcher's match+project core — evaluated by
-        :func:`post_process` on the query path, rejected for live
-        subscriptions (a diff-engine has no incremental GROUP BY)."""
+        :func:`post_process` on the query path; live subscriptions keep
+        aggregates/GROUP BY incrementally (AggregateMatcher) or by
+        recompute-and-diff over joins (JoinAggregateMatcher)."""
         return bool(
             self.aggregates or self.group_by or self.order_by
             or self.limit is not None or self.offset
@@ -188,7 +198,7 @@ class Select:
             columns=cols,
             where=self.where,
             alias=self.alias,
-            join=self.join,
+            joins=self.joins,
         )
 
     def normalized(self) -> str:
@@ -203,8 +213,7 @@ class Select:
         sql = f"SELECT {cols} FROM {self.table}"
         if self.alias is not None and self.alias != self.table:
             sql += f" AS {self.alias}"
-        if self.join is not None:
-            j = self.join
+        for j in self.joins:
             kw = "LEFT JOIN" if j.kind == "left" else "JOIN"
             sql += f" {kw} {j.table}"
             if j.alias != j.table:
@@ -400,9 +409,10 @@ class _Parser:
         self.expect("FROM")
         table = self.expect("ident")
         alias = self._opt_alias(table)
-        join = None
-        k = self.peek()[0]
-        if k in ("JOIN", "INNER", "LEFT"):
+        joins: list = []
+        known_aliases = [alias]
+        while self.peek()[0] in ("JOIN", "INNER", "LEFT"):
+            k = self.peek()[0]
             kind = "inner"
             if k == "INNER":
                 self.next()
@@ -414,9 +424,9 @@ class _Parser:
             self.expect("JOIN")
             jt = self.expect("ident")
             jalias = self._opt_alias(jt)
-            if jalias == alias:
+            if jalias in known_aliases:
                 raise QueryError(
-                    f"join sides need distinct aliases, both are {alias!r}"
+                    f"join sides need distinct aliases; {jalias!r} repeats"
                 )
             self.expect("ON")
             lhs = self.qual_ident()
@@ -424,13 +434,22 @@ class _Parser:
             if op != ("op", "="):
                 raise QueryError("JOIN ON supports equality only")
             rhs = self.qual_ident()
-            # normalize: on_left belongs to the FROM side
+
+            # normalize: on_left references an EARLIER side, on_right the
+            # alias this JOIN introduces
             def side(q):
                 return q.split(".", 1)[0] if "." in q else None
-            if side(lhs) == jalias and side(rhs) == alias:
+
+            if side(lhs) == jalias and side(rhs) in known_aliases:
                 lhs, rhs = rhs, lhs
-            join = Join(table=jt, alias=jalias, on_left=lhs, on_right=rhs,
-                        kind=kind)
+            if side(rhs) != jalias or side(lhs) not in known_aliases:
+                raise QueryError(
+                    f"JOIN ON must link {jalias!r} to an earlier side: "
+                    f"{lhs!r} = {rhs!r}"
+                )
+            joins.append(Join(table=jt, alias=jalias, on_left=lhs,
+                              on_right=rhs, kind=kind))
+            known_aliases.append(jalias)
         where = None
         if self.peek()[0] == "WHERE":
             self.next()
@@ -492,8 +511,8 @@ class _Parser:
                 )
         return Select(
             table=table, columns=tuple(cols), where=where,
-            alias=(alias if (alias != table or join is not None) else None),
-            join=join,
+            alias=(alias if (alias != table or joins) else None),
+            joins=tuple(joins),
             items=tuple(items),
             group_by=tuple(group_by),
             order_by=tuple(order_by),

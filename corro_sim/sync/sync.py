@@ -294,13 +294,11 @@ def sync_round(
         0,
     )
     wraps = jnp.arange(a, dtype=jnp.int32)[None, :] < phase  # (1, A)
-    csum = (c - cpm1 + jnp.where(wraps, total, 0)).astype(jnp.int16)
-    # int16 halves the (N, A, K') compare-reduce's bandwidth; counts are
-    # bounded by A (sync is exercised far below 32k actors per shard —
-    # the guard keeps a larger future config from silently wrapping)
-    if a >= (1 << 15):  # not an assert: must survive python -O
-        raise ValueError("actor axis exceeds int16 prefix-count range")
-    targets = jnp.arange(1, kprime + 1, dtype=jnp.int16)  # (K',)
+    # int16 halves the (N, A, K') compare-reduce's bandwidth; prefix
+    # counts are bounded by A, so fall back to int32 at >=32k actors
+    cdtype = jnp.int16 if a < (1 << 15) else jnp.int32
+    csum = (c - cpm1 + jnp.where(wraps, total, 0)).astype(cdtype)
+    targets = jnp.arange(1, kprime + 1, dtype=cdtype)  # (K',)
     idx = jnp.sum(
         csum[:, :, None] < targets[None, None, :], axis=1, dtype=jnp.int32
     )  # (N, K') — rotated index of the k-th positive; a = unfilled

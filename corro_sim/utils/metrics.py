@@ -60,6 +60,65 @@ CHANGE_WIRE_BYTES = 128
 CHUNK_HEADER_BYTES = 32
 
 
+class ChannelMetrics:
+    """Per-queue health counters — the ``corro.runtime.channel.*`` series
+    (reference ``corro-types/src/channel.rs:16-184``): send / recv /
+    failed-send counts, depth + max-capacity gauges, and a send-delay
+    EWMA per named channel. The reference wraps every tokio channel in a
+    counting sender/receiver; here the host-side queues (write queue, sub
+    event queues) count at their touch points and the device-side gossip
+    rings derive their series from step metrics."""
+
+    def __init__(self):
+        import threading
+
+        self._ch: dict[str, dict] = {}
+        self._lock = threading.Lock()  # touch points span HTTP handler
+        # threads and the tick thread; += on a dict entry is not atomic
+
+    def _c(self, name: str) -> dict:
+        return self._ch.setdefault(
+            name,
+            {"send": 0, "recv": 0, "failed": 0, "depth": 0,
+             "capacity": 0, "send_delay_ewma_ms": 0.0,
+             "delay_samples": 0},
+        )
+
+    def set_capacity(self, name: str, capacity: int) -> None:
+        with self._lock:
+            self._c(name)["capacity"] = int(capacity)
+
+    def set_depth(self, name: str, depth: int) -> None:
+        with self._lock:
+            self._c(name)["depth"] = int(depth)
+
+    def on_send(self, name: str, n: int = 1, delay_s: float | None = None):
+        with self._lock:
+            c = self._c(name)
+            c["send"] += n
+            c["depth"] += n
+            if delay_s is not None:
+                ms = delay_s * 1000.0
+                c["send_delay_ewma_ms"] += 0.2 * (
+                    ms - c["send_delay_ewma_ms"]
+                )
+                c["delay_samples"] += 1
+
+    def on_recv(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            c = self._c(name)
+            c["recv"] += n
+            c["depth"] = max(0, c["depth"] - n)
+
+    def on_failed(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._c(name)["failed"] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._ch.items()}
+
+
 def render_prometheus(cluster) -> str:
     lines: list[str] = []
 
@@ -148,6 +207,58 @@ def render_prometheus(cluster) -> str:
         "queued uncommitted changesets (SplitPool write queue analog)",
         pending,
     )
+
+    # ---- per-channel queue health (corro.runtime.channel.*,
+    # channel.rs:16-184): host-side queues count at their touch points;
+    # the device-side gossip pending rings derive theirs from step
+    # metrics (sends = enqueued chunks, recvs = emissions, failed =
+    # overflow clobbers, depth = live slots after the last round).
+    chans = getattr(cluster, "channels", None)
+    if chans is not None:
+        snap = chans.snapshot()
+        lasts = getattr(cluster, "metrics_lasts", lambda: {})()
+        snap["gossip_pending"] = {
+            "send": int(
+                totals.get("fresh_chunks", 0) + totals.get("writes", 0)
+            ),
+            "recv": int(totals.get("msgs_sent", 0)),
+            "failed": int(lasts.get("queue_overflow", 0)),
+            "depth": int(lasts.get("pend_live", 0)),
+            "capacity": cluster.cfg.num_nodes * cluster.cfg.pend_slots,
+            "send_delay_ewma_ms": 0.0,
+        }
+        series = [
+            ("send", "corro_runtime_channel_send_count_total", "counter",
+             "items enqueued per channel"),
+            ("recv", "corro_runtime_channel_recv_count_total", "counter",
+             "items dequeued per channel"),
+            ("failed", "corro_runtime_channel_failed_send_count_total",
+             "counter", "failed/overflowing sends per channel"),
+            ("depth", "corro_runtime_channel_depth", "gauge",
+             "current queued items per channel"),
+            ("capacity", "corro_runtime_channel_max_capacity", "gauge",
+             "channel capacity (0 = unbounded)"),
+            ("send_delay_ewma_ms", "corro_runtime_channel_send_delay_ms",
+             "gauge", "EWMA send delay per channel (only channels with "
+             "observed samples; host queues are unbounded deques that "
+             "never block)"),
+        ]
+        for field, name, kind, help_ in series:
+            rows_out = []
+            for cname in sorted(snap):
+                if (
+                    field == "send_delay_ewma_ms"
+                    and not snap[cname].get("delay_samples")
+                ):
+                    continue  # never measured — don't fake a healthy 0
+                rows_out.append(
+                    f'{name}{{channel_name="{cname}"}} '
+                    f"{snap[cname][field]}"
+                )
+            if rows_out:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} {kind}")
+                lines.extend(rows_out)
 
     # ---- per-table live rows per node (agent/metrics.rs per-table rows).
     # Per-node breakdown only below a cardinality cap: tables x N series

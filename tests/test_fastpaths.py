@@ -165,3 +165,33 @@ def test_enqueue_grouped_overflow_conserves_slots():
     np.testing.assert_array_equal(
         np.asarray(g_slow.cursor), np.asarray(g_fast.cursor)
     )
+
+
+def test_emit_slots_cap_services_all_slots():
+    """The egress cap's rotating window must service EVERY live slot within
+    ceil(P/E) rounds regardless of ring state (a cursor-coupled phase can
+    cancel the rotation and starve slots forever)."""
+    import jax
+    import jax.numpy as jnp
+
+    from corro_sim.gossip.broadcast import GossipState, broadcast_step
+
+    n, p, e = 4, 8, 3
+    g = GossipState(
+        pend_actor=jnp.zeros((n, p), jnp.int32),
+        pend_ver=jnp.arange(n * p, dtype=jnp.int32).reshape(n, p),
+        pend_chunk=jnp.zeros((n, p), jnp.int32),
+        pend_tx=jnp.ones((n, p), jnp.int32),  # every slot live, tx=1
+        cursor=jnp.asarray([0, 3, 5, 7], jnp.int32),
+        overflow=jnp.zeros((), jnp.int32),
+    )
+    alive = jnp.ones((n,), bool)
+    view = jnp.ones((1, n), bool)
+    rounds_needed = -(-p // e)  # ceil
+    for r in range(rounds_needed):
+        g, *_ = broadcast_step(
+            g, jax.random.PRNGKey(r), alive, view, 1,
+            emit_slots=e, round_idx=r,
+        )
+    # every slot's single transmission budget was consumed exactly once
+    assert int(g.pend_tx.sum()) == 0, np.asarray(g.pend_tx)
