@@ -806,23 +806,26 @@ class LiveCluster:
 
     def _notify_subs(self) -> None:
         events = self.subs.step(self.state.table)
+        delivered = False
         for sub_id, evs in events.items():
             queues = self._sub_queues.get(sub_id, ())
             for q in queues:  # live streams
                 q.extend(evs)
             if queues:
+                delivered = True
                 self.channels.on_send("subs_events", len(evs) * len(queues))
-                # depth from ground truth: attached consumers drain their
-                # deques directly, so the running send-recv difference
-                # would report a phantom backlog
-                self.channels.set_depth(
-                    "subs_events",
-                    sum(
-                        len(q)
-                        for qs in self._sub_queues.values()
-                        for q in qs
-                    ),
-                )
+        if delivered:
+            # depth from ground truth, once per tick: attached consumers
+            # drain their deques directly, so the running send-recv
+            # difference would report a phantom backlog
+            self.channels.set_depth(
+                "subs_events",
+                sum(
+                    len(q)
+                    for qs in self._sub_queues.values()
+                    for q in qs
+                ),
+            )
 
     def run_until_converged(self, max_rounds: int = 512) -> int | None:
         """Tick until every live node caught up; returns the round count.

@@ -39,6 +39,7 @@ from corro_sim.subs.query import (
     avg_cell,
     compile_predicate,
     eval_predicate_py,
+    fold_aggregate,
     parse_query,
     predicate_columns,
     predicate_intern_values,
@@ -982,26 +983,11 @@ class JoinAggregateMatcher(JoinMatcher):
                     out_cells.append(rows[0][item[1]] if rows else None)
                     continue
                 agg, p = item[1], item[2]
-                vals = (
-                    [r[p] for r in rows if r[p] is not None]
-                    if p is not None else rows
+                out_cells.append(
+                    fold_aggregate(
+                        agg, rows if p is None else [r[p] for r in rows]
+                    )
                 )
-                if agg.fn == "COUNT":
-                    out_cells.append(len(vals))
-                elif agg.fn in ("SUM", "AVG"):
-                    nums = [_sql_number(v) for v in vals]
-                    floats = sum(1 for v in nums if isinstance(v, float))
-                    total = sum(nums) if nums else 0
-                    if agg.fn == "SUM":
-                        out_cells.append(sum_cell(total, len(nums), floats))
-                    else:
-                        out_cells.append(avg_cell(total, len(nums)))
-                elif not vals:
-                    out_cells.append(None)
-                elif agg.fn == "MIN":
-                    out_cells.append(min(vals, key=sqlite_sort_key))
-                else:
-                    out_cells.append(max(vals, key=sqlite_sort_key))
             out[key] = out_cells
         return out
 

@@ -777,6 +777,29 @@ def _sql_number(v):
         return float(s)
 
 
+def fold_aggregate(a: "Agg", vals: list):
+    """One group's aggregate output from its member values (COUNT(*) gets
+    the member rows themselves). THE single definition of the SQL
+    aggregate fold — the one-shot query path, the join-aggregate
+    recompute, and tests all share it, so NULL filtering, numeric
+    coercion and empty-group rules cannot drift between paths."""
+    if a.col is None:  # COUNT(*)
+        return len(vals)
+    vals = [v for v in vals if v is not None]
+    if a.fn == "COUNT":
+        return len(vals)
+    if not vals:
+        return None
+    if a.fn in ("SUM", "AVG"):
+        nums = [_sql_number(v) for v in vals]
+        floats = sum(isinstance(x, float) for x in nums)
+        if a.fn == "SUM":
+            return sum_cell(sum(nums), len(nums), floats)
+        return avg_cell(sum(nums), len(nums))
+    key = sqlite_sort_key
+    return min(vals, key=key) if a.fn == "MIN" else max(vals, key=key)
+
+
 def sum_cell(total, nonnull: int, floats: int):
     """SQLite SUM output rule, shared by the one-shot query path and the
     incremental AggregateMatcher so the two can never drift: NULL over an
@@ -823,22 +846,9 @@ def post_process(select: Select, events: list) -> list:
             groups[()] = []  # aggregates over an empty table yield one row
 
         def agg_value(a: Agg, grp: list):
-            if a.col is None:  # COUNT(*)
-                return len(grp)
-            vals = [r[pos(a.col)] for r in grp]
-            vals = [v for v in vals if v is not None]
-            if a.fn == "COUNT":
-                return len(vals)
-            if not vals:
-                return None
-            if a.fn in ("SUM", "AVG"):
-                nums = [_sql_number(v) for v in vals]
-                floats = sum(isinstance(x, float) for x in nums)
-                if a.fn == "SUM":
-                    return sum_cell(sum(nums), len(nums), floats)
-                return avg_cell(sum(nums), len(nums))
-            key = sqlite_sort_key
-            return min(vals, key=key) if a.fn == "MIN" else max(vals, key=key)
+            return fold_aggregate(
+                a, grp if a.col is None else [r[pos(a.col)] for r in grp]
+            )
 
         out_cols = [
             (n if k == "col" else n.label()) for k, n in select.items
