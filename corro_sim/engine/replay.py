@@ -39,6 +39,7 @@ def inject_round(
     state: SimState,
     valid: jnp.ndarray,  # (A,) bool
     empty: jnp.ndarray,  # (A,) bool
+    ts: jnp.ndarray,  # (A,) int32 — EmptySet ts for cleared lanes (-1 none)
     ncells: jnp.ndarray,  # (A,) int32
     row: jnp.ndarray,  # (A, S) int32
     col: jnp.ndarray,  # (A, S) int32
@@ -83,10 +84,15 @@ def inject_round(
         state.log, actor, row, col, vr, cv, cl,
         jnp.where(empty, 0, ncells), valid,
     )
-    # Cleared versions occupy their slot but deliver nothing.
+    # Cleared versions occupy their slot but deliver nothing; each keeps
+    # the ts its EmptySet carried (message-granular, handlers.rs:524-719).
+    # Ownership-fold clearings during replay stay unstamped (-1): the
+    # trace carries no clock for them, and an unstamped EmptySet simply
+    # never advances a receiver's last_cleared (conservative).
     aidx = jnp.where(valid & empty, actor, log.head.shape[0])
     slot = (ver - 1) % log.capacity
     log = log.replace(cleared=log.cleared.at[aidx, slot].set(True, mode="drop"))
+    cleared_hlc = state.cleared_hlc.at[aidx, slot].max(ts, mode="drop")
 
     book = state.book.replace(
         head=state.book.head.at[actor, actor].add(valid.astype(jnp.int32))
@@ -116,7 +122,10 @@ def inject_round(
         cfg.max_transmissions,
     )
 
-    return state.replace(table=table, book=book, log=log, own=own, gossip=gossip)
+    return state.replace(
+        table=table, book=book, log=log, own=own, gossip=gossip,
+        cleared_hlc=cleared_hlc,
+    )
 
 
 @dataclasses.dataclass
@@ -183,6 +192,7 @@ def replay(
                 state,
                 jnp.asarray(trace.valid[r]),
                 jnp.asarray(trace.empty[r]),
+                jnp.asarray(trace.ts[r]),
                 jnp.asarray(trace.ncells[r]),
                 jnp.asarray(cells["row"][r]),
                 jnp.asarray(cells["col"][r]),

@@ -81,7 +81,7 @@ def state_shardings(
         round=replicated,
         hlc=node_sharded,
         last_cleared=node_sharded,
-        cleared_hlc=node_sharded,  # (A,) — actor axis rides the same mesh axis
+        cleared_hlc=node_sharded,  # (A, L) — actor axis rides the same mesh axis
         rtt=(
             node_sharded
             if state.rtt.shape[0] == num_nodes

@@ -41,8 +41,10 @@ class SimState:
     last_cleared: jnp.ndarray  # (N,) int32 — HLC ts of the newest emptyset
     # a node applied (last_cleared_ts analog, corro-types/src/sync.rs:80-87);
     # monotone max, so a stale-clock sender can never regress it
-    cleared_hlc: jnp.ndarray  # (A,) int32 — HLC stamp of each actor's
-    # latest cleared-version event (the ts carried by its EmptySet)
+    cleared_hlc: jnp.ndarray  # (A, L) int32 — HLC stamp of each cleared
+    # version (the ts its EmptySet carries, message-granular like
+    # store_empty_changeset's per-range ts, change.rs:267-389); -1 = not
+    # cleared / stamp unknown
     rtt: jnp.ndarray  # (N, N) uint8 observed edge delay [receiver, sender]
     # ((1,1) placeholder when rtt_rings is off — members.rs:140-179 analog)
     inflight: jnp.ndarray  # (slots, 6, L) int32 — in-flight delayed
@@ -100,7 +102,9 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
         round=jnp.zeros((), jnp.int32),
         hlc=jnp.zeros((n,), jnp.int32),
         last_cleared=jnp.full((n,), -1, jnp.int32),
-        cleared_hlc=jnp.full((cfg.num_actors,), -1, jnp.int32),
+        cleared_hlc=jnp.full(
+            (cfg.num_actors, cfg.log_capacity), -1, jnp.int32
+        ),
         rtt=make_rtt(n, cfg.rtt_rings),
         inflight=jnp.zeros(
             (cfg.inflight_slots, 6, cfg.lanes_per_round)

@@ -199,16 +199,16 @@ def sim_step(
         w_cell_live.reshape(-1),
         jnp.broadcast_to(w_del[:, None], (n, s)).reshape(-1),
     )
-    # Stamp each actor whose version(s) were newly cleared this round with
-    # the round's write-phase clock (max HLC over this round's live
-    # writers) — the ts an EmptySet carries (store_empty_changeset,
-    # change.rs:267-389), at round granularity: attributing each cleared
-    # version to the exact clearing writer would mean threading per-lane
-    # clocks through the ownership fold; the round-max is an upper bound
-    # minted by SOME live writer this round, and the monotone-max gate on
-    # last_cleared (the correctness property) is unaffected. A down/stale
-    # writer cannot mint a fresh ts: only live writers contribute.
-    newly_cleared = (log.cleared & ~pre_cleared).any(axis=1)  # (A,)
+    # Stamp each version cleared this round with the round's write-phase
+    # clock (max HLC over this round's live writers) — the ts its EmptySet
+    # carries (store_empty_changeset, change.rs:267-389). Message-granular
+    # per (actor, version-slot): a later EmptySet for a different version
+    # gets its own, newer stamp, exactly like the reference's per-range ts
+    # buffering in handle_emptyset (handlers.rs:524-719). The round-max
+    # writer clock is an upper bound minted by SOME live writer this
+    # round (per-lane attribution would mean threading clocks through the
+    # ownership fold); the monotone-max gate on last_cleared is unaffected.
+    newly_cleared = log.cleared & ~pre_cleared  # (A, L)
     writer_ts = jnp.max(jnp.where(writers, state.hlc, -1))
     cleared_hlc = jnp.where(
         newly_cleared,
@@ -328,16 +328,15 @@ def sim_step(
         book, dst, actor, ver, delivered, chunk=chunk, bits_per_version=cpv,
         presorted=True,
     )
+    g_actor = jnp.where(complete, actor, 0)
+    g_slot = (jnp.maximum(ver, 1) - 1) % log.capacity
     c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
-        log, jnp.where(complete, actor, 0), jnp.maximum(ver, 1)
+        log, g_actor, jnp.maximum(ver, 1)
     )
     m = dst.shape[0]
     # Cleared versions deliver no cells — the receiver of an emptied
     # changeset just fast-forwards bookkeeping (handle_emptyset analog).
-    c_cleared = log.cleared[
-        jnp.where(complete, actor, 0),
-        (jnp.maximum(ver, 1) - 1) % log.capacity,
-    ]
+    c_cleared = log.cleared[g_actor, g_slot]
     cell_live = (
         complete[:, None]
         & ~c_cleared[:, None]
@@ -387,7 +386,7 @@ def sim_step(
     # cannot regress it.
     last_cleared = state.last_cleared.at[
         jnp.where(complete & c_cleared, dst, n)
-    ].max(cleared_hlc[actor], mode="drop")
+    ].max(cleared_hlc[g_actor, g_slot], mode="drop")
 
     # ----------------------------------------------------------------- sync
     is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)

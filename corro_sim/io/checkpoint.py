@@ -40,7 +40,8 @@ import flax.serialization
 import jax.numpy as jnp
 import numpy as np
 
-FORMAT_VERSION = 3  # 3: packed changelog cell tensor (log/cells)
+FORMAT_VERSION = 4  # 4: per-version (A, L) cleared_hlc ts plane
+# 3: packed changelog cell tensor (log/cells)
 
 
 # ------------------------------------------------------------- value codec
@@ -245,7 +246,21 @@ def _read(path):
         planes = [flat.pop(f"log/{f}") for f in
                   ("row", "col", "vr", "cv", "cl")]
         flat["log/cells"] = np.stack(planes, axis=-1)
+        meta["format"] = 3
+    if meta.get("format") == 3 and "cleared_hlc" in flat:
+        # v3 → v4: per-actor EmptySet ts became per-version (A, L).
+        # Broadcast the old actor stamp into that actor's CLEARED slots
+        # (it was the newest clearing's ts — an upper bound for each,
+        # exactly the approximation v3 ran with); -1 elsewhere.
+        old = flat["cleared_hlc"]  # (A,)
+        cleared = flat.get("log/cleared")  # (A, L) bool
+        if old.ndim == 1 and cleared is not None:
+            flat["cleared_hlc"] = np.where(
+                cleared, old[:, None], np.int32(-1)
+            ).astype(np.int32)
         meta["format"] = FORMAT_VERSION
+    if meta.get("format") == 3:
+        meta["format"] = FORMAT_VERSION  # scrubbed checkpoints (no state)
     if meta.get("format") != FORMAT_VERSION:
         raise ValueError(
             f"unsupported checkpoint format {meta.get('format')!r}"

@@ -171,6 +171,8 @@ class EncodedTrace:
     vr: np.ndarray
     cv: np.ndarray
     cl: np.ndarray
+    ts: np.ndarray  # (rounds, actors) int32 — EmptySet ts per cleared
+    # lane; -1 = carries no stamp (full changeset, or a lost gap)
 
     @property
     def rounds(self) -> int:
@@ -239,9 +241,11 @@ def ingest(lines, layout=None) -> EncodedTrace:
         book = per_actor.setdefault(ev.actor_id, {})
         if isinstance(ev, TraceEmpty):
             for v in range(ev.versions[0], ev.versions[1] + 1):
-                book[v] = None  # cleared
+                # cleared; keep the EmptySet's ts (the stamp each cleared
+                # version carries on the wire, change.rs:267-389)
+                book[v] = int(ev.ts or 0)
             continue
-        if ev.version in book and book[ev.version] is not None:
+        if ev.version in book and isinstance(book[ev.version], TraceChangeset):
             raise ValueError(
                 f"duplicate version {ev.version} for actor {ev.actor_id}"
             )
@@ -300,6 +304,7 @@ def ingest(lines, layout=None) -> EncodedTrace:
 
     valid = np.zeros((rounds, a), bool)
     empty = np.zeros((rounds, a), bool)
+    ts = np.full((rounds, a), -1, np.int32)  # EmptySet ts per cleared lane
     delete = np.zeros((rounds, a), bool)
     ncells = np.zeros((rounds, a), np.int32)
     row = np.zeros((rounds, a, s), np.int32)
@@ -315,10 +320,13 @@ def ingest(lines, layout=None) -> EncodedTrace:
             r = v - 1
             ev = book.get(v, None)
             valid[r, ai] = True
-            if ev is None:
+            if not isinstance(ev, TraceChangeset):
                 # Cleared (or never-seen — a gap the trace itself lost;
-                # treat as cleared, the sync path's Empty answer).
+                # treat as cleared, the sync path's Empty answer). A real
+                # EmptySet carries its ts; a lost gap has none (-1).
                 empty[r, ai] = True
+                if ev is not None:
+                    ts[r, ai] = ev
                 continue
             chs = sorted(ev.changes, key=lambda c: c.seq)[:s]
             ncells[r, ai] = len(chs)
@@ -347,6 +355,7 @@ def ingest(lines, layout=None) -> EncodedTrace:
         values=values,
         valid=valid,
         empty=empty,
+        ts=ts,
         delete=delete,
         ncells=ncells,
         row=row,

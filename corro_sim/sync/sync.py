@@ -206,7 +206,7 @@ def sync_round(
     table: TableState,
     hlc: jnp.ndarray,  # (N,) node clocks — exchanged on every contact
     last_cleared: jnp.ndarray,  # (N,) last-applied EmptySet ts (monotone)
-    cleared_hlc: jnp.ndarray,  # (A,) ts of each actor's latest clearing
+    cleared_hlc: jnp.ndarray,  # (A, L) per-version EmptySet ts stamps
     key: jax.Array,
     alive: jnp.ndarray,
     view_alive: jnp.ndarray,
@@ -415,10 +415,9 @@ def sync_round(
     # Cleared versions are served as empties: bookkeeping fast-forwards
     # but no rows transfer (handle_need cleared → SyncMessage
     # Empty/EmptySet, api/peer.rs:716-758).
-    cleared_l = log.cleared[
-        jnp.where(valid_l, actor_l, 0),
-        (jnp.maximum(ver_l, 1) - 1) % log.capacity,
-    ]
+    g_actor_l = jnp.where(valid_l, actor_l, 0)
+    g_slot_l = (jnp.maximum(ver_l, 1) - 1) % log.capacity
+    cleared_l = log.cleared[g_actor_l, g_slot_l]
     cell_live = (
         valid_l[:, None]
         & ~cleared_l[:, None]
@@ -459,7 +458,7 @@ def sync_round(
     # EmptySet's stamp — monotone max, HLC-gated like the gossip path.
     last_cleared = last_cleared.at[
         jnp.where(valid_l & cleared_l, dst_l, n)
-    ].max(cleared_hlc[actor_l], mode="drop")
+    ].max(cleared_hlc[g_actor_l, g_slot_l], mode="drop")
 
     book = advance_heads(book, floor, bpv)
 

@@ -88,7 +88,7 @@ def test_sync_round_accounting_no_duplicate_transfers():
     book2, _, _, _, metrics = sync_round(
         cfg, book, log, table,
         jnp.zeros((n,), jnp.int32), jnp.full((n,), -1, jnp.int32),
-        jnp.full((n,), -1, jnp.int32),
+        jnp.full((n, 64), -1, jnp.int32),  # per-version EmptySet ts plane
         jax.random.PRNGKey(0), ones, view, jnp.ones((n, n), bool),
     )
     adv = int((np.asarray(book2.head) - head).sum())
@@ -173,7 +173,7 @@ def test_sync_round_probe_dealing_matches_argmax_accounting():
         book2, _, _, _, metrics = sync_round(
             cfg, book, log, table,
             jnp.zeros((n,), jnp.int32), jnp.full((n,), -1, jnp.int32),
-            jnp.full((n,), -1, jnp.int32),
+            jnp.full((n, 64), -1, jnp.int32),  # per-version ts plane
             jax.random.PRNGKey(0), ones, view, jnp.ones((n, n), bool),
         )
         adv = int((np.asarray(book2.head) - head).sum())
