@@ -531,6 +531,8 @@ def _sync_block(
         zero = jnp.int32(0)
         return book, table, hlc, lc, {
             "sync_pairs": zero,
+            "sync_requests": zero,
+            "sync_rejections": zero,
             "sync_versions": zero,
             "sync_empties": zero,
             "sync_cells": zero,

@@ -681,7 +681,8 @@ class LiveCluster:
         sums = packed.sum(axis=1)
         for k, v in zip(names, sums):
             self._totals[k] = self._totals.get(k, 0.0) + float(v)
-        for k in ("pend_live", "queue_overflow"):
+        for k in ("pend_live", "queue_overflow", "swim_suspects",
+                  "swim_down"):
             if k in names:
                 self._lasts[k] = float(packed[names.index(k), -1])
         self._gap = float(packed[names.index("gap"), -1])

@@ -243,7 +243,7 @@ def ingest(lines, layout=None) -> EncodedTrace:
             for v in range(ev.versions[0], ev.versions[1] + 1):
                 # cleared; keep the EmptySet's ts (the stamp each cleared
                 # version carries on the wire, change.rs:267-389)
-                book[v] = int(ev.ts or 0)
+                book[v] = -1 if ev.ts is None else int(ev.ts)
             continue
         if ev.version in book and isinstance(book[ev.version], TraceChangeset):
             raise ValueError(

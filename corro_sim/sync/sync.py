@@ -130,7 +130,7 @@ def choose_sync_peers(
         jnp.arange(m, dtype=jnp.int32)
     )
     granted = want & admitted_sorted[inv].reshape(n, p_cnt)
-    return peer, granted
+    return peer, granted, want
 
 
 def choose_serving_slots(
@@ -225,9 +225,9 @@ def sync_round(
     pass over the combined lanes."""
     n, a = book.head.shape
     k_peer, k_phase = jax.random.split(key)
-    peer, granted = choose_sync_peers(cfg, book, key=k_peer, alive=alive,
-                                      view_alive=view_alive,
-                                      reachable=reachable, rtt=rtt)
+    peer, granted, requested = choose_sync_peers(
+        cfg, book, key=k_peer, alive=alive, view_alive=view_alive,
+        reachable=reachable, rtt=rtt)
     p_cnt = peer.shape[1]
 
     # Clock exchange, both directions (SyncMessage::Clock is sent by client
@@ -464,6 +464,10 @@ def sync_round(
 
     metrics = {
         "sync_pairs": granted.sum(dtype=jnp.int32),
+        # client requests sent vs server-semaphore rejections
+        # (corro.sync.client.member accepted/rejected, handlers.rs)
+        "sync_requests": requested.sum(dtype=jnp.int32),
+        "sync_rejections": (requested & ~granted).sum(dtype=jnp.int32),
         "sync_versions": new_versions,
         "sync_empties": empties,
         # cell lanes shipped by this sweep — the byte-volume signal

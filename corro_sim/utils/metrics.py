@@ -144,11 +144,12 @@ def render_prometheus(cluster) -> str:
     # the cell/chunk counters via the Change wire-size constants above.
     g_cells = int(totals.get("gossip_cells", 0))
     g_chunks = int(totals.get("delivered", 0))
+    bcast_bytes = g_cells * CHANGE_WIRE_BYTES + g_chunks * CHUNK_HEADER_BYTES
     emit(
         "corro_broadcast_recv_bytes_total", "counter",
         "modeled broadcast bytes received "
         f"(cells*{CHANGE_WIRE_BYTES} + chunks*{CHUNK_HEADER_BYTES})",
-        g_cells * CHANGE_WIRE_BYTES + g_chunks * CHUNK_HEADER_BYTES,
+        bcast_bytes,
     )
     s_cells = int(totals.get("sync_cells", 0))
     s_versions = int(totals.get("sync_versions", 0))
@@ -189,6 +190,100 @@ def render_prometheus(cluster) -> str:
         "corro_members_alive", "gauge",
         "nodes marked alive by the harness", alive,
     )
+
+    # ---- reference-named series (agent/metrics.rs and friends) mapped
+    # from the same underlying data, so dashboards built against the
+    # reference's names point here unchanged.
+    lasts = cluster.metrics_lasts()
+    emit("corro_gossip_cluster_size", "gauge",
+         "configured cluster size (corro.gossip.cluster_size)",
+         cluster.cfg.num_nodes)
+    emit("corro_gossip_members", "gauge",
+         "live members (corro.gossip.members)", alive)
+    lines.append("# HELP corro_gossip_member_states members by SWIM state "
+                 "(corro.gossip.member.states)")
+    lines.append("# TYPE corro_gossip_member_states gauge")
+    suspects = int(lasts.get("swim_suspects", 0))
+    downs = int(lasts.get("swim_down", 0))
+    lines.append(f'corro_gossip_member_states{{state="alive"}} '
+                 f"{max(alive - suspects - downs, 0)}")
+    lines.append(f'corro_gossip_member_states{{state="suspect"}} {suspects}')
+    lines.append(f'corro_gossip_member_states{{state="down"}} {downs}')
+    emit("corro_gossip_config_max_transmissions", "gauge",
+         "broadcast re-send budget (corro.gossip.config.max_transmissions)",
+         cluster.cfg.max_transmissions)
+    emit("corro_gossip_config_num_indirect_probes", "gauge",
+         "SWIM indirect probes (corro.gossip.config.num_indirect_probes)",
+         cluster.cfg.swim_indirect_probes)
+    emit("corro_broadcast_pending_count", "gauge",
+         "live pending-broadcast slots (corro.broadcast.pending.count)",
+         int(lasts.get("pend_live", 0)))
+    emit("corro_broadcast_recv_count_total", "counter",
+         "broadcast datagrams delivered (corro.broadcast.recv.count)",
+         int(totals.get("delivered", 0)))
+    emit("corro_agent_changes_recv_total", "counter",
+         "change messages received (corro.agent.changes.recv)",
+         int(totals.get("delivered", 0)))
+    emit("corro_agent_changes_in_queue", "gauge",
+         "buffered partial versions (corro.agent.changes.in_queue)",
+         int(cluster._partials))
+    emit("corro_db_buffered_changes_rows_total", "gauge",
+         "buffered seq-incomplete rows (corro.db.buffered.changes.rows)",
+         int(cluster._partials))
+    emit("corro_db_gaps_sum", "gauge",
+         "unapplied version gap total (corro.db.gaps.sum)", int(gap))
+    emit("corro_sync_client_needed", "gauge",
+         "versions the cluster still needs (corro.sync.client.needed)",
+         int(gap))
+    emit("corro_sync_client_head", "gauge",
+         "max version head written (corro.sync.client.head)",
+         int(head.max()) if head.size else 0)
+    emit("corro_sync_changes_sent_total", "counter",
+         "versions served by sync (corro.sync.changes.sent; symmetric to "
+         "recv in-process)", int(totals.get("sync_versions", 0)))
+    emit("corro_sync_client_req_sent_total", "counter",
+         "sync requests sent (corro.sync.client.req.sent)",
+         int(totals.get("sync_requests", 0)))
+    lines.append("# HELP corro_sync_client_member sync admissions by result "
+                 "(corro.sync.client.member)")
+    lines.append("# TYPE corro_sync_client_member counter")
+    lines.append(f'corro_sync_client_member{{result="accepted"}} '
+                 f"{int(totals.get('sync_pairs', 0))}")
+    lines.append(f'corro_sync_client_member{{result="rejected"}} '
+                 f"{int(totals.get('sync_rejections', 0))}")
+    emit("corro_sync_empties_count_total", "counter",
+         "cleared versions served as empties (corro.sync.empties.count)",
+         int(totals.get("sync_empties", 0)))
+    emit("corro_peer_datagram_sent_total", "counter",
+         "gossip datagrams emitted (corro.peer.datagram.sent.total)",
+         int(totals.get("msgs_sent", 0)))
+    emit("corro_peer_datagram_recv_total", "counter",
+         "gossip datagrams delivered (corro.peer.datagram.recv.total)",
+         int(totals.get("delivered", 0)))
+    emit("corro_peer_datagram_bytes_recv_total", "counter",
+         "modeled datagram bytes received (corro.peer.datagram.bytes.recv; "
+         "same wire model as corro_broadcast_recv_bytes_total)",
+         bcast_bytes)
+    emit("corro_peer_connection_accept_total", "counter",
+         "sync connections admitted (corro.peer.connection.accept.total)",
+         int(totals.get("sync_pairs", 0)))
+    _ch = getattr(cluster, "channels", None)
+    emit("corro_subs_changes_matched_count_total", "counter",
+         "subscription events matched+queued "
+         "(corro.subs.changes.matched.count)",
+         int(_ch.snapshot().get("subs_events", {}).get("send", 0))
+         if _ch is not None else 0)
+    # modeled database footprint (corro.db.size analog): resident bytes of
+    # the cluster state tensors
+    try:
+        from corro_sim.engine.sharding import state_bytes
+
+        total_bytes, _ = state_bytes(cluster.cfg)
+        emit("corro_db_size_bytes", "gauge",
+             "modeled resident state bytes (corro.db.size analog)",
+             int(total_bytes))
+    except Exception:
+        pass
     emit(
         "corro_subs_count", "gauge",
         "registered live-query matchers", len(cluster.subs),

@@ -92,7 +92,7 @@ def main():
     def peers_body(i, carry):
         bk, key, acc = carry
         key, sub = jax.random.split(key)
-        peer, granted = choose_sync_peers(
+        peer, granted, _req = choose_sync_peers(
             cfg, bk, sub, alive, view1, reach1, rtt=None
         )
         return bk, key, acc + peer.sum() + granted.sum()
