@@ -71,3 +71,116 @@ def test_limit_ten():
     out = generate_bootstrap([f"10.3.0.{i}:8787" for i in range(30)])
     assert len(out) == 10
     assert out[0] == ("10.3.0.0", 8787)  # first-seen order preserved
+
+
+def _toy_dns_server(answers):
+    """One-shot RFC-1035 UDP responder on 127.0.0.1 (test fixture).
+
+    ``answers``: {qname: [ipv4, ...]}. Echoes the question, answers with
+    A records, NXDOMAIN for unknown names."""
+    import socket
+    import struct
+    import threading
+
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+
+    def serve():
+        sock.settimeout(10)
+        while True:
+            try:
+                buf, addr = sock.recvfrom(4096)
+            except OSError:
+                return
+            _answer(buf, addr)
+
+    def _answer(buf, addr):
+        txid = struct.unpack_from("!H", buf, 0)[0]
+        # parse qname labels
+        off = 12
+        labels = []
+        while buf[off]:
+            n = buf[off]
+            labels.append(buf[off + 1:off + 1 + n].decode())
+            off += 1 + n
+        qname = ".".join(labels)
+        q_end = off + 1 + 4
+        ips = answers.get(qname)
+        if ips is None:
+            hdr = struct.pack("!HHHHHH", txid, 0x8003, 1, 0, 0, 0)  # NXDOMAIN
+            sock.sendto(hdr + buf[12:q_end], addr)
+            return
+        hdr = struct.pack("!HHHHHH", txid, 0x8000, 1, len(ips), 0, 0)
+        resp = hdr + buf[12:q_end]
+        for ip in ips:
+            # name as compression pointer to offset 12, A IN TTL=60 len=4
+            resp += b"\xc0\x0c" + struct.pack("!HHIH", 1, 1, 60, 4)
+            resp += socket.inet_aton(ip)
+        sock.sendto(resp, addr)
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return port, sock
+
+
+def test_custom_dns_server_resolution():
+    """host:port@dns_server resolves through THAT server (bootstrap.rs
+    custom-resolver path), exercised against a local RFC-1035 responder."""
+    from corro_sim.membership.bootstrap import generate_bootstrap
+
+    port, sock = _toy_dns_server({"db.cluster.internal": ["10.1.2.3",
+                                                         "10.1.2.4"]})
+    try:
+        out = generate_bootstrap(
+            [f"db.cluster.internal:8787@127.0.0.1:{port}"]
+        )
+    finally:
+        sock.close()
+    assert out == [("10.1.2.3", 8787), ("10.1.2.4", 8787)]
+
+
+def test_custom_dns_nxdomain_falls_back_to_members(monkeypatch):
+    import socket as socket_mod
+
+    from corro_sim.membership import bootstrap as bs
+
+    port, sock = _toy_dns_server({})  # NXDOMAIN for everything
+
+    def no_host_resolver(*a, **kw):  # deterministic host-resolver miss
+        raise socket_mod.gaierror("forced miss")
+
+    monkeypatch.setattr(bs.socket, "getaddrinfo", no_host_resolver)
+    try:
+        out = bs.generate_bootstrap(
+            [f"nope.cluster.internal:1234@127.0.0.1:{port}"],
+            member_addrs=[("192.168.0.9", 4001)],
+        )
+    finally:
+        sock.close()
+    # the named server answered NXDOMAIN and the host resolver misses
+    # (forced) -> member-table fallback engages
+    assert out == [("192.168.0.9", 4001)]
+
+
+def test_dns_query_wire_shapes():
+    """The resolver parses compressed answers and rejects mismatched ids."""
+    from corro_sim.membership.bootstrap import dns_query
+
+    port, sock = _toy_dns_server({"x.y": ["10.0.0.1"]})
+    try:
+        assert dns_query("x.y", f"127.0.0.1:{port}") == ["10.0.0.1"]
+    finally:
+        sock.close()
+
+
+def test_dns_server_string_forms():
+    import socket as socket_mod
+
+    from corro_sim.membership.bootstrap import _parse_server
+
+    assert _parse_server("10.0.0.1") == ("10.0.0.1", 53, socket_mod.AF_INET)
+    assert _parse_server("ns1:5353") == ("ns1", 5353, socket_mod.AF_INET)
+    assert _parse_server("[::1]:53") == ("::1", 53, socket_mod.AF_INET6)
+    assert _parse_server("::1") == ("::1", 53, socket_mod.AF_INET6)
+    assert _parse_server("[fe80::2]") == ("fe80::2", 53, socket_mod.AF_INET6)
