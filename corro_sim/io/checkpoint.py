@@ -339,6 +339,27 @@ def load_checkpoint(path, tripwire=None):
 
 def _install(cluster, meta, flat, node):
     """Write tensors + counters into ``cluster`` (shapes must match)."""
+    pr = getattr(cluster.universe, "pending_remap", None)
+    if pr is not None:
+        # checkpoint written under the pre-r4 SQL-ordered rank space:
+        # LiveUniverse.restore re-ranked values into the extension's
+        # conflict order; translate every rank-typed tensor to match
+        # (order within a band is preserved, cross-band layout moved).
+        from corro_sim.core.changelog import CELL_VR
+        from corro_sim.utils.ranks import translate_ranks
+
+        old, new = pr
+        flat = dict(flat)
+        for key in ("table/vr", "own/vr"):
+            if key in flat:
+                flat[key] = translate_ranks(np.asarray(flat[key]), old, new)
+        if "log/cells" in flat:
+            cells = np.array(flat["log/cells"])
+            cells[..., CELL_VR] = translate_ranks(
+                cells[..., CELL_VR], old, new
+            )
+            flat["log/cells"] = cells
+        cluster.universe.pending_remap = None
     nested = _unflatten(flat)
     if node is not None and node != 0:
         nested = _permute_actors(nested, 0, node)

@@ -51,16 +51,38 @@ from corro_sim.subs.query import (
 
 
 class IdentityUniverse:
-    """Rank space for synthetic workloads: values ARE their ranks."""
+    """Rank space for synthetic workloads: values ARE their ranks
+    (single integer band, so SQL order == rank order trivially)."""
 
-    def rank_of(self, lit):
-        if lit is None:
-            return (-1, -1)  # NULL never stored in synthetic runs
+    _INT_MIN = -(2**31)
+    _INT_MAX = 2**31 - 1
+
+    def _check(self, lit):
         if not isinstance(lit, int):
             raise QueryError(
                 f"synthetic workloads store int values, got {lit!r}"
             )
+
+    def rank_of(self, lit):
+        if lit is None:
+            return (-1, -1)  # NULL never stored in synthetic runs
+        self._check(lit)
         return (lit, lit + 1)
+
+    def eq_ranges(self, lit):
+        return (self.rank_of(lit),)
+
+    def sql_ranges(self, lit, op):
+        self._check(lit)
+        # hi=None == open-ended (avoids an int32-overflowing 2^31 bound
+        # that would silently exclude a stored INT32_MAX)
+        if op == "<":
+            return ((self._INT_MIN, lit),)
+        if op == "<=":
+            return ((self._INT_MIN, lit + 1),)
+        if op == ">":
+            return ((lit + 1, None),)
+        return ((lit, None),)  # >=
 
     def decode(self, rank: int):
         return int(rank)

@@ -37,7 +37,11 @@ import re
 
 import jax.numpy as jnp
 
-from corro_sim.io.values import sqlite_sort_key
+from corro_sim.io.values import (
+    _BandRanges,
+    crsql_conflict_key,
+    sqlite_sort_key,
+)
 
 
 class QueryError(ValueError):
@@ -729,18 +733,45 @@ def like_prefix_ranges(pattern: str) -> list[tuple[str, str]] | None:
     return out
 
 
+def _numeric_twins(v):
+    """The cross-band companions a numeric literal's compiled ranges pin:
+    its exact float/int twins and, for fractional floats, the int-band
+    floor cut (see _BandRanges.sql_ranges)."""
+    import math
+
+    yield v
+    if isinstance(v, bool):
+        yield int(v)
+        yield float(v)
+    elif isinstance(v, int):
+        if float(v) == v:
+            yield float(v)
+    elif isinstance(v, float) and v == v and not math.isinf(v):
+        if v.is_integer():
+            yield int(v)
+        else:
+            yield math.floor(v)
+
+
 def predicate_intern_values(p):
     """Every value the compiled form bakes a rank constant for: Cmp/InList
-    literals plus the string endpoints of compilable LIKE prefix ranges.
-    Live universes must intern these BEFORE compiling so the baked
-    constants survive later inserts (see Matcher._build_eval)."""
+    literals (plus their cross-band numeric twins) and the string
+    endpoints of compilable LIKE prefix ranges. Live universes must
+    intern these BEFORE compiling so the baked constants are pure
+    lookups — a mid-compile insert could re-space the rank space under
+    closures compiled earlier in the same predicate."""
     if isinstance(p, Cmp):
         if p.lit is not None:
-            yield p.lit
+            yield from _numeric_twins(p.lit) if isinstance(
+                p.lit, (int, float)
+            ) else (p.lit,)
     elif isinstance(p, InList):
         for v in p.lits:
             if v is not None:
-                yield v
+                if isinstance(v, (int, float)):
+                    yield from _numeric_twins(v)
+                else:
+                    yield v
     elif isinstance(p, Like):
         ranges = like_prefix_ranges(p.pattern)
         if ranges:
@@ -1076,19 +1107,25 @@ def eval_predicate_py(p, get) -> bool:
 # ------------------------------------------------- rank-space compilation
 
 
-class RankUniverse:
-    """The frozen, sorted value universe ranks index into."""
+class RankUniverse(_BandRanges):
+    """The frozen, conflict-ordered value universe ranks index into
+    (rank order == the extension's equal-cv conflict order; SQL-semantics
+    comparisons come from the _BandRanges multi-range compilation)."""
 
     def __init__(self, sorted_values):
         self.values = list(sorted_values)
-        self._keys = [sqlite_sort_key(v) for v in self.values]
+        self._keys = [crsql_conflict_key(v) for v in self.values]
+
+    def _edge(self, key, right: bool) -> int:
+        return (bisect.bisect_right if right else bisect.bisect_left)(
+            self._keys, key
+        )
 
     def rank_of(self, lit):
-        """(lo, hi): ranks r with value == lit satisfy lo <= r < hi."""
-        k = sqlite_sort_key(lit)
-        lo = bisect.bisect_left(self._keys, k)
-        hi = bisect.bisect_right(self._keys, k)
-        return lo, hi
+        """(lo, hi): ranks r with conflict-key == lit's satisfy
+        lo <= r < hi (band+value identity; SQL equality = eq_ranges)."""
+        k = crsql_conflict_key(lit)
+        return self._edge(k, False), self._edge(k, True)
 
 
 def compile_predicate(pred, universe: RankUniverse, col_index):
@@ -1103,31 +1140,35 @@ def compile_predicate(pred, universe: RankUniverse, col_index):
     def comp(p):
         if isinstance(p, Cmp):
             ci = col_index(p.col)
-            lo, hi = universe.rank_of(p.lit)
             if p.lit is None:
                 # SQL: comparisons with NULL are never true
                 return lambda vr, unset: jnp.zeros(vr.shape[:1], bool)
-            op = p.op
+            # SQL comparison semantics over the conflict-ordered rank
+            # space: equality spans the int+real bands (3 == 3.0); order
+            # comparisons compile to up to three disjoint rank ranges
+            # (numbers sort below text below blob in SQL, but the bands
+            # are laid out in the extension's conflict order).
+            if p.op in ("=", "!="):
+                ranges = universe.eq_ranges(p.lit)
+                negate = p.op == "!="
+            else:
+                ranges = universe.sql_ranges(p.lit, p.op)
+                negate = False
             nlo, nhi = universe.rank_of(None)
 
-            def f(vr, unset, ci=ci, lo=lo, hi=hi, op=op, nlo=nlo, nhi=nhi):
+            def f(vr, unset, ci=ci, ranges=tuple(ranges), negate=negate,
+                  nlo=nlo, nhi=nhi):
                 r = vr[:, ci]
                 # three-valued logic: unset cells AND stored NULLs never
                 # satisfy a comparison (NULL < 5 is NULL, not true)
                 known = ~unset[:, ci] & ~((r >= nlo) & (r < nhi))
-                if op == "=":
-                    m = (r >= lo) & (r < hi)
-                elif op == "!=":
-                    m = (r < lo) | (r >= hi)
-                elif op == "<":
-                    m = r < lo
-                elif op == "<=":
-                    m = r < hi
-                elif op == ">":
-                    m = r >= hi
-                else:  # >=
-                    m = r >= lo
-                return m & known
+                m = jnp.zeros(r.shape, bool)
+                for lo, hi in ranges:
+                    part = r >= lo
+                    if hi is not None:  # None = open-ended upper bound
+                        part = part & (r < hi)
+                    m = m | part
+                return (~m if negate else m) & known
 
             return f
         if isinstance(p, IsNull):
@@ -1142,7 +1183,9 @@ def compile_predicate(pred, universe: RankUniverse, col_index):
         if isinstance(p, InList):
             ci = col_index(p.col)
             bounds = [
-                universe.rank_of(v) for v in p.lits if v is not None
+                rng
+                for v in p.lits if v is not None
+                for rng in universe.eq_ranges(v)
             ]
             nlo, nhi = universe.rank_of(None)
             has_null = any(v is None for v in p.lits)

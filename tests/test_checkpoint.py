@@ -264,3 +264,63 @@ def test_v2_checkpoint_converts(tmp_path):
     r = load_checkpoint(v2path)
     _, rows = r.query_rows("SELECT k, v FROM kv")
     assert rows == [["old", "fmt"]]
+
+
+def test_pre_conflict_order_checkpoint_migrates(tmp_path):
+    """A checkpoint whose universe ranks follow the pre-r4 SQL value order
+    (numbers < text, no band regions) re-ranks into the banded conflict
+    order on load, with every rank-typed tensor translated to match."""
+    import json
+
+    from corro_sim.io.values import sqlite_sort_key
+
+    c = make_cluster()
+    c.execute([["INSERT INTO kv (k, v, n) VALUES (?, ?, ?)", ["a", "x", 5]]],
+              node=0)
+    c.execute([["INSERT INTO kv (k, v, n) VALUES (?, ?, ?)", ["b", "y", 9]]],
+              node=1)
+    c.run_until_converged()
+    path = tmp_path / "old.npz"
+    save_checkpoint(c, path)
+
+    # rewrite the file as an OLD checkpoint: dense ranks in SQL order
+    with np.load(path) as z:
+        meta = json.loads(bytes(z["__meta__"]).decode())
+        flat = {k: z[k] for k in z.files if k != "__meta__"}
+    from corro_sim.io.checkpoint import _dec_value
+    from corro_sim.utils.ranks import translate_ranks
+
+    values = [_dec_value(v) for v in meta["universe"]["values"]]
+    cur_ranks = meta["universe"]["ranks"]
+    order = sorted(range(len(values)), key=lambda i: sqlite_sort_key(values[i]))
+    old_rank_of = {i: j for j, i in enumerate(order)}  # dense SQL-order rank
+    old_ranks = [old_rank_of[i] for i in range(len(values))]
+    for key in ("table/vr", "own/vr"):
+        flat[key] = translate_ranks(
+            np.asarray(flat[key]), cur_ranks, old_ranks
+        )
+    cells = np.array(flat["log/cells"])
+    from corro_sim.core.changelog import CELL_VR
+
+    cells[..., CELL_VR] = translate_ranks(
+        cells[..., CELL_VR], cur_ranks, old_ranks
+    )
+    flat["log/cells"] = cells
+    meta["universe"]["ranks"] = old_ranks
+    import io as _io
+
+    buf = _io.BytesIO()
+    np.savez(buf, __meta__=np.frombuffer(
+        json.dumps(meta).encode(), dtype=np.uint8
+    ), **flat)
+    path.write_bytes(buf.getvalue())
+
+    r = load_checkpoint(path)
+    for node in range(4):
+        assert r.query_rows("SELECT k, v, n FROM kv", node=node) == \
+            c.query_rows("SELECT k, v, n FROM kv", node=node)
+    # post-migration writes still merge and match correctly
+    r.execute([["UPDATE kv SET n = ? WHERE k = ?", [100, "a"]]], node=2)
+    r.run_until_converged()
+    _, rows = r.query_rows("SELECT k, n FROM kv WHERE n >= 100")
+    assert [tuple(x) for x in rows] == [("a", 100)]

@@ -324,3 +324,87 @@ def test_replay_parity_fixture_matches_crsqlite():
     for order_seed in (None, 5, 42):
         got = run(order_seed)
         assert got == EXPECTED, f"order_seed={order_seed}: {got}"
+
+
+@pytest.mark.parametrize("va, vb", [
+    (1.5, 2),            # float vs int: numeric order
+    (2, 1.5),
+    (100, "abc"),        # number vs text: SQLite orders numbers first
+    ("abc", "abd"),      # text vs text
+    (None, 5),           # explicit NULL vs number
+    ("zz", b"\x00"),     # text vs blob: blobs order after text
+])
+def test_equal_cv_value_ordering_matches_crsqlite(va, vb):
+    """Equal col_version → 'biggest value wins' under SQLite's cross-type
+    value ordering (doc/crdts.md:15-17). The interner must produce the
+    same total order as the real extension for floats, ints, text, blobs
+    and NULL — checked pairwise against the extension's own merge."""
+    conns = []
+    for _ in range(2):
+        conn = sqlite3.connect(":memory:", isolation_level=None)
+        conn.enable_load_extension(True)
+        try:
+            conn.load_extension(SO, entrypoint="sqlite3_crsqlite_init")
+        except Exception as e:  # pragma: no cover
+            pytest.skip(f"crsqlite extension unavailable: {e}")
+        conn.execute(
+            "CREATE TABLE m (id INTEGER NOT NULL PRIMARY KEY, v)"
+        )
+        conn.execute("SELECT crsql_as_crr('m')")
+        conns.append(conn)
+    A, B = conns
+    sids = [bytes(c.execute("SELECT crsql_site_id()").fetchone()[0])
+            for c in conns]
+
+    def tx_insert(c, val):
+        c.execute("BEGIN")
+        c.execute("INSERT INTO m (id, v) VALUES (1, ?)", (val,))
+        c.execute("COMMIT")
+
+    tx_insert(A, va)
+    tx_insert(B, vb)
+    rows = {}
+    for c, sid in zip(conns, sids):
+        rows[sid] = list(c.execute(
+            'SELECT "table", pk, cid, val, col_version, db_version, '
+            "site_id, cl, seq FROM crsql_changes WHERE site_id = ?", (sid,)
+        ))
+    for c, sid in zip(conns, sids):
+        other = sids[1] if sid == sids[0] else sids[0]
+        c.execute("BEGIN")
+        for r in rows[other]:
+            c.execute(
+                'INSERT INTO crsql_changes ("table", pk, cid, val, '
+                "col_version, db_version, site_id, cl, seq) "
+                "VALUES (?,?,?,?,?,?,?,?,?)", r)
+        c.execute("COMMIT")
+    got_a = list(A.execute("SELECT id, v FROM m"))
+    got_b = list(B.execute("SELECT id, v FROM m"))
+    assert got_a == got_b, "extension itself diverged?!"
+
+    # replay the same two changesets through the simulator
+    order = sorted(range(2), key=lambda i: sids[i])
+    lines = []
+    for oi, i in enumerate(order):
+        (tbl, pk, cid, val, cv, _dbv, _sid, cl, _seq) = rows[sids[i]][0]
+        if isinstance(val, bytes):
+            val = {"blob": list(val)}
+        lines.append(json.dumps({
+            "actor_id": f"site-{oi:02d}", "version": 1,
+            "changes": [{"table": tbl, "pk": list(pk), "cid": cid,
+                         "val": val, "col_version": cv, "db_version": 1,
+                         "seq": 0, "site_id": list(sids[i]), "cl": cl}],
+            "seqs": [0, 0], "last_seq": 0, "ts": 1}))
+    from corro_sim.engine.replay import read_table, replay
+    from corro_sim.io.traces import ingest
+
+    tr = ingest(lines)
+    res = replay(tr)
+    assert res.converged_round is not None
+    sim = read_table(res.state, tr, 0)
+    expect = {("m", (i,)): {"v": v} for i, v in got_a if v is not None}
+    # read_table omits NULL cells; normalize the crsqlite side the same way
+    for i, v in got_a:
+        if v is None:
+            expect.setdefault(("m", (i,)), {})
+    assert sim == expect, (sim, expect, va, vb)
