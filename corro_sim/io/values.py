@@ -194,7 +194,10 @@ class _BandRanges:
                     if n > 0:  # < +inf: all numbers except +inf itself
                         below(B_FLOAT, (B_FLOAT, n))
                         below(B_INT)
-                    # < -inf: nothing numeric
+                        if incl:  # <= +inf also matches a stored +Inf
+                            out.extend(self.eq_ranges(n))
+                    elif incl:  # <= -inf matches exactly a stored -Inf
+                        out.extend(self.eq_ranges(n))
                 else:
                     cut(B_FLOAT, (B_FLOAT, fl), f_incl_lt)
                     if ik is not None:
@@ -204,7 +207,10 @@ class _BandRanges:
                     if n < 0:  # > -inf: all numbers except -inf itself
                         above(B_FLOAT, (B_FLOAT, n))
                         below(B_INT)
-                    # > +inf: no numeric matches
+                        if incl:  # >= -inf also matches a stored -Inf
+                            out.extend(self.eq_ranges(n))
+                    elif incl:  # >= +inf matches exactly a stored +Inf
+                        out.extend(self.eq_ranges(n))
                 else:
                     cut(B_FLOAT, (B_FLOAT, fl), f_incl_gt)
                     if ik is not None:
@@ -265,11 +271,6 @@ class ValueInterner:
 
     def __len__(self) -> int:
         return len(self._values)
-
-    def frozen_values(self) -> list:
-        """Values in rank order (the decode table)."""
-        assert self._ranks is not None
-        return [self._values[k] for k in sorted(self._values)]
 
 
 def _hashable(value):

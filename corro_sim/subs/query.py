@@ -744,8 +744,10 @@ def _numeric_twins(v):
         yield int(v)
         yield float(v)
     elif isinstance(v, int):
-        if float(v) == v:
-            yield float(v)
+        # always include the (possibly rounded) float twin: sql_ranges
+        # pins the nearest double as its float-band cut regardless of
+        # exactness, and that pin must be a pure lookup at compile time
+        yield float(v)
     elif isinstance(v, float) and v == v and not math.isinf(v):
         if v.is_integer():
             yield int(v)

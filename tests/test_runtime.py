@@ -65,9 +65,13 @@ def test_lock_registry_snapshot():
 
 
 def test_live_universe_order_preserved():
+    from corro_sim.io.values import crsql_conflict_key
+
     u = LiveUniverse()
     ranks = {v: u.rank(v) for v in [5, "b", 1.5, None, "a", b"z", 3]}
-    vals = sorted(ranks, key=sqlite_sort_key)
+    # rank order == the extension's conflict order (NULL < blob < text <
+    # real < int), measured in tests/test_crsqlite_oracle.py
+    vals = sorted(ranks, key=crsql_conflict_key)
     got = sorted(ranks, key=lambda v: ranks[v])
     assert [str(v) for v in vals] == [str(v) for v in got]
     # interning is idempotent
@@ -91,9 +95,11 @@ def test_live_universe_remap_on_gap_exhaustion():
     # remap is order-preserving and parallel
     assert len(old) == len(new)
     assert sorted(new) == new
-    # after the dust settles, order still matches value order
+    # after the dust settles, order still matches the conflict order
+    from corro_sim.io.values import crsql_conflict_key
+
     vs = [u.decode(r) for r in sorted(u._ranks)]
-    assert vs == sorted(vs, key=sqlite_sort_key)
+    assert vs == sorted(vs, key=crsql_conflict_key)
 
 
 def test_statement_shapes():
