@@ -35,6 +35,20 @@ FROZEN_DEVCLUSTER = {
 }
 
 
+def _atomic_json_dump(path: str, obj) -> None:
+    """Write-then-rename so readers never see a torn file. Errors are
+    swallowed: progress artifacts must never kill the run they document
+    (a transient ENOSPC at chunk N would otherwise abort a multi-hour
+    benchmark with all its state)."""
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def run_headline_bench(
     n: int | None = None,
     chunk: int | None = None,
@@ -363,7 +377,8 @@ def run_config_4(n: int | None = None) -> dict:
 
 
 def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
-                 write_rounds: int = 24) -> dict:
+                 write_rounds: int = 24,
+                 progress_path: str | None = None) -> dict:
     """Config 5 — stretch: anti-entropy catch-up after a 30% outage.
 
     ``outage_frac`` of the cluster is down for the whole write phase and
@@ -427,11 +442,33 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
     from corro_sim.engine.driver import run_sim
     from corro_sim.engine.state import init_state
 
+    # Partial-artifact flush (VERDICT r4 #2): a multi-hour 50k run must
+    # leave evidence even if killed — after every chunk the progress file
+    # gets rounds completed, per-chunk walls, and the latest gap. (The
+    # sharded 50k state itself is ~95 GB resident; snapshotting it per
+    # chunk is not viable on this host — the JSON trail is the checkpoint.)
+    chunk_log: list[dict] = []
+
+    def _flush(info: dict) -> None:
+        chunk_log.append(info)
+        if progress_path:
+            _atomic_json_dump(progress_path, {
+                "metric": f"config5_{nodes}_node_outage_catchup_rounds",
+                "status": "running",
+                "nodes": nodes,
+                "devices": len(devices),
+                "rounds_done": info["rounds_done"],
+                "wall_s": info["wall_s"],
+                "compile_s": info["compile_s"],
+                "last_gap": info["gap"],
+                "chunks": chunk_log,
+            })
+
     res = run_sim(
         cfg, init_state(cfg, seed=0),
         Schedule(write_rounds=write_rounds, alive_fn=alive_fn),
         max_rounds=4096, chunk=8, seed=0, min_rounds=write_rounds + 1,
-        mesh=mesh,
+        mesh=mesh, on_chunk=_flush,
     )
     out = {
         "metric": f"config5_{nodes}_node_outage_catchup_rounds",
@@ -442,12 +479,15 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
         "devices": len(devices),
+        "chunks": chunk_log,
     }
     if sized_reason:
         out["note"] = (
             f"single-device run sized to {nodes} nodes by {sized_reason}; "
             "full 50k needs the device mesh (see tests/test_sharding_memory.py)"
         )
+    if progress_path:
+        _atomic_json_dump(progress_path, dict(out, status="done"))
     return out
 
 

@@ -138,6 +138,7 @@ def run_sim(
     mesh=None,
     phase_specialize: bool = True,
     warmup: bool = True,
+    on_chunk: Callable[[dict], None] | None = None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -146,7 +147,12 @@ def run_sim(
 
     ``mesh``: shard the cluster state over this device mesh before running
     (node-axis DP + actor-sharded log at scale, :mod:`engine.sharding`);
-    jit propagates the input shardings through the scan."""
+    jit propagates the input shardings through the scan.
+
+    ``on_chunk``: called after every executed chunk with a progress dict
+    (chunk index, rounds done, cumulative wall, last gap/pend_live, which
+    program ran, this chunk's wall). Long runs use it to flush partial
+    artifacts so a killed run still leaves evidence of how far it got."""
     schedule = schedule or Schedule()
     if min_rounds is None:
         min_rounds = schedule.write_rounds
@@ -263,16 +269,17 @@ def run_sim(
             # wall (the pre-AOT accounting)
             t0 = time.perf_counter()
             state, m = _exec(run_jit, run_jit, args)
-            elapsed = time.perf_counter() - t0
+            chunk_elapsed = time.perf_counter() - t0
             if ci == 0 or first_repair_jit:
-                compile_seconds += elapsed
+                compile_seconds += chunk_elapsed
             else:
-                wall += elapsed
+                wall += chunk_elapsed
                 timed_rounds += chunk
         else:
             t0 = time.perf_counter()
             state, m = _exec(run_compiled, run_jit, args)
-            wall += time.perf_counter() - t0
+            chunk_elapsed = time.perf_counter() - t0
+            wall += chunk_elapsed
             timed_rounds += chunk
         metrics_chunks.append(m)
         last_pend_live = int(m["pend_live"][-1])
@@ -282,7 +289,7 @@ def run_sim(
             print(
                 f"# chunk {ci} rounds {rounds}..{rounds + chunk}"
                 f" runner={'repair' if use_repair else 'full'}"
-                f" wall={time.perf_counter() - t0:.3f}s"
+                f" wall={chunk_elapsed:.3f}s"
                 f" pend_live={last_pend_live}"
                 f" gap={float(m['gap'][-1]):.0f}"
                 f" sync_pairs={int(m['sync_pairs'].sum())}",
@@ -290,6 +297,17 @@ def run_sim(
             )
         rounds += chunk
         ci += 1
+        if on_chunk is not None:
+            on_chunk({
+                "chunk": ci - 1,
+                "rounds_done": rounds,
+                "chunk_wall_s": round(chunk_elapsed, 3),
+                "wall_s": round(wall, 3),
+                "compile_s": round(compile_seconds, 3),
+                "runner": "repair" if use_repair else "full",
+                "gap": float(m["gap"][-1]),
+                "pend_live": last_pend_live,
+            })
         if m["log_wrapped"].any():
             # Ring-wrap tripwire fired: a live node lagged some actor past
             # log_capacity, so gathers may have read overwritten slots.

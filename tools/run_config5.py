@@ -1,0 +1,58 @@
+"""Config-5 (50k outage catch-up, BASELINE.md) long-run launcher.
+
+Sets the CPU-mesh environment BEFORE importing jax (8 virtual devices on
+the host platform; collective rendezvous timeouts raised for the 1-core
+host — threads time-share a single core past XLA's 40 s default), runs
+``run_config_5`` with per-chunk progress flushing, and writes the final
+artifact. Designed to be nohup'd at round start:
+
+    nohup nice -n 19 python tools/run_config5.py \
+        --progress BENCH_config5_r5_PROGRESS.json \
+        --out BENCH_config5_r5.json > /tmp/config5_50k.log 2>&1 &
+
+A killed run leaves the progress JSON (rounds completed, per-chunk walls,
+latest gap) — evidence, not hope (VERDICT r4 missing #5 / next #2).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=50000)
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--out", default="BENCH_config5_r5.json")
+    ap.add_argument("--progress", default="BENCH_config5_r5_PROGRESS.json")
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={args.devices}"
+        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=600"
+        " --xla_cpu_collective_call_terminate_timeout_seconds=14400"
+    ).strip()
+
+    # The environment's sitecustomize registers the TPU tunnel backend and
+    # pins ``jax_platforms`` programmatically — the env var alone is not
+    # enough (see tests/conftest.py); re-pin before the first backend use.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from corro_sim.benchmarks import _atomic_json_dump, run_config_5
+
+    t0 = time.time()
+    out = run_config_5(nodes=args.nodes, progress_path=args.progress)
+    out["total_wall_s"] = round(time.time() - t0, 1)
+    _atomic_json_dump(args.out, out)
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
