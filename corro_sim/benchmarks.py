@@ -507,6 +507,9 @@ CONFIGS = {0: run_north_star, 1: run_config_1, 2: run_config_2,
 
 def main(config: int | None = None, **kw) -> int:
     """Default (no config): the honest north-star comparison (config 0)."""
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     fn = CONFIGS.get(config if config is not None else 0, run_north_star)
     print(json.dumps(fn(**kw)))
     return 0

@@ -109,6 +109,22 @@ class SimConfig:
     # the ≤1178-byte SWIM packet bound (broadcast/mod.rs:743) at ~18 B per
     # piggybacked update; >= num_nodes disables the bound (full views)
 
+    # --- merge execution (TPU Pallas kernel, core/merge_kernel.py) ---
+    merge_kernel: str = "auto"  # "auto" = Pallas dst-grouped merge for the
+    # SYNC sweep on real TPU (single device, 128-aligned cell space;
+    # measured ~120 ms/sweep saved at 10k nodes) while gossip delivery
+    # keeps the XLA scatter (neutral there — mostly-invalid lanes make
+    # the in-situ scatter cheap); "on" forces the kernel on BOTH merge
+    # paths (equivalence tests; interpret mode off-TPU); "off" keeps the
+    # XLA scatter path everywhere (sharded runs force this — pallas_call
+    # does not partition over a mesh).
+    apply_queue_cap: int = 128  # max deliveries merged per node per round
+    # under the kernel path — the reference's bounded apply channel
+    # (config.rs:10-41: change-apply cost threshold + drop queue); lanes
+    # beyond the cap are dropped BEFORE bookkeeping (counted in
+    # dropped_window) and anti-entropy repairs them, exactly like queue
+    # overflow drops (handlers.rs:866-884). Must be a multiple of 128.
+
     # --- timing model ---
     round_ms: float = 200.0  # simulated wall-clock per round (broadcast
     # flush cadence is 500 ms in the reference, broadcast/mod.rs:378; one

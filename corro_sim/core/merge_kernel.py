@@ -1,0 +1,266 @@
+"""Pallas TPU kernel: dst-grouped CRDT cell merge without global scatters.
+
+:func:`corro_sim.core.crdt.apply_cell_changes` expresses the CR-SQLite
+lexicographic merge as four masked scatter-max passes plus three per-lane
+gathers over the (N, R, C) table planes. On TPU every scatter/gather lane
+is a descriptor (~30 ns each regardless of validity — measured in the
+round-5 ablations), so the merge runs at ~35 M lanes/s and dominates the
+10k-node round (~57 ms on the 520k-lane delivery batch, ~150 ms on the
+1.28M-lane sync sweep).
+
+This kernel exploits what the scatters cannot: lanes can be grouped by
+destination node (the step's hoisted lane sort; sync lanes are built
+node-major). Lanes live in a dense per-node mailbox — ``(8, N * cap)``
+int32, node ``n``'s lanes at columns ``[n*cap, (n+1)*cap)`` — so every
+block is 128-aligned and the pallas pipeline streams both the mailbox and
+the table planes through VMEM with no manual DMA. Each grid program
+merges a block of nodes with dense one-hot compare/max reduces over the
+(cells, cap) plane — pure VPU work, zero per-lane HBM descriptors — and
+writes the planes back aliased in place. Semantics are bit-for-bit
+`apply_cell_changes` (equivalence-tested in tests/test_merge_kernel.py);
+reference semantics as documented there (``doc/crdts.md:15-17,237``,
+``agent/util.rs:721-1062``).
+
+The per-node lane cap is the bounded apply-queue analog (reference
+``config.rs:10-41``): the delivery router drops beyond-cap lanes BEFORE
+bookkeeping (counted as drops; anti-entropy repairs them, like queue
+overflow ``handlers.rs:866-884``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from corro_sim.core.crdt import NEG
+
+NEG_I = -(2 ** 31)  # python-int NEG: kernels cannot capture device arrays
+
+# lane field rows of the packed (8, N*cap) mailbox tensor
+LANE_CELL, LANE_CV, LANE_VR, LANE_SITE, LANE_CL, LANE_VALID = range(6)
+LANE_FIELDS = 8  # padded to a power of two for clean strides
+
+
+def route_lanes(
+    dst: jnp.ndarray,  # (M,) int32 destination node per lane
+    rank: jnp.ndarray,  # (M,) int32 rank of the lane within its dst
+    cell: jnp.ndarray,  # (M,) int32 row * C + col
+    cv: jnp.ndarray,
+    vr: jnp.ndarray,
+    site: jnp.ndarray,
+    cl: jnp.ndarray,
+    valid: jnp.ndarray,  # (M,) bool
+    num_nodes: int,
+    cap: int,
+) -> jnp.ndarray:
+    """Scatter flat lanes into the dense (8, N*cap) per-node mailbox.
+
+    One scatter of M descriptors (each an (8,)-field column) replaces the
+    ~7 scatter/gather passes the XLA merge pays per lane. Lanes with
+    ``rank >= cap`` or ``~valid`` drop (out-of-bounds sentinel).
+    """
+    fields = jnp.stack([
+        cell.astype(jnp.int32),
+        cv.astype(jnp.int32),
+        vr.astype(jnp.int32),
+        site.astype(jnp.int32),
+        cl.astype(jnp.int32),
+        jnp.ones_like(cell, jnp.int32),  # routed lanes are valid
+        jnp.zeros_like(cell, jnp.int32),
+        jnp.zeros_like(cell, jnp.int32),
+    ], axis=1)  # (M, 8)
+    keep = valid & (rank < cap)
+    pos = jnp.where(keep, dst * cap + rank, num_nodes * cap)
+    box = jnp.zeros((num_nodes * cap, LANE_FIELDS), jnp.int32)
+    box = box.at[pos].set(fields, mode="drop")
+    return box.T  # (8, N*cap)
+
+
+def _kernel(cells, bn, cap, cols, lanes_ref,
+            cv_ref, vr_ref, site_ref, cl_ref,
+            ocv_ref, ovr_ref, osite_ref, ocl_ref):
+    """Merge a block of nodes' lane mailboxes into their table planes.
+
+    Orientation: the hot matrices are (cap, cells) — lanes on the
+    SUBLANE axis — so every masked-max reduce over lanes lowers to ~16
+    elementwise (8, cells) tile-row maxes instead of a log2(cap)
+    cross-lane shuffle tree. All per-lane tie-break conditions are
+    evaluated *inside* the hot matrix: at a hot (lane, cell) pair the
+    broadcast ``cv1[None, :]`` is exactly ``cv1`` at the lane's target
+    cell, so no lane-side gather of merged results is ever needed.
+    """
+    neg = jnp.int32(NEG_I)
+    cell_row = jax.lax.broadcasted_iota(
+        jnp.int32, (1, cells), 1
+    ) // jnp.int32(cols)
+    for j in range(bn):
+        lane = lanes_ref[:, j * cap:(j + 1) * cap]  # (8, cap)
+
+        def col(f, lane=lane):
+            return lane[f].reshape(cap, 1)  # lane field on sublanes
+
+        lcell = col(LANE_CELL)
+        lcv = col(LANE_CV)
+        lvr = col(LANE_VR)
+        lsite = col(LANE_SITE)
+        lcl = col(LANE_CL)
+        ok = col(LANE_VALID) != 0
+
+        iota_c = jax.lax.broadcasted_iota(jnp.int32, (1, cells), 1)
+        hot_c = lcell == iota_c  # (cap, cells)
+        # row-hot: every cell of the lane's row (cl is a per-row CRDT)
+        hot_r = (lcell // jnp.int32(cols)) == cell_row
+
+        def seg_max(mat, val):
+            return jnp.max(jnp.where(mat, val, neg), axis=0)
+
+        # Pass 0: causal length (per row) + generation wipe.
+        cl0 = cl_ref[j]
+        cl1 = jnp.maximum(cl0, seg_max(hot_r & ok, lcl))
+        bumped = cl1 > cl0
+        cv0 = jnp.where(bumped, 0, cv_ref[j])
+        vr0 = jnp.where(bumped, neg, vr_ref[j])
+        site0 = jnp.where(bumped, -1, site_ref[j])
+
+        # A value lane participates only at the row's current generation
+        # (cl1 is row-uniform in cell space, so the broadcast compare at
+        # the lane's hot cell IS the lane's-row comparison).
+        val = hot_c & ok & (lvr != neg) & (lcl == cl1[None, :])
+
+        # Pass 1: col_version.
+        cv1 = jnp.maximum(cv0, seg_max(val, lcv))
+
+        # Pass 2: value rank (stored value competes only if cv survived).
+        win1 = val & (lcv == cv1[None, :])
+        vr_base = jnp.where(cv1 > cv0, neg, vr0)
+        vr1 = jnp.maximum(vr_base, seg_max(win1, lvr))
+
+        # Pass 3: site (stored site survives only if (cv, vr) survived).
+        win2 = win1 & (lvr == vr1[None, :])
+        site_base = jnp.where((cv1 != cv0) | (vr1 != vr0), neg, site0)
+        site1 = jnp.maximum(site_base, seg_max(win2, lsite))
+
+        ocv_ref[j] = cv1
+        ovr_ref[j] = vr1
+        osite_ref[j] = site1
+        ocl_ref[j] = cl1
+
+
+def grouped_merge(
+    cvf: jnp.ndarray,  # (N, cells) int32 — col_version, flat cell space
+    vrf: jnp.ndarray,  # (N, cells) int32 — value rank
+    sitef: jnp.ndarray,  # (N, cells) int32 — site
+    clf: jnp.ndarray,  # (N, cells) int32 — causal length (row-broadcast)
+    lanes: jnp.ndarray,  # (8, N*cap) int32 — per-node lane mailbox
+    cap: int,  # static lanes per node (multiple of 128)
+    cols: int,  # C — cells per row (for the causal-length row-hot mask)
+    block_nodes: int = 8,
+    interpret: bool = False,
+):
+    """Merge the per-node lane mailbox into flat table planes, in place.
+
+    Returns updated ``(cvf, vrf, sitef, clf)``. ``cells`` and ``cap``
+    must be multiples of 128 and ``block_nodes`` must divide N.
+    """
+    n, cells = cvf.shape
+    assert cells % 128 == 0 and cap % 128 == 0
+    assert n % block_nodes == 0
+    assert lanes.shape == (LANE_FIELDS, n * cap)
+    grid = (n // block_nodes,)
+
+    plane = pl.BlockSpec((block_nodes, cells), lambda i: (i, 0))
+    lane_spec = pl.BlockSpec(
+        (LANE_FIELDS, block_nodes * cap), lambda i: (0, i)
+    )
+    kern = functools.partial(_kernel, cells, block_nodes, cap, cols)
+    shape = jax.ShapeDtypeStruct((n, cells), jnp.int32)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[lane_spec, plane, plane, plane, plane],
+        out_specs=(plane, plane, plane, plane),
+        out_shape=(shape, shape, shape, shape),
+        # alias the four table planes in place (lanes operand is index 0)
+        input_output_aliases={1: 0, 2: 1, 3: 2, 4: 3},
+        interpret=interpret,
+    )(lanes, cvf, vrf, sitef, clf)
+
+
+def merge_grouped(
+    state,  # TableState
+    lanes: jnp.ndarray,  # (8, N*cap) mailbox (route_lanes / reshape)
+    cap: int,
+    block_nodes: int = 8,
+    interpret: bool = False,
+):
+    """`apply_cell_changes` on a dense per-node lane mailbox, via Pallas.
+
+    Returns the merged :class:`TableState`.
+    """
+    from corro_sim.core.crdt import TableState
+
+    n, r, c = state.cv.shape
+    cells = r * c
+    clf = jnp.repeat(state.cl, c, axis=1)
+    ncv, nvr, nsite, nclf = grouped_merge(
+        state.cv.reshape(n, cells),
+        state.vr.reshape(n, cells),
+        state.site.reshape(n, cells),
+        clf,
+        lanes, cap, c,
+        block_nodes=block_nodes, interpret=interpret,
+    )
+    return TableState(
+        cv=ncv.reshape(n, r, c),
+        vr=nvr.reshape(n, r, c),
+        site=nsite.reshape(n, r, c),
+        cl=nclf.reshape(n, r, c)[:, :, 0],
+    )
+
+
+def pick_block_nodes(n: int) -> int:
+    for bn in (16, 8, 4, 2):
+        if n % bn == 0:
+            return bn
+    return 1
+
+
+def kernel_interpret() -> bool:
+    """Interpret mode off-TPU (tests force the kernel on CPU)."""
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+def kernel_supported(cfg, mesh_active: bool = False,
+                     path: str = "sync") -> bool:
+    """Static gate for routing merges through the kernel.
+
+    The kernel needs: a real TPU backend (Mosaic; the interpret path is
+    for tests), a 128-aligned flat cell space, and a single device
+    (pallas_call does not partition under a sharded mesh — sharded runs
+    keep the XLA scatter path).
+
+    ``path``: which merge site is asking. Under ``merge_kernel="auto"``
+    only the SYNC sweep uses the kernel — its 1.28M node-major lanes
+    save ~120 ms/sweep on the real chip — while the gossip-delivery
+    merge keeps the XLA scatter (mostly-invalid lanes make the in-situ
+    scatter cheap; the kernel's fixed cost measured ~neutral there).
+    ``"on"`` forces the kernel on both paths (equivalence tests).
+    """
+    if cfg.merge_kernel == "off" or mesh_active:
+        return False
+    cells = cfg.num_rows * cfg.num_cols
+    if not (cells % 128 == 0 and cells <= 8192):
+        return False
+    if cfg.merge_kernel == "on":
+        return True
+    if path != "sync":
+        return False
+    import jax
+
+    return jax.default_backend() == "tpu"

@@ -174,6 +174,10 @@ def run_sim(
             isinstance(s, jax.sharding.NamedSharding) for s in leaf_sh
         ):
             shardings = jax.tree.map(lambda leaf: leaf.sharding, state)
+    if shardings is not None and cfg.merge_kernel != "off":
+        # pallas_call does not partition over a device mesh — sharded
+        # runs always take the XLA scatter merge path.
+        cfg = dataclasses.replace(cfg, merge_kernel="off")
     runner = _chunk_runner(cfg, donate=donate, shardings=shardings,
                            packed=True)
     root = jax.random.PRNGKey(seed)
@@ -328,6 +332,14 @@ def run_sim(
                 converged_round = int(idx[np.argmax(eligible)])
                 break
 
+    # Drain the pipeline into the measured wall: the axon platform streams
+    # per-buffer readiness, so work not on the metric dependency path (the
+    # table merge feeds only the returned state, not the gap) can still be
+    # in flight when the last metric read returns. Convergence is about
+    # STATE, so the run is not done until the state is.
+    t0 = time.perf_counter()
+    jax.block_until_ready(state)
+    wall += time.perf_counter() - t0
     metrics = {
         k: np.concatenate([c[k] for c in metrics_chunks])
         for k in metrics_chunks[0]

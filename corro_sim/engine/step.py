@@ -27,6 +27,14 @@ from corro_sim.core.bookkeeping import deliver_versions, partial_versions
 from corro_sim.core.changelog import append_changesets, gather_changesets
 from corro_sim.core.compaction import update_ownership
 from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
+from corro_sim.core.merge_kernel import (
+    kernel_interpret,
+    kernel_supported,
+    merge_grouped,
+    pick_block_nodes,
+    route_lanes,
+)
+from corro_sim.utils.slots import ranks_within_group_masked
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
 from corro_sim.membership.rtt import link_delay, observe_rtt, recompute_ring0
@@ -324,10 +332,22 @@ def sim_step(
         ring0 = state.ring0
 
     # ------------------------------------- delivery: bookkeeping + merge
+    use_kernel = kernel_supported(cfg, path="delivery")
+    # Bounded apply queue (reference config.rs:10-41): each node processes
+    # at most apply_queue_cap deliveries per round; overflow drops BEFORE
+    # bookkeeping (counted below) and sync repairs it, like the
+    # reference's queue-overflow drops (handlers.rs:866-884). Applied on
+    # BOTH merge paths — a simulation-model bound, not an execution
+    # detail, so results are backend-independent. Lanes are sorted
+    # delivered-first-per-dst, so the masked rank is exact.
+    rankd = ranks_within_group_masked(dst, delivered)
+    overcap = delivered & (rankd >= cfg.apply_queue_cap)
+    delivered = delivered & ~overcap
     book, fresh_chunk, complete, dropped = deliver_versions(
         book, dst, actor, ver, delivered, chunk=chunk, bits_per_version=cpv,
         presorted=True,
     )
+    dropped = dropped | overcap
     g_actor = jnp.where(complete, actor, 0)
     g_slot = (jnp.maximum(ver, 1) - 1) % log.capacity
     c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
@@ -345,17 +365,41 @@ def sim_step(
     # The writing site is the actor — except for DELETE entries (logged with
     # vr == NEG), which are cl-only and must not claim the site slot either.
     c_site = jnp.where(c_vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (m, s)))
-    table = apply_cell_changes(
-        table,
-        jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
-        c_row.reshape(-1),
-        c_col.reshape(-1),
-        c_cv.reshape(-1),
-        c_vr.reshape(-1),
-        c_site.reshape(-1),
-        c_cl.reshape(-1),
-        cell_live.reshape(-1),
-    )
+    if use_kernel:
+        # Pallas dst-grouped merge: route cell lanes into the per-node
+        # mailbox (one scatter) and merge in VMEM — no per-lane
+        # scatter/gather descriptors (core/merge_kernel.py).
+        cap_lanes = cfg.apply_queue_cap * s
+        rank_cell = (rankd[:, None] * s
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])
+        box = route_lanes(
+            jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
+            rank_cell.reshape(-1),
+            (c_row * cfg.num_cols + c_col).reshape(-1),
+            c_cv.reshape(-1),
+            c_vr.reshape(-1),
+            c_site.reshape(-1),
+            c_cl.reshape(-1),
+            cell_live.reshape(-1),
+            n, cap_lanes,
+        )
+        table = merge_grouped(
+            table, box, cap_lanes,
+            block_nodes=pick_block_nodes(n),
+            interpret=kernel_interpret(),
+        )
+    else:
+        table = apply_cell_changes(
+            table,
+            jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
+            c_row.reshape(-1),
+            c_col.reshape(-1),
+            c_cv.reshape(-1),
+            c_vr.reshape(-1),
+            c_site.reshape(-1),
+            c_cl.reshape(-1),
+            cell_live.reshape(-1),
+        )
 
     # ------------------------------------------------- rebroadcast + enqueue
     # Fresh foreign chunks re-enter the destination's pending ring

@@ -35,6 +35,13 @@ from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping, advance_heads
 from corro_sim.core.changelog import ChangeLog, gather_changesets
 from corro_sim.core.crdt import NEG, TableState, apply_cell_changes
+from corro_sim.core.merge_kernel import (
+    LANE_FIELDS,
+    kernel_interpret,
+    kernel_supported,
+    merge_grouped,
+    pick_block_nodes,
+)
 from corro_sim.utils.bits import WINDOW_BITS
 from corro_sim.utils.slots import ranks_within_group
 
@@ -427,17 +434,49 @@ def sync_round(
     site_l = jnp.where(
         vr == NEG, NEG, jnp.broadcast_to(actor_l[:, None], (m, s))
     )
-    table = apply_cell_changes(
-        table,
-        jnp.broadcast_to(dst_l[:, None], (m, s)).reshape(-1),
-        row.reshape(-1),
-        col.reshape(-1),
-        cv.reshape(-1),
-        vr.reshape(-1),
-        site_l.reshape(-1),
-        cl.reshape(-1),
-        cell_live.reshape(-1),
-    )
+    if kernel_supported(cfg):
+        # Sync lanes are already node-major ((N, K', cap, S) construction)
+        # — the per-node mailbox is a reshape + pad, no routing scatter;
+        # the Pallas kernel then merges with zero per-lane descriptors
+        # (core/merge_kernel.py).
+        lanes_per_node = kprime * cap * s
+        pad = (-lanes_per_node) % 128
+        cell_f = row * cfg.num_cols + col
+
+        def node_major(x):
+            v = x.reshape(n, lanes_per_node)
+            if pad:
+                v = jnp.pad(v, ((0, 0), (0, pad)))
+            return v.reshape(-1)
+
+        box = jnp.stack([
+            node_major(cell_f),
+            node_major(cv),
+            node_major(vr),
+            node_major(site_l),
+            node_major(cl),
+            node_major(cell_live.astype(jnp.int32)),
+            jnp.zeros((n * (lanes_per_node + pad),), jnp.int32),
+            jnp.zeros((n * (lanes_per_node + pad),), jnp.int32),
+        ])
+        assert box.shape[0] == LANE_FIELDS
+        table = merge_grouped(
+            table, box, lanes_per_node + pad,
+            block_nodes=pick_block_nodes(n),
+            interpret=kernel_interpret(),
+        )
+    else:
+        table = apply_cell_changes(
+            table,
+            jnp.broadcast_to(dst_l[:, None], (m, s)).reshape(-1),
+            row.reshape(-1),
+            col.reshape(-1),
+            cv.reshape(-1),
+            vr.reshape(-1),
+            site_l.reshape(-1),
+            cl.reshape(-1),
+            cell_live.reshape(-1),
+        )
 
     # Raise heads: floor[i, topa] = head + take (max-combine; slots serve
     # disjoint actors, so duplicate topa entries only occur at take == 0).

@@ -1,0 +1,30 @@
+"""Persistent XLA compile cache for non-test entry points.
+
+The 10k-node chunk program costs tens of seconds to compile; tests already
+cache compiles on disk (tests/conftest.py) but the bench / CLI / tools
+entry points paid it on every process launch. One shared cache directory
+keeps bench re-runs and tool iterations warm. Safe to call repeatedly;
+honors an explicit JAX_COMPILATION_CACHE_DIR if the user set one.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache() -> None:
+    import jax
+
+    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+        return  # user already configured it via env
+    cache_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        ".jax_cache",
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass  # older jax without these flags: compile cache is best-effort

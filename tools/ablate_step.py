@@ -30,7 +30,7 @@ from profile_round import bench_cfg
 
 def north_cfg(n: int):
     """run_north_star's exact config."""
-    write_rounds = 16
+    write_rounds = 8
     return dataclasses.replace(
         bench_cfg(n),
         write_rate=1000.0 / (n * write_rounds),
@@ -38,7 +38,7 @@ def north_cfg(n: int):
         sync_cap_per_actor=2,
         sync_req_actors=64,
         sync_need_sample=64,
-        sync_deal_probes=2,
+        sync_deal_probes=0,
     )
 
 
@@ -53,6 +53,21 @@ VARIANTS = {
     "pend8": lambda c: dataclasses.replace(c, pend_slots=8),
     "syncevery": lambda c: dataclasses.replace(
         c, sync_interval=1, sync_adaptive=False),
+    "norebro": lambda c: dataclasses.replace(
+        c, rebroadcast_transmissions=0),
+    "ring0off": lambda c: dataclasses.replace(c, ring0_size=1),
+    "seqs4": lambda c: dataclasses.replace(c, seqs_per_version=4),
+    "kerneloff": lambda c: dataclasses.replace(c, merge_kernel="off"),
+    "probes2": lambda c: dataclasses.replace(c, sync_deal_probes=2),
+    "topk32": lambda c: dataclasses.replace(
+        c, sync_actor_topk=32, sync_req_actors=32),
+    "needs16": lambda c: dataclasses.replace(c, sync_need_sample=16),
+    "syncev_kernel": lambda c: dataclasses.replace(
+        c, sync_interval=1, sync_adaptive=False, merge_kernel="on"),
+    "syncev_kerneloff": lambda c: dataclasses.replace(
+        c, sync_interval=1, sync_adaptive=False, merge_kernel="off"),
+    "nosync_kerneloff": lambda c: dataclasses.replace(
+        c, sync_interval=10**6, sync_adaptive=False, merge_kernel="off"),
 }
 
 
@@ -71,7 +86,11 @@ def run_variant(name, cfg, chunk, chunks, writes=True, seed=0):
             state, keys, jnp.asarray(alive), jnp.asarray(part),
             jnp.asarray(we),
         )
-        jax.block_until_ready(m["gap"])
+        # Block on the FULL state, not just one metric: the axon platform
+        # streams per-buffer readiness, so a gap-only block returns before
+        # work not on the gap dependency path (e.g. the table merge) has
+        # run — kernel variants then measure ~1 ms/round of pure fiction.
+        jax.block_until_ready((state, m["gap"]))
         wall = time.perf_counter() - t0
         if ci > 0:  # chunk 0 = compile + warm (ring fill)
             walls.append(wall)

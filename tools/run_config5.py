@@ -44,12 +44,18 @@ def main() -> int:
 
     jax.config.update("jax_platforms", "cpu")
 
-    from corro_sim.benchmarks import _atomic_json_dump, run_config_5
+    from corro_sim.benchmarks import run_config_5
+    from corro_sim.utils.compile_cache import enable_compile_cache
 
+    enable_compile_cache()
     t0 = time.time()
     out = run_config_5(nodes=args.nodes, progress_path=args.progress)
     out["total_wall_s"] = round(time.time() - t0, 1)
-    _atomic_json_dump(args.out, out)
+    # the FINAL artifact write must not be silently swallowed — only the
+    # mid-run progress flushes use the error-tolerant helper
+    with open(args.out + ".tmp", "w") as f:
+        json.dump(out, f)
+    os.replace(args.out + ".tmp", args.out)
     print(json.dumps(out), flush=True)
     return 0
 
