@@ -190,27 +190,25 @@ def run_north_star(n: int | None = None) -> dict:
         # cuts the (N, N) plane traffic 4x (config.swim_interval)
         swim_interval=4,
         sync_interval=8,
-        # Measured round-4 config search: full-egress gossip + sync every
-        # round in the tail beats every "leaner" variant — halved rings
-        # (41→105 rounds), the literal 1 s ≈ 5-round backoff floor
-        # (41→85), and an 8-slot egress cap (41→56) all shift bulk
-        # transfer from gossip (full lane utilization) onto sync
-        # (scheduling losses), losing more wall than the cheaper rounds
-        # save. Keep gossip aggressive; spend engineering on cheaper
-        # lanes, not fewer.
+        # Round-5 config search INVERTED round 4's finding: with the
+        # dense hot-actor sync schedule (sync_hot_actors) + the Pallas
+        # sync merge, sweeps are cheap enough that LEANER gossip wins —
+        # 8 pend slots × fanout 2 (200k lanes vs 520k) converged in 20
+        # rounds at 308 ms/round vs 19-24 rounds at 404-430 ms/round for
+        # the full-fat ring (measured on-chip, doc/round5.md). Sync
+        # absorbs the bulk catch-up the leaner rings defer.
+        pend_slots=8,
+        fanout=2,
         sync_adaptive=True,
         sync_floor_rounds=1,
-        # version-granular budget: this workload leaves each actor ≤2-3
-        # versions behind, so wide per-actor caps are dead lanes — spend
-        # the same lane budget on MORE actors per sweep instead
-        # (64 actors × 2 versions vs the r2 32 × 8)
-        sync_actor_topk=64,
-        sync_cap_per_actor=2,
-        sync_req_actors=64,
+        # wide request axis, version-granular cap: each behind node needs
+        # ~500 distinct actors × ~1 version after the partition heals —
+        # K'=128 × cap 1 finishes catch-up within ~5 floor-cadence sweeps
+        # (measured: converged_round 19-20 vs 24 with K'=64 × cap 2)
+        sync_actor_topk=128,
+        sync_cap_per_actor=1,
+        sync_req_actors=128,
         sync_need_sample=64,
-        # exact-argmax serving assignment: with the r4 schedule cost cuts
-        # the better lane utilization wins outright — 37 rounds / 16.6 s
-        # vs 41 / 17.4 s with probe dealing (r4 measured)
         sync_deal_probes=0,
     )
 
@@ -220,13 +218,49 @@ def run_north_star(n: int | None = None) -> dict:
             p[num // 2:] = 1
         return p
 
-    res = run_sim(
-        cfg, init_state(cfg, seed=0),
-        Schedule(write_rounds=write_rounds, part_fn=part_fn),
-        max_rounds=1024, chunk=16, seed=0, min_rounds=write_rounds + 8,
-    )
-    jax.block_until_ready(res.state.table.vr)
-    sim_wall = res.wall_per_round_ms * (res.converged_round or res.rounds) / 1e3
+    # Stall-resistant measurement (VERDICT r4 weak #1): the axon tunnel
+    # shows 3x run-to-run variance on identically-shaped chunks, so ONE
+    # run's wall is not a trustworthy artifact. The measured phase runs
+    # `repeats` times (same seed -> identical trajectory and chunk
+    # structure; compiles are AOT'd and cached after the first), each
+    # chunk's wall is the MEDIAN across repeats, and the convergence wall
+    # sums per-chunk medians up to the converged round (the final partial
+    # chunk pro-rated). Every per-chunk wall of every repeat ships in the
+    # artifact so a stalled chunk is visible, not hidden.
+    repeats = int(os.environ.get("CORRO_BENCH_REPEATS", "3"))
+    chunk = 8
+    runs = []
+    converged_round = None
+    for _ in range(repeats):
+        chunk_log: list[dict] = []
+        res = run_sim(
+            cfg, init_state(cfg, seed=0),
+            Schedule(write_rounds=write_rounds, part_fn=part_fn),
+            max_rounds=1024, chunk=chunk, seed=0,
+            min_rounds=write_rounds + 8, on_chunk=chunk_log.append,
+        )
+        jax.block_until_ready(res.state.table.vr)
+        runs.append({
+            "chunk_walls_s": [c["chunk_wall_s"] for c in chunk_log],
+            "chunk_runners": [c["runner"] for c in chunk_log],
+            "wall_s": round(res.wall_seconds, 3),
+            "converged_round": res.converged_round,
+        })
+        converged_round = res.converged_round or res.rounds
+
+    n_chunks = min(len(r["chunk_walls_s"]) for r in runs)
+    med_walls = [
+        float(np_.median([r["chunk_walls_s"][i] for r in runs]))
+        for i in range(n_chunks)
+    ]
+    sim_wall = 0.0
+    for i, w in enumerate(med_walls):
+        start = i * chunk
+        if start >= converged_round:
+            break
+        frac = min(converged_round - start, chunk) / chunk
+        sim_wall += w * frac
+    run_walls = sorted(r["wall_s"] for r in runs)
 
     return {
         "metric": f"northstar_{n}_node_sim_convergence_wall_s",
@@ -237,13 +271,29 @@ def run_north_star(n: int | None = None) -> dict:
         # Scored against the FROZEN r3 baseline wall, not the fresh
         # measurement, so the goalposts cannot drift with engine changes.
         "vs_baseline": round(fz["wall_s"] / sim_wall, 3) if sim_wall else None,
-        "sim_rounds_to_convergence": res.converged_round,
-        "sim_wall_per_round_ms": round(res.wall_per_round_ms, 3),
-        "sim_converged": res.converged_round is not None,
+        "sim_rounds_to_convergence": converged_round,
+        "sim_wall_per_round_ms": round(
+            1000.0 * sim_wall / max(converged_round, 1), 3
+        ),
+        "sim_converged": runs[-1]["converged_round"] is not None,
+        "estimator": (
+            f"sum of per-chunk-index median walls over {repeats} repeats, "
+            "pro-rated to the converged round; all per-chunk walls in "
+            "`runs`"
+        ),
+        "runs": runs,
+        "run_total_wall_spread_s": [run_walls[0], run_walls[-1]],
         "devcluster_64_agents_wall_s": devc["value"],
+        "devcluster_per_insert_ms": devc["per_insert_ms"],
         "devcluster_converged": devc["converged"],
         "baseline_frozen_wall_s": fz["wall_s"],
+        "baseline_frozen_per_insert_ms": round(
+            1000.0 * fz["wall_s"] / fz["config"]["inserts"], 3
+        ),
         "baseline_drift_pct": round(100 * drift, 1),
+        # drift past the band in EITHER direction flags the artifact —
+        # favorable drift of the stand-in must not silently ease the
+        # target (VERDICT r3 ask #8 / r4 next #9)
         "baseline_drift_exceeded": bool(abs(drift) > 0.20),
         "baseline_note": (
             "64-agent leg is this repo's devcluster backend (labeled "
@@ -303,6 +353,7 @@ def run_config_1(inserts: int = 1000, nodes: int = 3) -> dict:
         "value": round(wall, 3),
         "unit": "s",
         "inserts_per_sec": round(inserts / wall, 1),
+        "per_insert_ms": round(1000.0 * wall / inserts, 3),
         "converged": converged is not None,
     }
 

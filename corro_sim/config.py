@@ -91,6 +91,15 @@ class SimConfig:
     # convergence-tail regime) k=2 matches argmax throughput at ~1/6 the
     # sweep-schedule cost on the real chip.
     sync_need_sample: int = 256  # actors sampled for need estimation
+    sync_hot_actors: int = 1024  # dense-schedule hot-actor axis width: per
+    # sweep, the actors that could possibly be needed by anyone (their
+    # written head exceeds some node's applied head) are compacted to at
+    # most this many (rotating fairly when more are hot), and the whole
+    # request schedule — needs, per-peer capability, serving assignment —
+    # runs as dense elementwise work over (N, P, A') instead of
+    # per-element gathers over (N, P, K') + an (N, A, K') compare-reduce.
+    # Exact, not approximate: a non-hot actor has zero need at every
+    # node. 0 = the legacy full-axis schedule.
 
     # --- SWIM membership (foca analog) ---
     swim_enabled: bool = False

@@ -17,6 +17,11 @@ TPU shape: each node owns a fixed ring buffer of pending broadcast ids
 ``fanout`` random targets per live slot and the resulting flat message
 batch is scattered into the cluster-wide delivery pipeline. There is no
 wire protocol — "sending" is building (dst, actor, ver) index arrays.
+
+The ring is ONE packed (N, P, 4) tensor — [actor, ver, chunk, tx] per
+slot — so an enqueue is a single scatter of (4,)-blocks instead of four
+per-plane scatters (TPU scatters cost per descriptor, not per byte; the
+packing measured ~20 ms/round at 10k nodes).
 """
 
 from __future__ import annotations
@@ -31,24 +36,37 @@ from corro_sim.utils.slots import (
     ranks_within_group_masked,
 )
 
+# slot layout of the packed pending ring
+PEND_ACTOR, PEND_VER, PEND_CHUNK, PEND_TX = range(4)
+
 
 @flax.struct.dataclass
 class GossipState:
-    pend_actor: jnp.ndarray  # (N, P) int32
-    pend_ver: jnp.ndarray  # (N, P) int32
-    pend_chunk: jnp.ndarray  # (N, P) int32 — changeset chunk index
-    pend_tx: jnp.ndarray  # (N, P) int32, 0 = free slot
+    pend: jnp.ndarray  # (N, P, 4) int32 — [actor, ver, chunk, tx]
     cursor: jnp.ndarray  # (N,) int32 ring-buffer write cursor
     overflow: jnp.ndarray  # () int32 — live slots overwritten (drop metric)
 
+    # unpacked read-only views (metrics, tests; hot paths use `pend`)
+    @property
+    def pend_actor(self) -> jnp.ndarray:
+        return self.pend[..., PEND_ACTOR]
+
+    @property
+    def pend_ver(self) -> jnp.ndarray:
+        return self.pend[..., PEND_VER]
+
+    @property
+    def pend_chunk(self) -> jnp.ndarray:
+        return self.pend[..., PEND_CHUNK]
+
+    @property
+    def pend_tx(self) -> jnp.ndarray:
+        return self.pend[..., PEND_TX]
+
 
 def make_gossip_state(num_nodes: int, pend_slots: int) -> GossipState:
-    shape = (num_nodes, pend_slots)
     return GossipState(
-        pend_actor=jnp.zeros(shape, jnp.int32),
-        pend_ver=jnp.zeros(shape, jnp.int32),
-        pend_chunk=jnp.zeros(shape, jnp.int32),
-        pend_tx=jnp.zeros(shape, jnp.int32),
+        pend=jnp.zeros((num_nodes, pend_slots, 4), jnp.int32),
         cursor=jnp.zeros((num_nodes,), jnp.int32),
         overflow=jnp.zeros((), jnp.int32),
     )
@@ -75,7 +93,7 @@ def enqueue_broadcasts(
     dst values are already nondecreasing (the step function's hoisted
     lane sort), so ranks come from a sort-free cumsum/cummax pass.
     """
-    n, p = gossip.pend_tx.shape
+    n, p, _ = gossip.pend.shape
     big = jnp.int32(n + 1)
     if grouped:
         s_dst = jnp.where(valid, dst, big)
@@ -116,17 +134,16 @@ def enqueue_broadcasts(
     # OOB-positive sentinel: -1 would wrap and clobber the last node's ring
     idx = (jnp.where(s_valid, s_dst, n), slot)
 
-    clobbered = ((gossip.pend_tx[idx] > 0) & s_valid) | over_capacity
+    clobbered = ((gossip.pend[idx][..., PEND_TX] > 0) & s_valid) | over_capacity
     if not grouped:
         counts = group_counts(jnp.where(s_valid, s_dst, big), n)
 
+    packed = jnp.stack([
+        s_actor, s_ver, s_chunk,
+        jnp.where(s_valid, transmissions, 0),
+    ], axis=-1)  # (m, 4) — ONE scatter of whole slots
     return GossipState(
-        pend_actor=gossip.pend_actor.at[idx].set(s_actor, mode="drop"),
-        pend_ver=gossip.pend_ver.at[idx].set(s_ver, mode="drop"),
-        pend_chunk=gossip.pend_chunk.at[idx].set(s_chunk, mode="drop"),
-        pend_tx=gossip.pend_tx.at[idx].set(
-            jnp.where(s_valid, transmissions, 0), mode="drop"
-        ),
+        pend=gossip.pend.at[idx].set(packed, mode="drop"),
         cursor=(gossip.cursor + counts) % p,
         overflow=gossip.overflow + clobbered.sum(dtype=jnp.int32),
     )
@@ -160,7 +177,7 @@ def broadcast_step(
     Returns ``(gossip, dst, src, actor, ver, chunk, valid)`` flat message
     arrays of length N * serviced_slots * fanout.
     """
-    n, p = gossip.pend_tx.shape
+    n, p, _ = gossip.pend.shape
     e = p if not emit_slots or emit_slots >= p else emit_slots
     if e < p:
         # rotate the serviced window every round so every slot is serviced
@@ -175,15 +192,10 @@ def broadcast_step(
         slot_ids = (base + node_phase[:, None]
                     + jnp.arange(e, dtype=jnp.int32)[None, :]) % p  # (N, E)
         rows = jnp.arange(n, dtype=jnp.int32)[:, None]
-        pend_tx = gossip.pend_tx[rows, slot_ids]
-        pend_actor = gossip.pend_actor[rows, slot_ids]
-        pend_ver = gossip.pend_ver[rows, slot_ids]
-        pend_chunk = gossip.pend_chunk[rows, slot_ids]
+        pend_e = gossip.pend[rows, slot_ids]  # (N, E, 4)
     else:
-        pend_tx = gossip.pend_tx
-        pend_actor = gossip.pend_actor
-        pend_ver = gossip.pend_ver
-        pend_chunk = gossip.pend_chunk
+        pend_e = gossip.pend
+    pend_tx = pend_e[..., PEND_TX]
     live = (pend_tx > 0) & sender_alive[:, None]  # (N, E)
 
     tkey = jax.random.fold_in(key, 7)
@@ -202,21 +214,27 @@ def broadcast_step(
 
     dst = targets.reshape(-1)
     valid = ok.reshape(-1)
-    actor = jnp.broadcast_to(pend_actor[:, :, None], targets.shape).reshape(-1)
-    ver = jnp.broadcast_to(pend_ver[:, :, None], targets.shape).reshape(-1)
+    actor = jnp.broadcast_to(
+        pend_e[..., PEND_ACTOR][:, :, None], targets.shape
+    ).reshape(-1)
+    ver = jnp.broadcast_to(
+        pend_e[..., PEND_VER][:, :, None], targets.shape
+    ).reshape(-1)
     chunk = jnp.broadcast_to(
-        pend_chunk[:, :, None], targets.shape
+        pend_e[..., PEND_CHUNK][:, :, None], targets.shape
     ).reshape(-1)
     src_flat = src.reshape(-1)
 
     if e < p:
-        new_tx = gossip.pend_tx.at[rows, slot_ids].add(
+        new_pend = gossip.pend.at[rows, slot_ids, PEND_TX].add(
             -live.astype(jnp.int32)
         )
     else:
-        new_tx = jnp.where(live, gossip.pend_tx - 1, gossip.pend_tx)
+        new_pend = gossip.pend.at[..., PEND_TX].add(
+            -live.astype(jnp.int32)
+        )
     return (
-        gossip.replace(pend_tx=new_tx),
+        gossip.replace(pend=new_pend),
         dst,
         src_flat,
         actor,

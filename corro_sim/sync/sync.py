@@ -206,6 +206,125 @@ def deal_serving_slots(
     return slot, rank_in_slot
 
 
+def _kth_positive(csum, kprime, n, axis_len, roll_phase=None):
+    """(N, K') column index of the k-th positive, from per-row INCLUSIVE
+    prefix counts of a positives mask.
+
+    Fast form: a fused compare-reduce — for monotone csum, (first index
+    with csum >= k) == #{j : csum[j] < k}, and the count is a multiset
+    property, so ``csum`` may be in any column order. One streaming pass
+    over the plane on TPU. Above 2^33 lanes (backends that materialize
+    the compare, XLA:CPU, would OOM — hit at 50k x 50k) a batched binary
+    search runs instead; that needs the MONOTONE row order, so callers
+    whose csum is in rotated-scan order pass ``roll_phase`` and the roll
+    materializes only on that branch.
+    """
+    cdt = jnp.int16 if axis_len < (1 << 15) else jnp.int32
+    tk = jnp.arange(1, kprime + 1, dtype=cdt)
+    if n * axis_len * kprime <= (1 << 33):
+        return jnp.sum(
+            csum.astype(cdt)[:, :, None] < tk[None, None, :], axis=1,
+            dtype=jnp.int32,
+        )
+    rolled = csum if roll_phase is None else jnp.roll(
+        csum, -roll_phase, axis=1
+    )
+    return jax.vmap(
+        lambda rw: jnp.searchsorted(rw, tk, side="left")
+    )(rolled.astype(cdt)).astype(jnp.int32)
+
+
+def _rank_within_slot(slot, rows, n, kprime):
+    """Rank of each lane within its serving-slot group (lanes are in
+    rotated scan order; the per-connection budget keeps the first kp)."""
+    order = jnp.argsort(slot, axis=1, stable=True)
+    s_sorted = jnp.take_along_axis(slot, order, 1)
+    idx2 = jnp.broadcast_to(
+        jnp.arange(kprime, dtype=jnp.int32)[None, :], (n, kprime)
+    )
+    newgrp = jnp.concatenate(
+        [jnp.ones((n, 1), bool), s_sorted[:, 1:] != s_sorted[:, :-1]],
+        axis=1,
+    )
+    grp_start = jax.lax.cummax(jnp.where(newgrp, idx2, 0), axis=1)
+    return jnp.zeros((n, kprime), jnp.int32).at[
+        rows[:, None], order
+    ].set(idx2 - grp_start)
+
+
+def _legacy_schedule(cfg, book, log, peer, granted, phase, rows,
+                     n, a, p_cnt, kp, kprime):
+    """The full-actor-axis request schedule (``sync_hot_actors == 0``).
+
+    Kept for comparison and as the fallback when the dense hot-actor
+    form is disabled. Built WITHOUT any (N, A)-sized gather OR scatter —
+    the r2 form packed lanes with an (N, A)-update scatter, and 1e8
+    scatter update lanes dominated the whole sweep on the real chip:
+
+    1. Each node selects up to K' actors it still needs (its own
+       bookkeeping vs the written heads — the needs side of
+       compute_available_needs, sync.rs:127-249) by scanning the actor
+       axis from a random per-sweep phase and keeping the first K'
+       positives (rotated round-robin — the reference's shuffled request
+       dealing, peer.rs:1241-1372). The k-th selected actor is recovered
+       from the per-row inclusive cumsum of the need mask by a fused
+       (N, A, K') compare-reduce (~26 ms at 10k on the real chip); above
+       2^33 lanes a batched binary search avoids materializing it.
+    2. One serving slot per lane: probe-dealing or exact argmax
+       (cfg.sync_deal_probes; see config.py for the trade-off).
+    """
+    my_need = jnp.maximum(log.head[None, :] - book.head, 0)  # (N, A)
+    pos = my_need > 0
+    # Rolled-order inclusive cumsum WITHOUT materializing a rolled (N, A)
+    # plane: for original column o, the prefix count in the rotated scan
+    # is c[o] - c[phase-1] (+ total when o < phase wraps to the tail).
+    c = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A) original order
+    total = c[:, -1:]
+    cpm1 = jnp.where(
+        phase > 0,
+        jnp.take(c, jnp.maximum(phase - 1, 0), axis=1)[:, None],
+        0,
+    )
+    wraps = jnp.arange(a, dtype=jnp.int32)[None, :] < phase  # (1, A)
+    csum = c - cpm1 + jnp.where(wraps, total, 0)
+    idx = _kth_positive(csum, kprime, n, a, roll_phase=phase)
+    lane_ok = idx < a
+    topa = (jnp.where(lane_ok, idx, 0) + phase) % a
+
+    my_head = book.head[rows[:, None], topa]  # (N, K')
+    if cfg.sync_deal_probes:
+        # Deal lanes round-robin across granted slots, then probe k
+        # candidate slots per lane and serve from the furthest-ahead
+        # (see deal_serving_slots; budget rank is arithmetic on the
+        # primary dealing).
+        slot, rank_in_slot = deal_serving_slots(granted, phase, kprime)
+        topv = jnp.zeros((n, kprime), jnp.int32)
+        for i in range(min(cfg.sync_deal_probes, p_cnt)):
+            slot_i, _ = deal_serving_slots(granted, phase + i, kprime)
+            peer_i = peer[rows[:, None], jnp.minimum(slot_i, p_cnt - 1)]
+            tv_i = jnp.where(
+                slot_i < p_cnt,
+                jnp.maximum(book.head[peer_i, topa] - my_head, 0), 0,
+            )
+            slot = jnp.where(tv_i > topv, slot_i, slot)
+            topv = jnp.maximum(tv_i, topv)
+        slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
+        within_budget = rank_in_slot < kp
+    else:
+        # Exact argmax: what each granted peer can serve of each
+        # requested actor — an (N, P, K') gather — then the
+        # furthest-ahead assignment with round-robin tie-breaking.
+        # Dead lanes get the sentinel slot p_cnt so they sort into
+        # their own budget group.
+        ph = book.head[peer[:, :, None], topa[:, None, :]]  # (N, P, K')
+        delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
+        delta_p = jnp.where(granted[:, :, None], delta_p, 0)
+        slot, topv = choose_serving_slots(delta_p, topa, phase)
+        slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
+        within_budget = _rank_within_slot(slot, rows, n, kprime) < kp
+    return topa, slot, topv, lane_ok, within_budget
+
+
 def sync_round(
     cfg: SimConfig,
     book: Bookkeeping,
@@ -264,128 +383,75 @@ def sync_round(
     s = log.seqs
     offs = jnp.arange(1, cap + 1, dtype=jnp.int32)  # (cap,)
 
-    # Request schedule, built WITHOUT any (N, A)-sized gather OR scatter —
-    # the r2 form packed lanes with an (N, A)-update scatter, and 1e8
-    # scatter update lanes dominated the whole sweep on the real chip
-    # (~0.9 s of the 971 ms sync stage in tools/profile_sync.py):
-    #
-    # 1. Each node selects up to K' actors it still needs (its own
-    #    bookkeeping vs the written heads — the needs side of
-    #    compute_available_needs, sync.rs:127-249) by scanning the actor
-    #    axis from a random per-sweep phase and keeping the first K'
-    #    positives. Rotated round-robin is what the reference's shuffled
-    #    request scheduler does anyway (chunked needs are SHUFFLED and
-    #    dealt round-robin, peer.rs:1241-1372 — not served largest-first).
-    #    The k-th selected actor is recovered from the per-row inclusive
-    #    cumsum of the need mask by a fused compare-reduce: for monotone
-    #    csum, (first index with csum >= k) == #{j : csum[j] < k}, so ONE
-    #    reduction over the actor axis answers every target at once. XLA
-    #    fuses the (N, A, K') compare into the reduce loop — the csum
-    #    plane streams through once (~26 ms at 10k on the real chip) —
-    #    where a batched binary search pays ceil(log2 A) = 14 rounds of
-    #    per-element take_along_axis gathers (~102 ms measured; TPU
-    #    random gathers are slow, streaming reduces are fast).
     phase = jax.random.randint(k_phase, (), 0, a, dtype=jnp.int32)
-    my_need = jnp.maximum(log.head[None, :] - book.head, 0)  # (N, A)
-    pos = my_need > 0
-    # Rolled-order inclusive cumsum WITHOUT materializing a rolled (N, A)
-    # plane: for original column o, the prefix count in the rotated scan
-    # is c[o] - c[phase-1] (+ total when o < phase wraps to the tail).
-    # The k-th-positive recovery below only needs the MULTISET of prefix
-    # counts (it counts entries < k), which a permutation preserves.
-    c = jnp.cumsum(pos.astype(jnp.int32), axis=1)  # (N, A) original order
-    total = c[:, -1:]
-    cpm1 = jnp.where(
-        phase > 0,
-        jnp.take(c, jnp.maximum(phase - 1, 0), axis=1)[:, None],
-        0,
-    )
-    wraps = jnp.arange(a, dtype=jnp.int32)[None, :] < phase  # (1, A)
-    # int16 halves the (N, A, K') compare-reduce's bandwidth; prefix
-    # counts are bounded by A, so fall back to int32 at >=32k actors
-    cdtype = jnp.int16 if a < (1 << 15) else jnp.int32
-    csum = (c - cpm1 + jnp.where(wraps, total, 0)).astype(cdtype)
-    targets = jnp.arange(1, kprime + 1, dtype=cdtype)  # (K',)
-    if n * a * kprime <= (1 << 33):
-        # fused compare-reduce: one streaming pass over the csum plane on
-        # TPU (the batched binary search measured ~4x slower there)
-        idx = jnp.sum(
-            csum[:, :, None] < targets[None, None, :], axis=1,
-            dtype=jnp.int32,
-        )  # (N, K') — rotated index of the k-th positive; a = unfilled
-    else:
-        # at 50k x 50k the (N, A, K') compare is ~10^11 lanes — backends
-        # that materialize it (XLA:CPU) OOM. The rolled-order prefix
-        # counts are monotone per row, so a batched binary search gives
-        # the same k-th-positive indices with O(N*K') memory.
-        rolled_seq = jnp.roll(csum, -phase, axis=1)
-        idx = jax.vmap(
-            lambda row: jnp.searchsorted(row, targets, side="left")
-        )(rolled_seq).astype(jnp.int32)
-    lane_ok = idx < a
-    topa = (jnp.where(lane_ok, idx, 0) + phase) % a
+    # The dense hot-actor schedule is exact-argmax only; an explicit
+    # probe-dealing policy (sync_deal_probes > 0) takes the legacy path
+    # so the configured policy actually executes.
+    if cfg.sync_hot_actors > 0 and not cfg.sync_deal_probes:
+        # ---------------- dense hot-actor schedule (the r5 form) --------
+        # Per sweep, compact the actor axis to the actors anyone could
+        # need — exactly {a : log.head[a] > min_n book.head[n, a]} — then
+        # run needs, per-peer capability, and the serving assignment as
+        # DENSE elementwise work over (N, P, A'). This replaces the
+        # (N, P, K') per-element capability gather (~99 ms at 10k: every
+        # lane a descriptor) and the (N, A, K') k-th-positive
+        # compare-reduce (~26 ms) with a handful of streaming passes over
+        # (N, P, A') plus one 100k-descriptor ROW gather — XLA gathers
+        # cost per descriptor, not per byte, so gathering whole hot-axis
+        # rows is ~free while per-element gathers are not. Semantically
+        # this requests only what an admitted peer actually HAS (their
+        # advertised heads minus ours — compute_available_needs,
+        # sync.rs:127-249), like the reference; the legacy path burned
+        # request lanes on needs no granted peer could serve.
+        ahot = min(cfg.sync_hot_actors, a)
+        min_head = book.head.min(axis=0)  # (A,)
+        hot_mask = log.head > min_head
+        hot_cs = jnp.cumsum(hot_mask.astype(jnp.int32))
+        total_hot = hot_cs[-1]
+        # rotated k-th positive over the (A,) hot mask from the sweep
+        # phase: fairness when more than A' actors are hot (the window
+        # rotates sweep to sweep, like the shuffled request dealing of
+        # peer.rs:1241-1372)
+        cpm1h = jnp.where(phase > 0, hot_cs[jnp.maximum(phase - 1, 0)], 0)
+        wrapsh = jnp.arange(a, dtype=jnp.int32) < phase
+        csumh = hot_cs - cpm1h + jnp.where(wrapsh, total_hot, 0)
+        tgt = jnp.arange(1, ahot + 1, dtype=jnp.int32)
+        hpos = jnp.searchsorted(
+            jnp.roll(csumh, -phase), tgt, side="left"
+        ).astype(jnp.int32)
+        hot_ok = hpos < a
+        hot_idx = (jnp.where(hot_ok, hpos, 0) + phase) % a  # (A',)
 
-    # 2.+3. One serving slot per lane. Two statically-selected policies
-    #    (cfg.sync_deal_probes; see config.py for the trade-off):
-    my_head = book.head[rows[:, None], topa]  # (N, K')
-    if cfg.sync_deal_probes:
-        # Deal lanes round-robin across granted slots (global range
-        # dedupe: one slot per lane — the reference's shuffled request
-        # dealing, peer.rs:1241-1372), then probe the capability of k
-        # candidate slots per lane and serve from the furthest-ahead —
-        # each probe is one (N, K') gather of the peer's head for the
-        # lane's actor (their haves minus ours,
-        # compute_available_needs sync.rs:127-249, restricted to the
-        # lane). With granted count <= probes this IS the argmax; a
-        # lane no probe can serve dies this sweep and re-deals under a
-        # fresh phase next sweep. Budget rank is arithmetic on the
-        # primary dealing (lane // granted-count): dead lanes consume
-        # budget, and a connection may serve a neighbor-dealt lane, so
-        # a slot's served count is bounded by probes x its chunk
-        # budget — and there is no (N, K') argsort.
-        slot, rank_in_slot = deal_serving_slots(granted, phase, kprime)
-        topv = jnp.zeros((n, kprime), jnp.int32)
-        for i in range(min(cfg.sync_deal_probes, p_cnt)):
-            slot_i, _ = deal_serving_slots(granted, phase + i, kprime)
-            peer_i = peer[rows[:, None], jnp.minimum(slot_i, p_cnt - 1)]
-            tv_i = jnp.where(
-                slot_i < p_cnt,
-                jnp.maximum(book.head[peer_i, topa] - my_head, 0), 0,
-            )
-            slot = jnp.where(tv_i > topv, slot_i, slot)
-            topv = jnp.maximum(tv_i, topv)
+        head_hot = book.head[:, hot_idx]  # (N, A') column gather
+        ph_hot = head_hot[peer]  # (N, P, A') row gather
+        delta_p = jnp.maximum(ph_hot - head_hot[:, None, :], 0)
+        delta_p = jnp.where(
+            granted[:, :, None] & hot_ok[None, None, :], delta_p, 0
+        )
+        slot_d, best_d = choose_serving_slots(
+            delta_p, jnp.broadcast_to(hot_idx[None, :], (n, ahot)), phase
+        )  # (N, A') each
+
+        # K' serviceable lanes per node, in (already rotated) hot order.
+        ch = jnp.cumsum((best_d > 0).astype(jnp.int32), axis=1)
+        idx = _kth_positive(ch, kprime, n, ahot)
+        lane_ok = idx < ahot
+        pos_sel = jnp.where(lane_ok, idx, 0)
+        topa = hot_idx[pos_sel]  # (N, K') actor ids
+        slot = jnp.take_along_axis(slot_d, pos_sel, 1)
+        topv = jnp.where(
+            lane_ok, jnp.take_along_axis(best_d, pos_sel, 1), 0
+        )
         slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
-        within_budget = rank_in_slot < kp
+        if kp >= kprime:
+            within_budget = jnp.ones((n, kprime), bool)
+        else:
+            within_budget = _rank_within_slot(slot, rows, n, kprime) < kp
     else:
-        # Exact argmax: what each granted peer can serve of each
-        # requested actor — an (N, P, K') gather — then the
-        # furthest-ahead assignment with round-robin tie-breaking.
-        # Dead lanes (unfilled, or no granted peer can serve them) get
-        # the sentinel slot p_cnt so they sort into their own budget
-        # group — defaulting them to slot 0 would consume that
-        # connection's kp budget and crowd out lanes the slot-0 peer
-        # could actually serve.
-        ph = book.head[peer[:, :, None], topa[:, None, :]]  # (N, P, K')
-        delta_p = jnp.maximum(ph - my_head[:, None, :], 0)
-        delta_p = jnp.where(granted[:, :, None], delta_p, 0)
-        slot, topv = choose_serving_slots(delta_p, topa, phase)
-        slot = jnp.where(lane_ok & (topv > 0), slot, p_cnt)
-        # rank of each lane within its slot group (lanes are in rotated
-        # scan order; the budget keeps the first kp per slot)
-        order = jnp.argsort(slot, axis=1, stable=True)
-        s_sorted = jnp.take_along_axis(slot, order, 1)
-        idx2 = jnp.broadcast_to(
-            jnp.arange(kprime, dtype=jnp.int32)[None, :], (n, kprime)
+        topa, slot, topv, lane_ok, within_budget = _legacy_schedule(
+            cfg, book, log, peer, granted, phase, rows,
+            n, a, p_cnt, kp, kprime,
         )
-        newgrp = jnp.concatenate(
-            [jnp.ones((n, 1), bool), s_sorted[:, 1:] != s_sorted[:, :-1]],
-            axis=1,
-        )
-        grp_start = jax.lax.cummax(jnp.where(newgrp, idx2, 0), axis=1)
-        rank_in_slot = jnp.zeros((n, kprime), jnp.int32).at[
-            rows[:, None], order
-        ].set(idx2 - grp_start)
-        within_budget = rank_in_slot < kp
 
     # adaptive chunk sizing (peer.rs:345-349): the reference halves its
     # send buffer 8 KiB → ≥1 KiB as a link slows; here a slow (high
@@ -434,6 +500,32 @@ def sync_round(
     site_l = jnp.where(
         vr == NEG, NEG, jnp.broadcast_to(actor_l[:, None], (m, s))
     )
+
+    # Seq-granular partial serving (SyncNeedV1::Partial,
+    # api/peer.rs:351-762, sync.rs:127-249): a version the receiver has
+    # PARTIALLY buffered via gossip only transfers its missing chunks —
+    # the buffered seq ranges apply locally from the buffer
+    # (__corro_buffered_changes in the reference; the shared change log
+    # here), costing no wire bytes. ``shipped`` masks out cells whose
+    # chunk bit is already set in the receiver's window; the byte-volume
+    # metric counts only shipped cells, while the merge still applies the
+    # full changeset (completion materializes the buffered data). Served
+    # versions are base + o, so the window offset is o - 1 — no per-lane
+    # gather needed beyond the (N, K') window word fetched for the
+    # already-applied accounting below.
+    win_k = book.win[rows[:, None], topa]  # (N, K') uint32
+    chunk_of_seq = (
+        jnp.arange(s, dtype=jnp.int32) * bpv // max(s, 1)
+    )  # (S,) — which chunk each seq belongs to
+    voff_o = (offs - 1).clip(0, vwin - 1)  # (cap,)
+    bit_off = (
+        voff_o[:, None] * bpv + chunk_of_seq[None, :]
+    ).astype(jnp.uint32)  # (cap, S)
+    buffered = (
+        (win_k[:, :, None, None] >> bit_off[None, None, :, :])
+        & jnp.uint32(1)
+    ).astype(bool) & ((offs - 1) < vwin)[None, None, :, None]  # (N,K',cap,S)
+    shipped = cell_live & ~buffered.reshape(m, s)
     if kernel_supported(cfg):
         # Sync lanes are already node-major ((N, K', cap, S) construction)
         # — the per-node mailbox is a reshape + pad, no routing scatter;
@@ -485,10 +577,9 @@ def sync_round(
     # Newly-applied count: versions in head+1..head+take that were already
     # seq-complete in the window arrived earlier via gossip and were
     # counted then — don't count the re-transfer again.
-    win_g = book.win[rows[:, None], topa]
     already = jnp.zeros(take.shape, jnp.int32)
     for o in range(min(cap, vwin)):
-        g = (win_g >> jnp.uint32(o * bpv)) & group_mask
+        g = (win_k >> jnp.uint32(o * bpv)) & group_mask
         already = already + ((g == group_mask) & (o < take)).astype(jnp.int32)
     new_versions = (take - already).sum(dtype=jnp.int32)
     empties = (valid_l & cleared_l).sum(dtype=jnp.int32)
@@ -509,8 +600,10 @@ def sync_round(
         "sync_rejections": (requested & ~granted).sum(dtype=jnp.int32),
         "sync_versions": new_versions,
         "sync_empties": empties,
-        # cell lanes shipped by this sweep — the byte-volume signal
-        # (corro.sync.chunk.sent.bytes analog, metrics.rs)
-        "sync_cells": cell_live.sum(dtype=jnp.int32),
+        # cell lanes SHIPPED by this sweep — the byte-volume signal
+        # (corro.sync.chunk.sent.bytes analog, metrics.rs). Chunks the
+        # receiver already buffered via gossip are excluded: partial
+        # needs transfer only the missing seq ranges (SyncNeedV1::Partial).
+        "sync_cells": shipped.sum(dtype=jnp.int32),
     }
     return book, table, hlc, last_cleared, metrics

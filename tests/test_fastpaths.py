@@ -178,10 +178,12 @@ def test_emit_slots_cap_services_all_slots():
 
     n, p, e = 4, 8, 3
     g = GossipState(
-        pend_actor=jnp.zeros((n, p), jnp.int32),
-        pend_ver=jnp.arange(n * p, dtype=jnp.int32).reshape(n, p),
-        pend_chunk=jnp.zeros((n, p), jnp.int32),
-        pend_tx=jnp.ones((n, p), jnp.int32),  # every slot live, tx=1
+        pend=jnp.stack([
+            jnp.zeros((n, p), jnp.int32),
+            jnp.arange(n * p, dtype=jnp.int32).reshape(n, p),
+            jnp.zeros((n, p), jnp.int32),
+            jnp.ones((n, p), jnp.int32),  # every slot live, tx=1
+        ], axis=-1),
         cursor=jnp.asarray([0, 3, 5, 7], jnp.int32),
         overflow=jnp.zeros((), jnp.int32),
     )

@@ -65,15 +65,17 @@ def test_deal_serving_slots_dedupes_and_spreads():
 
 
 @pytest.mark.quick
-def test_sync_round_accounting_no_duplicate_transfers():
+@pytest.mark.parametrize("hot_actors", [1024, 0])
+def test_sync_round_accounting_no_duplicate_transfers(hot_actors):
     """One sync_round on a crafted lagging cluster: head advancement must
     equal the reported sync_versions exactly — a duplicated range would
-    inflate the metric above the real head movement."""
+    inflate the metric above the real head movement. Runs both the dense
+    hot-actor schedule (default) and the legacy full-axis argmax path."""
     n = 16
     cfg = SimConfig(
         num_nodes=n, num_rows=8, num_cols=2, log_capacity=64,
         sync_peers=4, sync_actor_topk=8, sync_cap_per_actor=4,
-        sync_server_cap=16,
+        sync_server_cap=16, sync_hot_actors=hot_actors,
     ).validate()
     written = 10
     log = make_changelog(n, 64, 1)
@@ -182,3 +184,57 @@ def test_sync_round_probe_dealing_matches_argmax_accounting():
             f"probes={probes}: head advance {adv} != sync_versions "
             f"{int(metrics['sync_versions'])}"
         )
+
+
+def test_partial_sync_ships_only_missing_chunks():
+    """Seq-granular partial sync (SyncNeedV1::Partial, api/peer.rs:351-762,
+    sync.rs:127-249): a receiver that already buffered k of m chunks of a
+    version via gossip receives only the m-k missing chunks' cells over
+    sync — sync_cells must drop accordingly while the version still
+    completes (head advances past it)."""
+    n = 8
+    cpv, s = 4, 4  # 4 chunks per version, one seq per chunk
+    cfg = SimConfig(
+        num_nodes=n, num_rows=8, num_cols=4, log_capacity=32,
+        seqs_per_version=s, chunks_per_version=cpv,
+        sync_peers=2, sync_actor_topk=4, sync_cap_per_actor=2,
+        sync_server_cap=16,
+    ).validate()
+    log = make_changelog(n, 32, s)
+    # actor 1 wrote one version with 4 live cells (one per chunk)
+    cells = jnp.zeros((n, 32, s, 5), jnp.int32)
+    for si in range(s):
+        cells = cells.at[1, 0, si].set(
+            jnp.asarray([si, si % 4, 10 + si, 1, 1], jnp.int32)
+        )
+    log = log.replace(
+        cells=cells,
+        ncells=jnp.zeros((n, 32), jnp.int32).at[1, 0].set(s),
+        head=jnp.zeros((n,), jnp.int32).at[1].set(1),
+    )
+    head = np.zeros((n, n), np.int32)
+    head[:, 1] = 1  # everyone has actor 1's version...
+    head[0, 1] = 0  # ...except node 0
+    win = np.zeros((n, n), np.uint32)
+
+    def run(win0):
+        w = win.copy()
+        w[0, 1] = win0
+        book = Bookkeeping(head=jnp.asarray(head), win=jnp.asarray(w))
+        table = make_table_state(n, 8, 4)
+        book2, _, _, _, metrics = sync_round(
+            cfg, book, log, table,
+            jnp.zeros((n,), jnp.int32), jnp.full((n,), -1, jnp.int32),
+            jnp.full((n, 32), -1, jnp.int32),
+            jax.random.PRNGKey(1), jnp.ones((n,), bool),
+            jnp.ones((1, n), bool), jnp.ones((n, n), bool),
+        )
+        assert int(np.asarray(book2.head)[0, 1]) == 1, "version not served"
+        return int(metrics["sync_cells"])
+
+    full = run(0b0000)  # nothing buffered: all 4 chunks ship
+    partial = run(0b0011)  # chunks 0,1 already buffered via gossip
+    assert full == 4, f"expected 4 shipped cells, got {full}"
+    assert partial == 2, (
+        f"receiver holding 2 of 4 chunks must receive only 2 ({partial})"
+    )

@@ -184,7 +184,7 @@ def main() -> int:
                 g, sub, alive, view1, cfg.fanout
             )
             # keep queues live across iterations; consume outputs
-            g2 = g2.replace(pend_tx=g.pend_tx)
+            g2 = g2.replace(pend=g.pend)
             return g2, key, acc + jnp.where(ok, dst, 0).sum()
         timeit("emit", jax.jit(lambda c: jax.lax.fori_loop(0, iters, emit_body, c)),
                (state.gossip, jax.random.PRNGKey(5), jnp.int32(0)),
