@@ -40,15 +40,19 @@ class StatementError(ValueError):
 class WriteOp:
     """One parsed DML statement, normalized to cell operations."""
 
-    kind: str  # 'upsert' | 'update' | 'delete'
+    kind: str  # 'upsert' | 'update' | 'delete' | 'insert_select'
     table: str
     # upsert: list of (pk_tuple, {col: value}) — one per VALUES tuple
     rows: list | None = None
-    # update: {col: value} applied to rows selected by `where`
+    # update: {col: value-or-expression-AST} applied to selected rows
     sets: dict | None = None
     # update/delete row selection: either resolved pk tuples or a predicate
     pks: list | None = None
     where: object | None = None  # predicate AST when not pure pk-equality
+    where_expr: object | None = None  # scalar-expression WHERE (api/exprs)
+    # insert_select: target column list + the source SELECT
+    cols: list | None = None
+    select: object | None = None
 
 
 def parse_statement(stmt) -> tuple[str, list | dict]:
@@ -203,6 +207,40 @@ def _parse_insert(p: _Parser) -> WriteOp:
         p.next()
         cols.append(p.expect("ident"))
     p.expect(")")
+    if p.peek()[0] == "SELECT":
+        # INSERT … SELECT (reference: arbitrary SQL in the write tx,
+        # api/public/mod.rs:104-131): the source SELECT evaluates against
+        # the writing node's view at plan time, its rows become VALUES.
+        # Projections are full scalar expressions (SELECT id, v + 10 …).
+        from corro_sim.api.exprs import ExprError, ExprParser
+
+        p.next()
+        items = []
+        try:
+            while True:
+                items.append(ExprParser(p).parse_scalar())
+                if p.peek()[0] == "AS":
+                    p.next()
+                    p.expect("ident")
+                elif p.peek()[0] == "ident":
+                    p.next()  # bare alias
+                if p.peek()[0] == ",":
+                    p.next()
+                    continue
+                break
+        except ExprError as err:
+            raise StatementError(str(err)) from None
+        p.expect("FROM")
+        src = p.expect("ident")
+        where = where_expr = None
+        if p.peek()[0] == "WHERE":
+            where, where_expr = _parse_where(p)
+        elif p.peek()[0] != "eof":
+            raise StatementError(f"trailing tokens at {p.peek()!r}")
+        return WriteOp(
+            kind="insert_select", table=table, cols=cols,
+            select=(src, tuple(items)), where=where, where_expr=where_expr,
+        )
     p.expect("VALUES")
     tuples = []
     while True:
@@ -232,15 +270,36 @@ def _parse_insert(p: _Parser) -> WriteOp:
 
 
 def _value(p: _Parser):
-    k, v = p.next()
-    if k == "lit":
-        return v
-    if k == "NULL":
-        return None
-    raise StatementError(f"expected literal, got {k} {v!r}")
+    """One VALUES item: any column-free scalar expression, folded to its
+    value at parse time (``VALUES (1 + 2, upper('x'))`` works; referencing
+    a column inside VALUES is an error, as in SQLite)."""
+    from corro_sim.api.exprs import (
+        ExprError,
+        ExprParser,
+        columns_of,
+        const_value,
+    )
+
+    try:
+        e = ExprParser(p).parse_scalar()
+        cols = columns_of(e)
+        if cols:
+            raise StatementError(
+                f"VALUES may not reference columns: {sorted(cols)}"
+            )
+        return const_value(e)
+    except ExprError as err:
+        raise StatementError(str(err)) from None
 
 
 def _parse_update(p: _Parser) -> WriteOp:
+    from corro_sim.api.exprs import (
+        ExprError,
+        ExprParser,
+        columns_of,
+        const_value,
+    )
+
     p.expect("UPDATE")
     table = p.expect("ident")
     p.expect("SET")
@@ -250,34 +309,64 @@ def _parse_update(p: _Parser) -> WriteOp:
         k, v = p.next()
         if k != "op" or v != "=":
             raise StatementError(f"expected '=' after {col!r}")
-        sets[col] = _value(p)
+        try:
+            e = ExprParser(p).parse_scalar()
+            # column-free expressions fold to plain values (the fast
+            # path); column-referencing ones evaluate per target row at
+            # plan time (SET v = v + 1 — reference executes these inside
+            # the write tx, api/public/mod.rs:104-131)
+            sets[col] = e if columns_of(e) else const_value(e)
+        except ExprError as err:
+            raise StatementError(str(err)) from None
         if p.peek()[0] == ",":
             p.next()
             continue
         break
-    where = _parse_where(p)
-    return WriteOp(kind="update", table=table, sets=sets, where=where)
+    where, where_expr = _parse_where(p)
+    return WriteOp(
+        kind="update", table=table, sets=sets, where=where,
+        where_expr=where_expr,
+    )
 
 
 def _parse_delete(p: _Parser) -> WriteOp:
     p.expect("DELETE")
     p.expect("FROM")
     table = p.expect("ident")
-    where = _parse_where(p)
-    return WriteOp(kind="delete", table=table, where=where)
+    where, where_expr = _parse_where(p)
+    return WriteOp(
+        kind="delete", table=table, where=where, where_expr=where_expr
+    )
 
 
 def _parse_where(p: _Parser):
+    """Returns (predicate_ast, expr_ast): the vectorizable predicate
+    grammar when it fits (pk fast path + Matcher evaluation), otherwise
+    the scalar-expression fallback evaluated row-wise at plan time —
+    arithmetic, functions, CASE in WHERE all land there."""
+    from corro_sim.api.exprs import ExprError, ExprParser
+
     if p.peek()[0] != "WHERE":
         raise StatementError(
             "UPDATE/DELETE require a WHERE clause (full-table writes are "
             "refused, matching the constrained schema posture)"
         )
     p.next()
-    where = p.parse_or()
+    mark = p.i
+    try:
+        where = p.parse_or()
+        if p.peek()[0] != "eof":
+            raise QueryError(f"trailing tokens at {p.peek()!r}")
+        return where, None
+    except QueryError:
+        p.i = mark
+    try:
+        expr = ExprParser(p).parse_bool()
+    except ExprError as err:
+        raise StatementError(str(err)) from None
     if p.peek()[0] != "eof":
         raise StatementError(f"trailing tokens at {p.peek()!r}")
-    return where
+    return None, expr
 
 
 def pk_equalities(where, pk_cols: tuple) -> tuple | None:

@@ -455,10 +455,19 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
     mesh = make_mesh(devices) if len(devices) > 1 else None
 
     def mk_cfg(n):
+        # Catch-up math sizes the budgets: ~30% victims each miss ~5-6
+        # versions of essentially every live actor (~0.7*n of them), so
+        # repair needs ~0.7*n/K' full-budget sweeps. K'=512 with the
+        # dense hot-actor schedule's SEQUENTIAL window rotation covers
+        # the hot set in ~n/512 sweeps at floor cadence — the r4 config
+        # (K'=128 every 4th round) needed ~1100 rounds and could never
+        # finish inside a day on the CPU mesh (BENCH_config5_r5_attempt1).
         return SimConfig(
             num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
             write_rate=0.2, swim_enabled=False, sync_interval=4,
-            sync_actor_topk=64, sync_cap_per_actor=8,
+            sync_adaptive=True, sync_floor_rounds=1,
+            sync_actor_topk=512, sync_cap_per_actor=8,
+            sync_req_actors=512, sync_hot_actors=512,
         )
 
     sized_reason = None

@@ -79,6 +79,7 @@ def state_shardings(
         ring0=node_sharded,
         row_cdf=replicated,
         round=replicated,
+        sync_rounds=replicated,
         hlc=node_sharded,
         last_cleared=node_sharded,
         cleared_hlc=node_sharded,  # (A, L) — actor axis rides the same mesh axis

@@ -35,6 +35,10 @@ class SimState:
     ring0: jnp.ndarray  # (N, ring0_size) int32 static eager-peer table
     row_cdf: jnp.ndarray  # (R,) float32 cumulative row-sampling distribution
     round: jnp.ndarray  # () int32
+    sync_rounds: jnp.ndarray  # () int32 — executed anti-entropy sweeps;
+    # drives the dense schedule's sequential hot-window rotation (a
+    # round-derived start would stride by the sync cadence and alias
+    # against the hot-set size, permanently skipping part of it)
     hlc: jnp.ndarray  # (N,) int32 — per-node HLC (uhlc analog: merged
     # max+tick on every gossip delivery and sync contact, setup.rs:91-96,
     # api/peer.rs:1502-1521; physical component = the round counter)
@@ -100,6 +104,7 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
         ring0=jnp.asarray(_ring0(cfg, seed)),
         row_cdf=jnp.asarray(_row_cdf(cfg)),
         round=jnp.zeros((), jnp.int32),
+        sync_rounds=jnp.zeros((), jnp.int32),
         hlc=jnp.zeros((n,), jnp.int32),
         last_cleared=jnp.full((n,), -1, jnp.int32),
         cleared_hlc=jnp.full(

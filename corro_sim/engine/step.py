@@ -448,7 +448,7 @@ def sim_step(
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
         k_sync, alive, view, part,
-        rtt=rtt if cfg.rtt_rings else None,
+        rtt=rtt if cfg.rtt_rings else None, round_idx=state.sync_rounds,
     )
 
     # -------------------------------------------------------------- metrics
@@ -492,6 +492,7 @@ def sim_step(
         gossip=gossip,
         swim=swim,
         round=state.round + 1,
+        sync_rounds=state.sync_rounds + is_sync.astype(jnp.int32),
         hlc=hlc,
         last_cleared=last_cleared,
         cleared_hlc=cleared_hlc,
@@ -555,7 +556,7 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
 
 def _sync_block(
     cfg, is_sync, book, log, table, hlc, last_cleared, cleared_hlc,
-    k_sync, alive, view, part, rtt,
+    k_sync, alive, view, part, rtt, round_idx=0,
 ):
     """The sync cond: one anti-entropy sweep when ``is_sync``."""
 
@@ -567,7 +568,7 @@ def _sync_block(
             # reachability as a matrix-free pair of masks: same-partition
             # check happens inside via gathered part ids
             _pairwise_mask(alive, part),
-            rtt=rtt,
+            rtt=rtt, round_idx=round_idx,
         )
 
     def no_sync(args):
@@ -663,6 +664,7 @@ def _repair_step(
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,
         state.cleared_hlc, k_sync, alive, view, part, rtt=None,
+        round_idx=state.sync_rounds,
     )
 
     # -------------------------------------------------------------- metrics
@@ -697,6 +699,7 @@ def _repair_step(
         book=book,
         swim=swim,
         round=state.round + 1,
+        sync_rounds=state.sync_rounds + is_sync.astype(jnp.int32),
         hlc=hlc,
         last_cleared=last_cleared,
     )

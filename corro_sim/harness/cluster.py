@@ -357,7 +357,13 @@ class LiveCluster:
                 for row in op.rows:
                     vals.extend(v for c, v in row.items() if c not in pk)
             elif op.kind == "update":
-                vals.extend(op.sets.values())
+                # expression SETs (ASTs) evaluate per row at plan time —
+                # their results intern lazily; only plain values prefetch
+                vals.extend(
+                    v for v in op.sets.values()
+                    if isinstance(v, (type(None), bool, int, float, str,
+                                      bytes))
+                )
         if vals:
             self.universe.intern_many(vals)
 
@@ -374,6 +380,39 @@ class LiveCluster:
             raise StatementError(f"no such table {op.table!r}")
         s_cap = self.cfg.seqs_per_version
         live_ov, cell_ov = overlay
+
+        if op.kind == "insert_select":
+            # INSERT … SELECT: the source SELECT evaluates against the
+            # writing node's current view (batch overlay included — same
+            # single-tx visibility SQLite gives the reference), its rows
+            # become the VALUES of a plain upsert.
+            from corro_sim.api.exprs import eval_expr
+
+            src_name, items = op.select
+            src = self.layout.schema.tables.get(src_name)
+            if src is None:
+                raise StatementError(f"no such table {src_name!r}")
+            if len(items) != len(op.cols):
+                raise StatementError(
+                    f"INSERT…SELECT arity mismatch: {len(op.cols)} columns "
+                    f"vs {len(items)} selected"
+                )
+            sel_op = WriteOp(
+                kind="select", table=src_name, where=op.where,
+                where_expr=op.where_expr,
+            )
+            if op.where is None and op.where_expr is None:
+                slots = self._live_slots(src, node, overlay)
+            else:
+                slots = self._resolve_rows(sel_op, src, node, overlay)
+            envs = self._row_envs(src, node, slots, overlay)
+            rows = [
+                [eval_expr(e, env) for e in items] for env in envs
+            ]
+            op = WriteOp(
+                kind="upsert", table=op.table,
+                rows=[dict(zip(op.cols, r)) for r in rows],
+            )
 
         if op.kind == "upsert":
             # last-occurrence-wins per (row, col): SQLite upsert semantics,
@@ -420,14 +459,40 @@ class LiveCluster:
 
         slots = self._resolve_rows(op, t, node, overlay)
         if op.kind == "update":
+            from corro_sim.api.exprs import eval_expr
+
             for c in op.sets:
                 self.layout.col_index(t.name, c)  # validate
-            cells = [
-                (slot, self.layout.col_index(t.name, c),
-                 self.universe.rank(v))
-                for slot in slots
-                for c, v in op.sets.items()
-            ]
+            plain = all(
+                isinstance(v, (type(None), bool, int, float, str, bytes))
+                for v in op.sets.values()
+            )
+            if plain:
+                cells = [
+                    (slot, self.layout.col_index(t.name, c),
+                     self.universe.rank(v))
+                    for slot in slots
+                    for c, v in op.sets.items()
+                ]
+            else:
+                # expression SETs (SET v = v + 1, CASE …): evaluate per
+                # target row against its current values + the batch
+                # overlay — the reference gets this from SQLite executing
+                # the statement inside the write tx (mod.rs:104-131)
+                envs = self._row_envs(t, node, slots, overlay)
+                cells = []
+                for slot, env in zip(slots, envs):
+                    for c, v in op.sets.items():
+                        val = (
+                            v if isinstance(
+                                v, (type(None), bool, int, float, str,
+                                    bytes)
+                            ) else eval_expr(v, env)
+                        )
+                        cells.append((
+                            slot, self.layout.col_index(t.name, c),
+                            self.universe.rank(val),
+                        ))
             for i in range(0, len(cells), s_cap):
                 out.append(_PendingChangeset(False, cells[i:i + s_cap]))
             for slot, plane, rank in cells:
@@ -451,6 +516,19 @@ class LiveCluster:
         rows; a CRDT resurrect requires an INSERT. Rows staged earlier in
         the same batch count as live/dead per the overlay."""
         live_ov, _ = overlay
+        if op.where_expr is not None:
+            # Scalar-expression WHERE (arithmetic, functions, CASE): the
+            # vectorized predicate grammar could not express it, so the
+            # live rows of the table filter row-wise through the
+            # expression evaluator (SQL semantics: UNKNOWN → excluded).
+            from corro_sim.api.exprs import eval_expr
+
+            cands = self._live_slots(t, node, overlay)
+            envs = self._row_envs(t, node, cands, overlay)
+            return [
+                s for s, env in zip(cands, envs)
+                if eval_expr(op.where_expr, env) is True
+            ]
         pk = pk_equalities(op.where, t.pk)
         if pk is not None:
             slot = self.layout._slots.get((t.name, pk))
@@ -467,8 +545,59 @@ class LiveCluster:
 
         sel = Select(table=t.name, columns=(), where=op.where)
         matcher = self._matcher_for(sel, node)
-        match, _ = matcher._evaluate(self._overlaid_table(node, overlay))
+        view = self._overlaid_table(node, overlay)
+        if hasattr(matcher, "_rows"):
+            # semi-join matcher (WHERE … IN (SELECT …)): its row map IS
+            # the slot set (DML over subquery predicates)
+            return sorted(matcher._rows(view).keys())
+        match, _ = matcher._evaluate(view)
         return [int(s) + matcher._start for s in np.nonzero(match)[0]]
+
+    def _live_slots(self, t, node: int, overlay) -> list[int]:
+        """Allocated row slots of ``t`` live on ``node`` (overlay-aware)."""
+        live_ov, _ = overlay
+        start, cap = self.layout._range(t.name)
+        used = self.layout._used[t.name]
+        if not used:
+            return []
+        cl = np.asarray(self.state.table.cl[node, start:start + used])
+        out = []
+        for i in range(used):
+            slot = start + i
+            if slot in live_ov:
+                if live_ov[slot]:
+                    out.append(slot)
+            elif cl[i] % 2 == 1:
+                out.append(slot)
+        return out
+
+    def _row_envs(self, t, node: int, slots, overlay) -> list[dict]:
+        """{column: value} environments for row slots on ``node``, with
+        the batch overlay's staged cells applied — one batched device
+        read per statement, not one per row."""
+        from corro_sim.core.crdt import NEG as _NEG
+
+        _, cell_ov = overlay
+        if not slots:
+            return []
+        slots_a = np.asarray(slots, np.int32)
+        vr = np.asarray(self.state.table.vr[node, slots_a])  # (k, C)
+        envs = []
+        neg = int(_NEG)
+        for j, slot in enumerate(slots):
+            key = self.layout.key_of(slot)
+            env = dict(zip(t.pk, key[1])) if key else {}
+            for c in t.value_columns:
+                plane = self.layout.col_index(t.name, c.name)
+                rank = cell_ov.get((slot, plane))
+                if rank is None:
+                    rank = int(vr[j, plane])
+                env[c.name] = (
+                    None if rank == neg else self.universe.decode(int(rank))
+                )
+            envs.append(env)
+        return envs
+
 
     def _overlaid_table(self, node: int, overlay: tuple[dict, dict]):
         """The committed table state with the batch's staged cells applied

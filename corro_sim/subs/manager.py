@@ -449,10 +449,25 @@ class JoinMatcher(_EventStream):
                 raise QueryError(f"unknown alias {a!r} in {name!r}")
             return a, c
 
-        # per join link: ((earlier_alias, col), (new_alias, col), kind)
+        # per join link: ("eq", (earlier_alias, col), (new_alias, col),
+        # kind) — hash-probe equality — or ("expr", expr_ast, new_alias,
+        # kind, {alias: [cols]}) — a non-equality ON evaluated per
+        # candidate pair (the reference accepts arbitrary ON because
+        # SQLite executes it, pubsub.rs:697-832).
         self._links = []
         on_need: dict = {a: set() for a in self._aliases}
         for i, j in enumerate(select.joins):
+            if j.on_expr is not None:
+                from corro_sim.api.exprs import columns_of
+
+                refs: dict = {}
+                for q in columns_of(j.on_expr):
+                    a, c = split_q(q, "ON")
+                    refs.setdefault(a, []).append(c)
+                    on_need[a].add(c)
+                self._links.append(("expr", j.on_expr, j.alias, j.kind,
+                                    refs))
+                continue
             la, lc = split_q(j.on_left, "ON left")
             ra, rc = split_q(j.on_right, "ON right")
             if ra != j.alias and la == j.alias:
@@ -463,7 +478,7 @@ class JoinMatcher(_EventStream):
                     f"JOIN ON must link {j.alias!r} to an earlier side: "
                     f"{j.on_left!r} = {j.on_right!r}"
                 )
-            self._links.append(((la, lc), (ra, rc), j.kind))
+            self._links.append(("eq", (la, lc), (ra, rc), j.kind))
             on_need[la].add(lc)
             on_need[ra].add(rc)
 
@@ -570,7 +585,14 @@ class JoinMatcher(_EventStream):
         parts = [
             ((ls,), {a0: cells}) for ls, cells in side_rows[a0].items()
         ]
-        for (la, lc), (ra, rc), kind in self._links:
+        for link in self._links:
+            if link[0] == "expr":
+                _, expr, ra, kind, refs = link
+                parts = self._expr_link(
+                    parts, side_rows, expr, ra, kind, refs
+                )
+                continue
+            _, (la, lc), (ra, rc), kind = link
             rpos = self._cell_pos(ra, rc)
             ridx: dict = {}
             for rs, cells in side_rows[ra].items():
@@ -603,6 +625,42 @@ class JoinMatcher(_EventStream):
                 rid = rid * (self._rowspan + 1) + s
             out[rid] = self._project(sides)
         return out
+
+    def _expr_link(self, parts, side_rows, expr, ra, kind, refs):
+        """One non-equality join link: nested-loop over (partial tuple ×
+        candidate row), keeping pairs whose ON expression is TRUE (SQL
+        semantics: UNKNOWN drops the pair; LEFT keeps matchless tuples
+        with a NULL side)."""
+        from corro_sim.api.exprs import eval_expr
+
+        pos = {
+            (a, c): self._cell_pos(a, c)
+            for a, cols in refs.items() for c in cols
+        }
+        cand = list(side_rows[ra].items())
+        nxt = []
+        for slots, sides in parts:
+            env = {}
+            for a, cols in refs.items():
+                if a == ra:
+                    continue
+                cells = sides.get(a)
+                for c in cols:
+                    env[f"{a}.{c}"] = (
+                        None if cells is None else cells[pos[(a, c)]]
+                    )
+            matched = False
+            for rs, rcells in cand:
+                for c in refs.get(ra, ()):
+                    env[f"{ra}.{c}"] = rcells[pos[(ra, c)]]
+                if eval_expr(expr, env) is True:
+                    matched = True
+                    nxt.append(
+                        (slots + (rs + 1,), {**sides, ra: rcells})
+                    )
+            if not matched and kind == "left":
+                nxt.append((slots + (0,), {**sides, ra: None}))
+        return nxt
 
     def _project(self, sides) -> list:
         out = []
@@ -1054,10 +1112,195 @@ class JoinAggregateMatcher(JoinMatcher):
         return events
 
 
+def _has_inselect(p) -> bool:
+    from corro_sim.subs.query import And, InSelect, Not, Or
+
+    if isinstance(p, InSelect):
+        return True
+    if isinstance(p, (And, Or)):
+        return any(_has_inselect(q) for q in p.parts)
+    if isinstance(p, Not):
+        return _has_inselect(p.inner)
+    return False
+
+
+class SemiJoinMatcher(_EventStream):
+    """``WHERE col [NOT] IN (SELECT …)`` as a live matcher (VERDICT r4
+    #5). The reference gets this for free: SQLite evaluates the subquery
+    inside the rewritten per-table query (``pubsub.rs:697-832``). Here
+    each subquery runs as its own single-table matcher; per evaluation
+    the outer predicate re-materializes with the subquery's CURRENT value
+    set (InSelect → InList, compiled to rank space as usual), so changes
+    to the INNER table re-shape the outer match set — a live semi-join.
+    Events diff like the join matchers (recompute-and-diff)."""
+
+    def __init__(self, sub_id, select: Select, node: int, layout, universe,
+                 max_buffer: int = 512):
+        from corro_sim.subs.query import InSelect
+
+        self.id = sub_id
+        self.select = select
+        self.node = node
+        self.universe = universe
+        self._layout = layout
+        self._subqueries: list = []  # InSelect nodes, discovery order
+
+        def find(p):
+            if isinstance(p, InSelect):
+                self._subqueries.append(p)
+            elif isinstance(p, (And, Or)):
+                for q in p.parts:
+                    find(q)
+            elif isinstance(p, Not):
+                find(p.inner)
+
+        from corro_sim.subs.query import And, Not, Or
+
+        find(select.where)
+        self._inner = [
+            Matcher(f"{sub_id}:sub{i}", q.select, node, layout, universe,
+                    max_buffer=0)
+            for i, q in enumerate(self._subqueries)
+        ]
+        # small LRU keyed by the subquery value sets: a flapping inner
+        # table alternating between a few sets must not recompile the
+        # outer matcher (an XLA jit each time) on every step
+        self._outer_cache: dict = {}
+        self._outer_serial = 0
+        # column surface comes from a throwaway outer matcher with the
+        # subqueries replaced by empty lists
+        self._max_buffer = max_buffer
+        m = self._outer_matcher(((),) * len(self._subqueries))
+        # header matches Matcher.prime: pk prefix + selected value columns
+        self.columns = list(m._pk_cols() or ()) + list(m.columns)
+        self._pk_names = m._pk_names
+        self._prev: dict[int, list] = {}
+        self._init_events(max_buffer)
+
+    def _rewrite(self, p, vsets_by_node: dict):
+        from corro_sim.subs.query import And, InList, InSelect, Not, Or
+
+        if isinstance(p, InSelect):
+            return InList(
+                col=p.col, lits=vsets_by_node[id(p)], negated=p.negated
+            )
+        if isinstance(p, And):
+            return And(tuple(self._rewrite(q, vsets_by_node)
+                             for q in p.parts))
+        if isinstance(p, Or):
+            return Or(tuple(self._rewrite(q, vsets_by_node)
+                            for q in p.parts))
+        if isinstance(p, Not):
+            return Not(self._rewrite(p.inner, vsets_by_node))
+        return p
+
+    def _outer_matcher(self, vsets: tuple) -> "Matcher":
+        m = self._outer_cache.pop(vsets, None)
+        if m is None:
+            by_node = {
+                id(q): vsets[i] for i, q in enumerate(self._subqueries)
+            }
+            sel = dataclasses.replace(
+                self.select, where=self._rewrite(self.select.where, by_node)
+            )
+            self._outer_serial += 1
+            m = Matcher(
+                f"{self.id}:outer{self._outer_serial}", sel, self.node,
+                self._layout, self.universe, max_buffer=0,
+            )
+        self._outer_cache[vsets] = m  # re-insert = most recent
+        if len(self._outer_cache) > 8:
+            self._outer_cache.pop(next(iter(self._outer_cache)))
+        return m
+
+    def _subquery_values(self, i: int, table_state) -> tuple:
+        m = self._inner[i]
+        match, proj = m._evaluate(table_state)
+        vals = set()
+        saw_null = False
+        sq = self._subqueries[i]
+        want = sq.select.columns[0]
+        for s in np.nonzero(match)[0]:
+            row = m._decode_row(s, proj[s])
+            # selected column position within the decoded row
+            if want in m._pk_names:
+                v = row[m._pk_names.index(want)]
+            else:
+                v = row[len(m._pk_names) + m.columns.index(want)]
+            if v is None:
+                saw_null = True  # NOT IN with a NULL in the set → UNKNOWN
+            else:
+                vals.add(v)
+        out = tuple(sorted(vals, key=sqlite_sort_key))
+        # a NULL in the subquery result set must reach the InList
+        # compiler's has_null handling (three-valued NOT IN semantics)
+        return ((None,) if saw_null else ()) + out
+
+    def _rows(self, table_state) -> dict:
+        vsets = tuple(
+            self._subquery_values(i, table_state)
+            for i in range(len(self._inner))
+        )
+        m = self._outer_matcher(vsets)
+        match, proj = m._evaluate(table_state)
+        return {
+            int(s) + m._start: m._decode_row(s, proj[s])
+            for s in np.nonzero(match)[0]
+        }
+
+    # ------------------------------------------------------------ surface
+    def rebind(self, old_ranks, new_ranks) -> None:
+        for m in self._inner:
+            m.rebind(old_ranks, new_ranks)
+        self._outer_cache.clear()  # outer recompiles against fresh ranks
+
+    def is_candidate(self, touched) -> bool:
+        if touched is None:
+            return True
+        tables = {self.select.table} | {
+            q.select.table for q in self._subqueries
+        }
+        return any(t in tables for t, _ in touched)
+
+    def prime(self, table_state):
+        cur = self._rows(table_state)
+        self._prev = cur
+        self._primed = True
+        header = {"columns": list(self.columns)}
+        rows = [{"row": [rid, cur[rid]]} for rid in sorted(cur)]
+        eoq = {"eoq": {"change_id": self._change_id}}
+        return [header, *rows, eoq]
+
+    def step(self, table_state) -> list:
+        if not self._primed:
+            raise RuntimeError("matcher not primed — call prime() first")
+        cur = self._rows(table_state)
+        events: list = []
+        for rid in sorted(cur.keys() - self._prev.keys()):
+            self._emit(events, "insert", rid, cur[rid])
+        for rid in sorted(cur.keys() & self._prev.keys()):
+            if cur[rid] != self._prev[rid]:
+                self._emit(events, "update", rid, cur[rid])
+        for rid in sorted(self._prev.keys() - cur.keys()):
+            self._emit(events, "delete", rid, self._prev[rid])
+        self._prev = cur
+        self._buffer_events(events)
+        return events
+
+
 def make_matcher(sub_id, select: Select, node: int, layout, universe,
                  max_buffer: int = 512):
-    """Matcher factory: single-table, join chain, or aggregate (incremental
-    single-table / recompute-and-diff over joins) — same public surface."""
+    """Matcher factory: single-table, join chain, aggregate (incremental
+    single-table / recompute-and-diff over joins), or semi-join
+    (IN (SELECT …)) — same public surface."""
+    if _has_inselect(select.where):
+        if select.joins or select.aggregates:
+            raise QueryError(
+                "IN (SELECT …) combines with joins/aggregates only "
+                "through the query post-processor, not subscriptions"
+            )
+        return SemiJoinMatcher(sub_id, select, node, layout, universe,
+                               max_buffer=max_buffer)
     if select.aggregates:
         cls = JoinAggregateMatcher if select.joins else AggregateMatcher
         return cls(sub_id, select, node, layout, universe,

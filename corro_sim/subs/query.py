@@ -77,6 +77,21 @@ class InList:
 
 
 @dataclasses.dataclass(frozen=True)
+class InSelect:
+    """``col [NOT] IN (SELECT one_col FROM …)`` — a semi-join. The
+    reference matches these because SQLite evaluates the subquery inside
+    the rewritten per-table query (``pubsub.rs:697-832``); here the
+    subquery runs as its own single-table matcher and the outer predicate
+    re-materializes with the subquery's current value set
+    (:class:`~corro_sim.subs.manager.SemiJoinMatcher`). Negation lives on
+    the node for the same three-valued-logic reason as :class:`InList`."""
+
+    col: str
+    select: object  # Select — single-table, exactly one selected column
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Like:
     """``col [NOT] LIKE 'pattern'`` — SQLite semantics: ``%`` any run,
     ``_`` any one char, ASCII-case-insensitive. A pure prefix pattern
@@ -124,17 +139,22 @@ class JsonContains:
 
 @dataclasses.dataclass(frozen=True)
 class Join:
-    """One equi-join link in a join chain (``… JOIN b ON a.x = b.y``).
+    """One join link in a join chain (``… JOIN b ON a.x = b.y``).
 
     ``on_left`` may reference ANY earlier alias in the chain (the FROM
     table or a previous join's alias); ``on_right`` references this
-    join's own alias."""
+    join's own alias. A non-equality ON condition (range predicates,
+    arithmetic — the reference accepts arbitrary ON because SQLite
+    executes it, ``pubsub.rs:697-832``) is carried as ``on_expr``, a
+    scalar-expression AST (api/exprs) evaluated per candidate pair by
+    the join matcher; ``on_left``/``on_right`` are empty then."""
 
     table: str  # right table
     alias: str  # right alias (defaults to table name)
-    on_left: str  # qualified "alias.col" on an earlier side
-    on_right: str  # qualified "alias.col" on this join's side
+    on_left: str  # qualified "alias.col" on an earlier side ('' w/ expr)
+    on_right: str  # qualified "alias.col" on this join's side ('' w/ expr)
     kind: str = "inner"  # 'inner' | 'left'
+    on_expr: object = None  # expression AST for non-equality ON
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,7 +242,12 @@ class Select:
             sql += f" {kw} {j.table}"
             if j.alias != j.table:
                 sql += f" AS {j.alias}"
-            sql += f" ON {j.on_left} = {j.on_right}"
+            if j.on_expr is not None:
+                from corro_sim.api.exprs import sql_of
+
+                sql += f" ON {sql_of(j.on_expr)}"
+            else:
+                sql += f" ON {j.on_left} = {j.on_right}"
         if self.where is not None:
             sql += f" WHERE {_render(self.where)}"
         if self.group_by:
@@ -243,7 +268,8 @@ class Select:
         out = set()
 
         def walk(p):
-            if isinstance(p, (Cmp, IsNull, JsonContains, InList, Like)):
+            if isinstance(p, (Cmp, IsNull, JsonContains, InList, Like,
+                              InSelect)):
                 out.add(p.col)
             elif isinstance(p, (And, Or)):
                 for q in p.parts:
@@ -262,6 +288,9 @@ def _render(p) -> str:
     if isinstance(p, InList):
         lits = ", ".join(_render_lit(v) for v in p.lits)
         return f"{p.col}{' NOT' if p.negated else ''} IN ({lits})"
+    if isinstance(p, InSelect):
+        neg = " NOT" if p.negated else ""
+        return f"{p.col}{neg} IN ({p.select.normalized()})"
     if isinstance(p, Like):
         neg = " NOT" if p.negated else ""
         return f"{p.col}{neg} LIKE {_render_lit(p.pattern)}"
@@ -298,7 +327,7 @@ _TOKEN = re.compile(
     r"(?P<blob>[xX]'(?:[0-9A-Fa-f][0-9A-Fa-f])*')"
     r"|(?P<str>'(?:[^']|'')*')"
     r"|(?P<num>-?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)"
-    r"|(?P<op><=|>=|!=|<>|=|<|>)"
+    r"|(?P<op><=|>=|!=|<>|\|\||=|<|>|\+|-|/|%)"
     r"|(?P<punct>[(),*.])"
     r"|(?P<word>[A-Za-z_][A-Za-z_0-9]*)"
     r")"
@@ -399,7 +428,9 @@ class _Parser:
             return ("agg", Agg(fn=name.upper(), col=col))
         return ("col", name)
 
-    def parse_select(self) -> Select:
+    def parse_select(self, embedded: bool = False) -> Select:
+        """``embedded=True``: a subselect — stop at the enclosing ')'
+        instead of requiring end-of-input."""
         self.expect("SELECT")
         items = []
         if self.peek()[0] == "*":
@@ -433,26 +464,64 @@ class _Parser:
                     f"join sides need distinct aliases; {jalias!r} repeats"
                 )
             self.expect("ON")
-            lhs = self.qual_ident()
-            op = self.next()
-            if op != ("op", "="):
-                raise QueryError("JOIN ON supports equality only")
-            rhs = self.qual_ident()
+            mark = self.i
+            eq = None
+            try:
+                lhs = self.qual_ident()
+                op = self.next()
+                if op != ("op", "="):
+                    raise QueryError("not a plain equality")
+                rhs = self.qual_ident()
+                if self.peek()[0] in ("AND", "OR"):
+                    raise QueryError("compound ON")
+                eq = (lhs, rhs)
+            except QueryError:
+                self.i = mark
 
-            # normalize: on_left references an EARLIER side, on_right the
-            # alias this JOIN introduces
             def side(q):
                 return q.split(".", 1)[0] if "." in q else None
 
-            if side(lhs) == jalias and side(rhs) in known_aliases:
-                lhs, rhs = rhs, lhs
-            if side(rhs) != jalias or side(lhs) not in known_aliases:
-                raise QueryError(
-                    f"JOIN ON must link {jalias!r} to an earlier side: "
-                    f"{lhs!r} = {rhs!r}"
+            if eq is not None:
+                # normalize: on_left references an EARLIER side, on_right
+                # the alias this JOIN introduces
+                lhs, rhs = eq
+                if side(lhs) == jalias and side(rhs) in known_aliases:
+                    lhs, rhs = rhs, lhs
+                if side(rhs) != jalias or side(lhs) not in known_aliases:
+                    raise QueryError(
+                        f"JOIN ON must link {jalias!r} to an earlier side: "
+                        f"{lhs!r} = {rhs!r}"
+                    )
+                joins.append(Join(table=jt, alias=jalias, on_left=lhs,
+                                  on_right=rhs, kind=kind))
+            else:
+                # Non-equality / compound ON: a scalar-expression
+                # condition evaluated per candidate pair (reference:
+                # SQLite executes arbitrary ON, pubsub.rs:697-832).
+                from corro_sim.api.exprs import (
+                    ExprError,
+                    ExprParser,
+                    columns_of,
                 )
-            joins.append(Join(table=jt, alias=jalias, on_left=lhs,
-                              on_right=rhs, kind=kind))
+
+                try:
+                    expr = ExprParser(self).parse_bool()
+                except ExprError as err:
+                    raise QueryError(str(err)) from None
+                refs = columns_of(expr)
+                sides = {side(c) for c in refs}
+                if None in sides:
+                    raise QueryError(
+                        "JOIN ON columns must be alias-qualified"
+                    )
+                if jalias not in sides or not (
+                    sides - {jalias}
+                ) <= set(known_aliases):
+                    raise QueryError(
+                        f"JOIN ON must link {jalias!r} to earlier sides"
+                    )
+                joins.append(Join(table=jt, alias=jalias, on_left="",
+                                  on_right="", kind=kind, on_expr=expr))
             known_aliases.append(jalias)
         where = None
         if self.peek()[0] == "WHERE":
@@ -493,7 +562,7 @@ class _Parser:
                 if k != "lit" or not isinstance(v, int) or v < 0:
                     raise QueryError("OFFSET takes a non-negative integer")
                 offset = v
-        if self.peek()[0] != "eof":
+        if not embedded and self.peek()[0] != "eof":
             raise QueryError(f"trailing tokens at {self.peek()!r}")
 
         aggs = [a for k, a in items if k == "agg"]
@@ -562,6 +631,19 @@ class _Parser:
         if k0 == "IN":
             self.next()
             self.expect("(")
+            if self.peek()[0] == "SELECT":
+                sub = self.parse_select(embedded=True)
+                self.expect(")")
+                if sub.joins or sub.aggregates or sub.group_by:
+                    raise QueryError(
+                        "IN (SELECT …) subqueries must be single-table "
+                        "scalar selects"
+                    )
+                if len(sub.columns) != 1:
+                    raise QueryError(
+                        "IN (SELECT …) must select exactly one column"
+                    )
+                return InSelect(col=col, select=sub, negated=negated)
             lits = [self._lit_or_null()]
             while self.peek()[0] == ",":
                 self.next()
@@ -935,7 +1017,7 @@ def rewrite_columns(p, fn):
     strip alias qualifiers when routing join conjuncts to one side)."""
     if p is None:
         return None
-    if isinstance(p, (Cmp, IsNull, JsonContains, InList, Like)):
+    if isinstance(p, (Cmp, IsNull, JsonContains, InList, Like, InSelect)):
         return dataclasses.replace(p, col=fn(p.col))
     if isinstance(p, And):
         return And(tuple(rewrite_columns(q, fn) for q in p.parts))
@@ -951,7 +1033,7 @@ def predicate_columns(p) -> frozenset:
     out = set()
 
     def walk(q):
-        if isinstance(q, (Cmp, IsNull, JsonContains, InList, Like)):
+        if isinstance(q, (Cmp, IsNull, JsonContains, InList, Like, InSelect)):
             out.add(q.col)
         elif isinstance(q, (And, Or)):
             for r in q.parts:

@@ -219,7 +219,10 @@ def _kth_positive(csum, kprime, n, axis_len, roll_phase=None):
     whose csum is in rotated-scan order pass ``roll_phase`` and the roll
     materializes only on that branch.
     """
-    cdt = jnp.int16 if axis_len < (1 << 15) else jnp.int32
+    # both the prefix counts (<= axis_len) and the targets (<= kprime)
+    # must fit the compare dtype — sizing by axis_len alone would wrap
+    # tk negative when kprime >= 2^15 with a small compacted axis
+    cdt = jnp.int16 if max(axis_len, kprime) < (1 << 15) else jnp.int32
     tk = jnp.arange(1, kprime + 1, dtype=cdt)
     if n * axis_len * kprime <= (1 << 33):
         return jnp.sum(
@@ -338,6 +341,7 @@ def sync_round(
     view_alive: jnp.ndarray,
     reachable: jnp.ndarray,
     rtt: jnp.ndarray | None = None,
+    round_idx: jnp.ndarray | int = 0,
 ):
     """One anti-entropy sweep (multi-peer).
 
@@ -408,19 +412,27 @@ def sync_round(
         hot_mask = log.head > min_head
         hot_cs = jnp.cumsum(hot_mask.astype(jnp.int32))
         total_hot = hot_cs[-1]
-        # rotated k-th positive over the (A,) hot mask from the sweep
-        # phase: fairness when more than A' actors are hot (the window
-        # rotates sweep to sweep, like the shuffled request dealing of
-        # peer.rs:1241-1372)
-        cpm1h = jnp.where(phase > 0, hot_cs[jnp.maximum(phase - 1, 0)], 0)
-        wrapsh = jnp.arange(a, dtype=jnp.int32) < phase
-        csumh = hot_cs - cpm1h + jnp.where(wrapsh, total_hot, 0)
-        tgt = jnp.arange(1, ahot + 1, dtype=jnp.int32)
-        hpos = jnp.searchsorted(
-            jnp.roll(csumh, -phase), tgt, side="left"
-        ).astype(jnp.int32)
-        hot_ok = hpos < a
-        hot_idx = (jnp.where(hot_ok, hpos, 0) + phase) % a  # (A',)
+        # SEQUENTIAL window rotation over the hot set: sweep k serves hot
+        # ranks [k*A', (k+1)*A') mod total — full coverage of the hot set
+        # every ceil(total/A') sweeps. A random phase would re-cover
+        # actors coupon-collector style, which at 50k (≈35k hot after an
+        # outage) multiplies catch-up sweeps ~3-4x. As repair progresses,
+        # actors everyone holds drop out of the hot mask, so the window
+        # automatically re-concentrates on what is still missing.
+        start = (jnp.asarray(round_idx, jnp.int32) * ahot) % jnp.maximum(
+            total_hot, 1
+        )
+        ranks = (
+            start + jnp.arange(ahot, dtype=jnp.int32)
+        ) % jnp.maximum(total_hot, 1) + 1  # 1-based hot ranks, wrapped
+        hpos = jnp.searchsorted(hot_cs, ranks, side="left").astype(
+            jnp.int32
+        )
+        # positions beyond the number of distinct hot actors are
+        # wrapped duplicates — mask them (duplicate lanes would double
+        # count served versions)
+        hot_ok = jnp.arange(ahot, dtype=jnp.int32) < total_hot
+        hot_idx = jnp.where(hot_ok, hpos, 0).clip(0, a - 1)  # (A',)
 
         head_hot = book.head[:, hot_idx]  # (N, A') column gather
         ph_hot = head_hot[peer]  # (N, P, A') row gather

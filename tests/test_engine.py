@@ -467,7 +467,12 @@ def test_repair_phase_specialization_equivalence():
         return p
 
     sched = Schedule(write_rounds=8, part_fn=part_fn)
-    kw = dict(max_rounds=256, chunk=8, seed=3, min_rounds=12)
+    # min_rounds far past ring drain: the r5 dense sync converges the
+    # backlog before the rings empty, so an early min_rounds would end
+    # the run before any repair-specialized chunk gets to execute —
+    # holding convergence reporting back forces the repair program to
+    # run (and be equivalence-checked) for several chunks
+    kw = dict(max_rounds=256, chunk=8, seed=3, min_rounds=48)
     r_full = run_sim(cfg, init_state(cfg, seed=3), sched,
                      phase_specialize=False, **kw)
     r_spec = run_sim(cfg, init_state(cfg, seed=3), sched,

@@ -1,0 +1,217 @@
+"""Matcher grammar extensions (VERDICT r4 #5): ``IN (SELECT …)``
+subqueries and non-equality JOIN ON — both subscribable. The reference
+matches these because SQLite evaluates the rewritten per-table queries
+(``pubsub.rs:697-832``); here subqueries run as live semi-joins
+(SemiJoinMatcher) and non-equality ON conditions evaluate per candidate
+pair in the join chain.
+"""
+
+import pytest
+
+from corro_sim.harness.cluster import LiveCluster
+from corro_sim.subs.query import QueryError, parse_query
+
+SCHEMA = """
+CREATE TABLE users (
+    id INTEGER PRIMARY KEY,
+    team TEXT NOT NULL DEFAULT '',
+    score INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE vip_teams (
+    name TEXT PRIMARY KEY,
+    min_score INTEGER NOT NULL DEFAULT 0
+);
+"""
+
+
+def _cluster():
+    return LiveCluster(SCHEMA, num_nodes=3, default_capacity=64)
+
+
+# ----------------------------------------------------------------- parsing
+
+def test_parse_in_select_and_normalize():
+    s = parse_query(
+        "SELECT id FROM users WHERE team IN (SELECT name FROM vip_teams)"
+    )
+    assert "IN (SELECT name FROM vip_teams)" in s.normalized()
+    s2 = parse_query(s.normalized())  # normalization round-trips
+    assert s2.normalized() == s.normalized()
+
+
+def test_parse_in_select_rejects_non_scalar():
+    with pytest.raises(QueryError):
+        parse_query(
+            "SELECT id FROM users WHERE team IN (SELECT name, min_score "
+            "FROM vip_teams)"
+        )
+
+
+def test_parse_range_join_on():
+    s = parse_query(
+        "SELECT u.id, v.name FROM users u JOIN vip_teams v "
+        "ON u.score >= v.min_score"
+    )
+    assert s.joins[0].on_expr is not None
+    s2 = parse_query(s.normalized())
+    assert s2.normalized() == s.normalized()
+
+
+# ------------------------------------------------------------ subqueries
+
+def test_in_select_query(tmp_path=None):
+    c = _cluster()
+    try:
+        c.execute([
+            "INSERT INTO users (id, team, score) VALUES "
+            "(1, 'red', 10), (2, 'blue', 20), (3, 'red', 30)",
+            "INSERT INTO vip_teams (name) VALUES ('red')",
+        ])
+        _, rows = c.query_rows(
+            "SELECT id FROM users WHERE team IN "
+            "(SELECT name FROM vip_teams) ORDER BY id"
+        )
+        assert [r[0] for r in rows] == [1, 3]
+        _, rows = c.query_rows(
+            "SELECT id FROM users WHERE team NOT IN "
+            "(SELECT name FROM vip_teams)"
+        )
+        assert [r[0] for r in rows] == [2]
+    finally:
+        c.tripwire.trip()
+
+
+def test_in_select_live_subscription():
+    """Changes to EITHER side re-shape the match set: adding a vip team
+    must insert the users it admits; removing it deletes them."""
+    c = _cluster()
+    try:
+        c.execute([
+            "INSERT INTO users (id, team, score) VALUES "
+            "(1, 'red', 10), (2, 'blue', 20)",
+        ])
+        c.run_until_converged()
+        sub_id, initial, q = c.subscribe_attached(
+            "SELECT id, team FROM users WHERE team IN "
+            "(SELECT name FROM vip_teams)", node=2,
+        )
+        assert not [e for e in initial if "row" in e]
+
+        # INNER-table write admits user 1 → INSERT event
+        c.execute(["INSERT INTO vip_teams (name) VALUES ('red')"], node=0)
+        c.run_until_converged()
+        ins = [e for e in q if e.kind == "insert"]
+        assert len(ins) == 1 and ins[0].cells == [1, "red"]
+        q.clear()
+
+        # OUTER-table write joins the admitted set → INSERT
+        c.execute([
+            "INSERT INTO users (id, team) VALUES (4, 'red')"], node=1)
+        c.run_until_converged()
+        ins = [e for e in q if e.kind == "insert"]
+        assert len(ins) == 1 and ins[0].cells == [4, "red"]
+        q.clear()
+
+        # INNER-table delete evicts both red users → DELETEs
+        c.execute(["DELETE FROM vip_teams WHERE name = 'red'"], node=0)
+        c.run_until_converged()
+        assert sorted(e.cells[0] for e in q if e.kind == "delete") == [1, 4]
+    finally:
+        c.tripwire.trip()
+
+
+# ------------------------------------------------- non-equality JOIN ON
+
+def test_range_join_query():
+    c = _cluster()
+    try:
+        c.execute([
+            "INSERT INTO users (id, team, score) VALUES "
+            "(1, 'a', 5), (2, 'b', 25)",
+            "INSERT INTO vip_teams (name, min_score) VALUES "
+            "('bronze', 0), ('gold', 20)",
+        ])
+        _, rows = c.query_rows(
+            "SELECT u.id, v.name FROM users u JOIN vip_teams v "
+            "ON u.score >= v.min_score ORDER BY u.id"
+        )
+        got = sorted((r[0], r[1]) for r in rows)
+        assert got == [(1, "bronze"), (2, "bronze"), (2, "gold")]
+    finally:
+        c.tripwire.trip()
+
+
+def test_range_join_live_subscription():
+    c = _cluster()
+    try:
+        c.execute([
+            "INSERT INTO vip_teams (name, min_score) VALUES ('gold', 20)",
+        ])
+        c.run_until_converged()
+        sub_id, initial, q = c.subscribe_attached(
+            "SELECT u.id, v.name FROM users u JOIN vip_teams v "
+            "ON u.score >= v.min_score", node=2,
+        )
+        assert not [e for e in initial if "row" in e]
+
+        c.execute([
+            "INSERT INTO users (id, score) VALUES (9, 25)"], node=0)
+        c.run_until_converged()
+        ins = [e for e in q if e.kind == "insert"]
+        assert len(ins) == 1 and ins[0].cells == [9, "gold"]
+        q.clear()
+
+        # dropping the score below the threshold deletes the joined row
+        c.execute(["UPDATE users SET score = 10 WHERE id = 9"], node=1)
+        c.run_until_converged()
+        assert [e.kind for e in q] == ["delete"]
+    finally:
+        c.tripwire.trip()
+
+
+def test_not_in_select_null_three_valued():
+    """A NULL in the subquery result makes NOT IN return no rows (UNKNOWN
+    for every candidate) — SQLite three-valued semantics."""
+    c = LiveCluster(
+        """
+        CREATE TABLE a (id INTEGER PRIMARY KEY, v INTEGER NOT NULL DEFAULT 0);
+        CREATE TABLE b (id INTEGER PRIMARY KEY, v INTEGER);
+        """,
+        num_nodes=2, default_capacity=64,
+    )
+    try:
+        c.execute([
+            "INSERT INTO a (id, v) VALUES (1, 10), (2, 99)",
+            "INSERT INTO b (id, v) VALUES (1, 10), (2, NULL)",
+        ])
+        _, rows = c.query_rows(
+            "SELECT id FROM a WHERE v NOT IN (SELECT v FROM b)"
+        )
+        assert rows == [], rows
+        # without the NULL row, NOT IN behaves normally
+        c.execute(["DELETE FROM b WHERE id = 2"])
+        _, rows = c.query_rows(
+            "SELECT id FROM a WHERE v NOT IN (SELECT v FROM b)"
+        )
+        assert [r[0] for r in rows] == [2]
+    finally:
+        c.tripwire.trip()
+
+
+def test_dml_delete_with_in_select():
+    """UPDATE/DELETE whose WHERE contains IN (SELECT …) — the DML row
+    resolver must route through the semi-join matcher."""
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=64)
+    try:
+        c.execute([
+            "INSERT INTO users (id, team) VALUES (1, 'red'), (2, 'blue')",
+            "INSERT INTO vip_teams (name) VALUES ('red')",
+        ])
+        resp = c.execute([
+            "DELETE FROM users WHERE team IN (SELECT name FROM vip_teams)",
+        ])
+        assert resp["results"][0]["rows_affected"] == 1
+        _, rows = c.query_rows("SELECT id FROM users")
+        assert [r[0] for r in rows] == [2]
+    finally:
+        c.tripwire.trip()
