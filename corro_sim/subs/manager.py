@@ -540,6 +540,21 @@ class JoinMatcher(_EventStream):
         self._rowspan = getattr(layout, "total_rows", 1 << 20)
 
         self._prev: dict[int, list] = {}
+        # incremental tuple engine state (inner-only chains; LEFT links
+        # fall back to full rebuilds — a right-side removal can resurrect
+        # null-extended tuples, which restricted rebuilds cannot see)
+        self._side_cache: dict | None = None
+        self._tuples: dict[int, list] = {}
+        self._changed: dict[int, list | None] = {}  # rid → pre-build cells
+        self._rid_slots: dict[int, tuple] = {}
+        self._by_slot: dict[tuple, set] = {}
+        self._has_left = any(link[3] == "left" for link in self._links)
+        self.stats = {
+            "full_joins": 0,
+            "incremental_joins": 0,
+            "tuples_rebuilt": 0,
+            "groups_refolded": 0,
+        }
         self._init_events(max_buffer)
 
     # ------------------------------------------------------------ plumbing
@@ -570,17 +585,135 @@ class JoinMatcher(_EventStream):
             out[int(s) + m._start] = m._decode_row(s, proj[s])
         return out
 
-    def _join(self, table_state) -> dict:
-        """{rowid: output cells} of the current join-chain result.
+    def _rid_of(self, slots) -> int:
+        rid = slots[0]
+        for s in slots[1:]:
+            rid = rid * (self._rowspan + 1) + s
+        return rid
 
-        Tuples build link by link: each link probes its side's matched
-        rows (indexed by decoded ON-key value) from every partial tuple;
-        a LEFT link keeps keyless/matchless tuples with a NULL side. The
-        synthetic rowid is the mixed-radix (slot+1) tuple over rowspan —
-        stable for a given combination of source rows."""
+    def _slot_pairs(self, slots):
+        """(alias, slot) pairs a tuple's rows occupy (nulls excluded)."""
+        pairs = [(self._aliases[0], slots[0])]
+        for i, s in enumerate(slots[1:]):
+            if s != 0:
+                pairs.append((self._aliases[i + 1], s - 1))
+        return pairs
+
+    def _join(self, table_state) -> dict:
+        """{rowid: output cells} of the current join-chain result — kept
+        incrementally when the chain is inner-only: only tuples touching
+        a changed/added/removed side row rebuild (restricted chain
+        builds), the rest carry over. The reference diffs candidate pks
+        through its temp-table EXCEPT dance the same way
+        (``pubsub.rs:1518-1793``)."""
         side_rows = {
             a: self._side_rows(a, table_state) for a in self._aliases
         }
+        if self._side_cache is None or self._has_left:
+            cur = self._full_build(side_rows)
+        else:
+            cur = self._incr_build(side_rows)
+        if not self._has_left:
+            # LEFT chains always full-rebuild: the slot index and side
+            # snapshot would never be read — skip maintaining them
+            self._side_cache = side_rows
+        self._tuples = cur
+        return cur
+
+    def _register(self, rid, slots, cells, out) -> None:
+        """Install one tuple + its slot-index entries (the invariant the
+        incremental drop loop relies on: _rid_slots and _by_slot always
+        agree)."""
+        out[rid] = cells
+        self._rid_slots[rid] = slots
+        for pair in self._slot_pairs(slots):
+            self._by_slot.setdefault(pair, set()).add(rid)
+
+    def _full_build(self, side_rows) -> dict:
+        self.stats["full_joins"] += 1
+        parts = self._chain(side_rows)
+        self._rid_slots = {}
+        self._by_slot = {}
+        out = {}
+        old = self._tuples
+        if self._has_left:
+            for slots, sides in parts:
+                out[self._rid_of(slots)] = self._project(sides)
+        else:
+            for slots, sides in parts:
+                self._register(
+                    self._rid_of(slots), slots, self._project(sides), out
+                )
+        # changed-rid record for the group-local aggregate step
+        self._changed = {
+            rid: old.get(rid)
+            for rid in (out.keys() | old.keys())
+            if out.get(rid) != old.get(rid)
+        }
+        self.stats["tuples_rebuilt"] += len(out)
+        return out
+
+    def _incr_build(self, side_rows) -> dict:
+        self.stats["incremental_joins"] += 1
+        old = self._side_cache
+        diffs = {}
+        for a in self._aliases:
+            o, nw = old[a], side_rows[a]
+            added = nw.keys() - o.keys()
+            removed = o.keys() - nw.keys()
+            changed = {
+                s for s in (nw.keys() & o.keys()) if nw[s] != o[s]
+            }
+            diffs[a] = (added, removed, changed)
+
+        # drop every tuple touching a removed/changed row
+        touched: set = set()
+        for a in self._aliases:
+            added, removed, changed = diffs[a]
+            for s in removed | changed:
+                touched |= self._by_slot.get((a, s), set())
+        cur = self._tuples  # mutated in place; _join rebinds it anyway
+        self._changed = {}
+        for rid in touched:
+            self._changed[rid] = cur.pop(rid, None)
+            for pair in self._slot_pairs(self._rid_slots.pop(rid)):
+                self._by_slot.get(pair, set()).discard(rid)
+
+        # rebuild tuples that contain at least one added/changed row:
+        # one chain build per changed side, that side restricted to its
+        # changed rows (union over sides covers multi-side tuples; the
+        # dict assignment dedupes)
+        rebuilt = 0
+        for a in self._aliases:
+            added, removed, changed = diffs[a]
+            probe = added | changed
+            if not probe:
+                continue
+            restricted = dict(side_rows)
+            restricted[a] = {s: side_rows[a][s] for s in probe}
+            for slots, sides in self._chain(restricted):
+                rid = self._rid_of(slots)
+                if rid in cur:
+                    continue
+                self._register(rid, slots, self._project(sides), cur)
+                self._changed.setdefault(rid, None)
+                rebuilt += 1
+        # a dropped-and-rebuilt tuple whose cells came back identical is
+        # not a change
+        self._changed = {
+            rid: old for rid, old in self._changed.items()
+            if cur.get(rid) != old
+        }
+        self.stats["tuples_rebuilt"] += rebuilt
+        return cur
+
+    def _chain(self, side_rows) -> list:
+        """Join tuples as (slots, sides) parts, built link by link: each
+        link probes its side's matched rows (indexed by decoded ON-key
+        value) from every partial tuple; a LEFT link keeps
+        keyless/matchless tuples with a NULL side. The synthetic rowid is
+        the mixed-radix (slot+1) tuple over rowspan — stable for a given
+        combination of source rows."""
         a0 = self._aliases[0]
         parts = [
             ((ls,), {a0: cells}) for ls, cells in side_rows[a0].items()
@@ -617,14 +750,7 @@ class JoinMatcher(_EventStream):
                 elif kind == "left":
                     nxt.append((slots + (0,), {**sides, ra: None}))
             parts = nxt
-
-        out = {}
-        for slots, sides in parts:
-            rid = slots[0]
-            for s in slots[1:]:
-                rid = rid * (self._rowspan + 1) + s
-            out[rid] = self._project(sides)
-        return out
+        return parts
 
     def _expr_link(self, parts, side_rows, expr, ra, kind, refs):
         """One non-equality join link: nested-loop over (partial tuple ×
@@ -672,7 +798,7 @@ class JoinMatcher(_EventStream):
     # ------------------------------------------------------------- surface
     def prime(self, table_state):
         cur = self._join(table_state)
-        self._prev = cur
+        self._changed = {}
         self._primed = True
         header = {"columns": list(self.columns)}
         rows = [
@@ -682,18 +808,22 @@ class JoinMatcher(_EventStream):
         return [header, *rows, eoq]
 
     def step(self, table_state) -> list:
+        """Emit the join diff — driven by the build's changed-rid record
+        (old cells per changed rid), so steady-state cost follows the
+        CHANGE size, not the join size."""
         if not self._primed:
             raise RuntimeError("matcher not primed — call prime() first")
         cur = self._join(table_state)
         events: list = []
-        for rid in sorted(cur.keys() - self._prev.keys()):
-            self._emit(events, "insert", rid, cur[rid])
-        for rid in sorted(cur.keys() & self._prev.keys()):
-            if cur[rid] != self._prev[rid]:
-                self._emit(events, "update", rid, cur[rid])
-        for rid in sorted(self._prev.keys() - cur.keys()):
-            self._emit(events, "delete", rid, self._prev[rid])
-        self._prev = cur
+        for rid in sorted(self._changed):
+            oc = self._changed[rid]
+            nc = cur.get(rid)
+            if oc is None and nc is not None:
+                self._emit(events, "insert", rid, nc)
+            elif nc is None and oc is not None:
+                self._emit(events, "delete", rid, oc)
+            elif nc is not None and oc is not None:
+                self._emit(events, "update", rid, nc)
         self._buffer_events(events)
         return events
 
@@ -1046,29 +1176,39 @@ class JoinAggregateMatcher(JoinMatcher):
         self._rid_of_key: dict = {}
         self._next_rid = 0
 
+    def _group_key(self, cells) -> tuple:
+        return tuple(sqlite_sort_key(cells[i]) for i in self._gpos)
+
+    def _fold_group(self, rows) -> list:
+        out_cells = []
+        for item in self._items:
+            if item[0] == "col":
+                out_cells.append(rows[0][item[1]] if rows else None)
+                continue
+            agg, p = item[1], item[2]
+            out_cells.append(
+                fold_aggregate(
+                    agg, rows if p is None else [r[p] for r in rows]
+                )
+            )
+        return out_cells
+
     def _groups_of(self, table_state) -> dict:
-        """{group key: output cells} — full recompute from the join."""
+        """{group key: output cells} — full fold (prime path); also
+        (re)builds the group→tuple index the incremental step maintains."""
         joined = self._join(table_state)
+        self._group_rids = {}
         groups: dict = {}
-        for _rid, cells in sorted(joined.items()):
-            key = tuple(sqlite_sort_key(cells[i]) for i in self._gpos)
+        for rid, cells in sorted(joined.items()):
+            key = self._group_key(cells)
             groups.setdefault(key, []).append(cells)
+            self._group_rids.setdefault(key, set()).add(rid)
         if not self._agg_select.group_by and not groups:
             groups[()] = []  # SQLite: ungrouped aggregate = exactly one row
         out = {}
         for key, rows in groups.items():
-            out_cells = []
-            for item in self._items:
-                if item[0] == "col":
-                    out_cells.append(rows[0][item[1]] if rows else None)
-                    continue
-                agg, p = item[1], item[2]
-                out_cells.append(
-                    fold_aggregate(
-                        agg, rows if p is None else [r[p] for r in rows]
-                    )
-                )
-            out[key] = out_cells
+            out[key] = self._fold_group(rows)
+            self.stats["groups_refolded"] += 1
         return out
 
     def _rid(self, key) -> int:
@@ -1081,6 +1221,7 @@ class JoinAggregateMatcher(JoinMatcher):
 
     def prime(self, table_state):
         cur = self._groups_of(table_state)
+        self._changed = {}  # the snapshot consumed the build's diff
         self._prev = cur
         self._primed = True
         header = {"columns": list(self.columns)}
@@ -1092,22 +1233,46 @@ class JoinAggregateMatcher(JoinMatcher):
         return [header, *rows, eoq]
 
     def step(self, table_state) -> list:
+        """Group-local incremental aggregation (VERDICT r4 #6): the join
+        diff routes each changed tuple to its old/new group, and ONLY
+        those groups refold — from the tuple store, not the tables. An
+        update to one side of a 3-table join adjusts exactly the groups
+        it touches (asserted via `stats['groups_refolded']` in
+        tests/test_sub_aggregates.py)."""
         if not self._primed:
             raise RuntimeError("matcher not primed — call prime() first")
-        cur = self._groups_of(table_state)
+        cur_tuples = self._join(table_state)
+        keys_touched: set = set()
+        for rid, oc in self._changed.items():
+            if oc is not None:
+                k = self._group_key(oc)
+                self._group_rids.get(k, set()).discard(rid)
+                keys_touched.add(k)
+            nc = cur_tuples.get(rid)
+            if nc is not None:
+                k = self._group_key(nc)
+                self._group_rids.setdefault(k, set()).add(rid)
+                keys_touched.add(k)
         events: list = []
-        changed = [
-            key for key in (cur.keys() | self._prev.keys())
-            if cur.get(key) != self._prev.get(key)
-        ]
-        for key in sorted(changed, key=self._rid):
-            if key not in cur:
-                self._emit(events, "delete", self._rid(key), self._prev[key])
-            elif key not in self._prev:
-                self._emit(events, "insert", self._rid(key), cur[key])
-            else:
-                self._emit(events, "update", self._rid(key), cur[key])
-        self._prev = cur
+        for key in sorted(keys_touched, key=self._rid):
+            rids = self._group_rids.get(key, ())
+            if not rids and (self._agg_select.group_by or key != ()):
+                self._group_rids.pop(key, None)
+                if key in self._prev:
+                    self._emit(
+                        events, "delete", self._rid(key),
+                        self._prev.pop(key),
+                    )
+                continue
+            cells = self._fold_group(
+                [cur_tuples[r] for r in sorted(rids)]
+            )
+            self.stats["groups_refolded"] += 1
+            if key not in self._prev:
+                self._emit(events, "insert", self._rid(key), cells)
+            elif cells != self._prev[key]:
+                self._emit(events, "update", self._rid(key), cells)
+            self._prev[key] = cells
         self._buffer_events(events)
         return events
 
