@@ -167,6 +167,8 @@ class _Handler(BaseHTTPRequestHandler):
             pass
 
     def do_POST(self):  # noqa: N802
+        with self.api.cluster._api_req_lock:
+            self.api.cluster._api_requests += 1
         self._trace_ctx = None  # never leak a prior request's context
         if not self._authz():
             return
@@ -189,6 +191,8 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": "not found"}, status=404))
 
     def do_GET(self):  # noqa: N802
+        with self.api.cluster._api_req_lock:
+            self.api.cluster._api_requests += 1
         self._trace_ctx = None
         if not self._authz():
             return

@@ -1106,8 +1106,25 @@ class _Session:
 
     # ---------------------------------------------------------- main loop
     def serve(self) -> None:
+        import time as _time
+
+        _histograms = getattr(
+            self.cluster, "histograms", None
+        )
+        if _histograms is None:
+            from corro_sim.utils.metrics import histograms as _histograms
+
+        _t0 = _time.perf_counter()
         if not self.startup():
             return
+        # wire-session establishment (pgwire startup handshake) — the
+        # corro.transport.connect.time.seconds analog
+        _histograms.observe(
+            "corro_transport_connect_time_seconds",
+            _time.perf_counter() - _t0,
+            help_="wire-session establishment time (pgwire startup; "
+                  "corro.transport.connect.time.seconds analog)",
+        )
         buffered: list[bytes] = []
         skip_to_sync = False
         while True:

@@ -63,6 +63,8 @@ class _PendingChangeset:
 
     is_delete: bool
     cells: list  # [(row_slot, col_plane, value_rank)]; delete: [(slot, 0, 0)]
+    queued_at: float = 0.0  # perf_counter at enqueue — feeds the
+    # corro.agent.changes.queued.seconds histogram at drain time
 
 
 # Rounds per multi-round dispatch (the chunked fast path). Small clusters
@@ -101,7 +103,12 @@ class LiveCluster:
             )
         self._schema_history: list[str] = [schema_sql]
         self.universe = universe if universe is not None else LiveUniverse()
-        self.locks = LockRegistry()
+        from corro_sim.utils.metrics import HistogramRegistry
+
+        # cluster-scoped histograms: a process can host several clusters
+        # (tests, devcluster) — mixing their observations would lie
+        self.histograms = HistogramRegistry()
+        self.locks = LockRegistry(histograms=self.histograms)
         self.tripwire = tripwire or Tripwire()
         self._lock = threading.RLock()
         self._seed = seed
@@ -134,13 +141,17 @@ class LiveCluster:
         # so BENCH regressions are explainable without re-profiling.
         self._stage_ms: dict[str, tuple[float, float]] = {}
         self._gap = 0.0  # last round's convergence gap (metrics reuse)
+        self._prev_swim: dict[str, float] = {}  # transition-counter state
+        self._api_requests = 0  # served API requests (io_driver analog)
+        self._api_req_lock = threading.Lock()
+        self._chunk_dispatches = 0  # chunked tick batches executed
         self._log_poisoned = False  # ring-wrap tripwire latched
         self._partials = 0.0  # last round's buffered-partial gauge
         self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
         # per-queue health counters (corro.runtime.channel.* analog)
         from corro_sim.utils.metrics import ChannelMetrics
 
-        self.channels = ChannelMetrics()
+        self.channels = ChannelMetrics(histograms=self.histograms)
         self.channels.set_capacity("write_queue", 0)  # unbounded deques
         self.channels.set_capacity("subs_events", 0)
 
@@ -449,9 +460,9 @@ class LiveCluster:
                         dedup.setdefault((slot, 0), self.universe.rank(None))
             cells = [(r, c, v) for (r, c), v in dedup.items()]
             for i in range(0, len(cells), s_cap):
-                out.append(
-                    _PendingChangeset(False, cells[i:i + s_cap])
-                )
+                out.append(_PendingChangeset(
+                    False, cells[i:i + s_cap], time.perf_counter()
+                ))
             for slot in touched_slots:
                 live_ov[slot] = True
             cell_ov.update(dedup)
@@ -494,7 +505,9 @@ class LiveCluster:
                             self.universe.rank(val),
                         ))
             for i in range(0, len(cells), s_cap):
-                out.append(_PendingChangeset(False, cells[i:i + s_cap]))
+                out.append(_PendingChangeset(
+                False, cells[i:i + s_cap], time.perf_counter()
+            ))
             for slot, plane, rank in cells:
                 cell_ov[(slot, plane)] = rank
             return len(slots)
@@ -502,7 +515,7 @@ class LiveCluster:
         # delete: one cl-only changeset per row (a DELETE bumps the row's
         # causal length; CR-SQLite emits no value changes for it).
         for slot in slots:
-            out.append(_PendingChangeset(True, [(slot, 0, 0)]))
+            out.append(_PendingChangeset(True, [(slot, 0, 0)], time.perf_counter()))
             live_ov[slot] = False
         return len(slots)
 
@@ -765,16 +778,21 @@ class LiveCluster:
         vals = np.zeros((n, s), np.int32)
         dels = np.zeros((n,), bool)
         ncells = np.zeros((n,), np.int32)
+        _qwaits: list[float] = []
+        now = time.perf_counter()
         for i in range(n):
             if not self._pending[i]:
                 continue
             cs: _PendingChangeset = self._pending[i].popleft()
             self.channels.on_recv("write_queue")
+            if cs.queued_at:
+                _qwaits.append(now - cs.queued_at)
             writers[i] = True
             dels[i] = cs.is_delete
             ncells[i] = len(cs.cells)
             for j, (slot, plane, rank) in enumerate(cs.cells):
                 rows[i, j], cols[i, j], vals[i, j] = slot, plane, rank
+        self._observe_qwaits(_qwaits)
         return writers, rows, cols, vals, dels, ncells
 
     def _dequeue_writes_chunk(self, k: int):
@@ -790,12 +808,16 @@ class LiveCluster:
         vals = np.zeros((k, n, s), np.int32)
         dels = np.zeros((k, n), bool)
         ncells = np.zeros((k, n), np.int32)
+        _qwaits: list[float] = []
+        now = time.perf_counter()
         for i in range(n):
             q = self._pending[i]
             take = min(k, len(q))
             for r in range(take):
                 cs: _PendingChangeset = q.popleft()
                 self.channels.on_recv("write_queue")
+                if cs.queued_at:
+                    _qwaits.append(now - cs.queued_at)
                 writers[r, i] = True
                 dels[r, i] = cs.is_delete
                 ncells[r, i] = len(cs.cells)
@@ -803,7 +825,16 @@ class LiveCluster:
                     rows[r, i, j], cols[r, i, j], vals[r, i, j] = (
                         slot, plane, rank,
                     )
+        self._observe_qwaits(_qwaits)
         return writers, rows, cols, vals, dels, ncells
+
+    def _observe_qwaits(self, waits: list) -> None:
+        """One batched registry touch per drain (hot path)."""
+        self.histograms.observe_many(
+            "corro_agent_changes_queued_seconds", waits,
+            help_="time a committed changeset waited in the write queue "
+                  "(corro.agent.changes.queued.seconds)",
+        )
 
     def _record_metrics(self, packed: np.ndarray, names: list) -> None:
         """Fold a (num_metrics, rounds) block into the running totals."""
@@ -811,9 +842,32 @@ class LiveCluster:
         for k, v in zip(names, sums):
             self._totals[k] = self._totals.get(k, 0.0) + float(v)
         for k in ("pend_live", "queue_overflow", "swim_suspects",
-                  "swim_down"):
+                  "swim_down", "sync_pairs"):
             if k in names:
                 self._lasts[k] = float(packed[names.index(k), -1])
+        # SWIM membership transition counters (corro.swim.notification):
+        # positive deltas of the belief-state gauges, round by round
+        for k, ev in (("swim_suspects", "swim_suspect_events"),
+                      ("swim_down", "swim_down_events")):
+            if k in names:
+                series = packed[names.index(k)]
+                prev = self._prev_swim.get(k, 0.0)
+                up_k = 0.0
+                down_k = 0.0
+                for v in series:
+                    d = float(v) - prev
+                    if d > 0:
+                        up_k += d
+                    else:
+                        down_k -= d
+                    prev = float(v)
+                self._prev_swim[k] = prev
+                self._totals[ev] = self._totals.get(ev, 0.0) + up_k
+                if k == "swim_down":
+                    # a shrinking down-count = members back up
+                    self._totals["swim_up_events"] = (
+                        self._totals.get("swim_up_events", 0.0) + down_k
+                    )
         self._gap = float(packed[names.index("gap"), -1])
         self._partials = float(packed[names.index("buffered_partials"), -1])
         if "log_wrapped" in names and packed[names.index("log_wrapped")].any():
@@ -821,11 +875,38 @@ class LiveCluster:
             # wrong from here on — convergence must never be reported
             self._log_poisoned = True
         self._totals["rounds"] = self._rounds_ticked
+        # changes applied per round → the reference's chunk-size histogram
+        # (corro.agent.changes.processing.chunk_size; its own buckets)
+        if "fresh" in names:
+            from corro_sim.utils.metrics import CHUNK_SIZE_BUCKETS
+
+            per_round = packed[names.index("fresh")]
+            if "writes" in names:
+                per_round = per_round + packed[names.index("writes")]
+            self.histograms.observe_many(
+                "corro_agent_changes_processing_chunk_size",
+                [float(v) for v in per_round],
+                help_="changes applied per processing round "
+                      "(corro.agent.changes.processing.chunk_size)",
+                buckets=CHUNK_SIZE_BUCKETS,
+            )
+
+    _STAGE_HISTO = {
+        "step": "corro_agent_changes_processing_time_seconds",
+        "chunk_step": "corro_agent_changes_processing_time_seconds",
+        "subs": "corro_subs_changes_processing_duration_seconds",
+    }
 
     def _observe_stage(self, stage: str, seconds: float, per: int = 1) -> None:
         ms = seconds * 1000.0 / max(per, 1)
         ewma, _ = self._stage_ms.get(stage, (ms, ms))
         self._stage_ms[stage] = (ewma + 0.2 * (ms - ewma), ms)
+        name = self._STAGE_HISTO.get(stage)
+        if name is not None:
+            self.histograms.observe(
+                name, seconds / max(per, 1),
+                help_=f"per-round {stage} wall (reference histogram analog)",
+            )
 
     def stage_timings(self) -> dict:
         """{stage: {"ewma_ms": .., "last_ms": ..}} per-round wall by stage."""
@@ -883,6 +964,7 @@ class LiveCluster:
         candidate batching (1000 rows / 600 ms, ``pubsub.rs:1154-1296``) —
         but callers gate on _subs_active() to preserve per-round event
         granularity whenever someone is actually watching."""
+        self._chunk_dispatches += 1
         t0 = time.perf_counter()
         w = self._dequeue_writes_chunk(_CHUNK)
         self._observe_stage("dequeue", time.perf_counter() - t0, per=_CHUNK)

@@ -79,10 +79,32 @@ class ConsulAgentClient:
         self.timeout = timeout
 
     def _get(self, path: str):
-        with urllib.request.urlopen(
-            self.base_url + path, timeout=self.timeout
-        ) as r:
-            return json.loads(r.read())
+        import time as _time
+
+        from corro_sim.utils.metrics import histograms as _histograms
+
+        t0 = _time.perf_counter()
+        try:
+            with urllib.request.urlopen(
+                self.base_url + path, timeout=self.timeout
+            ) as r:
+                out = json.loads(r.read())
+        except Exception:
+            from corro_sim.utils.metrics import counters as _counters
+
+            _counters.inc(
+                "corro_consul_consul_response_errors_total",
+                help_="consul API errors "
+                      "(corro_consul.consul.response.errors)",
+            )
+            raise
+        _histograms.observe(
+            "corro_consul_consul_response_time_seconds",
+            _time.perf_counter() - t0,
+            help_="consul API response time "
+                  "(corro_consul.consul.response.time.seconds)",
+        )
+        return out
 
     def agent_services(self) -> dict:
         return self._get("/v1/agent/services")

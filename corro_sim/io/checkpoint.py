@@ -212,6 +212,11 @@ def _permute_actors(sd: dict, a: int, b: int) -> dict:
 def save_checkpoint(cluster, path, *, scrub: bool = False,
                     origin_node: int = 0) -> None:
     """Serialize a LiveCluster to ``path`` (.npz)."""
+    import time as _time
+
+    from corro_sim.utils.metrics import histograms as _histograms
+
+    _t0 = _time.perf_counter()
     with cluster._lock:
         meta = _meta_of(cluster, scrub, origin_node)
         sd = flax.serialization.to_state_dict(cluster.state)
@@ -235,6 +240,11 @@ def save_checkpoint(cluster, path, *, scrub: bool = False,
         )
     with open(path, "wb") as f:
         f.write(buf.getvalue())
+    _histograms.observe(
+        "corro_db_wal_truncate_seconds", _time.perf_counter() - _t0,
+        help_="durable snapshot wall (checkpoint save; "
+              "corro.db.wal.truncate.seconds analog)",
+    )
 
 
 def _read(path):

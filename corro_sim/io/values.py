@@ -497,6 +497,22 @@ class LiveUniverse(_BandRanges):
             fn(old_ranks, new_ranks)
 
     def _respace(self) -> None:
+        import time as _time
+
+        from corro_sim.utils.metrics import histograms as _histograms
+
+        _t0 = _time.perf_counter()
+        try:
+            return self._respace_inner()
+        finally:
+            _histograms.observe(
+                "corro_db_incremental_vacuum_seconds",
+                _time.perf_counter() - _t0,
+                help_="rank-space respace wall (universe remap; "
+                      "corro.db.incremental.vacuum.seconds analog)",
+            )
+
+    def _respace_inner(self) -> None:
         old = list(self._ranks)
         self._ranks = self._band_spread(self._keys)
         self._by_value = dict(zip(self._keys, self._ranks))

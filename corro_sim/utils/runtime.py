@@ -180,7 +180,8 @@ class LockRegistry:
     (``corro-types/src/agent.rs:890-1099``, dumped via corro-admin).
     """
 
-    def __init__(self):
+    def __init__(self, histograms=None):
+        self.histograms = histograms  # cluster-scoped wait histograms
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self._active: dict[int, dict] = {}
@@ -230,8 +231,31 @@ class _TrackedAcquire:
 
     def __enter__(self):
         self._lid = self._reg._register(self._label, self._kind, "acquiring")
+        t0 = time.perf_counter()
         self._lock.acquire()
+        wait = time.perf_counter() - t0
         self._reg._set_state(self._lid, "locked")
+        # lock-wait histograms (reference: write-permit acquisition and
+        # pool queue times, corro.sqlite.*.seconds) — the cluster-scoped
+        # registry when the LockRegistry belongs to a cluster
+        if self._reg.histograms is not None:
+            histograms = self._reg.histograms
+        else:
+            from corro_sim.utils.metrics import histograms
+
+        histograms.observe(
+            "corro_sqlite_write_permit_acquisition_seconds"
+            if self._kind == "write"
+            else "corro_sqlite_pool_queue_seconds",
+            wait,
+            help_=(
+                "write-lock acquisition wait "
+                "(corro.sqlite.write_permit.acquisition.seconds)"
+                if self._kind == "write"
+                else "read-path lock queue wait "
+                     "(corro.sqlite.pool.queue.seconds)"
+            ),
+        )
         return self
 
     def __exit__(self, *exc):
