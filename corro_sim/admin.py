@@ -113,10 +113,26 @@ class AdminServer:
         if c.cfg.swim_enabled:
             sw = c.state.swim
             status = np.asarray(sw.status)
-            out["incarnation"] = np.asarray(sw.inc).diagonal().tolist()
-            # per-node summary, not the full N×N belief matrix
-            out["suspected_by"] = (status == 1).sum(axis=0).tolist()
-            out["down_by"] = (status >= 2).sum(axis=0).tolist()
+            if hasattr(sw, "member"):  # windowed O(N·K) belief state
+                member = np.asarray(sw.member)
+                tracked = member >= 0
+                out["incarnation"] = np.asarray(sw.self_inc).tolist()
+                n = member.shape[0]
+                sus = np.zeros(n, np.int64)
+                dwn = np.zeros(n, np.int64)
+                np.add.at(
+                    sus, member[tracked & (status == 1)], 1
+                )
+                np.add.at(
+                    dwn, member[tracked & (status >= 2)], 1
+                )
+                out["suspected_by"] = sus.tolist()
+                out["down_by"] = dwn.tolist()
+            else:
+                out["incarnation"] = np.asarray(sw.inc).diagonal().tolist()
+                # per-node summary, not the full N×N belief matrix
+                out["suspected_by"] = (status == 1).sum(axis=0).tolist()
+                out["down_by"] = (status >= 2).sum(axis=0).tolist()
         return out
 
     def _cmd_cluster_rejoin(self, req):

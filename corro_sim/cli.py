@@ -38,6 +38,7 @@ _FLAG_TO_FIELD = {
     "write_rate": "write_rate",
     "zipf": "zipf_alpha",
     "swim": "swim_enabled",
+    "swim_view": "swim_view_size",
     "sync_interval": "sync_interval",
 }
 
@@ -342,6 +343,10 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--write-rate", type=float)
     pr.add_argument("--zipf", type=float)
     pr.add_argument("--swim", action="store_const", const=True)
+    pr.add_argument(
+        "--swim-view", type=int,
+        help="windowed SWIM: members tracked per node (0 = full view)",
+    )
     pr.add_argument("--sync-interval", type=int)
     pr.add_argument("--write-rounds", type=int, default=32)
     pr.add_argument("--max-rounds", type=int, default=4096)

@@ -114,6 +114,11 @@ class SimConfig:
     swim_gossip_peers: int = 3  # view-exchange peers per round
     swim_announce_interval: int = 4  # belief-independent announce cadence
     # (ANNOUNCE_INTERVAL analog, agent/mod.rs:32 — heals mutual-down splits)
+    swim_view_size: int = 0  # > 0: the windowed O(N·K) belief state
+    # (membership/swim_window.py) — each node tracks at most this many
+    # members instead of the full (N, N) plane (10 GB at 50k, why config
+    # 5 historically ran SWIM off). foca's per-node state is O(members
+    # known) the same way. 0 = the full-view automaton.
     swim_payload_members: int = 64  # member entries per exchange datagram —
     # the ≤1178-byte SWIM packet bound (broadcast/mod.rs:743) at ~18 B per
     # piggybacked update; >= num_nodes disables the bound (full views)

@@ -22,6 +22,7 @@ from corro_sim.core.crdt import TableState, make_table_state
 from corro_sim.gossip.broadcast import GossipState, make_gossip_state
 from corro_sim.membership.rtt import make_rtt
 from corro_sim.membership.swim import SwimState, make_swim_state
+from corro_sim.membership.swim_window import make_swim_window_state
 
 
 @flax.struct.dataclass
@@ -100,7 +101,14 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
         ),
         own=make_ownership(cfg.num_rows, cfg.num_cols),
         gossip=make_gossip_state(n, cfg.pend_slots),
-        swim=make_swim_state(n, enabled=cfg.swim_enabled),
+        swim=(
+            make_swim_window_state(
+                n, cfg.swim_view_size, seed=seed,
+                enabled=cfg.swim_enabled,
+            )
+            if cfg.swim_view_size > 0
+            else make_swim_state(n, enabled=cfg.swim_enabled)
+        ),
         ring0=jnp.asarray(_ring0(cfg, seed)),
         row_cdf=jnp.asarray(_row_cdf(cfg)),
         round=jnp.zeros((), jnp.int32),

@@ -38,7 +38,8 @@ from corro_sim.utils.slots import ranks_within_group_masked
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
 from corro_sim.membership.rtt import link_delay, observe_rtt, recompute_ring0
-from corro_sim.membership.swim import swim_step, view_alive
+from corro_sim.membership.swim import swim_step, view_alive  # noqa: F401
+from corro_sim.membership.swim_window import membership_view
 from corro_sim.sync.sync import sync_round
 
 
@@ -103,10 +104,7 @@ def sim_step(
     reach = _reachable_fn(alive, part)
 
     # ------------------------------------------------------------------ view
-    if cfg.swim_enabled:
-        view = view_alive(state.swim)  # (N, N) believed-up
-    else:
-        view = jnp.ones((1, n), bool)
+    view = membership_view(cfg, state.swim, n)
 
     # ---------------------------------------------------------- local writes
     # One changeset per node per round max — the reference serializes local
@@ -526,22 +524,32 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
             "swim_down": jnp.int32(0),
             "swim_probe_failures": jnp.int32(0),
         }
+    if cfg.swim_view_size > 0:
+        from corro_sim.membership.swim_window import swim_window_step
+
+        step_fn = swim_window_step
+    else:
+        step_fn = swim_step
     if cfg.swim_interval <= 1:
-        return swim_step(cfg, swim_state, k_swim, alive, reach, round_)
+        return step_fn(cfg, swim_state, k_swim, alive, reach, round_)
 
     def tick_swim(args):
         sw, k = args
-        return swim_step(cfg, sw, k, alive, reach, round_)
+        return step_fn(cfg, sw, k, alive, reach, round_)
 
     def skip_swim(args):
         sw, _ = args
         st = sw.status
+        tracked = (
+            sw.member >= 0 if cfg.swim_view_size > 0
+            else jnp.ones(st.shape, bool)
+        )
         return sw, {
             "swim_suspects": (
-                (st == 1) & alive[:, None]
+                (st == 1) & tracked & alive[:, None]
             ).sum(dtype=jnp.int32),
             "swim_down": (
-                (st == 2) & alive[:, None]
+                (st >= 2) & tracked & alive[:, None]
             ).sum(dtype=jnp.int32),
             "swim_probe_failures": jnp.int32(0),
         }
@@ -631,10 +639,7 @@ def _repair_step(
      k_sync) = jax.random.split(key, 9)
     reach = _reachable_fn(alive, part)
 
-    if cfg.swim_enabled:
-        view = view_alive(state.swim)
-    else:
-        view = jnp.ones((1, n), bool)
+    view = membership_view(cfg, state.swim, n)
 
     log = state.log
     book = state.book

@@ -205,8 +205,11 @@ def broadcast_step(
     src = jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32)[:, None, None], targets.shape)
     # Sender's belief about the target (gather per (src, target)). A shared
     # (1, N) view means "everyone believes the same thing" (no-SWIM configs)
-    # and avoids materializing an (N, N) belief matrix.
-    if target_alive_view.shape[0] == 1:
+    # and avoids materializing an (N, N) belief matrix; a CALLABLE view is
+    # the windowed-SWIM per-pair membership test (swim_window.py).
+    if callable(target_alive_view):
+        believed_up = target_alive_view(src, targets)
+    elif target_alive_view.shape[0] == 1:
         believed_up = target_alive_view[0][targets]
     else:
         believed_up = target_alive_view[src, targets]

@@ -1116,7 +1116,13 @@ class LiveCluster:
         out = []
         inc = None
         if self.cfg.swim_enabled:
-            inc = np.asarray(self.state.swim.inc)
+            sw = self.state.swim
+            # windowed SWIM keeps self in slot 0; the full plane on the
+            # diagonal
+            inc = np.asarray(
+                sw.self_inc if hasattr(sw, "self_inc")
+                else np.asarray(sw.inc).diagonal()
+            )
         for i in range(self.cfg.num_nodes):
             out.append(
                 {
@@ -1124,7 +1130,7 @@ class LiveCluster:
                     "alive": bool(self._alive[i]),
                     "partition": int(self._part[i]),
                     "pending_writes": len(self._pending[i]),
-                    **({"incarnation": int(inc[i, i])} if inc is not None else {}),
+                    **({"incarnation": int(inc[i])} if inc is not None else {}),
                 }
             )
         return out
@@ -1166,13 +1172,23 @@ class LiveCluster:
                 from corro_sim.membership.swim import INC_MAX, pack_swim
 
                 swim = self.state.swim
-                # saturate like swim_step's refutation — wrapping the
-                # 14-bit packed field would reset precedence to zero
-                new_inc = min(int(swim.inc[node, node]) + 1, INC_MAX)
-                # packed self-entry: ALIVE at the bumped incarnation
-                swim = swim.replace(
-                    p=swim.p.at[node, node].set(pack_swim(0, new_inc, 0))
-                )
+                if hasattr(swim, "member"):  # windowed: self = slot 0
+                    new_inc = min(int(swim.self_inc[node]) + 1, INC_MAX)
+                    swim = swim.replace(
+                        belief=swim.belief.at[node, 0].set(
+                            pack_swim(0, new_inc, 0)
+                        )
+                    )
+                else:
+                    # saturate like swim_step's refutation — wrapping the
+                    # 14-bit packed field would reset precedence to zero
+                    new_inc = min(int(swim.inc[node, node]) + 1, INC_MAX)
+                    # packed self-entry: ALIVE at the bumped incarnation
+                    swim = swim.replace(
+                        p=swim.p.at[node, node].set(
+                            pack_swim(0, new_inc, 0)
+                        )
+                    )
                 self.state = self.state.replace(swim=swim)
                 inc = new_inc
             return {"node": node, "alive": True, "incarnation": inc}

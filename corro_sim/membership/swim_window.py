@@ -1,0 +1,324 @@
+"""Windowed SWIM: O(N·K) belief state for 50k+ clusters (VERDICT r4 #8).
+
+The full-view automaton (:mod:`corro_sim.membership.swim`) holds one
+(N, N) packed plane — 400 MB at 10k nodes, 10 GB at 50k, which is why
+config 5 historically ran ``swim_enabled=False``. foca's per-node state
+is O(members known), and a member's datagrams carry at most ~64 entries
+(the ≤1178 B packet, ``broadcast/mod.rs:743``) — a node's working
+belief set is naturally bounded. This module is that bound made
+explicit: each node tracks at most K members,
+
+    member (N, K) int32   — tracked member id, -1 = empty (slot 0 = self)
+    belief (N, K) uint32  — the same (inc | status | since) packing as
+                            the full plane, so precedence merges stay
+                            integer max
+
+and the protocol per tick:
+
+- probe one known believed-up member (direct + indirect through known
+  intermediaries), suspect on silence; suspicion times out to DOWN;
+- pull-exchange with ``swim_gossip_peers`` known members: merge a
+  bounded payload block of the peer's view (matched members merge by
+  packed max — exactly foca's update precedence; unknown members fill
+  empty/evicted slots through a rotating cursor);
+- a periodic ANNOUNCE pull from a uniformly random member id (gated on
+  ground truth only) discovers members outside the view and heals
+  mutual-down splits, like the reference's announcer
+  (``handlers.rs:188-232``);
+- refutation: a node that sees itself suspected in its own slot-0 entry
+  bumps its incarnation (saturating, like the full automaton).
+
+Prototype scope (documented divergences from the full-view automaton):
+exchange is pull-only (the full version also pushes; pulls at the same
+cadence reach the same fixed point a few ticks later), and eviction is
+rotating-cursor rather than LRU. Consumers get ``believed_up_pairs``
+(per-(src, dst) membership test, dense over K) instead of an (N, N)
+plane; ``view_alive_dense`` reconstructs the plane for admin surfaces
+at small N only.
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from corro_sim.config import SimConfig
+from corro_sim.membership.swim import (
+    _INC_SHIFT,
+    _SINCE_MASK,
+    _STATUS_MASK,
+    _STATUS_SHIFT,
+    ALIVE,
+    DOWN,
+    INC_MAX,
+    SUSPECT,
+)
+
+_DOWN_KEY = jnp.uint32(DOWN) << _STATUS_SHIFT
+
+
+@flax.struct.dataclass
+class SwimWindowState:
+    member: jnp.ndarray  # (N, K) int32, -1 = empty; slot 0 = self
+    belief: jnp.ndarray  # (N, K) uint32 packed (inc | status | since)
+    cursor: jnp.ndarray  # (N,) int32 rotating insertion cursor
+
+    # unpacked read-only views mirroring SwimState's — admin surfaces,
+    # metrics, and the skip-round path all read these instead of
+    # re-implementing the bit layout. Entries of EMPTY slots read as
+    # ALIVE/0 — mask with ``member >= 0`` where that matters.
+    @property
+    def status(self) -> jnp.ndarray:
+        return ((self.belief >> _STATUS_SHIFT) & jnp.uint32(3)).astype(
+            jnp.int8
+        )
+
+    @property
+    def inc(self) -> jnp.ndarray:
+        return (self.belief >> _INC_SHIFT).astype(jnp.int32)
+
+    @property
+    def since(self) -> jnp.ndarray:
+        return (self.belief & _SINCE_MASK).astype(jnp.int32)
+
+    @property
+    def self_inc(self) -> jnp.ndarray:
+        """(N,) each node's own incarnation (slot 0 = self)."""
+        return (self.belief[:, 0] >> _INC_SHIFT).astype(jnp.int32)
+
+
+def make_swim_window_state(
+    num_nodes: int, view_size: int, seed: int = 0, enabled: bool = True
+) -> SwimWindowState:
+    n = num_nodes if enabled else 1
+    k = max(view_size, 2) if enabled else 1
+    member = jnp.full((n, k), -1, jnp.int32)
+    member = member.at[:, 0].set(jnp.arange(n, dtype=jnp.int32))
+    if enabled and n > 1:
+        # seed the view with a random member sample (the bootstrap
+        # peers), never the node itself — self lives ONLY in slot 0
+        # (refutation resets slot 0; a duplicate self entry elsewhere
+        # could hold a stale suspect belief it never clears)
+        key = jax.random.PRNGKey(seed ^ 0x5117)
+        fill = jax.random.randint(
+            key, (n, k - 1), 1, n, dtype=jnp.int32
+        )
+        rows = jnp.arange(n, dtype=jnp.int32)[:, None]
+        member = member.at[:, 1:].set((rows + fill) % n)
+    return SwimWindowState(
+        member=member,
+        belief=jnp.zeros(member.shape, jnp.uint32),
+        cursor=jnp.ones((n,), jnp.int32),
+    )
+
+
+def _status(b):
+    return (b >> _STATUS_SHIFT) & jnp.uint32(3)
+
+
+def membership_view(cfg, swim_state, n):
+    """The ``view`` consumed by gossip/sync: the windowed per-pair test
+    (a callable) when ``swim_view_size > 0``, the dense plane otherwise,
+    all-up when SWIM is off. One helper so sim_step and _repair_step
+    cannot drift."""
+    if not cfg.swim_enabled:
+        return jnp.ones((1, n), bool)
+    if cfg.swim_view_size > 0:
+        return lambda src, dst: believed_up_pairs(swim_state, src, dst)
+    from corro_sim.membership.swim import view_alive
+
+    return view_alive(swim_state)
+
+
+def believed_up_pairs(
+    st: SwimWindowState, src: jnp.ndarray, dst: jnp.ndarray
+) -> jnp.ndarray:
+    """Per-pair "would src still talk to dst": True unless src's view
+    holds dst as DOWN. Unknown members default to up — the reference
+    dials any member address it has until told otherwise. ``src``/``dst``
+    may be any equal (broadcastable) shapes; cost is pairs × K dense."""
+    mem = st.member[src]  # pairs + (K,)
+    bel = st.belief[src]
+    hit = mem == dst[..., None]
+    down = hit & ((bel & _STATUS_MASK) >= _DOWN_KEY)
+    return ~down.any(axis=-1)
+
+
+def view_alive_dense(st: SwimWindowState) -> jnp.ndarray:
+    """(N, N) believed-up plane for admin/metrics surfaces — O(N²·K);
+    call only at small N (the windowed form exists to avoid this)."""
+    n = st.member.shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    return believed_up_pairs(
+        st, jnp.broadcast_to(ids[:, None], (n, n)),
+        jnp.broadcast_to(ids[None, :], (n, n)),
+    )
+
+
+def _merge_block(st, peer, ok, pay_off, pay_k):
+    """Merge a payload block of ``peer``'s view into every node's view.
+
+    Matched members merge by packed max (foca's update precedence);
+    unmatched entries overwrite slots at the rotating cursor (never
+    slot 0 — a node's own entry only changes through refutation)."""
+    n, k = st.member.shape
+    rows = jnp.arange(n, dtype=jnp.int32)
+    cols = jnp.arange(k, dtype=jnp.int32)
+    # the payload: pay_k contiguous view slots of the peer, from pay_off
+    src_slots = (pay_off[:, None] + cols[None, :pay_k]) % k  # (N, P)
+    inc_mem = st.member[peer[:, None], src_slots]  # (N, P)
+    inc_bel = st.belief[peer[:, None], src_slots]
+    inc_ok = ok[:, None] & (inc_mem >= 0)
+
+    # matched merge: for each of my slots, the best incoming belief
+    # about the same member
+    match = st.member[:, :, None] == jnp.where(
+        inc_ok, inc_mem, -2
+    )[:, None, :]  # (N, K, P)
+    best_in = jnp.max(
+        jnp.where(match, inc_bel[:, None, :], jnp.uint32(0)), axis=2
+    )
+    belief = jnp.maximum(st.belief, best_in)
+
+    # unmatched incoming entries fill rotating-cursor slots
+    matched_any = match.any(axis=1)  # (N, P)
+    fresh = inc_ok & ~matched_any & (
+        inc_mem != rows[:, None]
+    )  # never re-insert self
+    frank = jnp.cumsum(fresh.astype(jnp.int32), axis=1) - 1  # (N, P)
+    dst_slot = jnp.where(
+        fresh,
+        1 + (st.cursor[:, None] + frank - 1) % (k - 1),
+        k,  # OOB — dropped
+    )
+    member = st.member.at[rows[:, None], dst_slot].set(inc_mem, mode="drop")
+    belief = belief.at[rows[:, None], dst_slot].set(inc_bel, mode="drop")
+    cursor = 1 + (st.cursor - 1 + fresh.sum(axis=1, dtype=jnp.int32)) % (
+        k - 1
+    )
+    return st.replace(member=member, belief=belief, cursor=cursor)
+
+
+def swim_window_step(
+    cfg: SimConfig,
+    st: SwimWindowState,
+    key: jax.Array,
+    alive: jnp.ndarray,
+    reachable,  # callable (src, dst) -> bool mask, ground truth links
+    round_idx: jnp.ndarray,
+):
+    """One windowed SWIM round for every node at once."""
+    n, k = st.member.shape
+    rows = jnp.arange(n, dtype=jnp.int32)
+    k_tgt, k_ind, k_ex, k_ann = jax.random.split(key, 4)
+    rnd16 = round_idx.astype(jnp.uint32) & _SINCE_MASK
+    pay = min(max(cfg.swim_payload_members, 2), k)
+
+    # --- probe: one random KNOWN target each ---------------------------
+    slot = jax.random.randint(k_tgt, (n,), 1, k, dtype=jnp.int32)
+    tgt = st.member[rows, slot]
+    cur = st.belief[rows, slot]
+    probing = (
+        alive & (tgt >= 0) & (tgt != rows)
+        & (_status(cur) < jnp.uint32(DOWN))
+    )
+    tgt_c = jnp.where(tgt >= 0, tgt, 0)
+    direct_ack = probing & alive[tgt_c] & reachable(rows, tgt_c)
+    islot = jax.random.randint(
+        k_ind, (n, cfg.swim_indirect_probes), 1, k, dtype=jnp.int32
+    )
+    inter = st.member[rows[:, None], islot]
+    inter_c = jnp.where(inter >= 0, inter, 0)
+    ind_ok = (
+        (inter >= 0)
+        & alive[inter_c]
+        & alive[tgt_c][:, None]
+        & reachable(rows[:, None], inter_c)
+        & reachable(inter_c, tgt_c[:, None])
+    ).any(axis=1)
+    acked = direct_ack | (probing & ind_ok)
+    failed = probing & ~acked
+
+    newly_suspect = failed & (_status(cur) == jnp.uint32(ALIVE))
+    refuted_ack = acked & (_status(cur) == jnp.uint32(SUSPECT))
+    new_status = jnp.where(
+        newly_suspect, jnp.uint32(SUSPECT),
+        jnp.where(refuted_ack, jnp.uint32(ALIVE), _status(cur)),
+    )
+    new_since = jnp.where(newly_suspect, rnd16, cur & _SINCE_MASK)
+    new_b = (
+        (cur & ~(_STATUS_MASK | _SINCE_MASK))
+        | (new_status << _STATUS_SHIFT) | new_since
+    )
+    onehot = jnp.arange(k, dtype=jnp.int32)[None, :] == slot[:, None]
+    belief = jnp.where(
+        onehot & probing[:, None], new_b[:, None], st.belief
+    )
+    st = st.replace(belief=belief)
+
+    # --- suspicion timeout → down --------------------------------------
+    elapsed = (rnd16 - (st.belief & _SINCE_MASK)) & _SINCE_MASK
+    timed_out = (
+        (_status(st.belief) == jnp.uint32(SUSPECT))
+        & (elapsed >= jnp.uint32(cfg.swim_suspect_rounds))
+        & alive[:, None]
+        & (st.member >= 0)
+    )
+    st = st.replace(belief=jnp.where(
+        timed_out, (st.belief & ~_STATUS_MASK) | _DOWN_KEY, st.belief
+    ))
+
+    # --- pull exchanges with known believed-up members -----------------
+    for g in range(cfg.swim_gossip_peers):
+        kg_s, kg_o = jax.random.split(jax.random.fold_in(k_ex, g))
+        pslot = jax.random.randint(kg_s, (n,), 1, k, dtype=jnp.int32)
+        peer = st.member[rows, pslot]
+        pb = st.belief[rows, pslot]
+        peer_c = jnp.where(peer >= 0, peer, 0)
+        ok = (
+            alive & (peer >= 0) & (peer != rows)
+            & ((pb & _STATUS_MASK) < _DOWN_KEY)
+            & alive[peer_c] & reachable(rows, peer_c)
+        )
+        off = jax.random.randint(kg_o, (n,), 0, k, dtype=jnp.int32)
+        st = _merge_block(st, peer_c, ok, off, pay)
+
+    # --- periodic announce: uniform-random member, ground-truth gated --
+    def do_announce(st):
+        ka_t, ka_o = jax.random.split(k_ann)
+        peer = jax.random.randint(ka_t, (n,), 0, n, dtype=jnp.int32)
+        ok = (
+            alive & (peer != rows) & alive[peer] & reachable(rows, peer)
+        )
+        off = jax.random.randint(ka_o, (n,), 0, k, dtype=jnp.int32)
+        return _merge_block(st, peer, ok, off, pay)
+
+    st = jax.lax.cond(
+        (round_idx % cfg.swim_announce_interval) < cfg.swim_interval,
+        do_announce, lambda s: s, st,
+    )
+
+    # --- refutation / identity renew (slot 0 = self) -------------------
+    self_b = st.belief[:, 0]
+    need_refute = alive & ((self_b & _STATUS_MASK) > jnp.uint32(0))
+    inc_next = jnp.minimum(
+        (self_b >> _INC_SHIFT) + 1, jnp.uint32(INC_MAX)
+    )
+    st = st.replace(belief=st.belief.at[:, 0].set(
+        jnp.where(need_refute, inc_next << _INC_SHIFT, self_b)
+    ))
+
+    tracked = st.member >= 0
+    metrics = {
+        "swim_suspects": (
+            (_status(st.belief) == jnp.uint32(SUSPECT))
+            & tracked & alive[:, None]
+        ).sum(dtype=jnp.int32),
+        "swim_down": (
+            (_status(st.belief) >= jnp.uint32(DOWN))
+            & tracked & alive[:, None]
+        ).sum(dtype=jnp.int32),
+        "swim_probe_failures": failed.sum(dtype=jnp.int32),
+    }
+    return st, metrics

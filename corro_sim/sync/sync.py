@@ -84,7 +84,12 @@ def choose_sync_peers(
     ).sum(axis=-1, dtype=jnp.int32)  # (N, C)
 
     rows = jnp.arange(n, dtype=jnp.int32)
-    if view_alive.shape[0] == 1:
+    if callable(view_alive):
+        # windowed SWIM: per-pair membership test over K-entry views
+        believed = view_alive(
+            jnp.broadcast_to(rows[:, None], cand.shape), cand
+        )
+    elif view_alive.shape[0] == 1:
         believed = view_alive[0][cand]
     else:
         believed = view_alive[rows[:, None], cand]

@@ -602,7 +602,14 @@ def render_prometheus(cluster) -> str:
 
     # ---- SWIM state breakdown (gossip/SWIM counts, broadcast/mod.rs)
     if cluster.cfg.swim_enabled:
-        status = np.asarray(cluster.state.swim.status)
+        sw = cluster.state.swim
+        if hasattr(sw, "member"):  # windowed O(N·K) belief state
+            tracked = np.asarray(sw.member) >= 0
+            status = np.asarray(sw.status) * tracked
+            self_inc = np.asarray(sw.self_inc).max()
+        else:
+            status = np.asarray(sw.status)
+            self_inc = np.asarray(sw.inc).diagonal().max()
         emit(
             "corro_swim_suspected_entries", "gauge",
             "suspect beliefs across all (observer, member) pairs",
@@ -616,7 +623,7 @@ def render_prometheus(cluster) -> str:
         emit(
             "corro_swim_incarnation_max", "gauge",
             "highest self-incarnation (refutation count)",
-            int(np.asarray(cluster.state.swim.inc).diagonal().max()),
+            int(self_inc),
         )
 
     # ---- tracing (tokio-metrics / runtime introspection analog)

@@ -147,3 +147,83 @@ def test_concurrent_pushes_merge_by_precedence():
     # suspicion of node 3
     assert (inc[:, 3] >= 2).all()
     assert (status[:, 3] == ALIVE).all()
+
+
+def test_windowed_swim_detects_and_heals():
+    """The windowed O(N·K) belief state (VERDICT r4 #8) detects a dead
+    member (views go suspect→down), keeps gossiping among the living,
+    and a returning member is re-admitted (refutation + announce pulls).
+    Behavioral, not bitwise: the windowed automaton is a documented
+    prototype divergence (pull-only exchange, rotating eviction)."""
+    import dataclasses
+
+    import numpy as np
+
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule, run_sim
+    from corro_sim.engine.state import init_state
+
+    n = 32
+    cfg = SimConfig(
+        num_nodes=n, num_rows=32, num_cols=2, log_capacity=256,
+        write_rate=0.3, swim_enabled=True, swim_view_size=16,
+        swim_suspect_rounds=4, sync_interval=4, sync_adaptive=True,
+        sync_floor_rounds=1,
+    )
+    down = np.zeros(n, bool)
+    down[3] = True
+
+    def alive_fn(r, num):
+        if 4 <= r < 20:
+            return ~down
+        return np.ones(num, bool)
+
+    res = run_sim(
+        cfg, init_state(cfg, seed=5),
+        Schedule(write_rounds=12, alive_fn=alive_fn),
+        max_rounds=256, chunk=8, seed=5, min_rounds=24,
+    )
+    # the cluster converged (node 3's catch-up included)
+    assert res.converged_round is not None
+    # failure detection engaged while node 3 was down: some views held a
+    # suspect or down belief at some point
+    assert (res.metrics["swim_suspects"] + res.metrics["swim_down"]).max() > 0
+    # and the final state holds node 3 alive again in the views that
+    # track it (re-admission after refutation)
+    sw = res.state.swim
+    member = np.asarray(sw.member)
+    belief = np.asarray(sw.belief)
+    tracks = member == 3
+    down_beliefs = ((belief >> 16) & 3 >= 2) & tracks
+    assert down_beliefs.sum() < max(tracks.sum(), 1), (
+        "node 3 still believed down everywhere after rejoining"
+    )
+
+
+def test_windowed_swim_admin_surfaces():
+    """members() / rejoin / membership-states admin paths work on the
+    windowed belief state (they read self-incarnation from slot 0 and
+    aggregate per-member beliefs from the K-entry views)."""
+    from corro_sim.harness.cluster import LiveCluster
+
+    c = LiveCluster(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v INTEGER NOT NULL "
+        "DEFAULT 0);",
+        num_nodes=4,
+        cfg_overrides={"swim_enabled": True, "swim_view_size": 4},
+    )
+    try:
+        mem = c.members()
+        assert [m["incarnation"] for m in mem] == [0, 0, 0, 0]
+        out = c.rejoin(2)
+        assert out["incarnation"] == 1
+        assert c.members()[2]["incarnation"] == 1
+        from corro_sim.admin import AdminServer
+
+        srv = AdminServer.__new__(AdminServer)
+        srv.cluster = c
+        states = srv._cmd_cluster_membership_states({})
+        assert states["swim_enabled"] and len(states["incarnation"]) == 4
+        assert states["incarnation"][2] == 1
+    finally:
+        c.tripwire.trip()
