@@ -455,19 +455,25 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
     mesh = make_mesh(devices) if len(devices) > 1 else None
 
     def mk_cfg(n):
-        # Catch-up math sizes the budgets: ~30% victims each miss ~5-6
-        # versions of essentially every live actor (~0.7*n of them), so
-        # repair needs ~0.7*n/K' full-budget sweeps. K'=512 with the
-        # dense hot-actor schedule's SEQUENTIAL window rotation covers
-        # the hot set in ~n/512 sweeps at floor cadence — the r4 config
-        # (K'=128 every 4th round) needed ~1100 rounds and could never
-        # finish inside a day on the CPU mesh (BENCH_config5_r5_attempt1).
+        # Catch-up at this scale is an EPIDEMIC, not a budget problem:
+        # right after the outage ends, each written version's holders are
+        # few (the writer + whatever gossip reached), and the 3-inbound
+        # server semaphore means an actor's holder set can only grow ~4x
+        # per sweep IN WHICH SOMEBODY REQUESTS THAT ACTOR. A narrow
+        # shared hot window synchronizes the whole cluster onto one
+        # actor cohort per sweep, so each actor is serviced once per
+        # full rotation — measured on a ratio-matched 4k repro:
+        # window 64 converged at round 381, window 1024 at round 125
+        # (doc/round5.md). The window must keep the rotation SHORT
+        # (hot/window ~4-8): 8192 at 50k. cap 16 drains an actor's whole
+        # backlog in one visit; 4 peer slots suffice (the semaphore
+        # grants ~3) and halve the dense capability planes.
         return SimConfig(
             num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
             write_rate=0.2, swim_enabled=False, sync_interval=4,
-            sync_adaptive=True, sync_floor_rounds=1,
-            sync_actor_topk=512, sync_cap_per_actor=8,
-            sync_req_actors=512, sync_hot_actors=512,
+            sync_adaptive=True, sync_floor_rounds=1, sync_peers=4,
+            sync_actor_topk=512, sync_cap_per_actor=16,
+            sync_req_actors=512, sync_hot_actors=8192,
         )
 
     sized_reason = None
