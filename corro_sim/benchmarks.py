@@ -571,11 +571,54 @@ CONFIGS = {0: run_north_star, 1: run_config_1, 2: run_config_2,
            3: run_config_3, 4: run_config_4, 5: run_config_5}
 
 
+def _device_preflight(timeout_s: int = 240) -> str | None:
+    """One trivial device op in a KILLABLE subprocess: the axon tunnel
+    can die in a way that makes every dispatch hang forever inside C
+    code (observed round 5 — SIGALRM never fires because the
+    interpreter never regains control). A hung benchmark leaves NO
+    artifact, which is worse than an honest error line."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp;"
+             "print(int(jnp.sum(jnp.arange(16.0)).block_until_ready()))"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"device unresponsive after {timeout_s}s"
+    if r.returncode != 0 or "120" not in r.stdout:
+        return f"device probe failed (rc={r.returncode}): {r.stderr[-200:]}"
+    return None
+
+
 def main(config: int | None = None, **kw) -> int:
     """Default (no config): the honest north-star comparison (config 0)."""
+    cfg_id = config if config is not None else 0
+    # preflight BEFORE anything imports jax in THIS process: with a dead
+    # tunnel even `import jax` hangs un-interruptibly in C. Opt out with
+    # CORRO_BENCH_NO_PREFLIGHT=1 (saves one subprocess jax import when
+    # the device is known healthy).
+    if not os.environ.get("CORRO_BENCH_NO_PREFLIGHT"):
+        err = _device_preflight()
+        if err is not None:
+            fn_name = CONFIGS.get(cfg_id, run_north_star).__name__
+            print(json.dumps({
+                "metric": f"bench_{fn_name}_unmeasured",
+                "value": None,
+                "vs_baseline": None,
+                "error": f"device preflight failed: {err}",
+                "note": "the compute device is unreachable — no "
+                        "measurement is possible (last good north-star "
+                        "capture: doc/round5.md, 5.90 s, "
+                        "vs_baseline 0.192)",
+            }))
+            return 1
     from corro_sim.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
-    fn = CONFIGS.get(config if config is not None else 0, run_north_star)
+    fn = CONFIGS.get(cfg_id, run_north_star)
     print(json.dumps(fn(**kw)))
     return 0
