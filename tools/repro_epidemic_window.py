@@ -1,0 +1,61 @@
+"""Ratio-matched repro of the config-5 repair-epidemic starvation
+(doc/round5.md): a narrow shared hot window synchronizes the cluster
+onto one actor cohort per sync sweep, so each actor's holder set (capped
+to ~4x growth per serviced sweep by the reference's 3-inbound semaphore)
+only grows once per full window rotation.
+
+    python tools/repro_epidemic_window.py          # WIN=64: starved
+    WIN=1024 CAP=16 python tools/repro_epidemic_window.py   # healthy
+
+Measured 2026-08-01 (4096 nodes, 30% outage, hot/window ~44 vs ~2.7):
+window 64/cap 8 converged at round 381; window 1024/cap 16 at round 125
+with per-chunk sync throughput accelerating 2.4e6 -> 5.4e6 as holders
+multiply.
+"""
+
+import os
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule, run_sim
+    from corro_sim.engine.state import init_state
+
+    n = int(os.environ.get("NODES", "4096"))
+    win = int(os.environ.get("WIN", "64"))
+    cap = int(os.environ.get("CAP", "8"))
+    cfg = SimConfig(
+        num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
+        write_rate=0.2, swim_enabled=False, sync_interval=4,
+        sync_adaptive=True, sync_floor_rounds=1,
+        sync_actor_topk=64, sync_cap_per_actor=cap,
+        sync_req_actors=64, sync_hot_actors=win,
+    )
+    write_rounds = 24
+    down = np.arange(n) < int(n * 0.3)
+
+    def alive_fn(r, num):
+        return ~down if r < write_rounds else np.ones(num, bool)
+
+    res = run_sim(
+        cfg, init_state(cfg, seed=0),
+        Schedule(write_rounds=write_rounds, alive_fn=alive_fn),
+        max_rounds=400, chunk=8, seed=0, min_rounds=write_rounds + 1,
+    )
+    m = res.metrics
+    print(f"WIN={win} CAP={cap} converged={res.converged_round} "
+          f"rounds={res.rounds}")
+    for ci in range(24, min(res.rounds, 96), 8):
+        sl = slice(ci, ci + 8)
+        print(f"  r{ci}..{ci + 8} gap_end={m['gap'][sl][-1]:.3e} "
+              f"sync_v={m['sync_versions'][sl].sum():.3e}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
