@@ -173,6 +173,28 @@ class AdminServer:
             spans = tracer.recent(n=n, name=name)
         return {"spans": [s.as_json() for s in spans]}
 
+    def _cmd_flight(self, req):
+        """Flight-recorder timeline: per-round metrics, annotations,
+        derived convergence diagnostics. ``diag_only`` trims the body to
+        the diagnostics; ``n`` keeps only the last n rounds; ``export``
+        additionally dumps the full ND-JSON to a path server-side."""
+        fl = getattr(self.cluster, "flight", None)
+        if fl is None:
+            raise AdminError("no flight recorder attached")
+        export = req.get("export")
+        if export:
+            fl.dump(str(export))
+        if req.get("diag_only"):
+            return {"diagnostics": fl.diagnostics(),
+                    **({"exported": export} if export else {})}
+        n = req.get("n")
+        if n is not None and int(n) < 0:
+            raise AdminError("n must be >= 0")
+        out = fl.timeline(last_rounds=int(n) if n else None)
+        if export:
+            out["exported"] = export
+        return out
+
     # ------------------------------------------------------------- db lock
     # `corrosion db lock "cmd"` holds exclusive byte-range locks on the DB
     # while a shell command runs (``main.rs:492-530``,

@@ -214,6 +214,8 @@ class _Handler(BaseHTTPRequestHandler):
                 name,
                 lambda: self._post_table_stats(params, body={"tables": []}),
             )
+        elif path == "/v1/flight":
+            self._traced(name, lambda: self._get_flight(params))
         elif path == "/metrics":
             self._traced(name, self._get_metrics)
         else:
@@ -379,6 +381,32 @@ class _Handler(BaseHTTPRequestHandler):
                 "tables": picked,
             }
         )
+
+    def _get_flight(self, params):
+        """GET /v1/flight — the cluster's per-round telemetry timeline.
+
+        ``?n=K`` trims to the last K rounds; ``?format=ndjson`` returns
+        the raw ND-JSON export (loadable by ``FlightRecorder.load``)."""
+        fl = getattr(self.api.cluster, "flight", None)
+        if fl is None:
+            raise _ApiError(404, "no flight recorder attached")
+        if params.get("format") == "ndjson":
+            body = fl.to_ndjson().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        last = None
+        if params.get("n"):
+            try:
+                last = int(params["n"])
+            except ValueError:
+                raise _ApiError(400, "n must be an integer") from None
+            if last < 0:
+                raise _ApiError(400, "n must be >= 0")
+        self._send_json(fl.timeline(last_rounds=last))
 
     def _get_metrics(self):
         from corro_sim.utils.metrics import render_prometheus

@@ -22,6 +22,13 @@ import numpy as np
 
 REFERENCE_CHANGES_PER_SEC = 156.04  # doc/quick-start.md:121
 
+# The bench invocation's shared flight recorder (set by main()): every
+# run_sim leg journals its per-round timeline to an ND-JSON file next to
+# the one-line BENCH JSON, chunk by chunk — so a run that dies mid-flight
+# (round 5's "device unresponsive after 240s") still leaves a replayable
+# curve up to its last completed chunk.
+_FLIGHT = None
+
 # The devcluster stand-in leg, FROZEN (VERDICT r3 weak #4 / next #8): the
 # 64-agent wall recorded in BENCH_r03.json with the config fingerprint it
 # was measured under. vs_baseline is computed against this frozen wall so
@@ -117,6 +124,10 @@ def run_headline_bench(
         new_state, m = run_chunk(state, ci, rounds + chunk)
         m = jax.tree.map(np.asarray, m)
         wall = time.perf_counter() - t0
+        if _FLIGHT is not None:
+            _FLIGHT.record_rounds(rounds + chunk + 1, m)
+            _FLIGHT.annotate(rounds + 2 * chunk, "chunk", chunk=ci,
+                             runner="full", wall_s=round(wall, 6))
         del state
         state = new_state
         applied = int(m["writes"].sum()) + int(m["fresh"].sum()) + int(
@@ -231,13 +242,18 @@ def run_north_star(n: int | None = None) -> dict:
     chunk = 8
     runs = []
     converged_round = None
-    for _ in range(repeats):
+    for rep in range(repeats):
         chunk_log: list[dict] = []
         res = run_sim(
             cfg, init_state(cfg, seed=0),
             Schedule(write_rounds=write_rounds, part_fn=part_fn),
             max_rounds=1024, chunk=chunk, seed=0,
             min_rounds=write_rounds + 8, on_chunk=chunk_log.append,
+            # repeats share a seed, so the CURVE is identical across
+            # them — journal only the first (mixing all three into one
+            # recorder would duplicate round indices and corrupt the
+            # exported diagnostics); per-repeat walls ship in `runs`
+            flight=_FLIGHT if rep == 0 else None,
         )
         jax.block_until_ready(res.state.table.vr)
         runs.append({
@@ -322,6 +338,10 @@ def run_config_1(inserts: int = 1000, nodes: int = 3) -> dict:
         schema, num_nodes=nodes, default_capacity=max(inserts + 16, 64),
         cfg_overrides={"log_capacity": max(2 * inserts, 1024)},
     )
+    if _FLIGHT is not None and _FLIGHT.sink_path:
+        # the live-path leg has its own recorder — journal it beside the
+        # sim leg's timeline
+        cluster.flight.attach_sink(_FLIGHT.sink_path + ".devcluster")
     # warm-up (compile) outside the timed window: single-round step,
     # chunked multi-round step, and the remap kernels
     cluster.execute(["INSERT INTO t (id, v) VALUES (0, 'warm')"])
@@ -365,6 +385,7 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
     res = run_sim(
         cfg, init_state(cfg, seed=0), schedule,
         max_rounds=max_rounds, chunk=8, seed=0, min_rounds=min_rounds,
+        flight=_FLIGHT,
     )
     return {
         "metric": label,
@@ -534,7 +555,7 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
         cfg, init_state(cfg, seed=0),
         Schedule(write_rounds=write_rounds, alive_fn=alive_fn),
         max_rounds=4096, chunk=8, seed=0, min_rounds=write_rounds + 1,
-        mesh=mesh, on_chunk=_flush,
+        mesh=mesh, on_chunk=_flush, flight=_FLIGHT,
     )
     out = {
         "metric": f"config5_{nodes}_node_outage_catchup_rounds",
@@ -620,5 +641,22 @@ def main(config: int | None = None, **kw) -> int:
 
     enable_compile_cache()
     fn = CONFIGS.get(cfg_id, run_north_star)
-    print(json.dumps(fn(**kw)))
+    # Flight-recorder timeline journaled NEXT TO the one-line JSON,
+    # flushed chunk-by-chunk: a run killed mid-flight still leaves the
+    # curve. CORRO_BENCH_FLIGHT overrides the path; "0" disables.
+    global _FLIGHT
+    flight_path = os.environ.get(
+        "CORRO_BENCH_FLIGHT", f"BENCH_flight_config{cfg_id}.ndjson"
+    )
+    if flight_path and flight_path != "0":
+        from corro_sim.obs.flight import FlightRecorder
+
+        _FLIGHT = FlightRecorder(sink_path=flight_path)
+        _FLIGHT.set_meta(bench_config=cfg_id)
+    try:
+        print(json.dumps(fn(**kw)))
+    finally:
+        if _FLIGHT is not None:
+            _FLIGHT.close()
+            _FLIGHT = None
     return 0

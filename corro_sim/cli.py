@@ -17,6 +17,7 @@ Command surface vs the reference's Command enum
   subs         — list / inspect subscriptions         [Command::Subs]
   locks        — lock registry dump                   [Command::Locks]
   traces       — recent tracer spans                  [telemetry analog]
+  flight       — per-round telemetry timeline         [flight recorder]
   db lock      — hold the write lock around a command [DbCommand::Lock]
   tls          — ca / server / client cert generation [Command::Tls]
   template     — render templates w/ live re-render   [Command::Template]
@@ -62,6 +63,18 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if getattr(args, flag) is not None
     }
     cfg = dataclasses.replace(cfg, **overrides).validate()
+    flight = None
+    if args.flight_out:
+        from corro_sim.obs.flight import FlightRecorder
+
+        # journaled chunk-by-chunk: a killed run still leaves the curve
+        flight = FlightRecorder(sink_path=args.flight_out)
+        if not flight.sink_active:
+            print(
+                f"warning: cannot write flight timeline to "
+                f"{args.flight_out!r} — continuing without it",
+                file=sys.stderr,
+            )
     res = run_sim(
         cfg,
         init_state(cfg, seed=args.seed),
@@ -69,7 +82,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
         max_rounds=args.max_rounds,
         chunk=args.chunk,
         seed=args.seed,
+        flight=flight,
     )
+    diag = res.flight.diagnostics()
     report = {
         "nodes": cfg.num_nodes,
         "converged_round": res.converged_round,
@@ -82,7 +97,16 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "compile_seconds": round(res.compile_seconds, 2),
         "sim_seconds_per_round": cfg.round_ms / 1000.0,
         "final_gap": float(np.asarray(res.metrics["gap"])[-1]),
+        # curve-shaped convergence diagnostics off the flight record
+        "gap_half_life_rounds": diag["gap_half_life_rounds"],
+        "epidemic_window_rounds": diag["epidemic_window_rounds"],
     }
+    if args.flight_out:
+        # a sink that died mid-run (ENOSPC, deleted dir) must not be
+        # reported as a written artifact
+        wrote = res.flight.sink_active
+        res.flight.close()
+        report["flight"] = args.flight_out if wrote else None
     if res.poisoned:
         # ring-wrap tripwire (engine/step.py): state may be silently wrong —
         # distinct from an ordinary round-budget miss (exit 3)
@@ -352,6 +376,11 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--max-rounds", type=int, default=4096)
     pr.add_argument("--chunk", type=int, default=16)
     pr.add_argument("--seed", type=int, default=0)
+    pr.add_argument(
+        "--flight-out",
+        help="journal the per-round flight-recorder timeline (ND-JSON) "
+             "to this path, chunk by chunk",
+    )
     pr.set_defaults(fn=_cmd_run)
 
     pb = sub.add_parser(
@@ -510,6 +539,21 @@ def build_parser() -> argparse.ArgumentParser:
     prl.add_argument("schema_files", nargs="+")
     prl.set_defaults(fn=_cmd_reload)
 
+    pfl = sub.add_parser(
+        "flight", help="per-round telemetry timeline (flight recorder)"
+    )
+    admin_args(pfl)
+    pfl.add_argument("-n", type=int, help="only the last N rounds")
+    pfl.add_argument(
+        "--diag", action="store_true",
+        help="print only the derived convergence diagnostics",
+    )
+    pfl.add_argument(
+        "--export", help="dump the full ND-JSON timeline to this path "
+        "(written by the agent process)",
+    )
+    pfl.set_defaults(fn=_cmd_flight)
+
     ptr = sub.add_parser("traces", help="recent spans from the tracer")
     admin_args(ptr)
     ptr.add_argument("-n", type=int, default=100)
@@ -624,6 +668,15 @@ def _cmd_reload(args) -> int:
     plan = client.schema_from_paths(args.schema_files)
     print(json.dumps(plan))
     return 0
+
+
+def _cmd_flight(args) -> int:
+    """Dump the agent's flight-recorder timeline (or just diagnostics)."""
+    return _print_json(
+        _admin(args).call(
+            "flight", n=args.n, diag_only=args.diag, export=args.export
+        )
+    )
 
 
 def _cmd_traces(args) -> int:

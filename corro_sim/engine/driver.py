@@ -27,6 +27,9 @@ import numpy as np
 from corro_sim.config import SimConfig
 from corro_sim.engine.state import SimState
 from corro_sim.engine.step import sim_step
+from corro_sim.obs.flight import FlightRecorder
+from corro_sim.utils.metrics import SECONDS_BUCKETS, counters, histograms
+from corro_sim.utils.tracing import tracer
 
 
 @dataclasses.dataclass
@@ -70,6 +73,7 @@ class RunResult:
     poisoned: bool = False  # change-log ring wrapped past a live laggard —
     # state may be silently wrong; convergence is never reported
     repair_chunks: int = 0  # chunks run on the repair-specialized program
+    flight: "FlightRecorder | None" = None  # per-round telemetry timeline
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -139,6 +143,7 @@ def run_sim(
     phase_specialize: bool = True,
     warmup: bool = True,
     on_chunk: Callable[[dict], None] | None = None,
+    flight: FlightRecorder | None = None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -152,8 +157,19 @@ def run_sim(
     ``on_chunk``: called after every executed chunk with a progress dict
     (chunk index, rounds done, cumulative wall, last gap/pend_live, which
     program ran, this chunk's wall). Long runs use it to flush partial
-    artifacts so a killed run still leaves evidence of how far it got."""
+    artifacts so a killed run still leaves evidence of how far it got.
+
+    ``flight``: a :class:`FlightRecorder` to fill with the per-round
+    metric timeline + annotations. One is created when not given, so
+    every run leaves a record (``RunResult.flight``); pass a recorder
+    with a ``sink_path`` to journal it to disk chunk by chunk."""
     schedule = schedule or Schedule()
+    if flight is None:
+        flight = FlightRecorder()
+    flight.set_meta(
+        driver="run_sim", nodes=cfg.num_nodes, chunk=chunk, seed=seed,
+        max_rounds=max_rounds,
+    )
     if min_rounds is None:
         min_rounds = schedule.write_rounds
     shardings = None
@@ -220,6 +236,7 @@ def run_sim(
     ci = 0
     repair_seen = False
     repair_chunks = 0
+    prev_writes = False
     while rounds < max_rounds:
         alive, part, we = schedule.slice(rounds, chunk, cfg.num_nodes)
         keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
@@ -239,16 +256,47 @@ def run_sim(
             )
             t0 = time.perf_counter()
             try:
-                repair_compiled = repair_runner.lower(*args).compile()
+                with tracer.span("aot lower+compile", program="repair",
+                                 slow_warn=False):
+                    repair_compiled = repair_runner.lower(*args).compile()
+                counters.inc(
+                    "corro_compile_total", labels='{program="repair"}',
+                    help_="XLA chunk-program compiles by program",
+                )
             except Exception:  # AOT unsupported on some backend
                 repair_compiled = None
+                counters.inc(
+                    "corro_compile_aot_fallback_total",
+                    labels='{program="repair"}',
+                    help_="AOT lower/compile failures falling back to jit",
+                )
+            c_done = time.perf_counter()
+            histograms.observe(
+                "corro_compile_seconds", c_done - t0,
+                labels='{program="repair"}',
+                help_="AOT lower+compile wall by program",
+            )
             if repair_compiled is not None and warmup and not donate:
                 # first execution of a program pays one-time platform
                 # initialization (~8 s over the tunnel) — burn it on a
                 # discarded run so every timed chunk runs warm
-                jax.block_until_ready(repair_compiled(*args)[0].round)
+                with tracer.span("warmup", program="repair",
+                                 slow_warn=False):
+                    jax.block_until_ready(repair_compiled(*args)[0].round)
+                flight.record_phase("warmup", time.perf_counter() - c_done)
             compile_seconds += time.perf_counter() - t0
+            flight.record_phase("compile", c_done - t0)
         first_repair_jit = use_repair and repair_compiled is None and not repair_seen
+        if use_repair and not repair_seen:
+            counters.inc(
+                "corro_repair_program_switches_total",
+                help_="post-quiesce switches to the repair-specialized "
+                      "chunk program",
+            )
+            flight.annotate(
+                rounds + 1, "repair_program_switch",
+                aot=repair_compiled is not None,
+            )
         if use_repair:
             repair_seen = True
             repair_chunks += 1
@@ -257,35 +305,86 @@ def run_sim(
         if ci == 0:
             t0 = time.perf_counter()
             try:
-                compiled = runner.lower(*args).compile()
+                with tracer.span("aot lower+compile", program="full",
+                                 slow_warn=False):
+                    compiled = runner.lower(*args).compile()
+                counters.inc(
+                    "corro_compile_total", labels='{program="full"}',
+                    help_="XLA chunk-program compiles by program",
+                )
             except Exception:  # AOT unsupported on some backend
                 compiled = None
+                counters.inc(
+                    "corro_compile_aot_fallback_total",
+                    labels='{program="full"}',
+                    help_="AOT lower/compile failures falling back to jit",
+                )
+            c_done = time.perf_counter()
+            histograms.observe(
+                "corro_compile_seconds", c_done - t0,
+                labels='{program="full"}',
+                help_="AOT lower+compile wall by program",
+            )
             # donated args must not be consumed by a throwaway run
             if compiled is not None and warmup and not donate:
-                jax.block_until_ready(compiled(*args)[0].round)
+                with tracer.span("warmup", program="full", slow_warn=False):
+                    jax.block_until_ready(compiled(*args)[0].round)
+                flight.record_phase("warmup", time.perf_counter() - c_done)
             # On fallback the failed-lowering wall still belongs to
             # compile accounting (ADVICE r3): chunk 0's mixed run adds on.
             compile_seconds = time.perf_counter() - t0
+            flight.record_phase("compile", c_done - t0)
             run_compiled = compiled
+        runner_name = "repair" if use_repair else "full"
         if run_compiled is None:
             # fallback: the first chunk through each program pays
             # compile+exec mixed and is excluded from the steady-state
             # wall (the pre-AOT accounting)
             t0 = time.perf_counter()
-            state, m = _exec(run_jit, run_jit, args)
+            with tracer.span("chunk", ci=ci, runner=runner_name,
+                             mode="jit"):
+                state, m = _exec(run_jit, run_jit, args)
             chunk_elapsed = time.perf_counter() - t0
             if ci == 0 or first_repair_jit:
                 compile_seconds += chunk_elapsed
+                flight.record_phase("compile", chunk_elapsed)
             else:
                 wall += chunk_elapsed
                 timed_rounds += chunk
+                flight.record_phase("execute", chunk_elapsed)
         else:
             t0 = time.perf_counter()
-            state, m = _exec(run_compiled, run_jit, args)
+            with tracer.span("chunk", ci=ci, runner=runner_name,
+                             mode="aot"):
+                state, m = _exec(run_compiled, run_jit, args)
             chunk_elapsed = time.perf_counter() - t0
             wall += chunk_elapsed
             timed_rounds += chunk
+            flight.record_phase("execute", chunk_elapsed)
+        counters.inc(
+            "corro_chunk_dispatch_total",
+            labels=f'{{runner="{runner_name}"}}',
+            help_="chunk dispatches by program",
+        )
+        histograms.observe(
+            "corro_chunk_wall_seconds", chunk_elapsed,
+            labels=f'{{runner="{runner_name}"}}',
+            help_="per-chunk execution wall by program",
+            buckets=SECONDS_BUCKETS,
+        )
         metrics_chunks.append(m)
+        flight.record_rounds(rounds + 1, m)
+        flight.annotate(
+            rounds + chunk, "chunk", chunk=ci, runner=runner_name,
+            wall_s=round(chunk_elapsed, 6),
+            aot=run_compiled is not None,
+        )
+        if prev_writes and not bool(we.any()):
+            # the schedule stopped writing — the measurement phase begins
+            flight.annotate(
+                rounds + 1, "schedule_transition", kind="write_phase_end",
+            )
+        prev_writes = bool(we.any())
         last_pend_live = int(m["pend_live"][-1])
         if _DEBUG_CHUNKS:
             import sys
@@ -317,6 +416,10 @@ def run_sim(
             # log_capacity, so gathers may have read overwritten slots.
             # Convergence can no longer be trusted — stop and poison.
             poisoned = True
+            wrapped_at = rounds - chunk + 1 + int(
+                np.argmax(np.asarray(m["log_wrapped"]) != 0)
+            )
+            flight.annotate(wrapped_at, "log_wrapped")
             break
         # Strictly greater: at rounds == min_rounds the round numbered
         # min_rounds (e.g. a scheduled rejoin) has not executed yet.
@@ -330,6 +433,7 @@ def run_sim(
                 idx = np.arange(1, chunk + 1) + base
                 eligible = (gaps == 0.0) & (idx > min_rounds)
                 converged_round = int(idx[np.argmax(eligible)])
+                flight.annotate(converged_round, "converged")
                 break
 
     # Drain the pipeline into the measured wall: the axon platform streams
@@ -339,7 +443,9 @@ def run_sim(
     # STATE, so the run is not done until the state is.
     t0 = time.perf_counter()
     jax.block_until_ready(state)
-    wall += time.perf_counter() - t0
+    drain = time.perf_counter() - t0
+    wall += drain
+    flight.record_phase("drain", drain)
     metrics = {
         k: np.concatenate([c[k] for c in metrics_chunks])
         for k in metrics_chunks[0]
@@ -354,4 +460,5 @@ def run_sim(
         timed_rounds=timed_rounds,
         poisoned=poisoned,
         repair_chunks=repair_chunks,
+        flight=flight,
     )

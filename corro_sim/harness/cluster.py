@@ -151,6 +151,14 @@ class LiveCluster:
         # per-queue health counters (corro.runtime.channel.* analog)
         from corro_sim.utils.metrics import ChannelMetrics
 
+        # flight recorder: the durable per-round telemetry timeline
+        # (GET /v1/flight, `corro-sim flight`, bench NDJSON artifacts)
+        from corro_sim.obs.flight import FlightRecorder
+
+        self.flight = FlightRecorder(capacity=16384)
+        self.flight.set_meta(
+            driver="live_cluster", nodes=num_nodes, seed=seed,
+        )
         self.channels = ChannelMetrics(histograms=self.histograms)
         self.channels.set_capacity("write_queue", 0)  # unbounded deques
         self.channels.set_capacity("subs_events", 0)
@@ -838,6 +846,13 @@ class LiveCluster:
 
     def _record_metrics(self, packed: np.ndarray, names: list) -> None:
         """Fold a (num_metrics, rounds) block into the running totals."""
+        # `k` is rebound by the metric-name loops below — keep the chunk
+        # round-count under its own name for the annotations at the end
+        k_rounds = packed.shape[1]
+        self.flight.record_rounds(
+            self._rounds_ticked - k_rounds + 1,
+            dict(zip(names, packed)),
+        )
         sums = packed.sum(axis=1)
         for k, v in zip(names, sums):
             self._totals[k] = self._totals.get(k, 0.0) + float(v)
@@ -873,6 +888,13 @@ class LiveCluster:
         if "log_wrapped" in names and packed[names.index("log_wrapped")].any():
             # ring-wrap tripwire (engine/step.py): state may be silently
             # wrong from here on — convergence must never be reported
+            if not self._log_poisoned:
+                row = packed[names.index("log_wrapped")]
+                self.flight.annotate(
+                    self._rounds_ticked - k_rounds + 1
+                    + int(np.argmax(row != 0)),
+                    "log_wrapped",
+                )
             self._log_poisoned = True
         self._totals["rounds"] = self._rounds_ticked
         # changes applied per round → the reference's chunk-size histogram
@@ -917,6 +939,14 @@ class LiveCluster:
             }
 
     def _tick_locked(self, rounds: int) -> None:
+        from corro_sim.utils.metrics import counters
+
+        if rounds > 0:
+            counters.inc(
+                "corro_chunk_dispatch_total", n=rounds,
+                labels='{runner="live_step"}',
+                help_="chunk dispatches by program",
+            )
         for _ in range(rounds):
             t0 = time.perf_counter()
             w = self._dequeue_writes()
@@ -965,6 +995,12 @@ class LiveCluster:
         but callers gate on _subs_active() to preserve per-round event
         granularity whenever someone is actually watching."""
         self._chunk_dispatches += 1
+        from corro_sim.utils.metrics import counters
+
+        counters.inc(
+            "corro_chunk_dispatch_total", labels='{runner="live_chunk"}',
+            help_="chunk dispatches by program",
+        )
         t0 = time.perf_counter()
         w = self._dequeue_writes_chunk(_CHUNK)
         self._observe_stage("dequeue", time.perf_counter() - t0, per=_CHUNK)
@@ -999,13 +1035,27 @@ class LiveCluster:
         a respace does). First XLA compile through the TPU tunnel is tens
         of seconds — an agent serving an API should pay it at boot, not on
         the first client transaction."""
+        from corro_sim.utils.metrics import counters, histograms
+        from corro_sim.utils.tracing import tracer
+
         with self.locks.tracked(self._lock, "warmup", "write"):
-            self._tick_locked(1)
-            if not self._subs_active():
-                self._tick_chunk_locked()
-            ranks = list(self.universe._ranks)
-            if ranks:
-                self._on_remap(ranks, ranks)
+            t0 = time.perf_counter()
+            with tracer.span("warmup", program="live", slow_warn=False):
+                self._tick_locked(1)
+                if not self._subs_active():
+                    self._tick_chunk_locked()
+                ranks = list(self.universe._ranks)
+                if ranks:
+                    self._on_remap(ranks, ranks)
+            counters.inc(
+                "corro_compile_total", labels='{program="live"}',
+                help_="XLA chunk-program compiles by program",
+            )
+            histograms.observe(
+                "corro_compile_seconds", time.perf_counter() - t0,
+                labels='{program="live"}',
+                help_="AOT lower+compile wall by program",
+            )
 
     def tick(self, rounds: int = 1) -> None:
         """Advance the cluster `rounds` gossip rounds (no new writes)."""
@@ -1149,11 +1199,20 @@ class LiveCluster:
         self._check_node(node)
         with self._lock:
             self._alive[node] = alive
+            self.flight.annotate(
+                self._rounds_ticked + 1, "schedule_transition",
+                kind="set_alive", node=node, alive=bool(alive),
+            )
 
     def set_partition(self, part: list[int]) -> None:
         with self._lock:
             assert len(part) == self.cfg.num_nodes
             self._part = np.asarray(part, np.int32)
+            self.flight.annotate(
+                self._rounds_ticked + 1, "schedule_transition",
+                kind="set_partition",
+                partitions=int(len(set(int(p) for p in part))),
+            )
 
     def rejoin(self, node: int) -> dict:
         """Admin `cluster rejoin` analog: revive with a *renewed identity*.

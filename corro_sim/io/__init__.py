@@ -1,4 +1,14 @@
-from corro_sim.io.config_file import load_config
 from corro_sim.io.values import ValueInterner, sqlite_sort_key
 
 __all__ = ["load_config", "ValueInterner", "sqlite_sort_key"]
+
+
+def __getattr__(name):
+    # lazy: config loading (and its TOML backend) must not be pulled in
+    # transitively by every `corro_sim.io.*` consumer — most of the
+    # package (values, columns, traces, checkpoint) never loads configs
+    if name == "load_config":
+        from corro_sim.io.config_file import load_config
+
+        return load_config
+    raise AttributeError(name)

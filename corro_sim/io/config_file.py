@@ -18,7 +18,14 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:  # 3.11+
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - version-dependent
+    try:  # the 3.10 backport, same API
+        import tomli as tomllib
+    except ModuleNotFoundError:
+        tomllib = None
 
 from corro_sim.config import SimConfig
 
@@ -46,8 +53,12 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
     values: dict = {}
 
     if path is not None:
-        with open(path, "rb") as fh:
-            doc = tomllib.load(fh)
+        if tomllib is not None:
+            with open(path, "rb") as fh:
+                doc = tomllib.load(fh)
+        else:
+            with open(path, encoding="utf-8") as fh:
+                doc = _parse_flat_toml(fh.read())
         table = doc.get("sim", doc)
         for k, v in table.items():
             if k not in fields:
@@ -60,3 +71,54 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
             values[k] = _coerce(field, env[env_key])
 
     return SimConfig(**values).validate()
+
+
+def _parse_flat_toml(text: str) -> dict:
+    """Minimal vendored parser for the flat ``[section]`` / ``key = value``
+    subset this config uses — the last-resort path when neither
+    ``tomllib`` (3.11+) nor ``tomli`` is importable. Values: booleans,
+    ints, floats, and single/double-quoted strings."""
+    doc: dict = {}
+    table = doc
+    for ln, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("["):
+            head = line.split("#", 1)[0].strip()  # `[sim]  # comment`
+            if not head.endswith("]"):
+                raise ValueError(
+                    f"config line {ln}: malformed table header {line!r}"
+                )
+            table = doc.setdefault(head[1:-1].strip(), {})
+            continue
+        key, eq, val = line.partition("=")
+        if not eq:
+            raise ValueError(f"config line {ln}: expected key = value")
+        key, val = key.strip(), val.strip()
+        if val and val[0] in "\"'":
+            # quoted string: ends at the matching quote; anything after
+            # it may only be a comment ('#' inside the quotes is data)
+            end = val.find(val[0], 1)
+            rest = val[end + 1:].strip() if end > 0 else "?"
+            if end <= 0 or (rest and not rest.startswith("#")):
+                raise ValueError(
+                    f"config line {ln} ({key}): malformed string {val!r}"
+                )
+            table[key] = val[1:end]
+            continue
+        val = val.split("#", 1)[0].strip()  # trailing comment
+        if val.lower() in ("true", "false"):
+            table[key] = val.lower() == "true"
+            continue
+        try:
+            table[key] = int(val)
+        except ValueError:
+            try:
+                table[key] = float(val)
+            except ValueError:
+                raise ValueError(
+                    f"config line {ln} ({key}): unsupported value "
+                    f"{val!r}"
+                ) from None
+    return doc

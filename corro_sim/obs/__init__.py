@@ -1,0 +1,3 @@
+from corro_sim.obs.flight import FlightRecorder
+
+__all__ = ["FlightRecorder"]

@@ -22,15 +22,32 @@ import datetime
 import ipaddress
 import ssl
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    _CRYPTOGRAPHY_ERROR = None
+except ModuleNotFoundError as _e:  # optional dep: fail at USE time with
+    # a clear message, not at import time (importing corro_sim.tls must
+    # stay safe for environments without the package)
+    x509 = hashes = serialization = ec = NameOID = None
+    _CRYPTOGRAPHY_ERROR = _e
 
 _DAY = datetime.timedelta(days=1)
 
 
+def _require_cryptography() -> None:
+    if _CRYPTOGRAPHY_ERROR is not None:
+        raise RuntimeError(
+            "corro_sim.tls certificate generation requires the "
+            "'cryptography' package (pip install cryptography)"
+        ) from _CRYPTOGRAPHY_ERROR
+
+
 def _keypair():
+    _require_cryptography()
     return ec.generate_private_key(ec.SECP384R1())
 
 
@@ -84,6 +101,7 @@ def generate_ca() -> tuple[str, str]:
 
 
 def _load_ca(ca_cert_pem: str, ca_key_pem: str):
+    _require_cryptography()
     ca_cert = x509.load_pem_x509_certificate(ca_cert_pem.encode())
     ca_key = serialization.load_pem_private_key(ca_key_pem.encode(), None)
     return ca_cert, ca_key

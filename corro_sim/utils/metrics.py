@@ -13,6 +13,8 @@ Prometheus exporter does the same mangling): e.g.
 
 from __future__ import annotations
 
+import bisect
+
 import numpy as np
 
 # step-metric key → (prometheus name, type, help)
@@ -83,12 +85,9 @@ class Histogram:
         self.count = 0
 
     def observe(self, value: float) -> None:
-        i = 0
-        for b in self.buckets:
-            if value <= b:
-                break
-            i += 1
-        self.counts[i] += 1
+        # first bucket with value <= bound (bisect: this sits on hot
+        # instrumentation paths — per-chunk, per-drain, per-request)
+        self.counts[bisect.bisect_left(self.buckets, value)] += 1
         self.sum += value
         self.count += 1
 
@@ -625,6 +624,31 @@ def render_prometheus(cluster) -> str:
             "highest self-incarnation (refutation count)",
             int(self_inc),
         )
+
+    # ---- flight recorder summary gauges (the durable per-round
+    # timeline; full curve via GET /v1/flight / `corro-sim flight`)
+    fl = getattr(cluster, "flight", None)
+    if fl is not None:
+        diag = fl.diagnostics()
+        emit("corro_flight_rounds_recorded", "gauge",
+             "rounds held in the flight-recorder ring",
+             diag["rounds_recorded"])
+        emit("corro_flight_events_recorded", "gauge",
+             "annotation events held in the flight recorder",
+             diag["events_recorded"])
+        emit("corro_flight_converged_round", "gauge",
+             "first round of the trailing gap==0 run (-1: not converged)",
+             diag["converged_round"]
+             if diag["converged_round"] is not None else -1)
+        if diag["gap_half_life_rounds"] is not None:
+            emit("corro_flight_gap_half_life_rounds", "gauge",
+                 "gossip mixing rate: rounds for the gap to halve "
+                 "(log-linear fit over the decay tail)",
+                 diag["gap_half_life_rounds"])
+        if diag["epidemic_window_rounds"] is not None:
+            emit("corro_flight_epidemic_window_rounds", "gauge",
+                 "rounds the gap spent above 10% of its peak",
+                 diag["epidemic_window_rounds"])
 
     # ---- tracing (tokio-metrics / runtime introspection analog)
     from corro_sim.utils.tracing import tracer as _tracer

@@ -23,6 +23,7 @@ The TPU-native equivalents here:
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import logging
 import os
@@ -112,15 +113,24 @@ class Tracer:
     def __init__(self, capacity: int = 2048, slow_warn_s: float = 1.0):
         self.capacity = capacity
         self.slow_warn_s = slow_warn_s
-        self._spans: list[Span] = []
+        # deque(maxlen=...) evicts in O(1) per append; the old list +
+        # del-slicing ring paid an O(capacity) shift on every overflow —
+        # this sits on the hot instrumentation path (every span end)
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=capacity
+        )
         self._lock = threading.Lock()
         self._local = threading.local()
 
     # --------------------------------------------------------- recording
     @contextlib.contextmanager
-    def span(self, name: str, parent: TraceContext | None = None, **attrs):
+    def span(self, name: str, parent: TraceContext | None = None,
+             slow_warn: bool = True, **attrs):
         """Context manager recording one span. Child spans inside inherit
-        the current span's context unless ``parent`` overrides it."""
+        the current span's context unless ``parent`` overrides it.
+        ``slow_warn=False`` opts out of the slow-span watchdog — for
+        spans that are *expected* to run long (XLA compiles), where the
+        warning would be noise rather than signal."""
         cur = getattr(self._local, "ctx", None)
         if parent is None:
             parent = cur
@@ -139,10 +149,8 @@ class Tracer:
                 parent.span_id if parent else None, t0, dur, attrs,
             )
             with self._lock:
-                self._spans.append(sp)
-                if len(self._spans) > self.capacity:
-                    del self._spans[: len(self._spans) - self.capacity]
-            if dur > self.slow_warn_s:
+                self._spans.append(sp)  # maxlen evicts the oldest
+            if slow_warn and dur > self.slow_warn_s:
                 # foca-loop slow-branch watchdog (broadcast/mod.rs:317-321)
                 log.warning("slow span %r took %.3fs", name, dur)
 

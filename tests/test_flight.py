@@ -1,0 +1,244 @@
+"""Flight recorder: the durable per-round telemetry timeline.
+
+Covers the recorder itself (record → export → load round-trips
+bit-identically; derived convergence diagnostics), both producers
+(``run_sim`` and ``LiveCluster``), and every read surface (``GET
+/v1/flight``, the admin ``flight`` command, Prometheus summary gauges).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from corro_sim.obs.flight import FlightRecorder
+
+SCHEMA = """
+CREATE TABLE kv (
+    k TEXT NOT NULL PRIMARY KEY,
+    v TEXT NOT NULL DEFAULT ''
+);
+"""
+
+
+def _synthetic() -> FlightRecorder:
+    """An exponential gap decay: 64 / 2^(r/4) — half-life 4 rounds."""
+    fl = FlightRecorder()
+    fl.set_meta(driver="test", nodes=8)
+    gaps = [0.0, 16.0, 64.0] + [64.0 * 2 ** (-(r - 2) / 4.0)
+                                for r in range(3, 28)] + [0.0, 0.0]
+    fl.record_rounds(1, {"gap": gaps, "pend_live": [1.0] * len(gaps)})
+    fl.annotate(2, "schedule_transition", kind="write_phase_end")
+    fl.annotate(16, "chunk", chunk=0, runner="full", wall_s=0.5)
+    fl.annotate(30, "chunk", chunk=1, runner="repair", wall_s=0.25)
+    fl.record_phase("compile", 1.5)
+    fl.record_phase("execute", 0.75)
+    return fl
+
+
+def test_diagnostics_convergence_curve():
+    d = _synthetic().diagnostics()
+    assert d["rounds_recorded"] == 30
+    assert d["peak_gap"] == 64.0
+    assert d["final_gap"] == 0.0
+    # trailing zero run starts at round 29
+    assert d["converged_round"] == 29
+    # constructed half-life is exactly 4 rounds; the log-linear fit sees
+    # the decaying tail only
+    assert d["gap_half_life_rounds"] == pytest.approx(4.0, rel=0.05)
+    assert d["epidemic_window_rounds"] >= 1
+    assert d["wall_s_by_phase"] == {"compile": 1.5, "execute": 0.75}
+    assert d["chunk_wall_s_by_runner"] == {"full": 0.5, "repair": 0.25}
+
+
+def test_not_converged_and_poisoned():
+    fl = FlightRecorder()
+    fl.record_rounds(1, {"gap": [4.0, 2.0, 1.0]})
+    assert fl.diagnostics()["converged_round"] is None
+    fl2 = FlightRecorder()
+    fl2.record_rounds(1, {"gap": [4.0, 0.0]})
+    fl2.annotate(2, "log_wrapped")
+    d = fl2.diagnostics()
+    # a poisoned run never reports convergence, whatever the gap says
+    assert d["poisoned"] is True and d["converged_round"] is None
+
+
+def test_ndjson_roundtrip_bit_identical(tmp_path):
+    fl = _synthetic()
+    p1, p2 = str(tmp_path / "a.ndjson"), str(tmp_path / "b.ndjson")
+    fl.dump(p1)
+    back = FlightRecorder.load(p1)
+    back.dump(p2)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+    assert back.diagnostics() == fl.diagnostics()
+    assert back.timeline() == fl.timeline()
+
+
+def test_load_tolerates_torn_tail(tmp_path):
+    fl = _synthetic()
+    p = str(tmp_path / "torn.ndjson")
+    fl.dump(p)
+    with open(p, "a") as f:
+        f.write('{"t": "round", "r": 99, "m": {"ga')  # killed mid-write
+    back = FlightRecorder.load(p)
+    assert back.diagnostics()["rounds_recorded"] == 30
+
+
+def test_sink_journal_matches_state(tmp_path):
+    p = str(tmp_path / "journal.ndjson")
+    fl = FlightRecorder(sink_path=p)
+    fl.set_meta(driver="test")
+    fl.record_rounds(1, {"gap": [2.0, 0.0]})
+    fl.annotate(2, "converged")
+    fl.close()
+    back = FlightRecorder.load(p)
+    assert back.series("gap") == ([1, 2], [2.0, 0.0])
+    assert back.diagnostics()["converged_round"] == 2
+
+
+def test_ring_is_bounded():
+    fl = FlightRecorder(capacity=8)
+    fl.record_rounds(1, {"gap": list(range(32, 0, -1))})
+    rs, _ = fl.series("gap")
+    assert rs == list(range(25, 33))
+
+
+def test_run_sim_produces_flight():
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule, run_sim
+    from corro_sim.engine.state import init_state
+
+    cfg = SimConfig(
+        num_nodes=8, num_rows=16, num_cols=1, log_capacity=64,
+        write_rate=0.5, swim_enabled=False, sync_interval=4,
+    )
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), Schedule(write_rounds=4),
+        max_rounds=64, chunk=4, seed=0,
+    )
+    fl = res.flight
+    assert fl is not None
+    d = fl.diagnostics()
+    assert d["rounds_recorded"] == res.rounds
+    assert d["final_gap"] == 0.0
+    # the flight record carries the full step-metric vector per round
+    rs, gaps = fl.series("gap")
+    assert gaps == [float(g) for g in res.metrics["gap"]]
+    assert rs[0] == 1 and rs[-1] == res.rounds
+    assert fl.series("pend_live")[1]
+    names = [e["name"] for e in fl.timeline()["events"]]
+    assert "chunk" in names and "converged" in names
+    assert "schedule_transition" in names  # write-phase end
+    assert set(d["wall_s_by_phase"]) >= {"compile", "execute", "drain"}
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    from corro_sim.harness.cluster import LiveCluster
+
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    c.execute(["INSERT INTO kv (k, v) VALUES ('a', '1')"])
+    c.tick(3)
+    return c
+
+
+def test_live_cluster_records(cluster):
+    d = cluster.flight.diagnostics()
+    assert d["rounds_recorded"] == cluster._rounds_ticked
+    assert cluster.flight.series("gap")[0][-1] == cluster._rounds_ticked
+
+
+def test_live_cluster_annotates_faults(cluster):
+    cluster.set_alive(1, False)
+    cluster.set_alive(1, True)
+    evs = [e for e in cluster.flight.timeline()["events"]
+           if e["name"] == "schedule_transition"]
+    assert evs and evs[-1]["attrs"] == {
+        "kind": "set_alive", "node": 1, "alive": True,
+    }
+
+
+def test_http_flight_endpoint(cluster):
+    from corro_sim.api.http import ApiServer
+
+    with ApiServer(cluster) as api:
+        tl = json.loads(
+            urllib.request.urlopen(api.url + "/v1/flight?n=2").read()
+        )
+        assert len(tl["rounds"]) == 2
+        assert tl["rounds"][-1]["r"] == cluster._rounds_ticked
+        assert "gap_half_life_rounds" in tl["diagnostics"]
+        nd = urllib.request.urlopen(
+            api.url + "/v1/flight?format=ndjson"
+        ).read().decode()
+        back = FlightRecorder.load(nd.splitlines())
+        assert (
+            back.diagnostics()["rounds_recorded"]
+            == cluster.flight.diagnostics()["rounds_recorded"]
+        )
+
+
+def test_admin_flight_command(cluster, tmp_path):
+    from corro_sim.admin import AdminClient, AdminServer
+
+    with AdminServer(cluster, str(tmp_path / "admin.sock")) as srv:
+        admin = AdminClient(srv.path)
+        diag = admin.call("flight", diag_only=True)["diagnostics"]
+        assert diag["rounds_recorded"] == cluster._rounds_ticked
+        out = str(tmp_path / "flight.ndjson")
+        resp = admin.call("flight", n=1, export=out)
+        assert len(resp["rounds"]) == 1 and resp["exported"] == out
+        assert FlightRecorder.load(out).diagnostics() == (
+            cluster.flight.diagnostics()
+        )
+
+
+def test_flight_gauges_in_prometheus(cluster):
+    from corro_sim.utils.metrics import render_prometheus
+
+    text = render_prometheus(cluster)
+    assert "corro_flight_rounds_recorded" in text
+    assert "corro_flight_converged_round" in text
+    # dispatch introspection counters ride the global registry
+    assert 'corro_chunk_dispatch_total{runner="live_step"}' in text
+
+
+def test_cli_flight_command(cluster, tmp_path, capsys):
+    from corro_sim.admin import AdminServer
+    from corro_sim.cli import main
+
+    with AdminServer(cluster, str(tmp_path / "cli.sock")) as srv:
+        rc = main(["flight", "--admin-path", srv.path, "--diag"])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["diagnostics"]["rounds_recorded"] == (
+            cluster._rounds_ticked
+        )
+
+
+def test_cluster_poison_annotation():
+    """The ring-wrap tripwire must annotate the flight record (and not
+    crash the tick path) — regression: a shadowed loop variable made
+    this raise TypeError on the first wrap."""
+    import numpy as np
+
+    from corro_sim.harness.cluster import LiveCluster
+
+    c = LiveCluster(SCHEMA, num_nodes=2, default_capacity=16)
+    names = sorted(["gap", "buffered_partials", "log_wrapped", "rounds"])
+    packed = np.zeros((len(names), 3), np.float32)
+    packed[names.index("log_wrapped"), 1] = 1.0
+    c._rounds_ticked = 3
+    c._record_metrics(packed, names)
+    assert c.log_poisoned
+    evs = [e for e in c.flight.timeline()["events"]
+           if e["name"] == "log_wrapped"]
+    assert evs and evs[0]["r"] == 2
+    assert c.flight.diagnostics()["converged_round"] is None
+
+
+def test_attach_sink_unwritable_is_survivable(tmp_path):
+    fl = _synthetic()
+    fl.attach_sink(str(tmp_path / "no-such-dir" / "x.ndjson"))
+    fl.record_rounds(100, {"gap": [1.0]})  # must not raise
+    assert fl.sink_path != str(tmp_path / "x.ndjson")

@@ -51,3 +51,27 @@ def test_interner_order_preserving():
     assert it.rank("b") < it.rank(2.0) < it.rank(1)
     with pytest.raises(RuntimeError):
         it.add("late")
+
+
+def test_vendored_flat_toml_parser():
+    """The last-resort parser (no tomllib, no tomli) handles the flat
+    [sim] subset: comments, quoted strings (including '#' inside),
+    bools, ints, floats — and names the line on bad values."""
+    import pytest
+
+    from corro_sim.io.config_file import _parse_flat_toml
+
+    doc = _parse_flat_toml(
+        "# header comment\n"
+        "[sim]\n"
+        "num_nodes = 1000  # trailing comment\n"
+        "write_rate = 0.3\n"
+        "swim_enabled = true\n"
+        'label = "node#3"\n'
+    )
+    assert doc["sim"] == {
+        "num_nodes": 1000, "write_rate": 0.3, "swim_enabled": True,
+        "label": "node#3",
+    }
+    with pytest.raises(ValueError, match="line 1 \\(bad\\)"):
+        _parse_flat_toml("bad = [1, 2]\n")

@@ -172,3 +172,75 @@ def test_series_width_and_histograms(cluster):
         'corro_agent_changes_processing_chunk_size_bucket{le="650.0"}'
         in text
     )
+
+
+def test_exposition_format_validates(cluster):
+    """Exposition-format validator: the contract a real Prometheus
+    scraper enforces — one # TYPE/# HELP per metric name, samples
+    parseable (name{labels} value), label syntax valid, every histogram's
+    buckets cumulative per label-set with the +Inf bucket equal to its
+    _count."""
+    import re
+
+    text = render_prometheus(cluster)
+    assert text.endswith("\n")
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+        r"(\{[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"{}]*\""
+        r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"{}]*\")*\})?"
+        r" (-?[0-9.eE+-]+|NaN|[+-]Inf)$"
+    )
+    types: dict[str, str] = {}
+    helps: set[str] = set()
+    hist_buckets: dict[tuple, list] = {}
+    hist_counts: dict[str, dict] = {}
+    for ln, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            assert name not in types, f"duplicate # TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram", "summary")
+            types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert name not in helps, f"duplicate # HELP for {name}"
+            helps.add(name)
+            continue
+        assert not line.startswith("#"), f"line {ln}: stray comment"
+        m = sample_re.match(line)
+        assert m, f"line {ln}: unparseable sample {line!r}"
+        name, labels = m.group(1), m.group(2) or ""
+        base = name
+        for sfx in ("_bucket", "_sum", "_count"):
+            if name.endswith(sfx) and name[: -len(sfx)] in types:
+                base = name[: -len(sfx)]
+                break
+        assert base in types, f"line {ln}: sample {name} missing # TYPE"
+        if types.get(base) == "histogram" and name.endswith("_bucket"):
+            le = re.search(r'le="([^"]*)"', labels)
+            assert le, f"line {ln}: histogram bucket without le label"
+            rest_labels = re.sub(r',?le="[^"]*"', "", labels)
+            hist_buckets.setdefault((base, rest_labels), []).append(
+                (le.group(1), float(m.group(4)))
+            )
+        if types.get(base) == "histogram" and name.endswith("_count"):
+            hist_counts.setdefault(base, {})[labels] = float(m.group(4))
+    assert types, "no # TYPE lines rendered"
+    # per-(family, label-set): cumulative counts, +Inf present and == count
+    for (base, rest_labels), buckets in hist_buckets.items():
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts), (
+            f"{base}{rest_labels}: buckets not cumulative"
+        )
+        assert buckets[-1][0] == "+Inf", f"{base}: last bucket not +Inf"
+        bounds = [float(b) for b, _ in buckets[:-1]]
+        assert bounds == sorted(bounds), f"{base}: le bounds not sorted"
+        total = hist_counts[base].get(rest_labels.replace("{}", "") or "")
+        if total is None:
+            total = hist_counts[base].get(rest_labels)
+        assert total == counts[-1], (
+            f"{base}{rest_labels}: +Inf bucket != _count"
+        )

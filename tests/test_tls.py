@@ -11,6 +11,8 @@ import io
 import ssl
 
 import pytest
+
+pytest.importorskip("cryptography")
 from cryptography import x509
 from cryptography.hazmat.primitives.asymmetric import ec
 
