@@ -195,6 +195,28 @@ class AdminServer:
             out["exported"] = export
         return out
 
+    def _cmd_probes(self, req):
+        """Probe-tracer provenance + the per-node lag observatory
+        (`corro-sim probes`). ``lag_only`` trims to the observatory;
+        ``export`` writes the NDJSON journal + Chrome trace JSON
+        server-side under the given path prefix."""
+        if req.get("lag_only"):
+            return {"node_lag": self.cluster.node_lag(
+                top_k=int(req.get("top", 8))
+            )}
+        out = self.cluster.probe_report()
+        export = req.get("export")
+        if export:
+            tr = self.cluster.probe_trace()
+            if tr is None:
+                raise AdminError(
+                    "probe tracer disabled — nothing to export"
+                )
+            tr.dump_ndjson(f"{export}.ndjson")
+            tr.dump_chrome_trace(f"{export}.trace.json")
+            out["exported"] = [f"{export}.ndjson", f"{export}.trace.json"]
+        return out
+
     # ------------------------------------------------------------- db lock
     # `corrosion db lock "cmd"` holds exclusive byte-range locks on the DB
     # while a shell command runs (``main.rs:492-530``,

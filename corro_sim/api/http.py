@@ -216,6 +216,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/v1/flight":
             self._traced(name, lambda: self._get_flight(params))
+        elif path == "/v1/probes":
+            self._traced(name, lambda: self._get_probes(params))
         elif path == "/metrics":
             self._traced(name, self._get_metrics)
         else:
@@ -407,6 +409,37 @@ class _Handler(BaseHTTPRequestHandler):
             if last < 0:
                 raise _ApiError(400, "n must be >= 0")
         self._send_json(fl.timeline(last_rounds=last))
+
+    def _get_probes(self, params):
+        """GET /v1/probes — probe-tracer provenance + lag observatory.
+
+        Default: JSON report (per-probe summaries with BFS stretch,
+        infection trees, node lag). ``?format=ndjson`` streams the raw
+        probe journal; ``?format=trace`` returns Chrome trace-event JSON
+        loadable in Perfetto / chrome://tracing."""
+        cluster = self.api.cluster
+        fmt = params.get("format")
+        if fmt in ("ndjson", "trace"):
+            tr = cluster.probe_trace()
+            if tr is None:
+                raise _ApiError(
+                    404,
+                    "probe tracer disabled — start the cluster with "
+                    "cfg_overrides={'probes': K}",
+                )
+            if fmt == "ndjson":
+                body = tr.to_ndjson().encode()
+                ctype = "application/x-ndjson"
+            else:
+                body = (json.dumps(tr.to_chrome_trace()) + "\n").encode()
+                ctype = "application/json"
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        self._send_json(cluster.probe_report())
 
     def _get_metrics(self):
         from corro_sim.utils.metrics import render_prometheus

@@ -379,15 +379,26 @@ def run_config_1(inserts: int = 1000, nodes: int = 3) -> dict:
 
 
 def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
+    import dataclasses
+
     from corro_sim.engine.driver import run_sim
     from corro_sim.engine.state import init_state
 
+    # CORRO_BENCH_PROBES=K threads the probe tracer through the bench
+    # run; its provenance journals next to the flight NDJSON (same
+    # basename + .probes.ndjson/.probes.trace.json) so a bench artifact
+    # carries both the convergence curve AND the per-key propagation
+    # evidence explaining it.
+    probes = int(os.environ.get("CORRO_BENCH_PROBES", "0") or 0)
+    if probes > 0:
+        # same invariant gate the CLI path runs (0 <= probes <= nodes)
+        cfg = dataclasses.replace(cfg, probes=probes).validate()
     res = run_sim(
         cfg, init_state(cfg, seed=0), schedule,
         max_rounds=max_rounds, chunk=8, seed=0, min_rounds=min_rounds,
         flight=_FLIGHT,
     )
-    return {
+    out = {
         "metric": label,
         "value": res.converged_round,
         "unit": "rounds_to_convergence",
@@ -396,6 +407,15 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
     }
+    if res.probe is not None and _FLIGHT is not None and _FLIGHT.sink_path:
+        prefix = _FLIGHT.sink_path + ".probes"
+        res.probe.dump_ndjson(prefix + ".ndjson")
+        res.probe.dump_chrome_trace(prefix + ".trace.json")
+        out["probe_artifacts"] = [
+            prefix + ".ndjson", prefix + ".trace.json",
+        ]
+        out["probe_delivery_p99_rounds"] = res.probe.delivery_p99()
+    return out
 
 
 def run_config_2(nodes: int = 64) -> dict:

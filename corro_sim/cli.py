@@ -18,6 +18,7 @@ Command surface vs the reference's Command enum
   locks        — lock registry dump                   [Command::Locks]
   traces       — recent tracer spans                  [telemetry analog]
   flight       — per-round telemetry timeline         [flight recorder]
+  probes       — gossip provenance + lag observatory  [probe tracer]
   db lock      — hold the write lock around a command [DbCommand::Lock]
   tls          — ca / server / client cert generation [Command::Tls]
   template     — render templates w/ live re-render   [Command::Template]
@@ -41,6 +42,7 @@ _FLAG_TO_FIELD = {
     "swim": "swim_enabled",
     "swim_view": "swim_view_size",
     "sync_interval": "sync_interval",
+    "probes": "probes",
 }
 
 
@@ -83,6 +85,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         chunk=args.chunk,
         seed=args.seed,
         flight=flight,
+        profile_dir=args.profile_dir,
     )
     diag = res.flight.diagnostics()
     report = {
@@ -107,6 +110,34 @@ def _cmd_run(args: argparse.Namespace) -> int:
         wrote = res.flight.sink_active
         res.flight.close()
         report["flight"] = args.flight_out if wrote else None
+    if res.probe is not None:
+        # probe artifacts land next to the flight record: NDJSON journal
+        # + Perfetto-loadable Chrome trace-event JSON. An unwritable
+        # path must not eat the completed run's report (same manners as
+        # the flight sink above).
+        prefix = args.probe_out or (
+            args.flight_out + ".probes" if args.flight_out else "probes"
+        )
+        try:
+            res.probe.dump_ndjson(prefix + ".ndjson")
+            res.probe.dump_chrome_trace(prefix + ".trace.json")
+            report["probe_artifacts"] = [
+                prefix + ".ndjson", prefix + ".trace.json",
+            ]
+        except OSError as e:
+            print(
+                f"warning: cannot write probe artifacts to {prefix!r}* "
+                f"({e}) — continuing without them",
+                file=sys.stderr,
+            )
+            report["probe_artifacts"] = None
+        summaries = [
+            res.probe.summary(k) for k in range(res.probe.num_probes)
+        ]
+        report["probe_delivery_p99_rounds"] = res.probe.delivery_p99()
+        report["probe_coverage"] = [s["coverage"] for s in summaries]
+    if args.profile_dir:
+        report["profile_dir"] = args.profile_dir
     if res.poisoned:
         # ring-wrap tripwire (engine/step.py): state may be silently wrong —
         # distinct from an ordinary round-budget miss (exit 3)
@@ -173,6 +204,9 @@ def _cmd_agent(args: argparse.Namespace) -> int:
             seed=args.seed,
             default_capacity=args.capacity,
             tripwire=tripwire,
+            cfg_overrides=(
+                {"probes": args.probes} if args.probes else None
+            ),
         )
     host, _, port = args.api_addr.partition(":")
     api = ApiServer(
@@ -381,6 +415,22 @@ def build_parser() -> argparse.ArgumentParser:
         help="journal the per-round flight-recorder timeline (ND-JSON) "
              "to this path, chunk by chunk",
     )
+    pr.add_argument(
+        "--probes", type=int,
+        help="track K sampled versions through the gossip fabric "
+             "on-device (probe tracer; 0 = off)",
+    )
+    pr.add_argument(
+        "--probe-out",
+        help="path prefix for the probe artifacts (<prefix>.ndjson + "
+             "<prefix>.trace.json, Perfetto-loadable); defaults next to "
+             "--flight-out",
+    )
+    pr.add_argument(
+        "--profile-dir",
+        help="capture a jax.profiler trace of the scan loop into this "
+             "directory (TensorBoard/Perfetto-loadable)",
+    )
     pr.set_defaults(fn=_cmd_run)
 
     pb = sub.add_parser(
@@ -422,6 +472,11 @@ def build_parser() -> argparse.ArgumentParser:
     pa.add_argument(
         "--tick-interval", type=float, default=0.1,
         help="background gossip cadence in seconds (0 disables)",
+    )
+    pa.add_argument(
+        "--probes", type=int, default=0,
+        help="track K sampled versions on-device (probe tracer; "
+             "read via /v1/probes or `corro-sim probes`)",
     )
     pa.set_defaults(fn=_cmd_agent)
 
@@ -554,6 +609,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pfl.set_defaults(fn=_cmd_flight)
 
+    ppb = sub.add_parser(
+        "probes",
+        help="probe-tracer provenance + per-node lag observatory",
+    )
+    admin_args(ppb)
+    ppb.add_argument(
+        "--lag", action="store_true",
+        help="print only the per-node lag observatory",
+    )
+    ppb.add_argument(
+        "--top", type=int, default=8,
+        help="laggards listed by the observatory",
+    )
+    ppb.add_argument(
+        "--export",
+        help="write <prefix>.ndjson + <prefix>.trace.json server-side",
+    )
+    ppb.set_defaults(fn=_cmd_probes)
+
     ptr = sub.add_parser("traces", help="recent spans from the tracer")
     admin_args(ptr)
     ptr.add_argument("-n", type=int, default=100)
@@ -675,6 +749,15 @@ def _cmd_flight(args) -> int:
     return _print_json(
         _admin(args).call(
             "flight", n=args.n, diag_only=args.diag, export=args.export
+        )
+    )
+
+
+def _cmd_probes(args) -> int:
+    """Dump the agent's probe provenance / lag observatory."""
+    return _print_json(
+        _admin(args).call(
+            "probes", lag_only=args.lag, top=args.top, export=args.export
         )
     )
 

@@ -139,6 +139,17 @@ class SimConfig:
     # dropped_window) and anti-entropy repairs them, exactly like queue
     # overflow drops (handlers.rs:866-884). Must be a multiple of 128.
 
+    # --- probe tracer (obs/probes.py; the sim-world analog of the
+    # reference's distributed tracing) ---
+    probes: int = 0  # K sampled versions tracked through the gossip
+    # fabric entirely on-device: per (probe, node) first-seen round,
+    # infector and hop count, plus duplicate-delivery counts and a
+    # per-node last-sync stamp (engine/probe.py). Static, so 0 traces
+    # ZERO extra ops — the step program is bit-identical to the
+    # uninstrumented one (tests/test_probes.py guards this). Probe k
+    # tracks version 1 of actor k*N//K by default; drivers may re-aim
+    # probes by replacing state.probe before running.
+
     # --- timing model ---
     round_ms: float = 200.0  # simulated wall-clock per round (broadcast
     # flush cadence is 500 ms in the reference, broadcast/mod.rs:378; one
@@ -191,6 +202,9 @@ class SimConfig:
         assert self.log_capacity >= 1
         assert self.sync_candidates >= 1
         assert self.seqs_per_version >= 1
+        assert 0 <= self.probes <= self.num_nodes, (
+            "probes samples distinct origin actors — at most one per node"
+        )
         assert self.chunks_per_version in (1, 2, 4, 8, 16, 32), (
             "chunks_per_version must divide the 32-bit version window"
         )

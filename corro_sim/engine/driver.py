@@ -28,6 +28,7 @@ from corro_sim.config import SimConfig
 from corro_sim.engine.state import SimState
 from corro_sim.engine.step import sim_step
 from corro_sim.obs.flight import FlightRecorder
+from corro_sim.obs.probes import ProbeTrace
 from corro_sim.utils.metrics import SECONDS_BUCKETS, counters, histograms
 from corro_sim.utils.tracing import tracer
 
@@ -74,6 +75,7 @@ class RunResult:
     # state may be silently wrong; convergence is never reported
     repair_chunks: int = 0  # chunks run on the repair-specialized program
     flight: "FlightRecorder | None" = None  # per-round telemetry timeline
+    probe: object | None = None  # obs.probes.ProbeTrace when cfg.probes
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -144,6 +146,7 @@ def run_sim(
     warmup: bool = True,
     on_chunk: Callable[[dict], None] | None = None,
     flight: FlightRecorder | None = None,
+    profile_dir: str | None = None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -162,7 +165,12 @@ def run_sim(
     ``flight``: a :class:`FlightRecorder` to fill with the per-round
     metric timeline + annotations. One is created when not given, so
     every run leaves a record (``RunResult.flight``); pass a recorder
-    with a ``sink_path`` to journal it to disk chunk by chunk."""
+    with a ``sink_path`` to journal it to disk chunk by chunk.
+
+    ``profile_dir``: wrap the whole scan loop in ``jax.profiler.trace``
+    so a TPU/CPU profile (XLA op timelines, host callstacks) lands next
+    to the probe/flight artifacts — load it in Perfetto or TensorBoard.
+    """
     schedule = schedule or Schedule()
     if flight is None:
         flight = FlightRecorder()
@@ -237,215 +245,259 @@ def run_sim(
     repair_seen = False
     repair_chunks = 0
     prev_writes = False
-    while rounds < max_rounds:
-        alive, part, we = schedule.slice(rounds, chunk, cfg.num_nodes)
-        keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
-        args = (
-            state, keys, jnp.asarray(alive), jnp.asarray(part),
-            jnp.asarray(we),
-        )
-        use_repair = (
-            repair_eligible
-            and last_pend_live == 0
-            and not bool(we.any())
-        )
-        if use_repair and repair_runner is None:
-            repair_runner = _chunk_runner(
-                cfg, donate=donate, shardings=shardings, repair=True,
-                packed=True,
-            )
-            t0 = time.perf_counter()
-            try:
-                with tracer.span("aot lower+compile", program="repair",
-                                 slow_warn=False):
-                    repair_compiled = repair_runner.lower(*args).compile()
-                counters.inc(
-                    "corro_compile_total", labels='{program="repair"}',
-                    help_="XLA chunk-program compiles by program",
-                )
-            except Exception:  # AOT unsupported on some backend
-                repair_compiled = None
-                counters.inc(
-                    "corro_compile_aot_fallback_total",
-                    labels='{program="repair"}',
-                    help_="AOT lower/compile failures falling back to jit",
-                )
-            c_done = time.perf_counter()
-            histograms.observe(
-                "corro_compile_seconds", c_done - t0,
-                labels='{program="repair"}',
-                help_="AOT lower+compile wall by program",
-            )
-            if repair_compiled is not None and warmup and not donate:
-                # first execution of a program pays one-time platform
-                # initialization (~8 s over the tunnel) — burn it on a
-                # discarded run so every timed chunk runs warm
-                with tracer.span("warmup", program="repair",
-                                 slow_warn=False):
-                    jax.block_until_ready(repair_compiled(*args)[0].round)
-                flight.record_phase("warmup", time.perf_counter() - c_done)
-            compile_seconds += time.perf_counter() - t0
-            flight.record_phase("compile", c_done - t0)
-        first_repair_jit = use_repair and repair_compiled is None and not repair_seen
-        if use_repair and not repair_seen:
+    probe_p99_last = None  # worst per-probe p99 delivery lag seen so far
+    profiling = False
+    if profile_dir is not None:
+        # `run --profile-dir`: a jax.profiler trace around the whole scan
+        # loop (+ drain), so an XLA op-level profile lands next to the
+        # probe/flight artifacts. start/stop (not a context manager)
+        # keeps the chunk loop unnested; stop is after the drain below.
+        try:
+            jax.profiler.start_trace(profile_dir)
+            profiling = True
+        except Exception:
             counters.inc(
-                "corro_repair_program_switches_total",
-                help_="post-quiesce switches to the repair-specialized "
-                      "chunk program",
+                "corro_profile_trace_failures_total",
+                help_="jax.profiler.trace start failures (profile skipped)",
             )
-            flight.annotate(
-                rounds + 1, "repair_program_switch",
-                aot=repair_compiled is not None,
+    try:
+        while rounds < max_rounds:
+            alive, part, we = schedule.slice(rounds, chunk, cfg.num_nodes)
+            keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
+            args = (
+                state, keys, jnp.asarray(alive), jnp.asarray(part),
+                jnp.asarray(we),
             )
-        if use_repair:
-            repair_seen = True
-            repair_chunks += 1
-        run_compiled = repair_compiled if use_repair else compiled
-        run_jit = repair_runner if use_repair else runner
-        if ci == 0:
-            t0 = time.perf_counter()
-            try:
-                with tracer.span("aot lower+compile", program="full",
-                                 slow_warn=False):
-                    compiled = runner.lower(*args).compile()
-                counters.inc(
-                    "corro_compile_total", labels='{program="full"}',
-                    help_="XLA chunk-program compiles by program",
+            use_repair = (
+                repair_eligible
+                and last_pend_live == 0
+                and not bool(we.any())
+            )
+            if use_repair and repair_runner is None:
+                repair_runner = _chunk_runner(
+                    cfg, donate=donate, shardings=shardings, repair=True,
+                    packed=True,
                 )
-            except Exception:  # AOT unsupported on some backend
-                compiled = None
+                t0 = time.perf_counter()
+                try:
+                    with tracer.span("aot lower+compile", program="repair",
+                                     slow_warn=False):
+                        repair_compiled = repair_runner.lower(*args).compile()
+                    counters.inc(
+                        "corro_compile_total", labels='{program="repair"}',
+                        help_="XLA chunk-program compiles by program",
+                    )
+                except Exception:  # AOT unsupported on some backend
+                    repair_compiled = None
+                    counters.inc(
+                        "corro_compile_aot_fallback_total",
+                        labels='{program="repair"}',
+                        help_="AOT lower/compile failures falling back to jit",
+                    )
+                c_done = time.perf_counter()
+                histograms.observe(
+                    "corro_compile_seconds", c_done - t0,
+                    labels='{program="repair"}',
+                    help_="AOT lower+compile wall by program",
+                )
+                if repair_compiled is not None and warmup and not donate:
+                    # first execution of a program pays one-time platform
+                    # initialization (~8 s over the tunnel) — burn it on a
+                    # discarded run so every timed chunk runs warm
+                    with tracer.span("warmup", program="repair",
+                                     slow_warn=False):
+                        jax.block_until_ready(repair_compiled(*args)[0].round)
+                    flight.record_phase("warmup", time.perf_counter() - c_done)
+                compile_seconds += time.perf_counter() - t0
+                flight.record_phase("compile", c_done - t0)
+            first_repair_jit = use_repair and repair_compiled is None and not repair_seen
+            if use_repair and not repair_seen:
                 counters.inc(
-                    "corro_compile_aot_fallback_total",
+                    "corro_repair_program_switches_total",
+                    help_="post-quiesce switches to the repair-specialized "
+                          "chunk program",
+                )
+                flight.annotate(
+                    rounds + 1, "repair_program_switch",
+                    aot=repair_compiled is not None,
+                )
+            if use_repair:
+                repair_seen = True
+                repair_chunks += 1
+            run_compiled = repair_compiled if use_repair else compiled
+            run_jit = repair_runner if use_repair else runner
+            if ci == 0:
+                t0 = time.perf_counter()
+                try:
+                    with tracer.span("aot lower+compile", program="full",
+                                     slow_warn=False):
+                        compiled = runner.lower(*args).compile()
+                    counters.inc(
+                        "corro_compile_total", labels='{program="full"}',
+                        help_="XLA chunk-program compiles by program",
+                    )
+                except Exception:  # AOT unsupported on some backend
+                    compiled = None
+                    counters.inc(
+                        "corro_compile_aot_fallback_total",
+                        labels='{program="full"}',
+                        help_="AOT lower/compile failures falling back to jit",
+                    )
+                c_done = time.perf_counter()
+                histograms.observe(
+                    "corro_compile_seconds", c_done - t0,
                     labels='{program="full"}',
-                    help_="AOT lower/compile failures falling back to jit",
+                    help_="AOT lower+compile wall by program",
                 )
-            c_done = time.perf_counter()
-            histograms.observe(
-                "corro_compile_seconds", c_done - t0,
-                labels='{program="full"}',
-                help_="AOT lower+compile wall by program",
-            )
-            # donated args must not be consumed by a throwaway run
-            if compiled is not None and warmup and not donate:
-                with tracer.span("warmup", program="full", slow_warn=False):
-                    jax.block_until_ready(compiled(*args)[0].round)
-                flight.record_phase("warmup", time.perf_counter() - c_done)
-            # On fallback the failed-lowering wall still belongs to
-            # compile accounting (ADVICE r3): chunk 0's mixed run adds on.
-            compile_seconds = time.perf_counter() - t0
-            flight.record_phase("compile", c_done - t0)
-            run_compiled = compiled
-        runner_name = "repair" if use_repair else "full"
-        if run_compiled is None:
-            # fallback: the first chunk through each program pays
-            # compile+exec mixed and is excluded from the steady-state
-            # wall (the pre-AOT accounting)
-            t0 = time.perf_counter()
-            with tracer.span("chunk", ci=ci, runner=runner_name,
-                             mode="jit"):
-                state, m = _exec(run_jit, run_jit, args)
-            chunk_elapsed = time.perf_counter() - t0
-            if ci == 0 or first_repair_jit:
-                compile_seconds += chunk_elapsed
-                flight.record_phase("compile", chunk_elapsed)
+                # donated args must not be consumed by a throwaway run
+                if compiled is not None and warmup and not donate:
+                    with tracer.span("warmup", program="full", slow_warn=False):
+                        jax.block_until_ready(compiled(*args)[0].round)
+                    flight.record_phase("warmup", time.perf_counter() - c_done)
+                # On fallback the failed-lowering wall still belongs to
+                # compile accounting (ADVICE r3): chunk 0's mixed run adds on.
+                compile_seconds = time.perf_counter() - t0
+                flight.record_phase("compile", c_done - t0)
+                run_compiled = compiled
+            runner_name = "repair" if use_repair else "full"
+            if run_compiled is None:
+                # fallback: the first chunk through each program pays
+                # compile+exec mixed and is excluded from the steady-state
+                # wall (the pre-AOT accounting)
+                t0 = time.perf_counter()
+                with tracer.span("chunk", ci=ci, runner=runner_name,
+                                 mode="jit"):
+                    state, m = _exec(run_jit, run_jit, args)
+                chunk_elapsed = time.perf_counter() - t0
+                if ci == 0 or first_repair_jit:
+                    compile_seconds += chunk_elapsed
+                    flight.record_phase("compile", chunk_elapsed)
+                else:
+                    wall += chunk_elapsed
+                    timed_rounds += chunk
+                    flight.record_phase("execute", chunk_elapsed)
             else:
+                t0 = time.perf_counter()
+                with tracer.span("chunk", ci=ci, runner=runner_name,
+                                 mode="aot"):
+                    state, m = _exec(run_compiled, run_jit, args)
+                chunk_elapsed = time.perf_counter() - t0
                 wall += chunk_elapsed
                 timed_rounds += chunk
                 flight.record_phase("execute", chunk_elapsed)
-        else:
-            t0 = time.perf_counter()
-            with tracer.span("chunk", ci=ci, runner=runner_name,
-                             mode="aot"):
-                state, m = _exec(run_compiled, run_jit, args)
-            chunk_elapsed = time.perf_counter() - t0
-            wall += chunk_elapsed
-            timed_rounds += chunk
-            flight.record_phase("execute", chunk_elapsed)
-        counters.inc(
-            "corro_chunk_dispatch_total",
-            labels=f'{{runner="{runner_name}"}}',
-            help_="chunk dispatches by program",
-        )
-        histograms.observe(
-            "corro_chunk_wall_seconds", chunk_elapsed,
-            labels=f'{{runner="{runner_name}"}}',
-            help_="per-chunk execution wall by program",
-            buckets=SECONDS_BUCKETS,
-        )
-        metrics_chunks.append(m)
-        flight.record_rounds(rounds + 1, m)
-        flight.annotate(
-            rounds + chunk, "chunk", chunk=ci, runner=runner_name,
-            wall_s=round(chunk_elapsed, 6),
-            aot=run_compiled is not None,
-        )
-        if prev_writes and not bool(we.any()):
-            # the schedule stopped writing — the measurement phase begins
+            counters.inc(
+                "corro_chunk_dispatch_total",
+                labels=f'{{runner="{runner_name}"}}',
+                help_="chunk dispatches by program",
+            )
+            histograms.observe(
+                "corro_chunk_wall_seconds", chunk_elapsed,
+                labels=f'{{runner="{runner_name}"}}',
+                help_="per-chunk execution wall by program",
+                buckets=SECONDS_BUCKETS,
+            )
+            metrics_chunks.append(m)
+            flight.record_rounds(rounds + 1, m)
             flight.annotate(
-                rounds + 1, "schedule_transition", kind="write_phase_end",
+                rounds + chunk, "chunk", chunk=ci, runner=runner_name,
+                wall_s=round(chunk_elapsed, 6),
+                aot=run_compiled is not None,
             )
-        prev_writes = bool(we.any())
-        last_pend_live = int(m["pend_live"][-1])
-        if _DEBUG_CHUNKS:
-            import sys
+            if prev_writes and not bool(we.any()):
+                # the schedule stopped writing — the measurement phase begins
+                flight.annotate(
+                    rounds + 1, "schedule_transition", kind="write_phase_end",
+                )
+            prev_writes = bool(we.any())
+            last_pend_live = int(m["pend_live"][-1])
+            if _DEBUG_CHUNKS:
+                import sys
 
-            print(
-                f"# chunk {ci} rounds {rounds}..{rounds + chunk}"
-                f" runner={'repair' if use_repair else 'full'}"
-                f" wall={chunk_elapsed:.3f}s"
-                f" pend_live={last_pend_live}"
-                f" gap={float(m['gap'][-1]):.0f}"
-                f" sync_pairs={int(m['sync_pairs'].sum())}",
-                file=sys.stderr, flush=True,
-            )
-        rounds += chunk
-        ci += 1
-        if on_chunk is not None:
-            on_chunk({
-                "chunk": ci - 1,
-                "rounds_done": rounds,
-                "chunk_wall_s": round(chunk_elapsed, 3),
-                "wall_s": round(wall, 3),
-                "compile_s": round(compile_seconds, 3),
-                "runner": "repair" if use_repair else "full",
-                "gap": float(m["gap"][-1]),
-                "pend_live": last_pend_live,
-            })
-        if m["log_wrapped"].any():
-            # Ring-wrap tripwire fired: a live node lagged some actor past
-            # log_capacity, so gathers may have read overwritten slots.
-            # Convergence can no longer be trusted — stop and poison.
-            poisoned = True
-            wrapped_at = rounds - chunk + 1 + int(
-                np.argmax(np.asarray(m["log_wrapped"]) != 0)
-            )
-            flight.annotate(wrapped_at, "log_wrapped")
-            break
-        # Strictly greater: at rounds == min_rounds the round numbered
-        # min_rounds (e.g. a scheduled rejoin) has not executed yet.
-        if stop_on_convergence and rounds > min_rounds:
-            gaps = m["gap"]
-            if gaps[-1] == 0.0:
-                # Only rounds strictly past min_rounds are convergence
-                # candidates — a transient zero during the write phase (all
-                # deliveries momentarily caught up) is not convergence.
-                base = rounds - chunk  # chunk covers rounds base+1 … rounds
-                idx = np.arange(1, chunk + 1) + base
-                eligible = (gaps == 0.0) & (idx > min_rounds)
-                converged_round = int(idx[np.argmax(eligible)])
-                flight.annotate(converged_round, "converged")
+                print(
+                    f"# chunk {ci} rounds {rounds}..{rounds + chunk}"
+                    f" runner={'repair' if use_repair else 'full'}"
+                    f" wall={chunk_elapsed:.3f}s"
+                    f" pend_live={last_pend_live}"
+                    f" gap={float(m['gap'][-1]):.0f}"
+                    f" sync_pairs={int(m['sync_pairs'].sum())}",
+                    file=sys.stderr, flush=True,
+                )
+            rounds += chunk
+            ci += 1
+            if cfg.probes:
+                # per-chunk probe extraction: one small (K, N) transfer. A
+                # probe whose p99 delivery lag WORSENED this chunk (a late
+                # straggler stretched the tail) annotates the flight record
+                # — the curve-level "why was this chunk slow" breadcrumb.
+                p99 = ProbeTrace.from_state(cfg, state).delivery_p99()
+                if (
+                    p99 is not None
+                    and probe_p99_last is not None
+                    and p99 > probe_p99_last
+                ):
+                    flight.annotate(
+                        rounds, "probe_p99_regression",
+                        p99=p99, prev=probe_p99_last,
+                    )
+                    counters.inc(
+                        "corro_probe_p99_regressions_total",
+                        help_="chunks in which a probe's p99 delivery lag "
+                              "worsened",
+                    )
+                if p99 is not None:
+                    probe_p99_last = p99
+            if on_chunk is not None:
+                on_chunk({
+                    "chunk": ci - 1,
+                    "rounds_done": rounds,
+                    "chunk_wall_s": round(chunk_elapsed, 3),
+                    "wall_s": round(wall, 3),
+                    "compile_s": round(compile_seconds, 3),
+                    "runner": "repair" if use_repair else "full",
+                    "gap": float(m["gap"][-1]),
+                    "pend_live": last_pend_live,
+                })
+            if m["log_wrapped"].any():
+                # Ring-wrap tripwire fired: a live node lagged some actor past
+                # log_capacity, so gathers may have read overwritten slots.
+                # Convergence can no longer be trusted — stop and poison.
+                poisoned = True
+                wrapped_at = rounds - chunk + 1 + int(
+                    np.argmax(np.asarray(m["log_wrapped"]) != 0)
+                )
+                flight.annotate(wrapped_at, "log_wrapped")
                 break
+            # Strictly greater: at rounds == min_rounds the round numbered
+            # min_rounds (e.g. a scheduled rejoin) has not executed yet.
+            if stop_on_convergence and rounds > min_rounds:
+                gaps = m["gap"]
+                if gaps[-1] == 0.0:
+                    # Only rounds strictly past min_rounds are convergence
+                    # candidates — a transient zero during the write phase (all
+                    # deliveries momentarily caught up) is not convergence.
+                    base = rounds - chunk  # chunk covers rounds base+1 … rounds
+                    idx = np.arange(1, chunk + 1) + base
+                    eligible = (gaps == 0.0) & (idx > min_rounds)
+                    converged_round = int(idx[np.argmax(eligible)])
+                    flight.annotate(converged_round, "converged")
+                    break
 
-    # Drain the pipeline into the measured wall: the axon platform streams
-    # per-buffer readiness, so work not on the metric dependency path (the
-    # table merge feeds only the returned state, not the gap) can still be
-    # in flight when the last metric read returns. Convergence is about
-    # STATE, so the run is not done until the state is.
-    t0 = time.perf_counter()
-    jax.block_until_ready(state)
-    drain = time.perf_counter() - t0
-    wall += drain
-    flight.record_phase("drain", drain)
+        # Drain the pipeline into the measured wall: the axon platform streams
+        # per-buffer readiness, so work not on the metric dependency path (the
+        # table merge feeds only the returned state, not the gap) can still be
+        # in flight when the last metric read returns. Convergence is about
+        # STATE, so the run is not done until the state is.
+        t0 = time.perf_counter()
+        jax.block_until_ready(state)
+        drain = time.perf_counter() - t0
+        wall += drain
+        flight.record_phase("drain", drain)
+    finally:
+        if profiling:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
     metrics = {
         k: np.concatenate([c[k] for c in metrics_chunks])
         for k in metrics_chunks[0]
@@ -461,4 +513,10 @@ def run_sim(
         poisoned=poisoned,
         repair_chunks=repair_chunks,
         flight=flight,
+        probe=(
+            ProbeTrace.from_state(
+                cfg, state, driver="run_sim", seed=seed, rounds=rounds,
+            )
+            if cfg.probes else None
+        ),
     )

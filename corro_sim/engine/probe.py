@@ -1,0 +1,182 @@
+"""On-device probe tracer: per-key propagation provenance tensors.
+
+The flight recorder (obs/flight.py) records the cluster-level convergence
+curve but cannot say *why* a round was slow — which node infected which,
+how many hops a change took, how much duplicate delivery the broadcast
+path wasted. Gossip-theory bounds are stated in hops and redundancy
+("Asynchrony and Acceleration in Gossip Algorithms", "The Algorithm of
+Pipelined Gossiping"); validating the simulator against them needs
+message-level provenance, the sim-world analog of the distributed traces
+real Corrosion agents emit per broadcast/sync contact.
+
+K sampled versions ("probes") are tracked through the fabric entirely
+on-device, so tracing rides the same `lax.scan` as the simulation and
+costs no extra host round-trips:
+
+- ``first_seen[K, N]`` — round node n first held probe k (-1 = never);
+- ``infector[K, N]`` — the peer whose message completed probe k at n
+  (scatter-min over same-round candidates → deterministic), ``-1`` at
+  the origin, ``-2`` when anti-entropy sync repaired it;
+- ``hop[K, N]`` — gossip path length from the origin (0 there; -1 for
+  sync joins, which are range transfers with no per-message hop);
+- ``dup[K]`` — delivered probe chunks that landed on already-infected
+  nodes (the redundancy the broadcast path wastes);
+- ``last_sync[N]`` — last round the node took part in an anti-entropy
+  sweep (feeds the lag observatory's sync-age column).
+
+Everything is masked where/scatter arithmetic over the lane arrays the
+step already materializes — with ``cfg.probes == 0`` none of it traces,
+and the step program is bit-identical to the uninstrumented one
+(tests/test_probes.py guards this).
+"""
+
+from __future__ import annotations
+
+import flax.struct
+import jax.numpy as jnp
+import numpy as np
+
+# infector sentinels
+INFECTOR_NONE = -1  # origin (or not yet infected)
+INFECTOR_SYNC = -2  # joined via an anti-entropy range transfer
+
+_BIG = np.int32(2**31 - 1)
+
+
+@flax.struct.dataclass
+class ProbeState:
+    actor: jnp.ndarray  # (K,) int32 — origin actor of each probe
+    ver: jnp.ndarray  # (K,) int32 — tracked version of that actor
+    first_seen: jnp.ndarray  # (K, N) int32 round, -1 = never
+    infector: jnp.ndarray  # (K, N) int32 peer id / INFECTOR_* sentinel
+    hop: jnp.ndarray  # (K, N) int32 gossip hops from origin, -1 = n/a
+    dup: jnp.ndarray  # (K,) int32 duplicate deliveries (redundancy)
+    last_sync: jnp.ndarray  # (N,) int32 last sync-sweep round, -1 = never
+
+
+def make_probe_state(num_probes: int, num_nodes: int) -> ProbeState:
+    """Probe k tracks version 1 of actor ``k * N // K`` — K origins spread
+    evenly over the id space. Drivers that want different targets replace
+    ``actor``/``ver`` before running. ``num_probes == 0`` returns a
+    (1, 1) placeholder (same trick as the inflight/rtt planes)."""
+    if num_probes <= 0:
+        return ProbeState(
+            actor=jnp.zeros((1,), jnp.int32),
+            ver=jnp.zeros((1,), jnp.int32),
+            first_seen=jnp.full((1, 1), -1, jnp.int32),
+            infector=jnp.full((1, 1), INFECTOR_NONE, jnp.int32),
+            hop=jnp.full((1, 1), -1, jnp.int32),
+            dup=jnp.zeros((1,), jnp.int32),
+            last_sync=jnp.full((1,), -1, jnp.int32),
+        )
+    k, n = num_probes, num_nodes
+    return ProbeState(
+        actor=jnp.asarray(
+            (np.arange(k, dtype=np.int64) * n // k).astype(np.int32)
+        ),
+        ver=jnp.ones((k,), jnp.int32),
+        first_seen=jnp.full((k, n), -1, jnp.int32),
+        infector=jnp.full((k, n), INFECTOR_NONE, jnp.int32),
+        hop=jnp.full((k, n), -1, jnp.int32),
+        dup=jnp.zeros((k,), jnp.int32),
+        last_sync=jnp.full((n,), -1, jnp.int32),
+    )
+
+
+def probe_write_update(
+    probe: ProbeState, round_, writers, w_ver
+) -> ProbeState:
+    """Origin marking: actor a committing version v this round seeds
+    probe (a, v) at itself — hop 0, no infector."""
+    k = probe.actor.shape[0]
+    kidx = jnp.arange(k, dtype=jnp.int32)
+    a = probe.actor
+    cur = probe.first_seen[kidx, a]
+    hit = writers[a] & (w_ver[a] == probe.ver) & (cur < 0)
+    return probe.replace(
+        first_seen=probe.first_seen.at[kidx, a].set(
+            jnp.where(hit, round_, cur)
+        ),
+        hop=probe.hop.at[kidx, a].set(
+            jnp.where(hit, 0, probe.hop[kidx, a])
+        ),
+    )
+
+
+def probe_delivery_update(
+    probe: ProbeState, round_, dst, src, actor, ver, delivered, complete
+) -> ProbeState:
+    """The broadcast merge point: lanes completing a probe's version at a
+    new node record (first_seen, infector, hop); delivered probe chunks
+    landing on already-infected nodes count as duplicates.
+
+    Same-round ties (several peers completing one dst in one batch) pick
+    the minimum src — a deterministic scatter-min, so replays and the
+    NumPy oracle agree. ``hop`` is the infector's hop + 1; a forwarder
+    that relayed chunks before completing the version itself (possible
+    only when chunks_per_version > 1) contributes hop 0 via the clamp.
+    """
+    k = probe.actor.shape[0]
+    m = dst.shape[0]
+    n = probe.first_seen.shape[1]
+    kk = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.int32)[:, None], (k, m)
+    )
+    dstb = jnp.broadcast_to(dst[None, :], (k, m))
+    srcb = jnp.broadcast_to(src[None, :], (k, m))
+    match = (actor[None, :] == probe.actor[:, None]) & (
+        ver[None, :] == probe.ver[:, None]
+    )  # (K, m)
+    seen = probe.first_seen[kk, dstb] >= 0  # (K, m), pre-update state
+    dup = probe.dup + (match & delivered[None, :] & seen).sum(
+        axis=1, dtype=jnp.int32
+    )
+    cand = match & complete[None, :] & ~seen
+    min_src = (
+        jnp.full((k, n), _BIG, jnp.int32)
+        .at[kk, jnp.where(cand, dstb, n)]
+        .min(srcb, mode="drop")
+    )
+    newly = min_src != _BIG  # (K, N)
+    hop_src = jnp.take_along_axis(
+        probe.hop, jnp.clip(min_src, 0, n - 1), axis=1
+    )
+    return probe.replace(
+        first_seen=jnp.where(newly, round_, probe.first_seen),
+        infector=jnp.where(newly, min_src, probe.infector),
+        hop=jnp.where(newly, jnp.maximum(hop_src, 0) + 1, probe.hop),
+        dup=dup,
+    )
+
+
+def probe_book_update(probe: ProbeState, book_head, round_) -> ProbeState:
+    """The anti-entropy merge point: any node whose applied head now
+    covers a probe's version without a recorded gossip delivery joined
+    via a sync range transfer — attributed to INFECTOR_SYNC with no hop
+    (sync ships version ranges, not per-message forwards). Runs after
+    the sync block every round; gossip-completed nodes were already
+    marked by :func:`probe_delivery_update`, so the where-guard makes
+    this a no-op for them."""
+    has = book_head[:, probe.actor].T >= probe.ver[:, None]  # (K, N)
+    newly = has & (probe.first_seen < 0)
+    return probe.replace(
+        first_seen=jnp.where(newly, round_, probe.first_seen),
+        infector=jnp.where(newly, INFECTOR_SYNC, probe.infector),
+    )
+
+
+def probe_sync_mark(probe: ProbeState, is_sync, alive, round_) -> ProbeState:
+    """Stamp sweep participation: every live node takes part in a sweep
+    round (the sweep is cluster-wide; per-node admission detail stays in
+    sync_metrics). Feeds the lag observatory's last-sync age."""
+    return probe.replace(
+        last_sync=jnp.where(is_sync & alive, round_, probe.last_sync)
+    )
+
+
+def probe_metrics(probe: ProbeState) -> dict:
+    """Per-round scalars for the metrics fold / flight recorder."""
+    return {
+        "probe_infected": (probe.first_seen >= 0).sum(dtype=jnp.int32),
+        "probe_dups": probe.dup.sum(dtype=jnp.int32),
+    }

@@ -91,6 +91,9 @@ def state_shardings(
         # in-flight delay ring: lane-axis blocks are src-major but mixed
         # (eager + gossip), and the whole ring is ~tens of MB — replicate
         inflight=replicated,
+        # probe planes are (K, N) — node axis trailing, and K is tiny;
+        # node_major keeps last_sync (N,) sharded, the rest replicated
+        probe=node_major(state.probe),
     )
 
 

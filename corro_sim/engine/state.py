@@ -19,6 +19,7 @@ from corro_sim.core.bookkeeping import Bookkeeping, make_bookkeeping
 from corro_sim.core.changelog import ChangeLog, make_changelog
 from corro_sim.core.compaction import CellOwnership, make_ownership
 from corro_sim.core.crdt import TableState, make_table_state
+from corro_sim.engine.probe import ProbeState, make_probe_state
 from corro_sim.gossip.broadcast import GossipState, make_gossip_state
 from corro_sim.membership.rtt import make_rtt
 from corro_sim.membership.swim import SwimState, make_swim_state
@@ -58,6 +59,10 @@ class SimState:
     # sits here until round r + d - 1: latency DELAYS delivery instead of
     # reading as loss (reference transport.rs:199-233 — VERDICT r2 next
     # #6). (1, 6, 1) placeholder when the latency model is off.
+    probe: ProbeState  # on-device probe tracer (engine/probe.py): per
+    # (probe, node) first-seen round / infector / hop provenance, dup
+    # counts, per-node last-sync stamps. Placeholder shapes when
+    # cfg.probes == 0 — the step never touches it then.
 
 
 def _row_cdf(cfg: SimConfig) -> np.ndarray:
@@ -125,4 +130,5 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
             else (1, 6, 1),
             jnp.int32,
         ),
+        probe=make_probe_state(cfg.probes, n),
     )

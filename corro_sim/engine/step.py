@@ -35,6 +35,13 @@ from corro_sim.core.merge_kernel import (
     route_lanes,
 )
 from corro_sim.utils.slots import ranks_within_group_masked
+from corro_sim.engine.probe import (
+    probe_book_update,
+    probe_delivery_update,
+    probe_metrics,
+    probe_sync_mark,
+    probe_write_update,
+)
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
 from corro_sim.membership.rtt import link_delay, observe_rtt, recompute_ring0
@@ -346,6 +353,17 @@ def sim_step(
         presorted=True,
     )
     dropped = dropped | overcap
+    # ------------------------------------------------------- probe tracer
+    # Origin seeding + the broadcast merge point (engine/probe.py). The
+    # flag is static: probes == 0 traces ZERO extra ops and the step
+    # program stays bit-identical to the uninstrumented one.
+    if cfg.probes:
+        probe = probe_write_update(state.probe, state.round, writers, w_ver)
+        probe = probe_delivery_update(
+            probe, state.round, dst, src, actor, ver, delivered, complete
+        )
+    else:
+        probe = state.probe
     g_actor = jnp.where(complete, actor, 0)
     g_slot = (jnp.maximum(ver, 1) - 1) % log.capacity
     c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
@@ -448,6 +466,11 @@ def sim_step(
         k_sync, alive, view, part,
         rtt=rtt if cfg.rtt_rings else None, round_idx=state.sync_rounds,
     )
+    if cfg.probes:
+        # the anti-entropy merge point: heads that now cover a probe's
+        # version without a recorded gossip delivery joined via sync
+        probe = probe_book_update(probe, book.head, state.round)
+        probe = probe_sync_mark(probe, is_sync, alive, state.round)
 
     # -------------------------------------------------------------- metrics
     # float32 sum: magnitudes can exceed int32 at 10k×10k scale, and the
@@ -480,6 +503,7 @@ def sim_step(
         "clock_skew": skew,
         **swim_metrics,
         **sync_metrics,
+        **(probe_metrics(probe) if cfg.probes else {}),
     }
 
     new_state = state.replace(
@@ -497,6 +521,7 @@ def sim_step(
         rtt=rtt,
         ring0=ring0,
         inflight=inflight,
+        probe=probe,
     )
     return new_state, metrics
 
@@ -671,6 +696,14 @@ def _repair_step(
         state.cleared_hlc, k_sync, alive, view, part, rtt=None,
         round_idx=state.sync_rounds,
     )
+    probe = state.probe
+    if cfg.probes:
+        # Bit-for-bit the full step's probe path under the precondition:
+        # no writers and no valid lanes make the origin/delivery updates
+        # masked no-ops there, so only the sync merge point + sweep stamp
+        # remain live here.
+        probe = probe_book_update(probe, book.head, state.round)
+        probe = probe_sync_mark(probe, is_sync, alive, state.round)
 
     # -------------------------------------------------------------- metrics
     gap = jnp.where(
@@ -697,6 +730,7 @@ def _repair_step(
         "clock_skew": skew,
         **swim_metrics,
         **sync_metrics,
+        **(probe_metrics(probe) if cfg.probes else {}),
     }
 
     new_state = state.replace(
@@ -707,5 +741,6 @@ def _repair_step(
         sync_rounds=state.sync_rounds + is_sync.astype(jnp.int32),
         hlc=hlc,
         last_cleared=last_cleared,
+        probe=probe,
     )
     return new_state, metrics

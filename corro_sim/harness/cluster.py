@@ -142,6 +142,8 @@ class LiveCluster:
         self._stage_ms: dict[str, tuple[float, float]] = {}
         self._gap = 0.0  # last round's convergence gap (metrics reuse)
         self._prev_swim: dict[str, float] = {}  # transition-counter state
+        self._probe_p99 = None  # worst per-probe p99 delivery lag seen
+        self._probe_infected_last = -1.0  # change-detector for the check
         self._api_requests = 0  # served API requests (io_driver analog)
         self._api_req_lock = threading.Lock()
         self._chunk_dispatches = 0  # chunked tick batches executed
@@ -885,6 +887,14 @@ class LiveCluster:
                     )
         self._gap = float(packed[names.index("gap"), -1])
         self._partials = float(packed[names.index("buffered_partials"), -1])
+        if "probe_infected" in names:
+            self._lasts["probe_infected"] = float(
+                packed[names.index("probe_infected"), -1]
+            )
+            self._lasts["probe_dups"] = float(
+                packed[names.index("probe_dups"), -1]
+            )
+            self._probe_p99_check()
         if "log_wrapped" in names and packed[names.index("log_wrapped")].any():
             # ring-wrap tripwire (engine/step.py): state may be silently
             # wrong from here on — convergence must never be reported
@@ -1184,6 +1194,100 @@ class LiveCluster:
                 }
             )
         return out
+
+    def probe_trace(self):
+        """The run's probe provenance (obs.probes.ProbeTrace); None when
+        ``cfg.probes == 0``."""
+        if not self.cfg.probes:
+            return None
+        from corro_sim.obs.probes import ProbeTrace
+
+        return ProbeTrace.from_state(
+            self.cfg, self.state, driver="live_cluster",
+            rounds=self._rounds_ticked,
+        )
+
+    def _suspected_by(self) -> np.ndarray:
+        """(N,) — how many observers currently suspect each node (SWIM
+        belief planes; zeros when SWIM is off)."""
+        n = self.cfg.num_nodes
+        out = np.zeros(n, np.int64)
+        if not self.cfg.swim_enabled:
+            return out
+        sw = self.state.swim
+        status = np.asarray(sw.status)
+        if hasattr(sw, "member"):  # windowed O(N·K) belief state
+            member = np.asarray(sw.member)
+            tracked = member >= 0
+            np.add.at(out, member[tracked & (status == 1)], 1)
+        else:
+            out += (status == 1).sum(axis=0)
+        return out
+
+    def node_lag(self, top_k: int = 8) -> dict:
+        """The per-node lag observatory (obs.probes.node_lag_observatory):
+        rows-behind, last-sync age (probe-tracked), SWIM suspicion, and
+        the top-k laggards. Works with probes off — only the sync-age
+        column needs the tracer."""
+        from corro_sim.obs.probes import node_lag_observatory
+
+        last_sync = (
+            np.asarray(self.state.probe.last_sync)
+            if self.cfg.probes else None
+        )
+        return node_lag_observatory(
+            np.asarray(self.state.log.head),
+            np.asarray(self.state.book.head),
+            self._alive,
+            self._rounds_ticked,
+            last_sync=last_sync,
+            suspected_by=self._suspected_by(),
+            top_k=top_k,
+        )
+
+    def probe_report(self) -> dict:
+        """The GET /v1/probes body: per-probe summaries + infection
+        trees (stretch vs the current ground-truth peer graph) plus the
+        lag observatory."""
+        from corro_sim.obs.probes import ground_truth_adjacency
+
+        tr = self.probe_trace()
+        out = {"node_lag": self.node_lag()}
+        if tr is None:
+            out["probes"] = None
+            out["note"] = (
+                "probe tracer disabled — start the cluster with "
+                "cfg_overrides={'probes': K}"
+            )
+            return out
+        adj = ground_truth_adjacency(self._alive, self._part)
+        out.update(tr.report(adj=adj))
+        return out
+
+    def _probe_p99_check(self) -> None:
+        """Flight annotation when a probe's p99 delivery lag worsens —
+        called from the metrics fold, but only when the infected count
+        moved (p99 can only change on a new infection, and the check
+        costs a (K, N) device read)."""
+        cur = self._lasts.get("probe_infected")
+        if cur is None or cur == self._probe_infected_last:
+            return
+        self._probe_infected_last = cur
+        tr = self.probe_trace()
+        if tr is None:
+            return
+        p99 = tr.delivery_p99()
+        if (
+            p99 is not None
+            and self._probe_p99 is not None
+            and p99 > self._probe_p99
+        ):
+            self.flight.annotate(
+                self._rounds_ticked, "probe_p99_regression",
+                p99=p99, prev=self._probe_p99,
+            )
+        if p99 is not None:
+            self._probe_p99 = p99
 
     def metrics_lasts(self) -> dict:
         """Last-round gauge snapshots (ring depth, cumulative overflow)."""

@@ -226,7 +226,7 @@ def save_checkpoint(cluster, path, *, scrub: bool = False,
             # gossip + swim state do not travel in a portable backup
             flat = {
                 k: v for k, v in flat.items()
-                if not k.startswith(("gossip/", "swim/", "rtt", "inflight"))
+                if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "probe/"))
             }
             if origin_node != 0:
                 nested = _unflatten(flat)
@@ -411,7 +411,7 @@ def restore(path, node: int = 0, tripwire=None):
     meta = {**meta, "subs": []}
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/"))
     }
     cluster = _cluster_from_meta(meta, tripwire)
     if node >= cluster.cfg.num_nodes:
@@ -443,7 +443,7 @@ def restore_into(cluster, path, node: int = 0) -> None:
     # restore()): the running cluster keeps its own topology + membership
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/"))
     }
     with cluster.locks.tracked(cluster._lock, "restore", "write"):
         new_layout = _rebuild_layout(meta)

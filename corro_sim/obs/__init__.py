@@ -1,3 +1,15 @@
 from corro_sim.obs.flight import FlightRecorder
+from corro_sim.obs.probes import (
+    ProbeTrace,
+    bfs_hops,
+    ground_truth_adjacency,
+    node_lag_observatory,
+)
 
-__all__ = ["FlightRecorder"]
+__all__ = [
+    "FlightRecorder",
+    "ProbeTrace",
+    "bfs_hops",
+    "ground_truth_adjacency",
+    "node_lag_observatory",
+]

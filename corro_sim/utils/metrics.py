@@ -283,9 +283,11 @@ def render_prometheus(cluster) -> str:
     for key, (name, kind, help_) in _SERIES.items():
         if key in totals:
             emit(name, kind, help_, int(totals[key]))
-    # remaining step metrics, generically
+    # remaining step metrics, generically. probe_* step metrics are
+    # GAUGES (infected count, cumulative dups) — summing them across
+    # rounds would lie; they render in the probe block below instead.
     for key, v in sorted(totals.items()):
-        if key not in _SERIES:
+        if key not in _SERIES and not key.startswith("probe_"):
             emit(
                 f"corro_sim_{key}_total", "counter",
                 f"sim step metric {key}", v,
@@ -649,6 +651,86 @@ def render_prometheus(cluster) -> str:
             emit("corro_flight_epidemic_window_rounds", "gauge",
                  "rounds the gap spent above 10% of its peak",
                  diag["epidemic_window_rounds"])
+
+    # ---- probe tracer + per-node lag observatory (obs/probes.py): the
+    # /metrics face of the gossip provenance — full infection trees ride
+    # GET /v1/probes / `corro-sim probes`. The lag observatory renders
+    # with probes off too (only its sync-age column needs the tracer).
+    if hasattr(cluster, "node_lag"):
+        lag = cluster.node_lag()
+        emit("corro_node_lag_rows_behind_sum", "gauge",
+             "versions written cluster-wide not yet applied, summed over "
+             "live nodes", lag["rows_behind_total"])
+        emit("corro_node_lag_rows_behind_max", "gauge",
+             "worst live node's unapplied-version backlog",
+             lag["rows_behind_max"])
+        emit("corro_node_lag_nodes_lagging", "gauge",
+             "live nodes with a nonzero unapplied-version backlog",
+             lag["lagging_nodes"])
+        if lag["last_sync_age_max"] is not None:
+            emit("corro_node_lag_last_sync_age_max", "gauge",
+                 "rounds since the stalest live node took part in an "
+                 "anti-entropy sweep", lag["last_sync_age_max"])
+        if lag["top_laggards"]:
+            lines.append("# HELP corro_node_lag_rows_behind top-k "
+                         "laggards: unapplied-version backlog per node")
+            lines.append("# TYPE corro_node_lag_rows_behind gauge")
+            for row in lag["top_laggards"]:
+                lines.append(
+                    f'corro_node_lag_rows_behind{{node="{row["node"]}"}} '
+                    f'{row["rows_behind"]}'
+                )
+            if "suspected_by" in lag["top_laggards"][0]:
+                lines.append("# HELP corro_node_lag_suspected_by top-k "
+                             "laggards: SWIM observers suspecting the node")
+                lines.append("# TYPE corro_node_lag_suspected_by gauge")
+                for row in lag["top_laggards"]:
+                    lines.append(
+                        f'corro_node_lag_suspected_by'
+                        f'{{node="{row["node"]}"}} {row["suspected_by"]}'
+                    )
+            if "last_sync_age" in lag["top_laggards"][0]:
+                lines.append("# HELP corro_node_lag_last_sync_age top-k "
+                             "laggards: rounds since the node's last "
+                             "anti-entropy sweep (-1 = never)")
+                lines.append("# TYPE corro_node_lag_last_sync_age gauge")
+                for row in lag["top_laggards"]:
+                    lines.append(
+                        f'corro_node_lag_last_sync_age'
+                        f'{{node="{row["node"]}"}} {row["last_sync_age"]}'
+                    )
+    tr = cluster.probe_trace() if hasattr(cluster, "probe_trace") else None
+    if tr is not None:
+        emit("corro_probe_count", "gauge",
+             "versions tracked by the on-device probe tracer",
+             tr.num_probes)
+        fams = (
+            ("coverage", "corro_probe_coverage",
+             "fraction of the cluster holding the probe's version"),
+            ("infected", "corro_probe_infected",
+             "nodes holding the probe's version"),
+            ("dup_deliveries", "corro_probe_dup_total",
+             "delivered probe chunks that landed on already-infected "
+             "nodes (redundancy)"),
+            ("delivery_round_p50", "corro_probe_delivery_round_p50",
+             "median delivery round relative to the origin commit"),
+            ("delivery_round_p99", "corro_probe_delivery_round_p99",
+             "p99 delivery round relative to the origin commit"),
+            ("hop_max", "corro_probe_hop_max",
+             "longest gossip path from the origin, in hops"),
+            ("redundancy_ratio", "corro_probe_redundancy_ratio",
+             "duplicate deliveries per non-origin infection"),
+        )
+        summaries = [tr.summary(k) for k in range(tr.num_probes)]
+        for field, name, help_ in fams:
+            rows_out = [
+                f'{name}{{probe="{s["probe"]}"}} {s[field]}'
+                for s in summaries if s[field] is not None
+            ]
+            if rows_out:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} gauge")
+                lines.extend(rows_out)
 
     # ---- tracing (tokio-metrics / runtime introspection analog)
     from corro_sim.utils.tracing import tracer as _tracer

@@ -180,9 +180,12 @@ def test_exposition_format_validates(cluster):
     parseable (name{labels} value), label syntax valid, every histogram's
     buckets cumulative per label-set with the +Inf bucket equal to its
     _count."""
+    _validate_exposition(render_prometheus(cluster))
+
+
+def _validate_exposition(text):
     import re
 
-    text = render_prometheus(cluster)
     assert text.endswith("\n")
     sample_re = re.compile(
         r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
@@ -244,3 +247,56 @@ def test_exposition_format_validates(cluster):
         assert total == counts[-1], (
             f"{base}{rest_labels}: +Inf bucket != _count"
         )
+
+
+@pytest.fixture(scope="module")
+def probe_cluster():
+    """A cluster with the probe tracer on (ISSUE 2): the corro_probe_* /
+    corro_node_lag_* families must render and validate."""
+    c = LiveCluster(
+        SCHEMA, num_nodes=4, default_capacity=16,
+        cfg_overrides={"swim_enabled": True, "probes": 2},
+    )
+    c.execute(["INSERT INTO kv (k, v) VALUES ('p', '1')"])
+    c.tick(8)
+    return c
+
+
+def test_probe_and_node_lag_families_present(probe_cluster):
+    text = render_prometheus(probe_cluster)
+    names = _names(text)
+    expected = {
+        "corro_probe_count", "corro_probe_coverage",
+        "corro_probe_infected", "corro_probe_dup_total",
+        "corro_node_lag_rows_behind_sum", "corro_node_lag_rows_behind_max",
+        "corro_node_lag_nodes_lagging", "corro_node_lag_rows_behind",
+        "corro_node_lag_suspected_by", "corro_node_lag_last_sync_age",
+        "corro_node_lag_last_sync_age_max",
+    }
+    missing = expected - names
+    assert not missing, f"missing series: {sorted(missing)}"
+    # probe families carry a probe= label per tracked version
+    assert 'corro_probe_coverage{probe="0"}' in text
+    assert 'corro_probe_coverage{probe="1"}' in text
+    # lag observatory rows carry node= labels
+    assert 'corro_node_lag_rows_behind{node="' in text
+    # probe step metrics are gauges here, never mis-summed into the
+    # generic corro_sim_*_total counter family
+    assert "corro_sim_probe_infected_total" not in text
+    assert "corro_sim_probe_dups_total" not in text
+
+
+def test_probe_exposition_validates(probe_cluster):
+    """The satellite ask: the Prometheus exposition validator covers the
+    new families too — label syntax, HELP/TYPE uniqueness, histogram
+    invariants all hold with the probe tracer enabled."""
+    _validate_exposition(render_prometheus(probe_cluster))
+
+
+def test_node_lag_renders_without_probes(cluster):
+    """The lag observatory never needs the tracer; only its sync-age
+    column does."""
+    text = render_prometheus(cluster)
+    assert "corro_node_lag_rows_behind_sum" in text
+    assert "corro_probe_count" not in text
+    assert "corro_node_lag_last_sync_age_max" not in text

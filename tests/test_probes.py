@@ -1,0 +1,338 @@
+"""Probe tracer: on-device provenance vs the pure-NumPy BFS oracle.
+
+Three layers of evidence (ISSUE 2):
+
+- **non-perturbation guard** — `sim_step` with ``cfg.probes`` disabled
+  is bit-identical (state AND metrics) to the instrumented config's
+  shared leaves: the tracer can never change what it measures;
+- **on-device trees vs BFS** — infection trees from real runs on
+  deterministic topologies (full mesh, partitioned islands) satisfy the
+  gossip bounds: monotone coverage, hop = parent hop + 1, hop >= BFS
+  shortest path on the ground-truth peer graph (stretch >= 1);
+- **reconstruction on synthetic provenance** — ring and star
+  infection trees built by hand reconstruct exactly, and the BFS oracle
+  agrees with the known closed-form distances.
+"""
+
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.state import init_state
+from corro_sim.engine.step import sim_step
+from corro_sim.obs.probes import (
+    INFECTOR_NONE,
+    INFECTOR_SYNC,
+    ProbeTrace,
+    bfs_hops,
+    ground_truth_adjacency,
+    node_lag_observatory,
+)
+
+N = 16
+BASE = SimConfig(
+    num_nodes=N, num_rows=32, num_cols=2, log_capacity=64, write_rate=0.6
+)
+
+
+def _run(cfg, rounds=24, write_rounds=6, part=None, seed=7):
+    state = init_state(cfg, seed=0)
+    alive = jnp.ones((cfg.num_nodes,), bool)
+    part = jnp.asarray(
+        part if part is not None
+        else np.zeros(cfg.num_nodes, np.int32)
+    )
+    step = jax.jit(
+        lambda st, k, we: sim_step(cfg, st, k, alive, part, we)
+    )
+    key = jax.random.PRNGKey(seed)
+    metrics = []
+    for r in range(rounds):
+        state, m = step(
+            state, jax.random.fold_in(key, r), jnp.asarray(r < write_rounds)
+        )
+        metrics.append({k: np.asarray(v) for k, v in m.items()})
+    return state, metrics
+
+
+@pytest.fixture(scope="module")
+def traced():
+    cfg = dataclasses.replace(BASE, probes=4)
+    state, metrics = _run(cfg)
+    return cfg, state, metrics
+
+
+def test_probes_do_not_perturb_simulation(traced):
+    """The guard: with probes disabled the state and metrics are
+    bit-identical to the instrumented run's shared leaves — the
+    instrumentation can never perturb the simulation."""
+    cfgp, sp, mp = traced
+    s0, m0 = _run(BASE)
+    for f in dataclasses.fields(type(s0)):
+        if f.name == "probe":
+            continue
+        for a, b in zip(
+            jax.tree.leaves(getattr(s0, f.name)),
+            jax.tree.leaves(getattr(sp, f.name)),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+    for r, (a, b) in enumerate(zip(m0, mp)):
+        for k in a:
+            assert np.array_equal(a[k], b[k]), (r, k)
+    # and the probe metrics are additive-only
+    assert set(mp[0]) - set(m0[0]) == {"probe_infected", "probe_dups"}
+
+
+def test_coverage_monotone_and_metrics_match(traced):
+    cfg, state, metrics = traced
+    tr = ProbeTrace.from_state(cfg, state)
+    for k in range(tr.num_probes):
+        _, counts = tr.coverage_curve(k)
+        assert counts == sorted(counts)
+    # the per-round probe_infected metric is itself monotone and ends at
+    # the final infected total
+    series = [int(m["probe_infected"]) for m in metrics]
+    assert series == sorted(series)
+    assert series[-1] == int((tr.first_seen >= 0).sum())
+
+
+def test_hops_bound_by_bfs_full_mesh(traced):
+    """hop >= BFS on the ground-truth peer graph for every reached node
+    (stretch >= 1): gossip cannot beat shortest paths."""
+    cfg, state, _ = traced
+    tr = ProbeTrace.from_state(cfg, state)
+    adj = ground_truth_adjacency(
+        np.ones(N, bool), np.zeros(N, np.int32)
+    )
+    checked = 0
+    for k in range(tr.num_probes):
+        if tr.origin_round(k) is None:
+            continue
+        bfs = bfs_hops(adj, int(tr.actor[k]))
+        hop = tr.hop[k]
+        mask = hop >= 1
+        assert (hop[mask] >= bfs[mask]).all()
+        st = tr.stretch(k, adj)
+        if st is not None:
+            assert st["min"] >= 1.0
+            checked += 1
+    assert checked >= 1
+
+
+def test_tree_edges_are_causal(traced):
+    """Every gossip edge's parent was infected no later than its child,
+    and the child's hop is exactly the parent's + 1 (single-chunk
+    versions: a forwarder always completed before relaying)."""
+    cfg, state, _ = traced
+    tr = ProbeTrace.from_state(cfg, state)
+    edges = 0
+    for k in range(tr.num_probes):
+        tree = tr.infection_tree(k)
+        for e in tree["edges"]:
+            p, c = e["parent"], e["child"]
+            assert tr.first_seen[k, p] >= 0
+            assert tr.first_seen[k, p] <= tr.first_seen[k, c]
+            assert tr.hop[k, c] == tr.hop[k, p] + 1
+            edges += 1
+        for j in tree["sync_joins"]:
+            assert tr.infector[k, j["node"]] == INFECTOR_SYNC
+    assert edges > 0
+
+
+def test_partition_blocks_probes():
+    """Two islands for the whole run: a probe seeded in partition 0
+    never reaches partition 1, matching the BFS oracle's unreachable
+    verdict."""
+    cfg = dataclasses.replace(BASE, probes=2, write_rate=1.0)
+    part = np.zeros(N, np.int32)
+    part[N // 2:] = 1
+    state, _ = _run(cfg, rounds=16, write_rounds=2, part=part)
+    tr = ProbeTrace.from_state(cfg, state)
+    adj = ground_truth_adjacency(np.ones(N, bool), part)
+    for k in range(tr.num_probes):
+        origin = int(tr.actor[k])
+        assert tr.origin_round(k) is not None  # write_rate 1: all wrote
+        bfs = bfs_hops(adj, origin)
+        other = part != part[origin]
+        assert (bfs[other] == -1).all()
+        assert (tr.first_seen[k][other] == -1).all()
+        # and the home island fully converges
+        same = (part == part[origin])
+        assert (tr.first_seen[k][same] >= 0).all()
+
+
+def _synthetic(first_seen, infector, hop, actor=0):
+    k, n = first_seen.shape
+    return ProbeTrace(
+        actor=np.full((k,), actor, np.int32),
+        ver=np.ones((k,), np.int32),
+        first_seen=np.asarray(first_seen, np.int32),
+        infector=np.asarray(infector, np.int32),
+        hop=np.asarray(hop, np.int32),
+        dup=np.zeros((k,), np.int32),
+        last_sync=np.full((n,), -1, np.int32),
+    )
+
+
+def test_bfs_reference_ring_star_topologies():
+    """The NumPy oracle against closed forms: ring distances are
+    min(i, n-i); star distances are 1 from the hub, 2 leaf-to-leaf."""
+    n = 8
+    ring = np.zeros((n, n), bool)
+    for i in range(n):
+        ring[i, (i + 1) % n] = ring[i, (i - 1) % n] = True
+    d = bfs_hops(ring, 0)
+    assert d.tolist() == [min(i, n - i) for i in range(n)]
+    star = np.zeros((n, n), bool)
+    star[0, 1:] = star[1:, 0] = True
+    assert bfs_hops(star, 0).tolist() == [0] + [1] * (n - 1)
+    assert bfs_hops(star, 3).tolist() == [1, 2, 2, 0, 2, 2, 2, 2]
+
+
+def test_tree_reconstruction_ring_provenance():
+    """A hand-built ring infection (node i infected by i-1 at round i)
+    reconstructs exactly and is BFS-tight along one direction."""
+    n = 6
+    fs = np.arange(n, dtype=np.int32)[None, :]
+    inf = np.concatenate([[INFECTOR_NONE], np.arange(n - 1)])[None, :]
+    hop = np.concatenate([[0], np.arange(1, n)])[None, :]
+    tr = _synthetic(fs, inf, hop)
+    tree = tr.infection_tree(0)
+    assert tree["origin_round"] == 0
+    assert tree["sync_joins"] == []
+    assert sorted((e["parent"], e["child"]) for e in tree["edges"]) == [
+        (i, i + 1) for i in range(n - 1)
+    ]
+    ring = np.zeros((n, n), bool)
+    for i in range(n - 1):  # a DIRECTED chain: hop i is also BFS-optimal
+        ring[i, i + 1] = True
+    st = tr.stretch(0, ring)
+    assert st == {"min": 1.0, "mean": 1.0, "max": 1.0, "nodes": n - 1}
+    _, counts = tr.coverage_curve(0)
+    assert counts == list(range(1, n + 1))
+
+
+def test_tree_reconstruction_star_provenance():
+    """A star: the hub infects every leaf in round 1 — all hops 1,
+    stretch exactly 1 vs the star graph."""
+    n = 5
+    fs = np.array([[0] + [1] * (n - 1)], np.int32)
+    inf = np.array([[INFECTOR_NONE] + [0] * (n - 1)], np.int32)
+    hop = np.array([[0] + [1] * (n - 1)], np.int32)
+    tr = _synthetic(fs, inf, hop)
+    tree = tr.infection_tree(0)
+    assert all(e["parent"] == 0 and e["hop"] == 1 for e in tree["edges"])
+    star = np.zeros((n, n), bool)
+    star[0, 1:] = star[1:, 0] = True
+    assert tr.stretch(0, star) == {
+        "min": 1.0, "mean": 1.0, "max": 1.0, "nodes": n - 1,
+    }
+    s = tr.summary(0, adj=star)
+    assert s["delivery_round_p50"] == 1.0 and s["hop_max"] == 1
+
+
+def test_exports_parse_and_are_loadable(traced):
+    """NDJSON journal lines all parse; the Chrome trace is structurally
+    what Perfetto's JSON importer requires (traceEvents array, ph/ts/pid
+    per event, flow arrows bound to slices)."""
+    cfg, state, _ = traced
+    tr = ProbeTrace.from_state(cfg, state, run="test")
+    lines = tr.to_ndjson().splitlines()
+    recs = [json.loads(ln) for ln in lines]
+    assert recs[0]["t"] == "probe_meta" and recs[0]["probes"] == 4
+    kinds = {r["t"] for r in recs}
+    assert kinds == {"probe_meta", "probe", "probe_node"}
+    # per-probe node records arrive in first-seen order (curve-readable)
+    for k in range(tr.num_probes):
+        rs = [r["r"] for r in recs if r["t"] == "probe_node" and r["k"] == k]
+        assert rs == sorted(rs)
+    ct = tr.to_chrome_trace()
+    assert isinstance(ct["traceEvents"], list) and ct["traceEvents"]
+    slices = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert slices and all(
+        {"pid", "tid", "ts", "dur", "name"} <= set(e) for e in slices
+    )
+    starts = [e for e in ct["traceEvents"] if e["ph"] == "s"]
+    ends = [e for e in ct["traceEvents"] if e["ph"] == "f"]
+    assert len(starts) == len(ends)
+    assert {e["id"] for e in starts} == {e["id"] for e in ends}
+    # round-trips through json (what dump_chrome_trace writes)
+    json.loads(json.dumps(ct))
+
+
+def test_node_lag_observatory_flags_laggard():
+    log_head = np.array([4, 0, 0, 0], np.int32)
+    book_head = np.tile(log_head, (4, 1))
+    book_head[2, 0] = 1  # node 2 is 3 versions behind actor 0
+    alive = np.ones(4, bool)
+    obs = node_lag_observatory(
+        log_head, book_head, alive, 10,
+        last_sync=np.array([9, 9, 2, 9], np.int32),
+        suspected_by=np.array([0, 0, 2, 0], np.int64),
+        top_k=2,
+    )
+    assert obs["rows_behind_total"] == 3
+    assert obs["rows_behind_max"] == 3
+    assert obs["lagging_nodes"] == 1
+    top = obs["top_laggards"][0]
+    assert top == {
+        "node": 2, "rows_behind": 3, "last_sync_age": 8, "suspected_by": 2,
+    }
+    assert obs["last_sync_age_max"] == 8
+    # dead nodes are excluded from the backlog
+    alive[2] = False
+    obs2 = node_lag_observatory(log_head, book_head, alive, 10)
+    assert obs2["rows_behind_total"] == 0
+
+
+def test_probe_state_placeholder_when_off():
+    state = init_state(BASE, seed=0)
+    assert state.probe.first_seen.shape == (1, 1)
+    cfgp = dataclasses.replace(BASE, probes=4)
+    sp = init_state(cfgp, seed=0)
+    assert sp.probe.first_seen.shape == (4, N)
+    # probes sample distinct, evenly spread origin actors
+    actors = np.asarray(sp.probe.actor)
+    assert len(set(actors.tolist())) == 4
+    assert (np.asarray(sp.probe.ver) == 1).all()
+
+
+def test_run_sim_probe_extraction_and_repair_equivalence():
+    """run_sim threads probes through BOTH chunk programs (full +
+    repair-specialized) — the provenance a driver run extracts matches a
+    plain per-round loop bit for bit, even when the driver switches to
+    the repair program mid-run."""
+    from corro_sim.engine.driver import Schedule, run_sim
+
+    cfg = dataclasses.replace(BASE, probes=3, write_rate=0.5)
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), Schedule(write_rounds=4),
+        max_rounds=64, chunk=8, seed=0, warmup=False,
+        stop_on_convergence=False, phase_specialize=True,
+    )
+    assert res.probe is not None
+    # reference: the plain jit step over the same schedule/keys
+    state = init_state(cfg, seed=0)
+    alive = jnp.ones((N,), bool)
+    part = jnp.zeros((N,), jnp.int32)
+    step = jax.jit(
+        lambda st, k, we: sim_step(cfg, st, k, alive, part, we)
+    )
+    root = jax.random.PRNGKey(0)
+    for ci in range(res.rounds // 8):
+        keys = jax.random.split(jax.random.fold_in(root, ci), 8)
+        for t in range(8):
+            state, _ = step(
+                state, keys[t], jnp.asarray(ci * 8 + t < 4)
+            )
+    ref = ProbeTrace.from_state(cfg, state)
+    assert np.array_equal(res.probe.first_seen, ref.first_seen)
+    assert np.array_equal(res.probe.infector, ref.infector)
+    assert np.array_equal(res.probe.hop, ref.hop)
+    assert np.array_equal(res.probe.dup, ref.dup)
+    assert np.array_equal(res.probe.last_sync, ref.last_sync)
