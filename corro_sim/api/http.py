@@ -186,9 +186,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._traced(name, lambda: self._post_migrations(params))
         elif path == "/v1/table_stats":
             self._traced(name, lambda: self._post_table_stats(params))
+        elif path == "/v1/faults":
+            self._traced(name, self._post_faults)
         else:
             self._traced(name, lambda: self._send_json(
                 {"error": "not found"}, status=404))
+
+    # POST /v1/faults — arm/disarm a chaos scenario on the live cluster:
+    # {"scenario": "lossy:p=0.1", "rounds": 128} or {"clear": true}
+    def _post_faults(self):
+        body = self._body_json()
+        if not isinstance(body, dict):
+            raise _ApiError(400, "body must be a JSON object")
+        if body.get("clear"):
+            self._send_json(self.api.cluster.clear_scenario())
+            return
+        spec = body.get("scenario")
+        if not spec:
+            raise _ApiError(400, "body needs \"scenario\" (or \"clear\")")
+        try:
+            out = self.api.cluster.load_scenario(
+                str(spec), rounds=int(body.get("rounds", 128)),
+                seed=body.get("seed"),
+            )
+        except (ValueError, KeyError, TypeError) as e:
+            raise _ApiError(400, str(e)) from None
+        self._send_json(out)
 
     def do_GET(self):  # noqa: N802
         with self.api.cluster._api_req_lock:
@@ -218,6 +241,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._traced(name, lambda: self._get_flight(params))
         elif path == "/v1/probes":
             self._traced(name, lambda: self._get_probes(params))
+        elif path == "/v1/faults":
+            self._traced(
+                name,
+                lambda: self._send_json(self.api.cluster.fault_report()),
+            )
         elif path == "/metrics":
             self._traced(name, self._get_metrics)
         else:

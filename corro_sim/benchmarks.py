@@ -393,10 +393,32 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
     if probes > 0:
         # same invariant gate the CLI path runs (0 <= probes <= nodes)
         cfg = dataclasses.replace(cfg, probes=probes).validate()
+    # CORRO_BENCH_SCENARIO=name[:k=v,...] runs the bench config under a
+    # chaos scenario (faults/scenarios.py): the scenario's schedule
+    # replaces the config's, its fault knobs compile into the step, and
+    # the invariant checkers ride along — every bench number can be
+    # re-taken under loss/churn/partitions with one env var.
+    scenario_spec = os.environ.get("CORRO_BENCH_SCENARIO", "") or None
+    scenario = None
+    invariants = None
+    if scenario_spec:
+        from corro_sim.faults import InvariantChecker, make_scenario
+
+        scenario = make_scenario(
+            scenario_spec, cfg.num_nodes, rounds=max_rounds,
+            write_rounds=schedule.write_rounds, seed=0,
+        )
+        cfg = scenario.apply(cfg)
+        schedule = scenario.schedule()
+        invariants = InvariantChecker(cfg)
+        if min_rounds is None or (scenario.heal_round or 0) > min_rounds:
+            min_rounds = max(
+                scenario.heal_round or 0, schedule.write_rounds
+            )
     res = run_sim(
         cfg, init_state(cfg, seed=0), schedule,
         max_rounds=max_rounds, chunk=8, seed=0, min_rounds=min_rounds,
-        flight=_FLIGHT,
+        flight=_FLIGHT, invariants=invariants,
     )
     out = {
         "metric": label,
@@ -407,6 +429,24 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
     }
+    if scenario is not None:
+        out["scenario"] = scenario.spec
+        if (
+            scenario.heal_round is not None
+            and res.converged_round is not None
+        ):
+            out["recovery_rounds"] = (
+                res.converged_round - scenario.heal_round
+            )
+        out["fault_totals"] = {
+            k: int(res.metrics[k].sum()) for k in sorted(res.metrics)
+            if k.startswith("fault_") and k != "fault_burst_nodes"
+        }
+        out["invariants_ok"] = invariants.ok
+        if not invariants.ok:
+            out["invariant_violations"] = [
+                v.as_dict() for v in invariants.violations[:8]
+            ]
     if res.probe is not None and _FLIGHT is not None and _FLIGHT.sink_path:
         prefix = _FLIGHT.sink_path + ".probes"
         res.probe.dump_ndjson(prefix + ".ndjson")

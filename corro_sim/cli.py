@@ -65,6 +65,22 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if getattr(args, flag) is not None
     }
     cfg = dataclasses.replace(cfg, **overrides).validate()
+    schedule = Schedule(write_rounds=args.write_rounds)
+    scenario = None
+    if args.scenario:
+        from corro_sim.faults import make_scenario
+
+        scenario = make_scenario(
+            args.scenario, cfg.num_nodes, rounds=args.max_rounds,
+            write_rounds=args.write_rounds, seed=args.seed,
+        )
+        cfg = scenario.apply(cfg)
+        schedule = scenario.schedule()
+    invariants = None
+    if args.check_invariants or args.scenario:
+        from corro_sim.faults import InvariantChecker
+
+        invariants = InvariantChecker(cfg)
     flight = None
     if args.flight_out:
         from corro_sim.obs.flight import FlightRecorder
@@ -80,12 +96,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
     res = run_sim(
         cfg,
         init_state(cfg, seed=args.seed),
-        Schedule(write_rounds=args.write_rounds),
+        schedule,
         max_rounds=args.max_rounds,
         chunk=args.chunk,
         seed=args.seed,
         flight=flight,
         profile_dir=args.profile_dir,
+        invariants=invariants,
+        min_rounds=(
+            max(scenario.heal_round or 0, args.write_rounds)
+            if scenario is not None else None
+        ),
     )
     diag = res.flight.diagnostics()
     report = {
@@ -138,6 +159,27 @@ def _cmd_run(args: argparse.Namespace) -> int:
         report["probe_coverage"] = [s["coverage"] for s in summaries]
     if args.profile_dir:
         report["profile_dir"] = args.profile_dir
+    if scenario is not None:
+        report["scenario"] = scenario.spec
+        report["heal_round"] = scenario.heal_round
+        if (
+            scenario.heal_round is not None
+            and res.converged_round is not None
+        ):
+            # the soak headline: rounds from heal to re-convergence
+            report["recovery_rounds"] = (
+                res.converged_round - scenario.heal_round
+            )
+    if cfg.faults.enabled:
+        fault_keys = [
+            k for k in res.metrics
+            if k.startswith("fault_") and k != "fault_burst_nodes"
+        ]  # burst_nodes is a gauge — summing it would lie
+        report["fault_totals"] = {
+            k: int(res.metrics[k].sum()) for k in sorted(fault_keys)
+        }
+    if invariants is not None:
+        report["invariants"] = invariants.report()
     if res.poisoned:
         # ring-wrap tripwire (engine/step.py): state may be silently wrong —
         # distinct from an ordinary round-budget miss (exit 3)
@@ -145,7 +187,118 @@ def _cmd_run(args: argparse.Namespace) -> int:
     print(json.dumps(report, indent=2))
     if res.poisoned:
         return 4
+    if invariants is not None and not invariants.ok:
+        return 5
     return 0 if res.converged_round is not None else 3
+
+
+def _cmd_soak(args: argparse.Namespace) -> int:
+    """`corro-sim soak` — sweep chaos scenarios under invariant checking.
+
+    Each scenario runs to (re-)convergence with every invariant checker
+    armed; the report carries per-scenario recovery time (rounds from the
+    scheduled heal to re-convergence), injected-fault totals and the
+    invariant verdicts. Exit codes: 0 all green; 5 an invariant broke;
+    3 a scenario failed to re-converge within the round budget."""
+    import dataclasses
+
+    import numpy as np
+
+    from corro_sim.engine import init_state, run_sim
+    from corro_sim.faults import InvariantChecker, make_scenario
+    from corro_sim.io.config_file import load_config
+    from corro_sim.obs.flight import FlightRecorder
+
+    base = load_config(args.config)
+    overrides = {
+        field: getattr(args, flag)
+        for flag, field in _FLAG_TO_FIELD.items()
+        if getattr(args, flag) is not None
+    }
+    base = dataclasses.replace(base, **overrides).validate()
+    from corro_sim.faults.scenarios import SOAK_DEFAULT
+
+    # the default sweep covers the RECOVERABLE catalog — scenarios whose
+    # faults persist forever by design (blackhole_one_way, ring/star
+    # topology studies) can never re-converge and are opt-in by name
+    specs = args.scenario or list(SOAK_DEFAULT)
+    runs = []
+    any_violation = False
+    any_unconverged = False
+    for i, spec in enumerate(specs):
+        sc = make_scenario(
+            spec, base.num_nodes, rounds=args.rounds,
+            write_rounds=args.write_rounds, seed=args.seed,
+        )
+        cfg = sc.apply(base)
+        inv = InvariantChecker(cfg)
+        flight = None
+        if args.out:
+            # filename from the FULL spec (sanitized), indexed — two
+            # parameterizations of one scenario must not share a journal
+            safe = "".join(
+                ch if ch.isalnum() or ch in "._-" else "-"
+                for ch in sc.spec
+            )
+            flight = FlightRecorder(
+                sink_path=f"{args.out}.{i:02d}.{safe}.ndjson"
+            )
+        res = run_sim(
+            cfg, init_state(cfg, seed=args.seed), sc.schedule(),
+            max_rounds=args.max_rounds, chunk=args.chunk, seed=args.seed,
+            min_rounds=max(sc.heal_round or 0, args.write_rounds),
+            flight=flight, invariants=inv,
+        )
+        heal = sc.heal_round
+        recovery = (
+            res.converged_round - heal
+            if heal is not None and res.converged_round is not None
+            else None
+        )
+        fault_totals = {
+            k: int(np.asarray(res.metrics[k]).sum())
+            for k in sorted(res.metrics)
+            if k.startswith("fault_") and k != "fault_burst_nodes"
+        }
+        run = {
+            "scenario": sc.spec,
+            "converged_round": res.converged_round,
+            "rounds_run": res.rounds,
+            "heal_round": heal,
+            "recovery_rounds": recovery,
+            "poisoned": res.poisoned,
+            "fault_totals": fault_totals,
+            "invariants": inv.report(),
+        }
+        if flight is not None:
+            run["flight"] = (
+                flight.sink_path if flight.sink_active else None
+            )
+            flight.close()
+        runs.append(run)
+        any_violation |= not inv.ok
+        any_unconverged |= res.converged_round is None
+        print(
+            f"# {sc.spec}: converged={res.converged_round} "
+            f"recovery={recovery} invariants="
+            f"{'ok' if inv.ok else 'VIOLATED'}",
+            file=sys.stderr, flush=True,
+        )
+    report = {
+        "nodes": base.num_nodes,
+        "rounds": args.rounds,
+        "seed": args.seed,
+        "scenarios": runs,
+        "ok": not (any_violation or any_unconverged),
+    }
+    if args.out:
+        with open(f"{args.out}.report.json", "w") as f:
+            json.dump(report, f, indent=2)
+        report["report"] = f"{args.out}.report.json"
+    print(json.dumps(report, indent=2))
+    if any_violation:
+        return 5
+    return 3 if any_unconverged else 0
 
 
 def _cmd_bench(args: argparse.Namespace) -> int:
@@ -431,7 +584,56 @@ def build_parser() -> argparse.ArgumentParser:
         help="capture a jax.profiler trace of the scan loop into this "
              "directory (TensorBoard/Perfetto-loadable)",
     )
+    pr.add_argument(
+        "--scenario",
+        help="chaos scenario spec `name[:k=v,...]` (faults/scenarios.py: "
+             "lossy:p=0.1, rolling_restart, split_brain_heal, churn, "
+             "flapper, blackhole_one_way, ...); arms the invariant "
+             "checkers and reports recovery time",
+    )
+    pr.add_argument(
+        "--check-invariants", action="store_true",
+        help="run the fault invariant checkers (faults/invariants.py) "
+             "even without a scenario; violations exit 5",
+    )
     pr.set_defaults(fn=_cmd_run)
+
+    ps = sub.add_parser(
+        "soak",
+        help="sweep chaos scenarios under invariant checking; report "
+             "recovery time per scenario",
+    )
+    ps.add_argument("--config", help="TOML config file ([sim] table)")
+    ps.add_argument("--nodes", type=int)
+    ps.add_argument("--rows", type=int)
+    ps.add_argument("--cols", type=int)
+    ps.add_argument("--log-capacity", type=int)
+    ps.add_argument("--write-rate", type=float)
+    ps.add_argument("--zipf", type=float)
+    ps.add_argument("--swim", action="store_const", const=True)
+    ps.add_argument("--swim-view", type=int)
+    ps.add_argument("--sync-interval", type=int)
+    ps.add_argument("--probes", type=int)
+    ps.add_argument(
+        "--scenario", action="append",
+        help="scenario spec `name[:k=v,...]`; repeatable (default: sweep "
+             "the recoverable catalog — permanent-fault scenarios like "
+             "blackhole_one_way and ring/star are opt-in by name)",
+    )
+    ps.add_argument(
+        "--rounds", type=int, default=128,
+        help="scenario length in rounds (fault timeline horizon)",
+    )
+    ps.add_argument("--write-rounds", type=int, default=16)
+    ps.add_argument("--max-rounds", type=int, default=4096)
+    ps.add_argument("--chunk", type=int, default=16)
+    ps.add_argument("--seed", type=int, default=0)
+    ps.add_argument(
+        "--out",
+        help="artifact path prefix: <out>.<scenario>.ndjson flight "
+             "journals + <out>.report.json",
+    )
+    ps.set_defaults(fn=_cmd_soak)
 
     pb = sub.add_parser(
         "bench",

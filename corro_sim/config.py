@@ -18,6 +18,92 @@ import dataclasses
 
 
 @dataclasses.dataclass(frozen=True)
+class FaultConfig:
+    """Chaos-injection knobs (corro_sim/faults/): stochastic link faults
+    applied on-device at the two transport points of ``engine/step.py`` —
+    the broadcast emission/delivery split and the anti-entropy lane grant
+    — exactly where the reference's UDP datagrams and QUIC sync streams
+    would fail. Static like everything else on :class:`SimConfig`: with
+    every knob at its default (``enabled`` False) the step program traces
+    ZERO extra ops and is bit-identical to the fault-free one
+    (tests/test_faults.py guards this, the ``cfg.probes`` discipline).
+
+    The fault surface is the DATA plane (gossip chunks + sync grants).
+    SWIM probe traffic is modeled as control-plane and not fault-gated —
+    membership false positives come from the *schedule* (nodes actually
+    down / partitioned), not from datagram loss, so the SWIM false-DOWN
+    invariant (faults/invariants.py) stays checkable under any fault mix.
+    """
+
+    loss: float = 0.0  # P(a deliverable gossip chunk is dropped) — the
+    # per-link Bernoulli UDP-loss analog, applied at DELIVERY time so it
+    # hits eager ring-0 sends, random gossip and matured in-flight lanes
+    # alike (reference transport would drop on the wire the same way)
+    dup: float = 0.0  # P(a delivered chunk arrives twice). The second
+    # copy is accounted (fault_dup metric, conservation checker) but not
+    # re-merged: every merge path is idempotent per (dst, actor, ver,
+    # chunk), so a duplicate datagram's only real-world effect here is
+    # wasted accounting — the same reason the reference tolerates UDP
+    # duplication without a dedupe layer.
+    burst_enter: float = 0.0  # Gilbert burst-loss Markov knob: P(a node's
+    # receive path enters the burst state) per round. 0 disables the
+    # burst machinery entirely (no state, no draws).
+    burst_exit: float = 0.5  # P(leaving the burst state) per round
+    burst_loss: float = 1.0  # loss probability while in the burst state
+    # (applied as max(loss, burst_loss) on the victim's incoming links)
+    sync_loss: float | None = None  # P(an admitted sync connection drops
+    # before serving) — the QUIC stream-failure analog, applied at the
+    # lane grant in sync/sync.py. None = same as ``loss``.
+    blackhole: tuple = ()  # asymmetric blackhole masks: directed
+    # (src, dst) node pairs whose messages silently vanish; -1 is a
+    # wildcard (``(3, -1)`` = everything node 3 sends is dropped while it
+    # still receives — the one-way-partition failure gossip must survive).
+    # Also constrains sync (a grant over a blackholed edge fails).
+    trace_vacuous: bool = False  # force the fault program to TRACE with
+    # every knob at zero effect — the non-perturbation guard's lever
+    # (tests/test_faults.py): the injection points themselves must not
+    # change state, metrics or key derivation.
+
+    @property
+    def enabled(self) -> bool:
+        """Static gate: False traces zero fault ops (the cfg.probes
+        discipline)."""
+        return bool(
+            self.loss > 0.0
+            or self.dup > 0.0
+            or self.burst_enter > 0.0
+            or self.blackhole
+            or self.trace_vacuous
+        )
+
+    @property
+    def resolved_sync_loss(self) -> float:
+        return self.loss if self.sync_loss is None else self.sync_loss
+
+    def validate(self, num_nodes: int) -> "FaultConfig":
+        for name in ("loss", "dup", "burst_enter", "burst_exit",
+                     "burst_loss"):
+            v = getattr(self, name)
+            assert 0.0 <= v <= 1.0, f"faults.{name} must be in [0, 1]"
+        if self.sync_loss is not None:
+            assert 0.0 <= self.sync_loss <= 1.0, (
+                "faults.sync_loss must be in [0, 1]"
+            )
+        if self.blackhole:
+            # vectorized: topology scenarios carry O(N^2) pairs
+            import numpy as _np
+
+            pairs = _np.asarray(self.blackhole, dtype=_np.int64)
+            assert pairs.ndim == 2 and pairs.shape[1] == 2, (
+                "blackhole entries are (src, dst) pairs"
+            )
+            assert ((pairs >= -1) & (pairs < num_nodes)).all(), (
+                f"blackhole pairs out of range for {num_nodes} nodes"
+            )
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
 class SimConfig:
     # --- cluster shape ---
     num_nodes: int = 64
@@ -150,6 +236,12 @@ class SimConfig:
     # tracks version 1 of actor k*N//K by default; drivers may re-aim
     # probes by replacing state.probe before running.
 
+    # --- chaos injection (corro_sim/faults/) ---
+    faults: FaultConfig = FaultConfig()  # stochastic link faults at the
+    # two transport points (broadcast delivery + sync grant). Defaults
+    # disabled: zero extra traced ops, bit-identical step program
+    # (tests/test_faults.py non-perturbation guard).
+
     # --- timing model ---
     round_ms: float = 200.0  # simulated wall-clock per round (broadcast
     # flush cadence is 500 ms in the reference, broadcast/mod.rs:378; one
@@ -212,4 +304,5 @@ class SimConfig:
             "the in-flight delay ring buffers the inter-region class only; "
             "intra-region delivery is same-round (latency_intra must be 1)"
         )
+        self.faults.validate(self.num_nodes)
         return self

@@ -39,25 +39,99 @@ class Schedule:
 
     The default models the happy path: everybody up, one partition, writes
     enabled for ``write_rounds`` rounds then quiesce (the measurement phase).
-    Churn/partition scenarios override the callables.
+
+    Fault scenarios provide **precomputed arrays** (``alive``/``part``,
+    shape ``(rounds, n)`` — the compiled form every generator in
+    :mod:`corro_sim.faults.scenarios` emits); rounds past the array's end
+    hold its last row, so a run that outlives the scenario keeps its final
+    topology. The legacy ``alive_fn``/``part_fn`` callables are still
+    accepted: they are materialized into the same arrays once (cached), so
+    ``slice`` itself is pure array indexing either way — no per-round
+    Python loop, and the schedule rows a chunk sees are a function of the
+    absolute round only, never of chunk boundaries
+    (tests/test_scenarios.py pins this).
+
+    ``events``: sparse ``(round, name, attrs)`` fault markers (node kill /
+    rejoin, partition split / heal, loss windows) — ``run_sim`` copies the
+    ones inside each executed chunk into the flight recorder.
     """
 
     write_rounds: int = 16
     alive_fn: Callable[[int, int], np.ndarray] | None = None  # (round, n) -> (n,) bool
     part_fn: Callable[[int, int], np.ndarray] | None = None  # (round, n) -> (n,) int32
+    alive: np.ndarray | None = None  # (R, n) bool precomputed ground truth
+    part: np.ndarray | None = None  # (R, n) int32 precomputed partition ids
+    events: list = dataclasses.field(default_factory=list)
+    name: str | None = None  # scenario label (flight meta, soak reports)
+
+    # materialized-callable caches (grow monotonically; slice reads them)
+    _alive_cache: np.ndarray | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+    _part_cache: np.ndarray | None = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
+
+    def _materialize(self, upto: int, n: int) -> None:
+        """Evaluate the legacy callables out to round ``upto`` (exclusive),
+        once per round ever — later slices reuse the cache, so a stateful
+        callable cannot produce different faults for different chunkings."""
+        if self.alive_fn is not None:
+            have = 0 if self._alive_cache is None else len(self._alive_cache)
+            if upto > have:
+                new = np.stack(
+                    [np.asarray(self.alive_fn(r, n), bool)
+                     for r in range(have, upto)]
+                )
+                self._alive_cache = (
+                    new if self._alive_cache is None
+                    else np.concatenate([self._alive_cache, new])
+                )
+        if self.part_fn is not None:
+            have = 0 if self._part_cache is None else len(self._part_cache)
+            if upto > have:
+                new = np.stack(
+                    [np.asarray(self.part_fn(r, n), np.int32)
+                     for r in range(have, upto)]
+                )
+                self._part_cache = (
+                    new if self._part_cache is None
+                    else np.concatenate([self._part_cache, new])
+                )
+
+    @staticmethod
+    def _rows(src: np.ndarray | None, idx: np.ndarray):
+        """Gather schedule rows, holding the last row past the end."""
+        if src is None or len(src) == 0:
+            return None
+        return src[np.minimum(idx, len(src) - 1)]
 
     def slice(self, start: int, length: int, n: int):
-        alive = np.ones((length, n), bool)
-        part = np.zeros((length, n), np.int32)
-        we = np.zeros((length,), bool)
-        for t in range(length):
-            r = start + t
-            if self.alive_fn is not None:
-                alive[t] = self.alive_fn(r, n)
-            if self.part_fn is not None:
-                part[t] = self.part_fn(r, n)
-            we[t] = r < self.write_rounds
-        return alive, part, we
+        idx = np.arange(start, start + length)
+        self._materialize(start + length, n)
+        alive = self._rows(
+            self.alive if self.alive is not None else self._alive_cache, idx
+        )
+        if alive is None:
+            alive = np.ones((length, n), bool)
+        part = self._rows(
+            self.part if self.part is not None else self._part_cache, idx
+        )
+        if part is None:
+            part = np.zeros((length, n), np.int32)
+        we = idx < self.write_rounds
+        return (
+            np.ascontiguousarray(alive, dtype=bool),
+            np.ascontiguousarray(part, dtype=np.int32),
+            np.ascontiguousarray(we, dtype=bool),
+        )
+
+    def events_in(self, start: int, length: int) -> list:
+        """The fault events falling inside rounds [start, start+length)."""
+        return [
+            ev for ev in self.events
+            if start <= ev[0] < start + length
+        ]
 
 
 @dataclasses.dataclass
@@ -147,6 +221,7 @@ def run_sim(
     on_chunk: Callable[[dict], None] | None = None,
     flight: FlightRecorder | None = None,
     profile_dir: str | None = None,
+    invariants=None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -170,6 +245,12 @@ def run_sim(
     ``profile_dir``: wrap the whole scan loop in ``jax.profiler.trace``
     so a TPU/CPU profile (XLA op timelines, host callstacks) lands next
     to the probe/flight artifacts — load it in Perfetto or TensorBoard.
+
+    ``invariants``: an opt-in :class:`corro_sim.faults.InvariantChecker`
+    — called with the state + metrics after every chunk (one extra
+    device→host read of the bookkeeping planes per chunk, which is why
+    it is opt-in); every violation it finds is annotated into the flight
+    record and counted in ``corro_fault_invariant_violations_total``.
     """
     schedule = schedule or Schedule()
     if flight is None:
@@ -177,6 +258,7 @@ def run_sim(
     flight.set_meta(
         driver="run_sim", nodes=cfg.num_nodes, chunk=chunk, seed=seed,
         max_rounds=max_rounds,
+        **({"scenario": schedule.name} if schedule.name else {}),
     )
     if min_rounds is None:
         min_rounds = schedule.write_rounds
@@ -403,6 +485,45 @@ def run_sim(
                 wall_s=round(chunk_elapsed, 6),
                 aot=run_compiled is not None,
             )
+            # scenario fault events (node kill/rejoin, split, heal, loss
+            # windows) land in the flight record at their scheduled round
+            # — the provenance that makes a chaos run's curve readable
+            for ev_r, ev_name, ev_attrs in schedule.events_in(rounds, chunk):
+                flight.annotate(ev_r + 1, "fault_event", kind=ev_name,
+                                **ev_attrs)
+                counters.inc(
+                    "corro_fault_events_total",
+                    labels=f'{{kind="{ev_name}"}}',
+                    help_="scheduled fault events executed, by kind",
+                )
+            if "fault_lost" in m:
+                for mk, cname in (
+                    ("fault_lost", "corro_fault_lost_total"),
+                    ("fault_dup", "corro_fault_dup_total"),
+                    ("fault_blackholed", "corro_fault_blackholed_total"),
+                    ("fault_sync_lost", "corro_fault_sync_lost_total"),
+                ):
+                    delta = int(np.asarray(m[mk]).sum()) if mk in m else 0
+                    if delta:
+                        counters.inc(
+                            cname, n=delta,
+                            help_="injected fault effects "
+                                  "(corro_sim/faults/)",
+                        )
+            if invariants is not None:
+                for v in invariants.on_chunk(
+                    state, m, alive, part, rounds
+                ):
+                    flight.annotate(
+                        v.round + 1 if v.round is not None else rounds + 1,
+                        "invariant_violation",
+                        invariant=v.invariant, detail=v.detail,
+                    )
+                    counters.inc(
+                        "corro_fault_invariant_violations_total",
+                        labels=f'{{invariant="{v.invariant}"}}',
+                        help_="soak invariant violations by checker",
+                    )
             if prev_writes and not bool(we.any()):
                 # the schedule stopped writing — the measurement phase begins
                 flight.annotate(
@@ -480,6 +601,22 @@ def run_sim(
                     eligible = (gaps == 0.0) & (idx > min_rounds)
                     converged_round = int(idx[np.argmax(eligible)])
                     flight.annotate(converged_round, "converged")
+                    if invariants is not None:
+                        # the convergence report itself is checked: no
+                        # report may stand while a live same-partition
+                        # pair still disagrees on table state
+                        for v in invariants.on_converged(
+                            state, alive[-1], part[-1]
+                        ):
+                            flight.annotate(
+                                converged_round, "invariant_violation",
+                                invariant=v.invariant, detail=v.detail,
+                            )
+                            counters.inc(
+                                "corro_fault_invariant_violations_total",
+                                labels=f'{{invariant="{v.invariant}"}}',
+                                help_="soak invariant violations by checker",
+                            )
                     break
 
         # Drain the pipeline into the measured wall: the axon platform streams

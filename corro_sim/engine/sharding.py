@@ -94,6 +94,11 @@ def state_shardings(
         # probe planes are (K, N) — node axis trailing, and K is tiny;
         # node_major keeps last_sync (N,) sharded, the rest replicated
         probe=node_major(state.probe),
+        fault_burst=(
+            node_sharded
+            if state.fault_burst.shape[0] == num_nodes
+            else replicated  # (1,) placeholder when burst loss is off
+        ),
     )
 
 

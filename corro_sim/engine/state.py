@@ -63,6 +63,10 @@ class SimState:
     # (probe, node) first-seen round / infector / hop provenance, dup
     # counts, per-node last-sync stamps. Placeholder shapes when
     # cfg.probes == 0 — the step never touches it then.
+    fault_burst: jnp.ndarray  # (N,) bool — Gilbert burst-loss Markov
+    # state per node's receive path (corro_sim/faults/): True = the
+    # node's incoming links lose at faults.burst_loss this round. (1,)
+    # placeholder when cfg.faults.burst_enter == 0 — untouched then.
 
 
 def _row_cdf(cfg: SimConfig) -> np.ndarray:
@@ -131,4 +135,7 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
             jnp.int32,
         ),
         probe=make_probe_state(cfg.probes, n),
+        fault_burst=jnp.zeros(
+            (n,) if cfg.faults.burst_enter > 0 else (1,), bool
+        ),
     )

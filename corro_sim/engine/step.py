@@ -35,6 +35,12 @@ from corro_sim.core.merge_kernel import (
     route_lanes,
 )
 from corro_sim.utils.slots import ranks_within_group_masked
+from corro_sim.faults.inject import (
+    blackhole_mask,
+    burst_update,
+    fault_keys,
+    link_fault_masks,
+)
 from corro_sim.engine.probe import (
     probe_book_update,
     probe_delivery_update,
@@ -109,6 +115,23 @@ def sim_step(
         jax.random.split(key, 9)
     )
     reach = _reachable_fn(alive, part)
+
+    # ----------------------------------------------------- chaos injection
+    # Static gate (cfg.probes discipline): faults off traces ZERO extra
+    # ops and the program is bit-identical to the fault-free one. The
+    # fault key lane is fold_in-derived, NOT a wider split, so the 9
+    # subkeys above are byte-identical either way and the repair step
+    # derives the same fault stream (faults/inject.py).
+    fault_on = cfg.faults.enabled
+    if fault_on:
+        k_fburst, k_flink, k_fsync = fault_keys(key)
+        burst = burst_update(cfg.faults, state.fault_burst, k_fburst)
+        bh = blackhole_mask(cfg.faults, n)
+        bh = None if bh is None else jnp.asarray(bh)
+    else:
+        burst = state.fault_burst
+        k_fsync = None
+        bh = None
 
     # ------------------------------------------------------------------ view
     view = membership_view(cfg, state.swim, n)
@@ -273,9 +296,18 @@ def sim_step(
         # live partition fails immediately (the reference transport errors
         # at send time); reach() is then re-checked at delivery below, so a
         # partition landing mid-flight loses the lane too.
+        far = valid & (d > 1)
+        park_ok = far & reach(src, dst)
+        if fault_on:
+            # conservation accounting for the invariant checker
+            # (faults/invariants.py): emissions that parked vs died at
+            # emission, and parked lanes re-entering this round
+            f_parked = park_ok.sum(dtype=jnp.int32)
+            f_emit_lost = (far & ~reach(src, dst)).sum(dtype=jnp.int32)
+            f_matured = mat[5].sum(dtype=jnp.int32)
         inflight = state.inflight.at[slot].set(
             jnp.stack([dst, src, actor, ver, chunk,
-                       (valid & (d > 1) & reach(src, dst)).astype(jnp.int32)])
+                       park_ok.astype(jnp.int32)])
         )
         dst = jnp.concatenate([dst, mat[0]])
         src = jnp.concatenate([src, mat[1]])
@@ -285,10 +317,32 @@ def sim_step(
         valid = jnp.concatenate([valid & (d <= 1), mat[5].astype(bool)])
     else:
         inflight = state.inflight
+        if fault_on:
+            f_parked = f_emit_lost = f_matured = jnp.int32(0)
 
     # Ground truth: the packet lands iff the link is actually up at
     # delivery time (same round for near lanes, d-1 rounds later for far).
     delivered = valid & reach(src, dst)
+
+    # ------------------------------------------------- link-fault masks
+    # The broadcast transport point: deliverable lanes die to the seeded
+    # Bernoulli loss draw (receiver-burst-aware), to the static blackhole
+    # mask, or arrive twice (dup — accounted only: every merge path is
+    # idempotent per (dst, actor, ver, chunk), so the second copy of a
+    # datagram changes no state, exactly like real UDP duplication).
+    if fault_on:
+        f_unreachable = (valid & ~delivered).sum(dtype=jnp.int32)
+        if bh is not None:
+            holed = delivered & bh[src, dst]
+            delivered = delivered & ~holed
+            f_blackholed = holed.sum(dtype=jnp.int32)
+        else:
+            f_blackholed = jnp.int32(0)
+        keep, dup_m = link_fault_masks(cfg.faults, k_flink, dst, burst)
+        f_lost = (delivered & ~keep).sum(dtype=jnp.int32)
+        delivered = delivered & keep
+        f_dup = (delivered & dup_m).sum(dtype=jnp.int32)
+        f_delivered = delivered.sum(dtype=jnp.int32)
 
     # ONE lane sort for the whole delivery pipeline: bookkeeping dedupe
     # (deliver_versions presorted path), changeset gathers, the merge
@@ -465,6 +519,7 @@ def sim_step(
         cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
         k_sync, alive, view, part,
         rtt=rtt if cfg.rtt_rings else None, round_idx=state.sync_rounds,
+        fault_key=k_fsync,
     )
     if cfg.probes:
         # the anti-entropy merge point: heads that now cover a probe's
@@ -504,6 +559,24 @@ def sim_step(
         **swim_metrics,
         **sync_metrics,
         **(probe_metrics(probe) if cfg.probes else {}),
+        # fault accounting (additive-only, like the probe metrics): the
+        # conservation invariant checker reconstructs per-round message
+        # flow from these — msgs_sent + matured - parked - emit_lost ==
+        # delivered + unreachable + blackholed + lost (invariants.py)
+        **({
+            "fault_lost": f_lost,
+            "fault_dup": f_dup,
+            "fault_blackholed": f_blackholed,
+            "fault_unreachable": f_unreachable,
+            "fault_delivered": f_delivered,
+            "fault_parked": f_parked,
+            "fault_emit_lost": f_emit_lost,
+            "fault_matured": f_matured,
+            "fault_burst_nodes": (
+                burst.sum(dtype=jnp.int32)
+                if cfg.faults.burst_enter > 0 else jnp.int32(0)
+            ),
+        } if fault_on else {}),
     }
 
     new_state = state.replace(
@@ -522,6 +595,7 @@ def sim_step(
         ring0=ring0,
         inflight=inflight,
         probe=probe,
+        fault_burst=burst,
     )
     return new_state, metrics
 
@@ -589,9 +663,14 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
 
 def _sync_block(
     cfg, is_sync, book, log, table, hlc, last_cleared, cleared_hlc,
-    k_sync, alive, view, part, rtt, round_idx=0,
+    k_sync, alive, view, part, rtt, round_idx=0, fault_key=None,
 ):
-    """The sync cond: one anti-entropy sweep when ``is_sync``."""
+    """The sync cond: one anti-entropy sweep when ``is_sync``.
+
+    ``fault_key``: the per-round sync-fault subkey (faults/inject.py)
+    when chaos injection is on — admitted connections then drop with
+    ``faults.resolved_sync_loss`` and across blackholed edges. Static:
+    None (faults off) traces the pre-fault program exactly."""
 
     def do_sync(args):
         book, table, hlc, lc = args
@@ -601,13 +680,13 @@ def _sync_block(
             # reachability as a matrix-free pair of masks: same-partition
             # check happens inside via gathered part ids
             _pairwise_mask(alive, part),
-            rtt=rtt, round_idx=round_idx,
+            rtt=rtt, round_idx=round_idx, fault_key=fault_key,
         )
 
     def no_sync(args):
         book, table, hlc, lc = args
         zero = jnp.int32(0)
-        return book, table, hlc, lc, {
+        m = {
             "sync_pairs": zero,
             "sync_requests": zero,
             "sync_rejections": zero,
@@ -615,6 +694,9 @@ def _sync_block(
             "sync_empties": zero,
             "sync_cells": zero,
         }
+        if cfg.faults.enabled:
+            m["fault_sync_lost"] = zero
+        return book, table, hlc, lc, m
 
     return jax.lax.cond(
         is_sync, do_sync, no_sync, (book, table, hlc, last_cleared)
@@ -664,6 +746,19 @@ def _repair_step(
      k_sync) = jax.random.split(key, 9)
     reach = _reachable_fn(alive, part)
 
+    # same fold_in-derived fault lane as the full step: the burst Markov
+    # state keeps evolving and the sync grant keeps failing through the
+    # convergence tail — recovery under loss must not get a fault-free
+    # repair program. The unused link-loss subkey costs nothing (the full
+    # step's draws on zero valid lanes are masked no-ops there too).
+    fault_on = cfg.faults.enabled
+    if fault_on:
+        k_fburst, _k_flink, k_fsync = fault_keys(key)
+        burst = burst_update(cfg.faults, state.fault_burst, k_fburst)
+    else:
+        burst = state.fault_burst
+        k_fsync = None
+
     view = membership_view(cfg, state.swim, n)
 
     log = state.log
@@ -694,7 +789,7 @@ def _repair_step(
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,
         state.cleared_hlc, k_sync, alive, view, part, rtt=None,
-        round_idx=state.sync_rounds,
+        round_idx=state.sync_rounds, fault_key=k_fsync,
     )
     probe = state.probe
     if cfg.probes:
@@ -731,6 +826,22 @@ def _repair_step(
         **swim_metrics,
         **sync_metrics,
         **(probe_metrics(probe) if cfg.probes else {}),
+        # the zeros the full step would compute on zero lanes, plus the
+        # two live fault series (burst state, sync-grant losses)
+        **({
+            "fault_lost": zero,
+            "fault_dup": zero,
+            "fault_blackholed": zero,
+            "fault_unreachable": zero,
+            "fault_delivered": zero,
+            "fault_parked": zero,
+            "fault_emit_lost": zero,
+            "fault_matured": zero,
+            "fault_burst_nodes": (
+                burst.sum(dtype=jnp.int32)
+                if cfg.faults.burst_enter > 0 else zero
+            ),
+        } if fault_on else {}),
     }
 
     new_state = state.replace(
@@ -742,5 +853,6 @@ def _repair_step(
         hlc=hlc,
         last_cleared=last_cleared,
         probe=probe,
+        fault_burst=burst,
     )
     return new_state, metrics

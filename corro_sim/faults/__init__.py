@@ -1,0 +1,43 @@
+"""Chaos engine: on-device fault injection, named failure scenarios, and
+invariant-checked soak runs.
+
+Corrosion's value claim is that gossip + anti-entropy *recover* — from
+lossy links, crashed agents and partitions — and gossip theory guarantees
+convergence precisely under asynchrony and message loss (PAPERS:
+"Asynchrony and Acceleration in Gossip Algorithms"; SWARM). A simulator
+whose network never fails can only produce happy-path numbers. This
+package makes faults first-class:
+
+- :mod:`inject` — the jax kernels behind :class:`corro_sim.config.
+  FaultConfig`: seeded Bernoulli loss/duplication masks, Gilbert
+  burst-loss Markov state and asymmetric blackhole masks, applied at the
+  two transport points in ``engine/step.py`` (broadcast delivery and the
+  anti-entropy lane grant);
+- :mod:`scenarios` — named, seeded failure generators (``rolling_restart``,
+  ``flapper``, ``split_brain_heal``, ``churn``, ``lossy``,
+  ``blackhole_one_way``, …) that compile into vectorized ``Schedule``
+  arrays plus fault-config overrides, parseable from ``name[:k=v,...]``
+  spec strings (CLI ``--scenario``, ``CORRO_BENCH_SCENARIO``,
+  ``LiveCluster.load_scenario``);
+- :mod:`invariants` — per-chunk assertions that must hold under ANY fault
+  mix (applied-head monotonicity, bookkeeping conservation, no
+  convergence while a live pair disagrees, SWIM never falsely DOWN), and
+  the soak harness behind ``corro-sim soak``.
+"""
+
+from corro_sim.faults.invariants import InvariantChecker, InvariantViolation
+from corro_sim.faults.scenarios import (
+    SCENARIOS,
+    Scenario,
+    make_scenario,
+    parse_scenario_spec,
+)
+
+__all__ = [
+    "SCENARIOS",
+    "Scenario",
+    "InvariantChecker",
+    "InvariantViolation",
+    "make_scenario",
+    "parse_scenario_spec",
+]

@@ -1,0 +1,112 @@
+"""On-device fault kernels: the jax side of :class:`FaultConfig`.
+
+Everything here is statically gated on ``cfg.faults`` — a disabled knob
+contributes zero traced ops, so the default step program stays
+bit-identical to the fault-free one (tests/test_faults.py guard).
+
+Key discipline: the fault lane derives its randomness by ``fold_in`` on
+the round key with a fixed tag, NOT by widening the step's 9-way split.
+That keeps every existing subkey (writes, broadcast, SWIM, sync)
+untouched whether faults are on or off, and lets the repair-specialized
+step derive the identical fault keys — the bit-for-bit equivalence the
+driver's post-quiesce program switch depends on.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.faults.masks import pairs_to_mask
+
+# fold_in tag for the fault key lane (arbitrary constant, fixed forever:
+# changing it changes every seeded fault stream)
+FAULT_KEY_TAG = 0x0FA17
+
+
+def fault_keys(key: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """(k_burst, k_link, k_sync) — the per-round fault subkeys.
+
+    Derived identically by the full and repair step programs (both hold
+    the same round key), so the fault stream is invariant under the
+    driver's post-quiesce program specialization. It is a function of
+    the ROUND KEY — which ``run_sim`` derives from (seed, chunk index,
+    offset) — so exact replay of the stochastic draws needs the same
+    seed AND the same chunking, like every other stochastic stream in
+    the simulation; only the *scheduled* fault timeline (alive/part
+    arrays, events) is chunk-layout-independent.
+    """
+    kf = jax.random.fold_in(key, FAULT_KEY_TAG)
+    k_burst, k_link, k_sync = jax.random.split(kf, 3)
+    return k_burst, k_link, k_sync
+
+
+def blackhole_mask(faults, n: int) -> np.ndarray | None:
+    """(N, N) bool host-side constant: True where src→dst silently drops.
+
+    Built from the static ``faults.blackhole`` directed pairs (-1 =
+    wildcard; shared expansion in :mod:`corro_sim.faults.masks` so the
+    BFS oracle sees the same graph), baked into the program as a
+    constant — no runtime cost beyond the gather at the delivery point."""
+    if not faults.blackhole:
+        return None
+    return pairs_to_mask(faults.blackhole, n)
+
+
+def burst_update(faults, burst: jnp.ndarray, k_burst: jax.Array):
+    """Advance the per-node Gilbert burst state one round.
+
+    Two independent uniforms per node: in-burst nodes exit with
+    ``burst_exit``, healthy nodes enter with ``burst_enter``. Static
+    no-op (returns the placeholder untouched) when the knob is off."""
+    if faults.burst_enter <= 0.0:
+        return burst
+    u = jax.random.uniform(k_burst, (2,) + burst.shape)
+    enter = u[0] < faults.burst_enter
+    stay = u[1] >= faults.burst_exit
+    return jnp.where(burst, stay, enter)
+
+
+def link_fault_masks(
+    faults,
+    k_link: jax.Array,
+    dst: jnp.ndarray,
+    burst: jnp.ndarray,
+):
+    """(keep, dup) lane masks for the broadcast delivery point.
+
+    ``keep``: survives the Bernoulli loss draw (per-lane, receiver-side
+    burst state raises the rate to ``burst_loss``); ``dup``: the lane is
+    delivered twice (accounted, not re-merged — the merge paths are
+    idempotent per (dst, actor, ver, chunk))."""
+    u = jax.random.uniform(k_link, (2,) + dst.shape)
+    p = jnp.float32(faults.loss)
+    if faults.burst_enter > 0.0:
+        p = jnp.where(
+            burst[dst], jnp.maximum(p, jnp.float32(faults.burst_loss)), p
+        )
+    keep = u[0] >= p
+    dup = u[1] < jnp.float32(faults.dup)
+    return keep, dup
+
+
+def sync_grant_keep(
+    faults,
+    k_sync: jax.Array,
+    rows: jnp.ndarray,  # (N,) node iota
+    peer: jnp.ndarray,  # (N, P) chosen peers
+    bh: jnp.ndarray | None,  # (N, N) blackhole constant or None
+):
+    """(N, P) keep mask for admitted sync connections.
+
+    A grant fails with ``resolved_sync_loss`` (the QUIC stream-drop
+    analog) and deterministically when EITHER direction of the
+    client↔server edge is blackholed — sync is a request/response
+    exchange, so a one-way hole kills the connection either way."""
+    u = jax.random.uniform(k_sync, peer.shape)
+    keep = u >= jnp.float32(faults.resolved_sync_loss)
+    if bh is not None:
+        hole = bh[rows[:, None], peer] | bh[peer, rows[:, None]]
+        keep = keep & ~hole
+    return keep

@@ -1,0 +1,211 @@
+"""Invariant checkers: the assertions that must hold under ANY fault mix.
+
+Chaos injection is only evidence if something checks the wreckage. These
+checkers run host-side between driver chunks (opt-in — one extra
+device→host read of the bookkeeping planes per chunk) and accumulate
+:class:`InvariantViolation` records instead of raising, so a soak run
+reports every broken property, not just the first:
+
+- **head monotonicity** — a node's applied version head per actor never
+  decreases: loss, duplication, churn and partitions may stall progress
+  but can never un-apply a version (the reference's bookkeeping is
+  insert-or-max, never decrement);
+- **bookkeeping conservation** — every emitted message is accounted for,
+  round by round: ``sent + matured == parked + emit_lost + delivered +
+  unreachable + blackholed + lost`` (the fault metrics from
+  ``engine/step.py``; checkable only while faults are enabled, which is
+  when it matters);
+- **convergence honesty** — when the driver reports convergence, every
+  pair of live same-partition nodes must actually agree on table state
+  (checked pairwise against a per-partition reference replica);
+- **SWIM liveness honesty** — a node that has been up and reachable by
+  an observer for longer than the suspicion window (plus refutation
+  slack) must not be marked DOWN in that observer's belief: the failure
+  detector may be slow, never permanently wrong about a live peer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["InvariantChecker", "InvariantViolation"]
+
+
+@dataclasses.dataclass
+class InvariantViolation:
+    round: int | None  # absolute 0-based round (None: end-of-run check)
+    invariant: str
+    detail: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class InvariantChecker:
+    """Accumulating per-chunk invariant checker for ``run_sim``.
+
+    Pass one via ``run_sim(..., invariants=InvariantChecker(cfg))``;
+    read ``.violations`` / ``.report()`` afterwards. Stateless apart
+    from the previous chunk's snapshots, so one instance covers one run.
+    """
+
+    def __init__(self, cfg):
+        self.cfg = cfg
+        self.violations: list[InvariantViolation] = []
+        self.chunks_checked = 0
+        self._prev_head: np.ndarray | None = None
+        # (N, N) rounds each directed pair has been continuously
+        # mutually-reachable with both ends up — the SWIM check's clock
+        self._reach_streak: np.ndarray | None = None
+
+    # ------------------------------------------------------------- checks
+    def on_chunk(self, state, metrics, alive, part, start_round):
+        """Run every per-chunk invariant; returns the NEW violations.
+
+        ``alive``/``part``: the chunk's ground-truth schedule rows
+        ((chunk, n)); ``start_round``: absolute 0-based round of the
+        chunk's first row."""
+        new: list[InvariantViolation] = []
+        alive = np.asarray(alive, bool)
+        part = np.asarray(part)
+        chunk = alive.shape[0]
+        self.chunks_checked += 1
+
+        # ---- applied-head monotonicity per (node, actor)
+        head = np.asarray(state.book.head)
+        if self._prev_head is not None:
+            dec = head < self._prev_head
+            if dec.any():
+                i, a = np.argwhere(dec)[0]
+                new.append(InvariantViolation(
+                    start_round + chunk - 1, "head_monotonicity",
+                    f"book.head[{i}, {a}] decreased "
+                    f"{int(self._prev_head[i, a])} → {int(head[i, a])} "
+                    f"(+{int(dec.sum()) - 1} more entries)",
+                ))
+        self._prev_head = head
+
+        # ---- bookkeeping conservation (fault metrics present ⇔ faults on)
+        if "fault_delivered" in metrics:
+            sent = np.asarray(metrics["msgs_sent"], np.int64)
+            lhs = sent + np.asarray(metrics["fault_matured"], np.int64)
+            rhs = (
+                np.asarray(metrics["fault_parked"], np.int64)
+                + np.asarray(metrics["fault_emit_lost"], np.int64)
+                + np.asarray(metrics["fault_delivered"], np.int64)
+                + np.asarray(metrics["fault_unreachable"], np.int64)
+                + np.asarray(metrics["fault_blackholed"], np.int64)
+                + np.asarray(metrics["fault_lost"], np.int64)
+            )
+            bad = lhs != rhs
+            if bad.any():
+                t = int(np.argmax(bad))
+                new.append(InvariantViolation(
+                    start_round + t, "conservation",
+                    f"sent+matured={int(lhs[t])} != parked+emit_lost+"
+                    f"delivered+unreachable+blackholed+lost={int(rhs[t])}"
+                    f" ({int(bad.sum())} bad rounds in chunk)",
+                ))
+
+        # ---- SWIM: no live long-reachable node marked DOWN
+        self._update_reach_streak(alive, part)
+        if self.cfg.swim_enabled:
+            v = self._check_swim(state, alive[-1], start_round + chunk - 1)
+            if v is not None:
+                new.append(v)
+
+        self.violations.extend(new)
+        return new
+
+    def _update_reach_streak(self, alive, part):
+        n = alive.shape[1]
+        if self._reach_streak is None:
+            self._reach_streak = np.zeros((n, n), np.int64)
+        for t in range(alive.shape[0]):
+            reach = (
+                alive[t][:, None] & alive[t][None, :]
+                & (part[t][:, None] == part[t][None, :])
+            )
+            self._reach_streak = np.where(
+                reach, self._reach_streak + 1, 0
+            )
+
+    def _swim_window_rounds(self) -> int:
+        """Rounds a (kill → refutation-gossip) cycle may legitimately
+        take: suspicion timeout + announce cadence + dissemination slack,
+        all stretched by the SWIM tick interval."""
+        cfg = self.cfg
+        return int(cfg.swim_interval) * (
+            int(cfg.swim_suspect_rounds)
+            + int(cfg.swim_announce_interval) + 8
+        )
+
+    def _check_swim(self, state, alive_now, round_idx):
+        window = self._swim_window_rounds()
+        ok_pairs = self._reach_streak > window  # (observer, subject)
+        if not ok_pairs.any():
+            return None
+        from corro_sim.membership.swim import down_belief_matrix
+
+        n = alive_now.shape[0]
+        # [observer, subject] — the canonical belief decoding, shared so
+        # a layout change cannot silently desync this checker
+        down_belief = down_belief_matrix(state.swim, n)
+        bad = down_belief & ok_pairs & alive_now[:, None]
+        if bad.any():
+            i, j = np.argwhere(bad)[0]
+            return InvariantViolation(
+                round_idx, "swim_false_down",
+                f"observer {i} believes live node {j} DOWN after "
+                f"{int(self._reach_streak[i, j])} rounds of mutual "
+                f"reachability (window {window})",
+            )
+        return None
+
+    def on_converged(self, state, alive_now, part_now):
+        """The convergence-honesty check: called by the driver at the
+        moment it reports convergence. Every live node must agree with
+        its partition's reference replica on the full table state."""
+        new: list[InvariantViolation] = []
+        alive_now = np.asarray(alive_now, bool)
+        part_now = np.asarray(part_now)
+        cv = np.asarray(state.table.cv)
+        vr = np.asarray(state.table.vr)
+        cl = np.asarray(state.table.cl)
+        for pid in np.unique(part_now[alive_now]):
+            members = np.nonzero(alive_now & (part_now == pid))[0]
+            if len(members) < 2:
+                continue
+            ref = members[0]
+            for m in members[1:]:
+                if not (
+                    np.array_equal(cv[ref], cv[m])
+                    and np.array_equal(vr[ref], vr[m])
+                    and np.array_equal(cl[ref], cl[m])
+                ):
+                    ncell = int(
+                        (cv[ref] != cv[m]).sum() + (vr[ref] != vr[m]).sum()
+                    )
+                    new.append(InvariantViolation(
+                        None, "convergence_disagreement",
+                        f"converged reported but live nodes {int(ref)} and "
+                        f"{int(m)} (partition {int(pid)}) differ on "
+                        f"~{ncell} cells",
+                    ))
+                    break  # one witness per partition is enough
+        self.violations.extend(new)
+        return new
+
+    # ------------------------------------------------------------ reporting
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def report(self) -> dict:
+        return {
+            "ok": self.ok,
+            "chunks_checked": self.chunks_checked,
+            "violations": [v.as_dict() for v in self.violations],
+        }

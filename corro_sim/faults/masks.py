@@ -1,0 +1,36 @@
+"""The blackhole wildcard-pair semantics, in exactly one place.
+
+``FaultConfig.blackhole`` is a tuple of directed ``(src, dst)`` pairs
+with ``-1`` as a wildcard. Three consumers need the expanded (N, N)
+drop mask — the transport injection point (:mod:`inject`), the sync
+grant (:mod:`corro_sim.sync.sync` via inject) and the BFS oracle graph
+(:mod:`corro_sim.obs.probes`) — and they MUST agree, or the hop/stretch
+bounds the chaos tests assert stop meaning anything. numpy-only so the
+jax-free obs layer can import it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["pairs_to_mask"]
+
+
+def pairs_to_mask(pairs, n: int) -> np.ndarray:
+    """(N, N) bool: True where src→dst is blackholed.
+
+    ``(s, d)`` drops that directed edge; ``(s, -1)`` drops everything s
+    sends (one-way blackhole: it still receives); ``(-1, d)`` drops
+    everything d receives. A ``(-1, -1)`` wildcard is ignored — it would
+    drop every edge. Vectorized: topology scenarios carry O(N^2) pairs.
+    """
+    m = np.zeros((n, n), bool)
+    if not len(pairs):
+        return m
+    arr = np.asarray(pairs, dtype=np.int64)
+    s, d = arr[:, 0], arr[:, 1]
+    exact = (s >= 0) & (d >= 0)
+    m[s[exact], d[exact]] = True
+    m[s[(s >= 0) & (d < 0)], :] = True
+    m[:, d[(s < 0) & (d >= 0)]] = True
+    return m

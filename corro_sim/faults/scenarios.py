@@ -1,0 +1,385 @@
+"""Named failure scenarios: seeded generators compiling into vectorized
+``Schedule`` arrays + fault-config overrides.
+
+A scenario is the reproducible form of a chaos experiment: the same
+``(name, params, n, rounds, seed)`` always produces the same
+``(rounds, n)`` alive/partition arrays, the same fault knobs and the
+same event markers. The scheduled timeline is indexed by absolute
+round, so the rows a chunked driver sees are independent of chunk
+boundaries (tests/test_scenarios.py pins it); the *stochastic* knobs
+(loss/dup/burst draws) replay exactly under the same run seed and
+chunking, like every other random stream in the simulation.
+
+Spec strings (CLI ``--scenario``, ``CORRO_BENCH_SCENARIO``,
+``LiveCluster.load_scenario``) are ``name[:k=v,...]``::
+
+    lossy:p=0.1
+    rolling_restart:batch=4,down=8
+    split_brain_heal:at=8,heal=40
+    churn:rate=0.05
+    blackhole_one_way:src=0
+
+Event tuples are ``(round, kind, attrs)``; an attrs ``phase="heal"``
+marks the moment the last scheduled fault clears — the soak harness
+measures recovery time (rounds from heal to re-convergence) from the
+latest such event.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from corro_sim.config import SimConfig
+
+__all__ = [
+    "SCENARIOS",
+    "Scenario",
+    "make_scenario",
+    "parse_scenario_spec",
+    "ring_blackhole",
+    "star_blackhole",
+]
+
+
+@dataclasses.dataclass
+class Scenario:
+    """A compiled failure scenario: schedule arrays + fault overrides."""
+
+    name: str
+    params: dict
+    rounds: int
+    write_rounds: int
+    faults: dict  # FaultConfig field overrides
+    alive: np.ndarray | None = None  # (rounds, n) bool
+    part: np.ndarray | None = None  # (rounds, n) int32
+    events: list = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        # round-sorted invariant: LiveCluster's event cursor and the
+        # flight-record reader both assume chronological order (wave
+        # generators emit kill/rejoin interleaved)
+        self.events.sort(key=lambda ev: ev[0])
+
+    def schedule(self):
+        """The vectorized :class:`corro_sim.engine.driver.Schedule`."""
+        from corro_sim.engine.driver import Schedule
+
+        return Schedule(
+            write_rounds=self.write_rounds,
+            alive=self.alive,
+            part=self.part,
+            events=list(self.events),
+            name=self.spec,
+        )
+
+    def apply(self, cfg: SimConfig) -> SimConfig:
+        """``cfg`` with this scenario's fault knobs merged in."""
+        if not self.faults:
+            return cfg
+        return dataclasses.replace(
+            cfg, faults=dataclasses.replace(cfg.faults, **self.faults)
+        ).validate()
+
+    @property
+    def spec(self) -> str:
+        if not self.params:
+            return self.name
+        kv = ",".join(f"{k}={v}" for k, v in sorted(self.params.items()))
+        return f"{self.name}:{kv}"
+
+    @property
+    def heal_round(self) -> int | None:
+        heals = [r for r, _, attrs in self.events
+                 if attrs.get("phase") == "heal"]
+        return max(heals) if heals else None
+
+
+def _base(n: int, rounds: int) -> tuple[np.ndarray, np.ndarray]:
+    return np.ones((rounds, n), bool), np.zeros((rounds, n), np.int32)
+
+
+def lossy(n, rounds, write_rounds, seed, p: float = 0.1):
+    """Uniform stochastic link loss for the whole run — the baseline
+    chaos every gossip-theory convergence guarantee is stated under."""
+    return Scenario(
+        name="lossy", params={"p": p}, rounds=rounds,
+        write_rounds=write_rounds, faults={"loss": float(p)},
+    )
+
+
+def duplicating(n, rounds, write_rounds, seed, p: float = 0.1,
+                dup: float = 0.2):
+    """Lossy AND duplicating links (UDP's full failure menu)."""
+    return Scenario(
+        name="duplicating", params={"p": p, "dup": dup}, rounds=rounds,
+        write_rounds=write_rounds,
+        faults={"loss": float(p), "dup": float(dup)},
+    )
+
+
+def burst(n, rounds, write_rounds, seed, enter: float = 0.05,
+          exit: float = 0.3, loss: float = 1.0):
+    """Gilbert burst loss: node receive paths flip into a high-loss
+    state and back (the flaky-NIC / congested-uplink pattern)."""
+    return Scenario(
+        name="burst",
+        params={"enter": enter, "exit": exit, "loss": loss},
+        rounds=rounds, write_rounds=write_rounds,
+        faults={
+            "burst_enter": float(enter), "burst_exit": float(exit),
+            "burst_loss": float(loss),
+        },
+    )
+
+
+def blackhole_one_way(n, rounds, write_rounds, seed, src: int = 0):
+    """Node ``src`` transmits into a void but still receives — the
+    asymmetric-partition failure SWIM's indirect probes exist for."""
+    return Scenario(
+        name="blackhole_one_way", params={"src": int(src)}, rounds=rounds,
+        write_rounds=write_rounds,
+        faults={"blackhole": ((int(src), -1),)},
+    )
+
+
+def rolling_restart(n, rounds, write_rounds, seed, batch: int = 0,
+                    down: int = 6, stagger: int = 0, start: int = 2):
+    """Restart every node once, in staggered batches — the deploy-wave
+    scenario. ``batch`` nodes go down per wave (default: ~n/8), each wave
+    ``stagger`` rounds after the previous (default: down//2, so waves
+    overlap like a real rolling deploy), each node down ``down`` rounds.
+    """
+    batch = int(batch) or max(1, n // 8)
+    stagger = int(stagger) or max(1, int(down) // 2)
+    down = int(down)
+    alive, part = _base(n, rounds)
+    events = []
+    waves = (n + batch - 1) // batch
+    last_up = 0
+    for w in range(waves):
+        lo, hi = w * batch, min((w + 1) * batch, n)
+        t0 = int(start) + w * stagger
+        t1 = t0 + down
+        if t0 >= rounds:
+            break
+        alive[t0:min(t1, rounds), lo:hi] = False
+        events.append((t0, "kill", {"nodes": [lo, hi], "wave": w}))
+        if t1 < rounds:
+            events.append((t1, "rejoin", {"nodes": [lo, hi], "wave": w}))
+        last_up = max(last_up, min(t1, rounds - 1))
+    if events:
+        events.append((last_up, "heal", {"phase": "heal"}))
+    return Scenario(
+        name="rolling_restart",
+        params={"batch": batch, "down": down, "stagger": stagger},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        alive=alive, part=part, events=events,
+    )
+
+
+def flapper(n, rounds, write_rounds, seed, frac: float = 0.1,
+            period: int = 4, until: int = 0):
+    """A fraction of nodes flap down/up on a fixed period until round
+    ``until`` (default: half the run), then stay up — the crash-looping
+    agent that SWIM must keep re-admitting."""
+    until = int(until) or rounds // 2
+    k = max(1, int(round(n * float(frac))))
+    period = max(1, int(period))
+    alive, part = _base(n, rounds)
+    r = np.arange(rounds)
+    flap_down = ((r // period) % 2 == 1) & (r < until)
+    alive[:, :k] = ~flap_down[:, None]
+    events = [
+        (0, "flap_start", {"nodes": [0, k], "period": period}),
+        (min(until, rounds - 1), "heal", {"phase": "heal"}),
+    ]
+    return Scenario(
+        name="flapper",
+        params={"frac": frac, "period": period, "until": until},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        alive=alive, part=part, events=events,
+    )
+
+
+def split_brain_heal(n, rounds, write_rounds, seed, at: int = -1,
+                     heal: int = -1, parts: int = 2):
+    """Partition the cluster into ``parts`` contiguous islands at round
+    ``at`` (default: mid-write-phase; 0 = split from the very first
+    round), heal at ``heal`` (default: half the run) — convergence then
+    requires anti-entropy to merge the divergent islands' histories."""
+    at = int(at) if int(at) >= 0 else max(1, write_rounds // 2)
+    heal = int(heal) if int(heal) > at else max(at + 1, rounds // 2)
+    parts = max(2, int(parts))
+    alive, part = _base(n, rounds)
+    island = (np.arange(n) * parts // n).astype(np.int32)
+    part[at:heal] = island[None, :]
+    events = [
+        (at, "split", {"parts": parts}),
+        (min(heal, rounds - 1), "heal", {"phase": "heal", "parts": parts}),
+    ]
+    return Scenario(
+        name="split_brain_heal",
+        params={"at": at, "heal": heal, "parts": parts},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        alive=alive, part=part, events=events,
+    )
+
+
+def churn(n, rounds, write_rounds, seed, rate: float = 0.02,
+          down: int = 6, until: int = 0):
+    """Memoryless churn: every up node crashes with probability ``rate``
+    per round and stays down ``down`` rounds, until round ``until``
+    (default: half the run) — the background failure hum of a large
+    fleet. Seeded: the same (n, rounds, seed) always crashes the same
+    nodes at the same rounds."""
+    until = int(until) or rounds // 2
+    down = int(down)
+    rng = np.random.default_rng(int(seed) ^ 0xC0FF)
+    alive, part = _base(n, rounds)
+    down_until = np.zeros(n, np.int64)  # round each node revives
+    events = []
+    kills = 0
+    for r in range(min(until, rounds)):
+        up = down_until <= r
+        crash = up & (rng.random(n) < float(rate))
+        if crash.any():
+            down_until[crash] = r + down
+            kills += int(crash.sum())
+            events.append(
+                (r, "kill", {"nodes": np.nonzero(crash)[0].tolist()})
+            )
+        alive[r] = down_until <= r
+    # after `until`, everyone is forced back up (the heal edge); nodes
+    # still serving a down window revive there
+    last_down = int(min(max(down_until.max(), until), rounds - 1))
+    for r in range(until, rounds):
+        alive[r] = down_until <= r
+    alive[last_down:] = True
+    events.append((last_down, "heal", {"phase": "heal", "kills": kills}))
+    return Scenario(
+        name="churn",
+        params={"rate": rate, "down": down, "until": until},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        alive=alive, part=part, events=events,
+    )
+
+
+# ----------------------------------------------------- topology constraints
+def _allow_only(n: int, allowed: np.ndarray) -> tuple:
+    """Blackhole pairs blocking every directed edge NOT in ``allowed``
+    ((N, N) bool). Self-edges are irrelevant (never delivered).
+
+    O(N^2) pairs by construction — topology studies are meant for
+    modest clusters (the soak default sweep excludes them); the
+    validate/mask consumers are vectorized so even a large list only
+    costs memory, not Python-loop time."""
+    allowed = allowed | np.eye(n, dtype=bool)
+    blocked = np.argwhere(~allowed)
+    return tuple(map(tuple, blocked.tolist()))
+
+
+def ring_blackhole(n: int) -> tuple:
+    """Blackhole mask constraining gossip to a bidirectional ring —
+    node i can only reach i±1 (mod n). The BFS oracle's ring topology
+    (obs/probes.py) realized in the transport layer."""
+    allowed = np.zeros((n, n), bool)
+    i = np.arange(n)
+    allowed[i, (i + 1) % n] = True
+    allowed[i, (i - 1) % n] = True
+    return _allow_only(n, allowed)
+
+
+def star_blackhole(n: int, hub: int = 0) -> tuple:
+    """Blackhole mask constraining gossip to a star around ``hub``."""
+    allowed = np.zeros((n, n), bool)
+    allowed[hub, :] = True
+    allowed[:, hub] = True
+    return _allow_only(n, allowed)
+
+
+def ring(n, rounds, write_rounds, seed, p: float = 0.0):
+    """Gossip constrained to a ring topology via blackhole masks (+
+    optional loss) — the worst-diameter graph gossip bounds quote."""
+    return Scenario(
+        name="ring", params={"p": p}, rounds=rounds,
+        write_rounds=write_rounds,
+        faults={"blackhole": ring_blackhole(n), "loss": float(p)},
+    )
+
+
+def star(n, rounds, write_rounds, seed, hub: int = 0, p: float = 0.0):
+    """Gossip constrained to a star topology via blackhole masks."""
+    return Scenario(
+        name="star", params={"hub": hub, "p": p}, rounds=rounds,
+        write_rounds=write_rounds,
+        faults={
+            "blackhole": star_blackhole(n, int(hub)), "loss": float(p),
+        },
+    )
+
+
+SCENARIOS = {
+    "lossy": lossy,
+    "duplicating": duplicating,
+    "burst": burst,
+    "blackhole_one_way": blackhole_one_way,
+    "rolling_restart": rolling_restart,
+    "flapper": flapper,
+    "split_brain_heal": split_brain_heal,
+    "churn": churn,
+    "ring": ring,
+    "star": star,
+}
+
+# The soak sweep's default set: scenarios whose faults clear (or are
+# survivable) so re-convergence is the pass criterion. Excluded by
+# design: blackhole_one_way (the hole never heals — an availability
+# study, not a recovery one) and ring/star (topology-constrained
+# studies whose convergence time grows with the graph diameter).
+SOAK_DEFAULT = (
+    "lossy", "duplicating", "burst", "rolling_restart", "flapper",
+    "split_brain_heal", "churn",
+)
+
+
+def parse_scenario_spec(spec: str) -> tuple[str, dict]:
+    """``name[:k=v,...]`` → (name, params). Values parse as int, then
+    float, then bare string."""
+    name, _, kv = spec.partition(":")
+    name = name.strip()
+    if name not in SCENARIOS:
+        raise ValueError(
+            f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})"
+        )
+    params: dict = {}
+    if kv.strip():
+        for item in kv.split(","):
+            k, eq, v = item.partition("=")
+            if not eq:
+                raise ValueError(
+                    f"scenario param {item!r} must be key=value"
+                )
+            v = v.strip()
+            try:
+                parsed: object = int(v)
+            except ValueError:
+                try:
+                    parsed = float(v)
+                except ValueError:
+                    parsed = v
+            params[k.strip()] = parsed
+    return name, params
+
+
+def make_scenario(
+    spec: str,
+    n: int,
+    rounds: int = 256,
+    write_rounds: int = 16,
+    seed: int = 0,
+) -> Scenario:
+    """Compile a ``name[:k=v,...]`` spec for an ``n``-node cluster."""
+    name, params = parse_scenario_spec(spec)
+    return SCENARIOS[name](n, rounds, write_rounds, seed, **params)

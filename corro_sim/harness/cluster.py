@@ -149,6 +149,13 @@ class LiveCluster:
         self._chunk_dispatches = 0  # chunked tick batches executed
         self._log_poisoned = False  # ring-wrap tripwire latched
         self._partials = 0.0  # last round's buffered-partial gauge
+        self._scenario = None  # active chaos scenario (load_scenario)
+        self._scenario_base = 0  # round the scenario was loaded at
+        self._scenario_events = 0  # events already annotated
+        # the fault knobs the cluster was constructed with (cfg_overrides)
+        # — scenarios apply RELATIVE to this baseline, so switching
+        # scenarios never leaks the previous one's knobs
+        self._baseline_faults = self.cfg.faults
         self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
         # per-queue health counters (corro.runtime.channel.* analog)
         from corro_sim.utils.metrics import ChannelMetrics
@@ -859,7 +866,7 @@ class LiveCluster:
         for k, v in zip(names, sums):
             self._totals[k] = self._totals.get(k, 0.0) + float(v)
         for k in ("pend_live", "queue_overflow", "swim_suspects",
-                  "swim_down", "sync_pairs"):
+                  "swim_down", "sync_pairs", "fault_burst_nodes"):
             if k in names:
                 self._lasts[k] = float(packed[names.index(k), -1])
         # SWIM membership transition counters (corro.swim.notification):
@@ -958,6 +965,7 @@ class LiveCluster:
                 help_="chunk dispatches by program",
             )
         for _ in range(rounds):
+            self._apply_scenario_round()
             t0 = time.perf_counter()
             w = self._dequeue_writes()
             if w is None:
@@ -1004,6 +1012,13 @@ class LiveCluster:
         candidate batching (1000 rows / 600 ms, ``pubsub.rs:1154-1296``) —
         but callers gate on _subs_active() to preserve per-round event
         granularity whenever someone is actually watching."""
+        if self._scenario is not None and not self._scenario_uniform(_CHUNK):
+            # the scenario timeline changes topology inside this window —
+            # alive/part are per-chunk constants here, so run the rounds
+            # singly (identical keys/semantics, just more dispatches)
+            self._tick_locked(_CHUNK)
+            return
+        self._apply_scenario_round()
         self._chunk_dispatches += 1
         from corro_sim.utils.metrics import counters
 
@@ -1260,7 +1275,10 @@ class LiveCluster:
                 "cfg_overrides={'probes': K}"
             )
             return out
-        adj = ground_truth_adjacency(self._alive, self._part)
+        adj = ground_truth_adjacency(
+            self._alive, self._part,
+            blackhole=self.cfg.faults.blackhole,
+        )
         out.update(tr.report(adj=adj))
         return out
 
@@ -1299,6 +1317,157 @@ class LiveCluster:
             return dict(self._totals)
 
     # ---------------------------------------------------- fault injection
+    def load_scenario(self, spec: str, rounds: int = 128,
+                      seed: int | None = None) -> dict:
+        """Arm a chaos scenario (faults/scenarios.py) on the live cluster.
+
+        The scenario's alive/partition timeline replays relative to the
+        CURRENT round — each subsequent tick applies the matching row
+        (holding the last row once the timeline ends) and its fault-knob
+        overrides are compiled into the step programs. Scheduled events
+        annotate the flight record as the rounds pass. Returns a summary
+        dict (the POST /v1/faults body)."""
+        import dataclasses as _dc
+
+        from corro_sim.faults import make_scenario
+
+        with self.locks.tracked(self._lock, "load_scenario", "write"):
+            sc = make_scenario(
+                spec, self.cfg.num_nodes, rounds=rounds,
+                write_rounds=0,  # live writes come from the API, not a
+                # synthetic write phase
+                seed=self._seed if seed is None else seed,
+            )
+            # apply relative to the construction-time baseline, never to
+            # a previously armed scenario's knobs (no fault leak between
+            # scenarios)
+            new_cfg = sc.apply(_dc.replace(
+                self.cfg, faults=self._baseline_faults
+            ))
+            if new_cfg != self.cfg:
+                self.cfg = new_cfg
+                self._resize_fault_burst()
+                self._build_step()  # fault knobs are compiled in
+            self._scenario = sc
+            self._scenario_base = self._rounds_ticked
+            self._scenario_events = 0
+            self.flight.annotate(
+                self._rounds_ticked + 1, "scenario_loaded",
+                scenario=sc.spec, rounds=rounds,
+            )
+            self.flight.set_meta(scenario=sc.spec)
+            return self.fault_report()
+
+    def clear_scenario(self) -> dict:
+        """Disarm the scenario: restore full liveness, one partition and
+        the construction-time baseline fault knobs."""
+        import dataclasses as _dc
+
+        with self.locks.tracked(self._lock, "clear_scenario", "write"):
+            self._scenario = None
+            self._alive[:] = True
+            self._part[:] = 0
+            if self.cfg.faults != self._baseline_faults:
+                self.cfg = _dc.replace(
+                    self.cfg, faults=self._baseline_faults
+                )
+                self._resize_fault_burst()
+                self._build_step()
+            self.flight.annotate(
+                self._rounds_ticked + 1, "scenario_cleared",
+            )
+            return self.fault_report()
+
+    def _resize_fault_burst(self) -> None:
+        """Match ``state.fault_burst`` to the (possibly re-armed) fault
+        config: the Gilbert burst state is per-node (N,) when the knob is
+        on, the (1,) placeholder when off. Without this, a cluster built
+        with burst off that arms a burst scenario would evolve a single
+        shared coin (index clamping) instead of per-node burst state."""
+        want = (
+            (self.cfg.num_nodes,) if self.cfg.faults.burst_enter > 0
+            else (1,)
+        )
+        if tuple(self.state.fault_burst.shape) != want:
+            self.state = self.state.replace(
+                fault_burst=jnp.zeros(want, bool)
+            )
+
+    def _apply_scenario_round(self) -> None:
+        """Set alive/partition ground truth for the round about to run
+        from the armed scenario's timeline; annotate passing events."""
+        sc = self._scenario
+        if sc is None:
+            return
+        r = self._rounds_ticked - self._scenario_base
+        if sc.alive is not None:
+            self._alive = np.asarray(
+                sc.alive[min(r, len(sc.alive) - 1)], bool
+            ).copy()
+        if sc.part is not None:
+            self._part = np.asarray(
+                sc.part[min(r, len(sc.part) - 1)], np.int32
+            ).copy()
+        while self._scenario_events < len(sc.events):
+            ev_r, ev_name, ev_attrs = sc.events[self._scenario_events]
+            if ev_r > r:
+                break
+            self.flight.annotate(
+                self._scenario_base + ev_r + 1, "fault_event",
+                kind=ev_name, **ev_attrs,
+            )
+            self._scenario_events += 1
+
+    def _scenario_uniform(self, k: int) -> bool:
+        """Whether the next ``k`` scenario rows are identical — the
+        chunked multi-round dispatch passes alive/part as per-chunk
+        constants, so a varying window must fall back to single rounds."""
+        sc = self._scenario
+        if sc is None:
+            return True
+        r = self._rounds_ticked - self._scenario_base
+        for arr in (sc.alive, sc.part):
+            if arr is None:
+                continue
+            lo = min(r, len(arr) - 1)
+            hi = min(r + k - 1, len(arr) - 1)
+            window = arr[lo:hi + 1]
+            if len(window) and (window != window[0]).any():
+                return False
+        return True
+
+    def fault_report(self) -> dict:
+        """The GET /v1/faults body: armed scenario, compiled fault knobs,
+        injected-fault totals and the burst gauge."""
+        import dataclasses as _dc
+
+        with self._lock:
+            sc = self._scenario
+            totals = {
+                k: int(v) for k, v in sorted(self._totals.items())
+                if k.startswith("fault_") and k != "fault_burst_nodes"
+            }
+            faults = _dc.asdict(self.cfg.faults)
+            faults["blackhole"] = [
+                list(p) for p in self.cfg.faults.blackhole
+            ]
+            return {
+                "scenario": sc.spec if sc is not None else None,
+                "scenario_round": (
+                    self._rounds_ticked - self._scenario_base
+                    if sc is not None else None
+                ),
+                "heal_round": sc.heal_round if sc is not None else None,
+                "faults": faults,
+                "enabled": self.cfg.faults.enabled,
+                "totals": totals,
+                "burst_nodes": int(
+                    self._lasts.get("fault_burst_nodes", 0)
+                ),
+                "alive": int(self._alive.sum()),
+                "partitions": int(len(set(self._part.tolist()))),
+            }
+
     def set_alive(self, node: int, alive: bool) -> None:
         self._check_node(node)
         with self._lock:

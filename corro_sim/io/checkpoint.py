@@ -226,7 +226,7 @@ def save_checkpoint(cluster, path, *, scrub: bool = False,
             # gossip + swim state do not travel in a portable backup
             flat = {
                 k: v for k, v in flat.items()
-                if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "probe/"))
+                if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "probe/", "fault_burst"))
             }
             if origin_node != 0:
                 nested = _unflatten(flat)
@@ -314,6 +314,14 @@ def _cluster_from_meta(meta, tripwire=None):
     num_nodes = cfg.pop("num_nodes")
     for k in ("num_rows", "num_cols"):
         cfg.pop(k)  # derived from the layout
+    faults = cfg.pop("faults", None)
+    if faults:  # asdict + JSON flattened the FaultConfig — rebuild it
+        from corro_sim.config import FaultConfig
+
+        faults["blackhole"] = tuple(
+            tuple(int(x) for x in p) for p in faults.get("blackhole", ())
+        )
+        cfg["faults"] = FaultConfig(**faults)
     layout = _rebuild_layout(meta)
     universe = LiveUniverse.restore(
         [_dec_value(v) for v in meta["universe"]["values"]],
@@ -411,7 +419,7 @@ def restore(path, node: int = 0, tripwire=None):
     meta = {**meta, "subs": []}
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/", "fault_burst"))
     }
     cluster = _cluster_from_meta(meta, tripwire)
     if node >= cluster.cfg.num_nodes:
@@ -443,7 +451,7 @@ def restore_into(cluster, path, node: int = 0) -> None:
     # restore()): the running cluster keeps its own topology + membership
     flat = {
         k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/"))
+        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/", "fault_burst"))
     }
     with cluster.locks.tracked(cluster._lock, "restore", "write"):
         new_layout = _rebuild_layout(meta)

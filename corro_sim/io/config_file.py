@@ -11,7 +11,14 @@ override prefix is ``CORRO_SIM__``::
     write_rate = 0.3
     swim_enabled = true
 
+    [sim.faults]          # chaos injection (corro_sim/faults/)
+    loss = 0.05
+    dup = 0.01
+    blackhole = [[3, -1]] # directed (src, dst) pairs; -1 = wildcard
+
     CORRO_SIM__NUM_NODES=5000 corro-sim run --config cluster.toml
+    CORRO_SIM__FAULTS__LOSS=0.1 corro-sim run ...
+    CORRO_SIM__FAULTS__BLACKHOLE="3:-1,0:7" corro-sim run ...
 """
 
 from __future__ import annotations
@@ -27,9 +34,10 @@ except ModuleNotFoundError:  # pragma: no cover - version-dependent
     except ModuleNotFoundError:
         tomllib = None
 
-from corro_sim.config import SimConfig
+from corro_sim.config import FaultConfig, SimConfig
 
 ENV_PREFIX = "CORRO_SIM__"
+FAULTS_ENV_PREFIX = ENV_PREFIX + "FAULTS__"
 
 
 def _coerce(field: dataclasses.Field, raw: str):
@@ -46,11 +54,51 @@ def _coerce(field: dataclasses.Field, raw: str):
     return raw
 
 
+def _parse_blackhole(raw) -> tuple:
+    """Blackhole pairs from TOML (``[[3, -1], [0, 7]]``) or an env string
+    (``"3:-1,0:7"``) into the tuple-of-pairs FaultConfig carries."""
+    if isinstance(raw, str):
+        pairs = []
+        for item in raw.split(","):
+            if not item.strip():
+                continue
+            s, colon, d = item.partition(":")
+            if not colon:
+                raise ValueError(
+                    f"blackhole entry {item!r} must be src:dst"
+                )
+            pairs.append((int(s), int(d)))
+        return tuple(pairs)
+    return tuple((int(s), int(d)) for s, d in raw)
+
+
+def _build_faults(table: dict, env) -> FaultConfig | None:
+    """The ``[sim.faults]`` block + ``CORRO_SIM__FAULTS__*`` overrides."""
+    ffields = {f.name: f for f in dataclasses.fields(FaultConfig)}
+    values: dict = {}
+    for k, v in table.items():
+        if k not in ffields:
+            raise KeyError(f"unknown faults config key: {k!r}")
+        values[k] = _parse_blackhole(v) if k == "blackhole" else v
+    for k, field in ffields.items():
+        env_key = FAULTS_ENV_PREFIX + k.upper()
+        if env_key in env:
+            raw = env[env_key]
+            if k == "blackhole":
+                values[k] = _parse_blackhole(raw)
+            elif k == "sync_loss":  # `float | None` — not _coerce-able
+                values[k] = None if raw.lower() == "none" else float(raw)
+            else:
+                values[k] = _coerce(field, raw)
+    return FaultConfig(**values) if values else None
+
+
 def load_config(path: str | None = None, env=None) -> SimConfig:
     """Build a SimConfig from an optional TOML file + env overrides."""
     env = os.environ if env is None else env
     fields = {f.name: f for f in dataclasses.fields(SimConfig)}
     values: dict = {}
+    faults_table: dict = {}
 
     if path is not None:
         if tomllib is not None:
@@ -60,16 +108,27 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
             with open(path, encoding="utf-8") as fh:
                 doc = _parse_flat_toml(fh.read())
         table = doc.get("sim", doc)
+        # the vendored flat parser spells nesting as a [sim.faults] table
+        faults_table = dict(
+            table.pop("faults", None) or doc.get("sim.faults") or {}
+        )
         for k, v in table.items():
+            if k == "sim.faults" or isinstance(v, dict):
+                continue
             if k not in fields:
                 raise KeyError(f"unknown config key in {path}: {k!r}")
             values[k] = v
 
     for k, field in fields.items():
+        if k == "faults":
+            continue
         env_key = ENV_PREFIX + k.upper()
         if env_key in env:
             values[k] = _coerce(field, env[env_key])
 
+    faults = _build_faults(faults_table, env)
+    if faults is not None:
+        values["faults"] = faults
     return SimConfig(**values).validate()
 
 

@@ -95,6 +95,25 @@ def make_swim_state(num_nodes: int, enabled: bool = True) -> SwimState:
     return SwimState(p=jnp.zeros((n, n), jnp.uint32))
 
 
+def down_belief_matrix(sw, n: int):
+    """(observer, subject) bool numpy matrix: who currently believes whom
+    DOWN (status >= 2). Host-side, handles BOTH belief layouts — the full
+    (N, N) plane and the windowed member/belief state — so every consumer
+    (the SWIM false-DOWN invariant checker, admin introspection) decodes
+    beliefs one way and cannot drift."""
+    import numpy as np
+
+    status = np.asarray(sw.status)
+    if hasattr(sw, "member"):  # windowed O(N·K) belief state
+        member = np.asarray(sw.member)
+        out = np.zeros((n, n), bool)
+        obs = np.broadcast_to(np.arange(n)[:, None], member.shape)
+        hit = (member >= 0) & (status >= 2)
+        out[obs[hit], member[hit]] = True
+        return out
+    return status >= 2
+
+
 def view_alive(swim: SwimState) -> jnp.ndarray:
     """(N, N) bool: who each node would still gossip/sync with.
 

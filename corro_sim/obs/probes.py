@@ -64,12 +64,18 @@ def bfs_hops(adj: np.ndarray, src: int) -> np.ndarray:
     return dist
 
 
-def ground_truth_adjacency(alive, part) -> np.ndarray:
+def ground_truth_adjacency(alive, part, blackhole=None) -> np.ndarray:
     """The simulator's link predicate as a dense graph: both endpoints
     up and in the same partition (engine/step._reachable_fn). Gossip
     targets are sampled uniformly over the membership view, so this is
     the densest graph any message could traverse — BFS over it lower-
-    bounds every achievable hop count."""
+    bounds every achievable hop count.
+
+    ``blackhole``: the fault layer's directed (src, dst) drop pairs
+    (``FaultConfig.blackhole``, -1 = wildcard) — edges it covers carry
+    nothing, so they leave the oracle graph too. This is how the chaos
+    tests realize ring/star topologies and validate hop counts against
+    BFS on the constrained graph (tests/test_faults.py)."""
     alive = np.asarray(alive, bool)
     part = np.asarray(part)
     adj = (
@@ -77,6 +83,12 @@ def ground_truth_adjacency(alive, part) -> np.ndarray:
         & alive[None, :]
         & (part[:, None] == part[None, :])
     )
+    if blackhole:
+        # the SAME wildcard expansion the transport applies
+        # (faults/masks.py) — oracle graph and drop mask cannot diverge
+        from corro_sim.faults.masks import pairs_to_mask
+
+        adj &= ~pairs_to_mask(blackhole, adj.shape[0])
     np.fill_diagonal(adj, False)
     return adj
 

@@ -347,6 +347,7 @@ def sync_round(
     reachable: jnp.ndarray,
     rtt: jnp.ndarray | None = None,
     round_idx: jnp.ndarray | int = 0,
+    fault_key: jax.Array | None = None,
 ):
     """One anti-entropy sweep (multi-peer).
 
@@ -364,6 +365,29 @@ def sync_round(
         cfg, book, key=k_peer, alive=alive, view_alive=view_alive,
         reachable=reachable, rtt=rtt)
     p_cnt = peer.shape[1]
+
+    # The anti-entropy transport point (corro_sim/faults/): an ADMITTED
+    # connection still fails with resolved_sync_loss — the QUIC stream
+    # dying mid-sync — and deterministically across a blackholed edge.
+    # Applied before the clock exchange: a dropped connection carries
+    # nothing, clocks included. Static: faults off traces none of this.
+    # `rejected` snapshots the semaphore verdict FIRST: a fault-killed
+    # connection was admitted, so it must count in fault_sync_lost, not
+    # in the concurrency-rejection metric.
+    rejected = requested & ~granted
+    fault_metrics = {}
+    if cfg.faults.enabled:
+        from corro_sim.faults.inject import blackhole_mask, sync_grant_keep
+
+        bh = blackhole_mask(cfg.faults, n)
+        keep = sync_grant_keep(
+            cfg.faults, fault_key, jnp.arange(n, dtype=jnp.int32), peer,
+            None if bh is None else jnp.asarray(bh),
+        )
+        fault_metrics["fault_sync_lost"] = (granted & ~keep).sum(
+            dtype=jnp.int32
+        )
+        granted = granted & keep
 
     # Clock exchange, both directions (SyncMessage::Clock is sent by client
     # AND server on every sync contact, api/peer.rs:1074-1126,1502-1521):
@@ -612,9 +636,11 @@ def sync_round(
     metrics = {
         "sync_pairs": granted.sum(dtype=jnp.int32),
         # client requests sent vs server-semaphore rejections
-        # (corro.sync.client.member accepted/rejected, handlers.rs)
+        # (corro.sync.client.member accepted/rejected, handlers.rs) —
+        # pre-fault, so injected connection loss is not misread as
+        # concurrency-limiter pressure
         "sync_requests": requested.sum(dtype=jnp.int32),
-        "sync_rejections": (requested & ~granted).sum(dtype=jnp.int32),
+        "sync_rejections": rejected.sum(dtype=jnp.int32),
         "sync_versions": new_versions,
         "sync_empties": empties,
         # cell lanes SHIPPED by this sweep — the byte-volume signal
@@ -622,5 +648,6 @@ def sync_round(
         # receiver already buffered via gossip are excluded: partial
         # needs transfer only the missing seq ranges (SyncNeedV1::Partial).
         "sync_cells": shipped.sum(dtype=jnp.int32),
+        **fault_metrics,
     }
     return book, table, hlc, last_cleared, metrics

@@ -286,12 +286,37 @@ def render_prometheus(cluster) -> str:
     # remaining step metrics, generically. probe_* step metrics are
     # GAUGES (infected count, cumulative dups) — summing them across
     # rounds would lie; they render in the probe block below instead.
+    # fault_* metrics render in their own corro_fault_* block.
     for key, v in sorted(totals.items()):
-        if key not in _SERIES and not key.startswith("probe_"):
+        if (
+            key not in _SERIES
+            and not key.startswith(("probe_", "fault_"))
+        ):
             emit(
                 f"corro_sim_{key}_total", "counter",
                 f"sim step metric {key}", v,
             )
+
+    # ---- chaos injection (corro_sim/faults/): injected-fault flow
+    # counters + the burst-state gauge, named corro_fault_* so soak
+    # dashboards and the driver-side counters line up.
+    fault_totals = {
+        k: int(v) for k, v in totals.items()
+        if k.startswith("fault_") and k != "fault_burst_nodes"
+    }
+    _faults = getattr(getattr(cluster, "cfg", None), "faults", None)
+    if fault_totals or (_faults is not None and _faults.enabled):
+        for key in sorted(fault_totals):
+            emit(
+                f"corro_{key}_total", "counter",
+                f"injected fault effect {key[6:]} (corro_sim/faults/)",
+                fault_totals[key],
+            )
+        emit(
+            "corro_fault_burst_nodes", "gauge",
+            "nodes currently in the burst-loss state",
+            int(cluster.metrics_lasts().get("fault_burst_nodes", 0)),
+        )
 
     # ---- wire byte volume (corro.broadcast.recv.bytes /
     # corro.sync.chunk.sent.bytes analogs, agent/metrics.rs): modeled from

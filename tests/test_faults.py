@@ -1,0 +1,265 @@
+"""Chaos engine: the on-device fault injection layer (corro_sim/faults/).
+
+Three layers of evidence, mirroring the probe tracer's (ISSUE 3):
+
+- **non-perturbation guard** — ``FaultConfig()`` defaults trace zero
+  fault ops (no ``fault_*`` metrics, program untouched), and a config
+  with the fault program TRACED but every knob at zero effect
+  (``trace_vacuous``) produces bit-identical state and metrics: the
+  injection points themselves can never perturb a fault-free run;
+- **accounting** — the bookkeeping conservation identity holds round by
+  round under loss + duplication + in-flight delay, on-device counts
+  against host recomputation;
+- **semantics vs the BFS oracle** — blackhole masks that constrain
+  gossip to ring/star topologies produce probe hop counts bounded below
+  by BFS on the constrained ground-truth graph (obs/probes.py), and a
+  one-way blackhole starves exactly the one direction it covers.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corro_sim.config import FaultConfig, SimConfig
+from corro_sim.engine.state import init_state
+from corro_sim.engine.step import sim_step
+from corro_sim.faults.scenarios import ring_blackhole, star_blackhole
+from corro_sim.obs.probes import ProbeTrace, bfs_hops, ground_truth_adjacency
+
+N = 12
+BASE = SimConfig(
+    num_nodes=N, num_rows=16, num_cols=2, log_capacity=128, write_rate=0.6
+)
+
+
+def _run(cfg, rounds=16, write_rounds=4, seed=3, part=None):
+    state = init_state(cfg, seed=0)
+    alive = jnp.ones((cfg.num_nodes,), bool)
+    part = jnp.asarray(
+        part if part is not None
+        else np.zeros(cfg.num_nodes, np.int32)
+    )
+    step = jax.jit(
+        lambda st, k, we: sim_step(cfg, st, k, alive, part, we)
+    )
+    key = jax.random.PRNGKey(seed)
+    metrics = []
+    for r in range(rounds):
+        state, m = step(
+            state, jax.random.fold_in(key, r), jnp.asarray(r < write_rounds)
+        )
+        metrics.append({k: np.asarray(v) for k, v in m.items()})
+    return state, metrics
+
+
+def test_fault_defaults_trace_nothing():
+    """The static gate: a default SimConfig has faults disabled and its
+    step emits no fault metrics — the program is the pre-chaos one."""
+    assert SimConfig().faults.enabled is False
+    assert FaultConfig().enabled is False
+    _, metrics = _run(BASE, rounds=3)
+    assert not any(k.startswith("fault_") for k in metrics[0])
+
+
+def test_vacuous_faults_do_not_perturb_simulation():
+    """The guard (mirrors tests/test_probes.py): the fault program
+    traced with every knob at zero effect is bit-identical — state and
+    metrics — to the fault-free run. The injection points can never
+    change delivery order, key derivation or merge outcomes."""
+    s0, m0 = _run(BASE)
+    cfgv = dataclasses.replace(
+        BASE, faults=FaultConfig(trace_vacuous=True)
+    ).validate()
+    sv, mv = _run(cfgv)
+    for f in dataclasses.fields(type(s0)):
+        if f.name == "fault_burst":
+            continue
+        for a, b in zip(
+            jax.tree.leaves(getattr(s0, f.name)),
+            jax.tree.leaves(getattr(sv, f.name)),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+    for r, (a, b) in enumerate(zip(m0, mv)):
+        for k in a:
+            assert np.array_equal(a[k], b[k]), (r, k)
+    # fault metrics are additive-only, and all identically zero here
+    extra = set(mv[0]) - set(m0[0])
+    assert extra == {
+        "fault_lost", "fault_dup", "fault_blackholed",
+        "fault_unreachable", "fault_delivered", "fault_parked",
+        "fault_emit_lost", "fault_matured", "fault_burst_nodes",
+        "fault_sync_lost",
+    }
+    for m in mv:
+        for k in ("fault_lost", "fault_dup", "fault_blackholed",
+                  "fault_sync_lost", "fault_burst_nodes"):
+            assert int(m[k]) == 0, k
+
+
+def test_loss_drops_and_conservation_holds():
+    """Lossy + duplicating links: losses actually happen, and the
+    conservation identity (sent + matured == parked + emit_lost +
+    delivered + unreachable + blackholed + lost) balances every round."""
+    cfg = dataclasses.replace(
+        BASE, faults=FaultConfig(loss=0.3, dup=0.15)
+    ).validate()
+    _, metrics = _run(cfg, rounds=20, write_rounds=6)
+    lost = sum(int(m["fault_lost"]) for m in metrics)
+    dup = sum(int(m["fault_dup"]) for m in metrics)
+    assert lost > 0 and dup > 0
+    for r, m in enumerate(metrics):
+        lhs = int(m["msgs_sent"]) + int(m["fault_matured"])
+        rhs = (
+            int(m["fault_parked"]) + int(m["fault_emit_lost"])
+            + int(m["fault_delivered"]) + int(m["fault_unreachable"])
+            + int(m["fault_blackholed"]) + int(m["fault_lost"])
+        )
+        assert lhs == rhs, (r, lhs, rhs)
+        # the post-queue-cap delivered metric can only be <= the
+        # pre-cap fault accounting
+        assert int(m["delivered"]) <= int(m["fault_delivered"])
+
+
+def test_conservation_with_inflight_delay_ring():
+    """Same identity with the latency model on: parked/matured lanes
+    traverse the in-flight ring and still balance."""
+    cfg = dataclasses.replace(
+        BASE,
+        latency_regions=2, latency_intra=1, latency_inter=4,
+        faults=FaultConfig(loss=0.2),
+    ).validate()
+    _, metrics = _run(cfg, rounds=24, write_rounds=8)
+    parked = sum(int(m["fault_parked"]) for m in metrics)
+    matured = sum(int(m["fault_matured"]) for m in metrics)
+    assert parked > 0 and matured > 0
+    for r, m in enumerate(metrics):
+        lhs = int(m["msgs_sent"]) + int(m["fault_matured"])
+        rhs = (
+            int(m["fault_parked"]) + int(m["fault_emit_lost"])
+            + int(m["fault_delivered"]) + int(m["fault_unreachable"])
+            + int(m["fault_blackholed"]) + int(m["fault_lost"])
+        )
+        assert lhs == rhs, (r, lhs, rhs)
+
+
+def test_one_way_blackhole_starves_one_direction():
+    """Node 0 transmits into a void but still receives: nobody ever
+    applies node 0's writes (gossip AND sync blocked), while node 0
+    keeps applying everyone else's."""
+    cfg = dataclasses.replace(
+        BASE, write_rate=1.0, faults=FaultConfig(blackhole=((0, -1),))
+    ).validate()
+    state, metrics = _run(cfg, rounds=32, write_rounds=4)
+    assert sum(int(m["fault_blackholed"]) for m in metrics) > 0
+    head = np.asarray(state.book.head)
+    log_head = np.asarray(state.log.head)
+    assert log_head[0] > 0  # node 0 did write
+    assert (head[1:, 0] == 0).all()  # nobody received any of it
+    # node 0 still catches up on every other actor
+    assert (head[0, 1:] == log_head[1:]).all()
+
+
+def test_burst_markov_state_evolves_and_drops():
+    cfg = dataclasses.replace(
+        BASE,
+        faults=FaultConfig(burst_enter=0.3, burst_exit=0.3, burst_loss=1.0),
+    ).validate()
+    state, metrics = _run(cfg, rounds=16, write_rounds=6)
+    series = [int(m["fault_burst_nodes"]) for m in metrics]
+    assert max(series) > 0, "burst state never entered"
+    assert state.fault_burst.shape == (N,)
+    assert sum(int(m["fault_lost"]) for m in metrics) > 0
+    # burst state disabled -> placeholder leaf, gauge pinned to zero
+    cfg0 = dataclasses.replace(
+        BASE, faults=FaultConfig(loss=0.1)
+    ).validate()
+    s0, m0 = _run(cfg0, rounds=4)
+    assert s0.fault_burst.shape == (1,)
+    assert all(int(m["fault_burst_nodes"]) == 0 for m in m0)
+
+
+def test_sync_grant_loss_blocks_repair():
+    """sync_loss=1 kills every admitted anti-entropy connection: the
+    rejected grants are counted and no versions are ever served by
+    sync, while gossip still converges the cluster."""
+    cfg = dataclasses.replace(
+        BASE, sync_interval=4,
+        faults=FaultConfig(sync_loss=1.0, trace_vacuous=True),
+    ).validate()
+    _, metrics = _run(cfg, rounds=24, write_rounds=4)
+    assert sum(int(m["fault_sync_lost"]) for m in metrics) > 0
+    assert sum(int(m["sync_versions"]) for m in metrics) == 0
+    assert sum(int(m["sync_pairs"]) for m in metrics) == 0
+
+
+def _probe_hops_vs_bfs(blackhole, adj_blackhole=None, rounds=48):
+    """Run with probes under a blackhole-constrained topology; assert
+    every gossip hop count is bounded below by BFS on the constrained
+    ground-truth graph (stretch >= 1 — gossip cannot beat shortest
+    paths on the graph the fault layer actually allows)."""
+    cfg = dataclasses.replace(
+        BASE, probes=3, write_rate=1.0,
+        faults=FaultConfig(blackhole=blackhole),
+    ).validate()
+    state, _ = _run(cfg, rounds=rounds, write_rounds=2)
+    tr = ProbeTrace.from_state(cfg, state)
+    adj = ground_truth_adjacency(
+        np.ones(N, bool), np.zeros(N, np.int32),
+        blackhole=adj_blackhole if adj_blackhole is not None else blackhole,
+    )
+    checked = 0
+    for k in range(tr.num_probes):
+        if tr.origin_round(k) is None:
+            continue
+        bfs = bfs_hops(adj, int(tr.actor[k]))
+        hop = tr.hop[k]
+        mask = hop >= 1
+        assert (bfs[mask] >= 1).all()  # gossip-reached ⇒ BFS-reachable
+        assert (hop[mask] >= bfs[mask]).all(), (
+            k, hop[mask], bfs[mask]
+        )
+        if mask.any():
+            checked += 1
+    assert checked >= 1
+    return tr, adj
+
+
+def test_ring_topology_hops_bounded_by_bfs():
+    """Blackhole masks constraining gossip to a bidirectional ring: on-
+    device hop counts respect BFS ring distances min(|i-j|, n-|i-j|)."""
+    tr, adj = _probe_hops_vs_bfs(ring_blackhole(N))
+    # the oracle itself matches the ring closed form
+    d = bfs_hops(adj, 0)
+    assert d.tolist() == [min(i, N - i) for i in range(N)]
+
+
+def test_star_topology_hops_bounded_by_bfs():
+    """Star around node 0: every BFS distance is 1 (hub) or 2 (leaf to
+    leaf), and gossip hops respect them."""
+    tr, adj = _probe_hops_vs_bfs(star_blackhole(N, hub=0))
+    d = bfs_hops(adj, 3)
+    assert d.tolist() == [1] + [0 if i == 3 else 2 for i in range(1, N)]
+
+
+def test_checkpoint_roundtrip_with_faults(tmp_path):
+    """A fault-enabled cluster checkpoints and resumes: fault knobs live
+    in the config (meta), burst state is volatile (scrubbed like gossip
+    buffers)."""
+    from corro_sim.harness.cluster import LiveCluster
+    from corro_sim.io.checkpoint import load_checkpoint, save_checkpoint
+
+    c = LiveCluster(
+        "CREATE TABLE kv (k TEXT PRIMARY KEY, v TEXT);", num_nodes=4,
+        cfg_overrides={"faults": FaultConfig(loss=0.2)},
+    )
+    c.execute(["INSERT INTO kv (k, v) VALUES ('a', '1')"], node=0)
+    c.tick(4)
+    p = str(tmp_path / "chaos.ckpt")
+    save_checkpoint(c, p)
+    c2 = load_checkpoint(p)
+    assert c2.cfg.faults.loss == pytest.approx(0.2)
+    assert c2.cfg.faults.enabled
+    c2.tick(2)  # fault-enabled step recompiles and runs

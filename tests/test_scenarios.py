@@ -1,0 +1,357 @@
+"""Scenario library + vectorized Schedule + invariant checkers + soak.
+
+Pins (ISSUE 3 satellites):
+
+- ``Schedule.slice`` is pure array indexing (precomputed arrays AND
+  memoized legacy callables) and the fault stream is a function of the
+  absolute round only — any chunking of the same run sees the same
+  schedule rows;
+- scenario generators are deterministic in (name, params, n, rounds,
+  seed) and their compiled timelines behave as advertised (waves kill
+  every node once, splits isolate islands, heals heal);
+- recovery: ``rolling_restart``, ``split_brain_heal`` and ``lossy(0.1)``
+  re-converge under invariant checking, and during a split the probes
+  agree with the BFS oracle that the far island is unreachable;
+- the invariant checkers actually detect violations (synthetic broken
+  states/metrics for each checker).
+"""
+
+import dataclasses
+import types
+
+import numpy as np
+import pytest
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.driver import Schedule, run_sim
+from corro_sim.engine.state import init_state
+from corro_sim.faults import (
+    SCENARIOS,
+    InvariantChecker,
+    make_scenario,
+    parse_scenario_spec,
+)
+from corro_sim.obs.probes import bfs_hops, ground_truth_adjacency
+
+N = 16
+BASE = SimConfig(
+    num_nodes=N, num_rows=16, num_cols=2, log_capacity=256, write_rate=0.5
+)
+
+
+# ------------------------------------------------------------ Schedule form
+def test_schedule_slice_vectorized_arrays():
+    alive = np.ones((8, 4), bool)
+    alive[2:5, 1] = False
+    part = np.zeros((8, 4), np.int32)
+    part[6:, :2] = 1
+    sched = Schedule(write_rounds=3, alive=alive, part=part)
+    a, p, we = sched.slice(0, 8, 4)
+    np.testing.assert_array_equal(a, alive)
+    np.testing.assert_array_equal(p, part)
+    assert we.tolist() == [True] * 3 + [False] * 5
+    # beyond the timeline: the last row holds
+    a2, p2, _ = sched.slice(6, 4, 4)
+    np.testing.assert_array_equal(a2[2:], np.broadcast_to(alive[-1], (2, 4)))
+    np.testing.assert_array_equal(p2[2:], np.broadcast_to(part[-1], (2, 4)))
+
+
+def test_schedule_chunk_boundary_determinism():
+    """The same schedule sliced as one 32-round chunk or as 16+16 (or
+    8x4) yields identical rows — resume/repair-program chunks see the
+    same fault sequence. Holds for arrays AND for legacy callables,
+    including STATEFUL ones (the memoization satellite): each round is
+    evaluated exactly once, ever."""
+    sc = make_scenario("churn:rate=0.2,down=3", 8, rounds=32, seed=5)
+    sched = sc.schedule()
+    whole = sched.slice(0, 32, 8)
+
+    sched2 = sc.schedule()
+    parts = [sched2.slice(0, 16, 8), sched2.slice(16, 16, 8)]
+    for i in range(3):
+        np.testing.assert_array_equal(
+            whole[i], np.concatenate([parts[0][i], parts[1][i]])
+        )
+
+    calls = []
+
+    def flaky_alive(r, n):  # stateful: returns garbage if re-evaluated
+        calls.append(r)
+        out = np.ones(n, bool)
+        out[len(calls) % n] = False  # depends on call COUNT, not round
+        return out
+
+    s3 = Schedule(write_rounds=4, alive_fn=flaky_alive)
+    whole3 = s3.slice(0, 16, 8)[0]
+    again = np.concatenate(
+        [s3.slice(0, 8, 8)[0], s3.slice(8, 8, 8)[0]]
+    )
+    np.testing.assert_array_equal(whole3, again)
+    assert calls == list(range(16))  # one evaluation per round, ever
+
+
+def test_legacy_callables_still_drive_schedule():
+    def alive_fn(r, n):
+        a = np.ones(n, bool)
+        if 2 <= r < 5:
+            a[0] = False
+        return a
+
+    def part_fn(r, n):
+        return np.full(n, 1 if r >= 3 else 0, np.int32)
+
+    sched = Schedule(write_rounds=2, alive_fn=alive_fn, part_fn=part_fn)
+    a, p, we = sched.slice(0, 6, 4)
+    assert a[:, 0].tolist() == [True, True, False, False, False, True]
+    assert p[:, 0].tolist() == [0, 0, 0, 1, 1, 1]
+    assert we.tolist() == [True, True, False, False, False, False]
+
+
+# ----------------------------------------------------------- generators
+def test_scenarios_deterministic_and_parse():
+    name, params = parse_scenario_spec("lossy:p=0.25")
+    assert name == "lossy" and params == {"p": 0.25}
+    with pytest.raises(ValueError):
+        parse_scenario_spec("no_such_scenario")
+    with pytest.raises(ValueError):
+        parse_scenario_spec("lossy:oops")
+    for spec in ("churn:rate=0.1", "rolling_restart", "flapper",
+                 "split_brain_heal"):
+        a = make_scenario(spec, 12, rounds=48, seed=7)
+        b = make_scenario(spec, 12, rounds=48, seed=7)
+        if a.alive is not None:
+            np.testing.assert_array_equal(a.alive, b.alive)
+            np.testing.assert_array_equal(a.part, b.part)
+        assert a.events == b.events
+        assert a.spec == b.spec
+
+
+def test_rolling_restart_covers_every_node_once():
+    sc = make_scenario("rolling_restart:batch=3,down=4,stagger=2",
+                       10, rounds=64, seed=0)
+    down_ever = ~sc.alive.all(axis=0)
+    assert down_ever.all(), "every node must restart exactly once"
+    # each node's outage lasts exactly `down` rounds
+    for i in range(10):
+        assert int((~sc.alive[:, i]).sum()) == 4
+    assert sc.heal_round is not None
+    assert sc.alive[sc.heal_round:].all()
+
+
+def test_split_brain_timeline_and_heal():
+    sc = make_scenario("split_brain_heal:at=4,heal=20,parts=2",
+                       12, rounds=40, seed=0)
+    assert (sc.part[:4] == 0).all()
+    mid = sc.part[10]
+    assert set(mid.tolist()) == {0, 1}
+    assert (sc.part[20:] == 0).all()
+    assert sc.heal_round == 20
+    kinds = [name for _, name, _ in sc.events]
+    assert kinds == ["split", "heal"]
+
+
+# ------------------------------------------------- recovery + invariants
+def _soak(spec, cfg=BASE, rounds=160, write_rounds=8, seed=1, **kw):
+    sc = make_scenario(spec, cfg.num_nodes, rounds=rounds,
+                       write_rounds=write_rounds, seed=seed)
+    cfg = sc.apply(cfg)
+    inv = InvariantChecker(cfg)
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), sc.schedule(),
+        max_rounds=1024, chunk=16, seed=seed, warmup=False,
+        invariants=inv,
+        min_rounds=max(sc.heal_round or 0, write_rounds), **kw,
+    )
+    return sc, res, inv
+
+
+def test_recovery_lossy():
+    """Under 10% loss the cluster still converges and every invariant
+    holds — the acceptance bar's first half."""
+    sc, res, inv = _soak("lossy:p=0.1")
+    assert res.converged_round is not None
+    assert int(res.metrics["fault_lost"].sum()) > 0
+    assert inv.ok, inv.report()
+
+
+def test_recovery_rolling_restart():
+    """Acceptance bar second half: a rolling restart heals and the sim
+    re-converges a bounded time after the last node returns, invariants
+    green throughout."""
+    sc, res, inv = _soak("rolling_restart:batch=4,down=6")
+    assert res.converged_round is not None
+    assert sc.heal_round is not None
+    assert res.converged_round - sc.heal_round >= 0
+    assert inv.ok, inv.report()
+
+
+def test_recovery_lossy_plus_rolling_restart():
+    """The acceptance scenario verbatim: lossy:p=0.1 AND a rolling
+    restart at once — loss knobs from one, timeline from the other."""
+    sc = make_scenario("rolling_restart:batch=4,down=6", N,
+                       rounds=160, write_rounds=8, seed=1)
+    cfg = dataclasses.replace(
+        BASE,
+        faults=dataclasses.replace(BASE.faults, loss=0.1),
+    ).validate()
+    inv = InvariantChecker(cfg)
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), sc.schedule(),
+        max_rounds=1024, chunk=16, seed=1, warmup=False, invariants=inv,
+        min_rounds=max(sc.heal_round or 0, 8),
+    )
+    assert res.converged_round is not None
+    assert int(res.metrics["fault_lost"].sum()) > 0
+    assert inv.ok, inv.report()
+
+
+def test_recovery_split_brain_heal_and_bfs_oracle():
+    """During the split, probes seeded in island 0 never cross to island
+    1 and the BFS oracle agrees (unreachable); after the heal the run
+    re-converges with invariants green."""
+    # phase 1: run only THROUGH the split window, no convergence exit.
+    # The split holds from round 0 — the probes' version 1 commits
+    # inside an island and must stay there.
+    cfg = dataclasses.replace(BASE, probes=2, write_rate=1.0).validate()
+    sc = make_scenario("split_brain_heal:at=0,heal=48", N,
+                       rounds=96, write_rounds=4, seed=1)
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), sc.schedule(),
+        max_rounds=32, chunk=16, seed=1, warmup=False,
+        stop_on_convergence=False,
+    )
+    from corro_sim.obs.probes import ProbeTrace
+
+    tr = ProbeTrace.from_state(cfg, res.state)
+    part_mid = sc.part[16]
+    adj = ground_truth_adjacency(np.ones(N, bool), part_mid)
+    crossed = 0
+    for k in range(tr.num_probes):
+        origin = int(tr.actor[k])
+        if tr.origin_round(k) is None:
+            continue
+        other = part_mid != part_mid[origin]
+        assert (bfs_hops(adj, origin)[other] == -1).all()
+        assert (tr.first_seen[k][other] == -1).all()
+        crossed += 1
+    assert crossed >= 1
+    # phase 2: the full timeline heals and re-converges
+    sc2, res2, inv = _soak("split_brain_heal:at=0,heal=48", rounds=96)
+    assert res2.converged_round is not None
+    assert res2.converged_round > 48  # islands really diverged
+    assert inv.ok, inv.report()
+
+
+# ------------------------------------------------- checker detection power
+def _stub_state(head, table=None, swim=None):
+    ns = types.SimpleNamespace(book=types.SimpleNamespace(head=head))
+    if table is not None:
+        ns.table = table
+    if swim is not None:
+        ns.swim = swim
+    return ns
+
+
+def test_invariant_checker_detects_head_regression():
+    cfg = SimConfig(num_nodes=4)
+    inv = InvariantChecker(cfg)
+    alive = np.ones((2, 4), bool)
+    part = np.zeros((2, 4), np.int32)
+    h0 = np.array([[2, 1], [1, 1]], np.int32)
+    assert inv.on_chunk(_stub_state(h0), {}, alive, part, 0) == []
+    h1 = h0.copy()
+    h1[0, 0] = 1  # regression
+    bad = inv.on_chunk(_stub_state(h1), {}, alive, part, 2)
+    assert [v.invariant for v in bad] == ["head_monotonicity"]
+    assert not inv.ok
+
+
+def test_invariant_checker_detects_conservation_break():
+    cfg = SimConfig(num_nodes=4)
+    inv = InvariantChecker(cfg)
+    alive = np.ones((2, 4), bool)
+    part = np.zeros((2, 4), np.int32)
+    metrics = {
+        "msgs_sent": np.array([10, 10]),
+        "fault_matured": np.array([0, 0]),
+        "fault_parked": np.array([0, 0]),
+        "fault_emit_lost": np.array([0, 0]),
+        "fault_delivered": np.array([8, 7]),  # round 1: 7+2 != 10
+        "fault_unreachable": np.array([0, 0]),
+        "fault_blackholed": np.array([0, 0]),
+        "fault_lost": np.array([2, 2]),
+    }
+    bad = inv.on_chunk(
+        _stub_state(np.zeros((4, 4), np.int32)), metrics, alive, part, 0
+    )
+    assert [v.invariant for v in bad] == ["conservation"]
+    assert bad[0].round == 1
+
+
+def test_invariant_checker_detects_convergence_disagreement():
+    cfg = SimConfig(num_nodes=3)
+    inv = InvariantChecker(cfg)
+    cv = np.zeros((3, 4, 2), np.int32)
+    vr = np.zeros((3, 4, 2), np.int32)
+    cl = np.zeros((3, 4), np.int32)
+    cv[2, 1, 0] = 9  # node 2 disagrees
+    table = types.SimpleNamespace(cv=cv, vr=vr, cl=cl)
+    st = _stub_state(np.zeros((3, 3), np.int32), table=table)
+    bad = inv.on_converged(
+        st, np.ones(3, bool), np.zeros(3, np.int32)
+    )
+    assert [v.invariant for v in bad] == ["convergence_disagreement"]
+    # agreeing replicas pass
+    inv2 = InvariantChecker(cfg)
+    cv[2, 1, 0] = 0
+    assert inv2.on_converged(
+        st, np.ones(3, bool), np.zeros(3, np.int32)
+    ) == []
+
+
+def test_invariant_checker_detects_swim_false_down():
+    cfg = SimConfig(num_nodes=4, swim_enabled=True)
+    inv = InvariantChecker(cfg)
+    window = inv._swim_window_rounds()
+    rounds = window + 4
+    alive = np.ones((rounds, 4), bool)
+    part = np.zeros((rounds, 4), np.int32)
+    status = np.zeros((4, 4), np.int8)
+    status[0, 2] = 2  # observer 0 stamps live node 2 DOWN — forever
+    swim = types.SimpleNamespace(status=status)  # full-view (no .member)
+    st = _stub_state(np.zeros((4, 4), np.int32), swim=swim)
+    bad = inv.on_chunk(st, {}, alive, part, 0)
+    assert [v.invariant for v in bad] == ["swim_false_down"]
+    # inside the window the same belief is legitimate suspicion lag
+    inv2 = InvariantChecker(cfg)
+    short = alive[: window - 2]
+    assert inv2.on_chunk(st, {}, short, part[: window - 2], 0) == []
+
+
+def test_swim_stays_honest_under_rolling_restart():
+    """End to end: SWIM on, nodes restarting — the failure detector may
+    suspect and DOWN the genuinely-dead, but never a long-recovered
+    node (the invariant is checked live through the run)."""
+    cfg = dataclasses.replace(
+        BASE, swim_enabled=True, swim_interval=1
+    ).validate()
+    sc, res, inv = _soak(
+        "rolling_restart:batch=4,down=6", cfg=cfg, rounds=200
+    )
+    assert res.converged_round is not None
+    assert inv.ok, inv.report()
+    assert inv.chunks_checked > 0
+
+
+def test_all_catalog_scenarios_compile():
+    """Every registered scenario builds a valid schedule + fault block
+    for a small cluster (the soak sweep's precondition)."""
+    for name in sorted(SCENARIOS):
+        sc = make_scenario(name, 8, rounds=32, write_rounds=4, seed=3)
+        cfg = sc.apply(SimConfig(num_nodes=8))
+        sched = sc.schedule()
+        a, p, we = sched.slice(0, 32, 8)
+        assert a.shape == (32, 8) and p.shape == (32, 8)
+        if sc.alive is not None:
+            assert a.any(axis=1).all(), f"{name}: a round killed everyone"
+        assert cfg.faults.validate(8)
