@@ -42,6 +42,15 @@ FROZEN_DEVCLUSTER = {
 }
 
 
+def _bench_pipeline() -> bool | None:
+    """CORRO_BENCH_NO_PIPELINE=1 forces the sequential chunk loop on
+    every run_sim leg (A/B-ing the pipelined dispatch win with one env
+    var; doc/performance.md); default (None) follows cfg.pipeline.
+    Parsed with the repo's env-bool convention: ""/0/false = unset."""
+    raw = os.environ.get("CORRO_BENCH_NO_PIPELINE", "").lower()
+    return False if raw not in ("", "0", "false") else None
+
+
 def _atomic_json_dump(path: str, obj) -> None:
     """Write-then-rename so readers never see a torn file. Errors are
     swallowed: progress artifacts must never kill the run they document
@@ -254,6 +263,7 @@ def run_north_star(n: int | None = None) -> dict:
             # recorder would duplicate round indices and corrupt the
             # exported diagnostics); per-repeat walls ship in `runs`
             flight=_FLIGHT if rep == 0 else None,
+            pipeline=_bench_pipeline(),
         )
         jax.block_until_ready(res.state.table.vr)
         runs.append({
@@ -261,6 +271,9 @@ def run_north_star(n: int | None = None) -> dict:
             "chunk_runners": [c["runner"] for c in chunk_log],
             "wall_s": round(res.wall_seconds, 3),
             "converged_round": res.converged_round,
+            # per-repeat chunk-pipeline stats: the overlap the artifact
+            # claims must be visible next to the walls it shaped
+            "pipeline": res.pipeline,
         })
         converged_round = res.converged_round or res.rounds
 
@@ -419,6 +432,7 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         cfg, init_state(cfg, seed=0), schedule,
         max_rounds=max_rounds, chunk=8, seed=0, min_rounds=min_rounds,
         flight=_FLIGHT, invariants=invariants,
+        pipeline=_bench_pipeline(),
     )
     out = {
         "metric": label,
@@ -428,6 +442,7 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         "converged": res.converged_round is not None,
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
+        "pipeline": res.pipeline,
     }
     if scenario is not None:
         out["scenario"] = scenario.spec
@@ -616,6 +631,7 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
         Schedule(write_rounds=write_rounds, alive_fn=alive_fn),
         max_rounds=4096, chunk=8, seed=0, min_rounds=write_rounds + 1,
         mesh=mesh, on_chunk=_flush, flight=_FLIGHT,
+        pipeline=_bench_pipeline(),
     )
     out = {
         "metric": f"config5_{nodes}_node_outage_catchup_rounds",
@@ -627,6 +643,7 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
         + int(res.metrics["sync_versions"].sum()),
         "devices": len(devices),
         "chunks": chunk_log,
+        "pipeline": res.pipeline,
     }
     if sized_reason:
         out["note"] = (
@@ -652,27 +669,48 @@ CONFIGS = {0: run_north_star, 1: run_config_1, 2: run_config_2,
            3: run_config_3, 4: run_config_4, 5: run_config_5}
 
 
-def _device_preflight(timeout_s: int = 240) -> str | None:
+def _device_preflight(timeout_s: int = 240, attempts: int = 3) -> str | None:
     """One trivial device op in a KILLABLE subprocess: the axon tunnel
     can die in a way that makes every dispatch hang forever inside C
     code (observed round 5 — SIGALRM never fires because the
     interpreter never regains control). A hung benchmark leaves NO
-    artifact, which is worse than an honest error line."""
+    artifact, which is worse than an honest error line.
+
+    Retried with exponential backoff before declaring the device dead:
+    BENCH_r05 lost a whole round to ONE transient 240 s probe failure
+    on a tunnel that recovered seconds later — a flaky probe must cost
+    a retry, not the round."""
     import subprocess
     import sys
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp;"
-             "print(int(jnp.sum(jnp.arange(16.0)).block_until_ready()))"],
-            timeout=timeout_s, capture_output=True, text=True,
+    last_err = None
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(2.0 * 2 ** (attempt - 1))  # 2 s, 4 s, ...
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp;"
+                 "print(int(jnp.sum(jnp.arange(16.0))"
+                 ".block_until_ready()))"],
+                timeout=timeout_s, capture_output=True, text=True,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"device unresponsive after {timeout_s}s"
+        else:
+            if r.returncode == 0 and "120" in r.stdout:
+                return None
+            last_err = (
+                f"device probe failed (rc={r.returncode}): "
+                f"{r.stderr[-200:]}"
+            )
+        # stderr: the stdout contract is ONE JSON result line
+        print(
+            f"# preflight attempt {attempt + 1}/{attempts} failed: "
+            f"{last_err}",
+            file=sys.stderr, flush=True,
         )
-    except subprocess.TimeoutExpired:
-        return f"device unresponsive after {timeout_s}s"
-    if r.returncode != 0 or "120" not in r.stdout:
-        return f"device probe failed (rc={r.returncode}): {r.stderr[-200:]}"
-    return None
+    return f"{last_err} ({attempts} attempts, exponential backoff)"
 
 
 def main(config: int | None = None, **kw) -> int:

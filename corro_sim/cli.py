@@ -43,6 +43,7 @@ _FLAG_TO_FIELD = {
     "swim_view": "swim_view_size",
     "sync_interval": "sync_interval",
     "probes": "probes",
+    "pipeline": "pipeline",
 }
 
 
@@ -124,6 +125,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         # curve-shaped convergence diagnostics off the flight record
         "gap_half_life_rounds": diag["gap_half_life_rounds"],
         "epidemic_window_rounds": diag["epidemic_window_rounds"],
+        # chunk-pipeline stats (overlap ratio, speculation, fetch-wait
+        # wall; doc/performance.md) — present in both modes so a
+        # pipelined-vs-sequential pair is directly comparable
+        "pipeline": res.pipeline,
     }
     if args.flight_out:
         # a sink that died mid-run (ENOSPC, deleted dir) must not be
@@ -559,6 +564,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="windowed SWIM: members tracked per node (0 = full view)",
     )
     pr.add_argument("--sync-interval", type=int)
+    pr.add_argument(
+        "--no-pipeline", dest="pipeline", action="store_const", const=False,
+        help="disable pipelined chunk dispatch (speculative next-chunk "
+             "dispatch + async metric fetch; doc/performance.md) and run "
+             "the sequential chunk loop — results are bit-identical, "
+             "only dispatch order changes",
+    )
     pr.add_argument("--write-rounds", type=int, default=32)
     pr.add_argument("--max-rounds", type=int, default=4096)
     pr.add_argument("--chunk", type=int, default=16)
@@ -614,6 +626,10 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--swim-view", type=int)
     ps.add_argument("--sync-interval", type=int)
     ps.add_argument("--probes", type=int)
+    ps.add_argument(
+        "--no-pipeline", dest="pipeline", action="store_const", const=False,
+        help="disable pipelined chunk dispatch for every scenario run",
+    )
     ps.add_argument(
         "--scenario", action="append",
         help="scenario spec `name[:k=v,...]`; repeatable (default: sweep "

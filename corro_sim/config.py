@@ -242,6 +242,16 @@ class SimConfig:
     # disabled: zero extra traced ops, bit-identical step program
     # (tests/test_faults.py non-perturbation guard).
 
+    # --- host-side driver (engine/driver.py) ---
+    pipeline: bool = True  # pipelined chunk dispatch: overlap device
+    # compute with host-side control/transfers/bookkeeping (speculative
+    # next-chunk dispatch + async metric fetch; doc/performance.md).
+    # Purely host-side restructuring — the chunk programs, keys and
+    # schedule rows are identical either way, and results are
+    # bit-identical to the sequential loop (tests/test_pipeline.py).
+    # `corro-sim run --no-pipeline` / `CORRO_SIM__PIPELINE=0` opt out;
+    # donated-buffer runs (run_sim(donate=True)) force it off.
+
     # --- timing model ---
     round_ms: float = 200.0  # simulated wall-clock per round (broadcast
     # flush cadence is 500 ms in the reference, broadcast/mod.rs:378; one

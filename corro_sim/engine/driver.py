@@ -8,6 +8,22 @@ every written version (``gap == 0``) after the write phase ends.
 ``lax.scan`` cannot early-exit, so rounds run in device-resident chunks;
 between chunks the host reads one scalar (the last gap) and decides whether
 to continue — one small transfer per chunk, not per round.
+
+Chunk dispatch is **pipelined** by default (``SimConfig.pipeline``,
+``corro-sim run --no-pipeline`` to opt out): the next chunk is issued to
+the device *speculatively* before the previous chunk's convergence scalar
+lands on the host (JAX async dispatch returns futures immediately), and
+the packed metric stacks travel device→host via ``copy_to_host_async``
+started at dispatch time. Host-side control — convergence logic,
+invariant checks, fault-event annotation, probe extraction, flight
+recording, schedule slicing — then runs *while* the device executes the
+next chunk, instead of the device idling through it. Results are
+bit-identical to the sequential path (same chunk programs, same keys,
+same schedule rows — only dispatch order changes; tests/test_pipeline.py
+pins this): a speculative chunk that the sequential path would not have
+run (the run converged or poisoned one chunk earlier, or the repair
+program switch landed) is discarded and, for a program mispredict,
+re-dispatched on the correct program. See doc/performance.md.
 """
 
 from __future__ import annotations
@@ -29,7 +45,14 @@ from corro_sim.engine.state import SimState
 from corro_sim.engine.step import sim_step
 from corro_sim.obs.flight import FlightRecorder
 from corro_sim.obs.probes import ProbeTrace
-from corro_sim.utils.metrics import SECONDS_BUCKETS, counters, histograms
+from corro_sim.utils.metrics import (
+    PIPELINE_FETCH_WAIT,
+    PIPELINE_FETCH_WAIT_HELP,
+    SECONDS_BUCKETS,
+    counters,
+    histograms,
+)
+from corro_sim.utils.runtime import start_async_fetch
 from corro_sim.utils.tracing import tracer
 
 
@@ -45,11 +68,12 @@ class Schedule:
     :mod:`corro_sim.faults.scenarios` emits); rounds past the array's end
     hold its last row, so a run that outlives the scenario keeps its final
     topology. The legacy ``alive_fn``/``part_fn`` callables are still
-    accepted: they are materialized into the same arrays once (cached), so
-    ``slice`` itself is pure array indexing either way — no per-round
-    Python loop, and the schedule rows a chunk sees are a function of the
-    absolute round only, never of chunk boundaries
-    (tests/test_scenarios.py pins this).
+    accepted: each round is materialized into a cached row exactly once,
+    so a slice gathers cached rows (a short per-row loop over the chunk
+    for the list-backed cache, pure array indexing for precomputed
+    arrays) and never re-evaluates the callable — the schedule rows a
+    chunk sees are a function of the absolute round only, never of chunk
+    boundaries (tests/test_scenarios.py pins this).
 
     ``events``: sparse ``(round, name, attrs)`` fault markers (node kill /
     rejoin, partition split / heal, loss windows) — ``run_sim`` copies the
@@ -64,58 +88,58 @@ class Schedule:
     events: list = dataclasses.field(default_factory=list)
     name: str | None = None  # scenario label (flight meta, soak reports)
 
-    # materialized-callable caches (grow monotonically; slice reads them)
-    _alive_cache: np.ndarray | None = dataclasses.field(
-        default=None, repr=False, compare=False
+    # materialized-callable caches: one (n,) row per round, appended to a
+    # list (O(1) amortized) and stacked per slice read. The old scheme
+    # re-concatenated the WHOLE cache on every growth, O(R²) over a long
+    # run; a slice now stacks only the rows it returns.
+    _alive_rows: list = dataclasses.field(
+        default_factory=list, repr=False, compare=False
     )
-    _part_cache: np.ndarray | None = dataclasses.field(
-        default=None, repr=False, compare=False
+    _part_rows: list = dataclasses.field(
+        default_factory=list, repr=False, compare=False
     )
 
     def _materialize(self, upto: int, n: int) -> None:
         """Evaluate the legacy callables out to round ``upto`` (exclusive),
-        once per round ever — later slices reuse the cache, so a stateful
-        callable cannot produce different faults for different chunkings."""
+        once per round ever — later slices reuse the cached rows, so a
+        stateful callable cannot produce different faults for different
+        chunkings."""
         if self.alive_fn is not None:
-            have = 0 if self._alive_cache is None else len(self._alive_cache)
-            if upto > have:
-                new = np.stack(
-                    [np.asarray(self.alive_fn(r, n), bool)
-                     for r in range(have, upto)]
-                )
-                self._alive_cache = (
-                    new if self._alive_cache is None
-                    else np.concatenate([self._alive_cache, new])
+            for r in range(len(self._alive_rows), upto):
+                self._alive_rows.append(
+                    np.asarray(self.alive_fn(r, n), bool)
                 )
         if self.part_fn is not None:
-            have = 0 if self._part_cache is None else len(self._part_cache)
-            if upto > have:
-                new = np.stack(
-                    [np.asarray(self.part_fn(r, n), np.int32)
-                     for r in range(have, upto)]
-                )
-                self._part_cache = (
-                    new if self._part_cache is None
-                    else np.concatenate([self._part_cache, new])
+            for r in range(len(self._part_rows), upto):
+                self._part_rows.append(
+                    np.asarray(self.part_fn(r, n), np.int32)
                 )
 
     @staticmethod
-    def _rows(src: np.ndarray | None, idx: np.ndarray):
-        """Gather schedule rows, holding the last row past the end."""
+    def _rows(src, idx: np.ndarray):
+        """Gather schedule rows, holding the last row past the end.
+        ``src`` is a precomputed (R, n) array or the row-list cache."""
         if src is None or len(src) == 0:
             return None
+        if isinstance(src, list):
+            last = len(src) - 1
+            return np.stack([src[min(int(i), last)] for i in idx])
         return src[np.minimum(idx, len(src) - 1)]
 
     def slice(self, start: int, length: int, n: int):
         idx = np.arange(start, start + length)
         self._materialize(start + length, n)
         alive = self._rows(
-            self.alive if self.alive is not None else self._alive_cache, idx
+            self.alive if self.alive is not None
+            else (self._alive_rows if self.alive_fn is not None else None),
+            idx,
         )
         if alive is None:
             alive = np.ones((length, n), bool)
         part = self._rows(
-            self.part if self.part is not None else self._part_cache, idx
+            self.part if self.part is not None
+            else (self._part_rows if self.part_fn is not None else None),
+            idx,
         )
         if part is None:
             part = np.zeros((length, n), np.int32)
@@ -150,6 +174,9 @@ class RunResult:
     repair_chunks: int = 0  # chunks run on the repair-specialized program
     flight: "FlightRecorder | None" = None  # per-round telemetry timeline
     probe: object | None = None  # obs.probes.ProbeTrace when cfg.probes
+    pipeline: dict | None = None  # chunk-pipeline stats: enabled, overlap
+    # ratio, speculative dispatched/wasted, fetch-wait wall (sequential
+    # runs report their blocking-read wall under the same key)
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -205,6 +232,27 @@ def _chunk_runner(
     return run_chunk
 
 
+@dataclasses.dataclass
+class _InFlight:
+    """One dispatched-but-unprocessed chunk riding the device queue."""
+
+    ci: int
+    base: int  # first round the chunk covers (0-based)
+    state_out: object  # carry futures — chunk N+1's input
+    i_s: object  # packed int metric stack (future)
+    f_s: object  # packed float metric stack (future)
+    owner: object  # the jit runner whose unpack decodes the stacks
+    use_repair: bool
+    aot: bool
+    speculative: bool  # dispatched ahead of the convergence scalar
+    alive: np.ndarray
+    part: np.ndarray
+    we: np.ndarray
+    untimed: bool = False  # jit-fallback first chunk through a program:
+    # its commit interval is compile+exec mixed — booked as compile and
+    # excluded from wall/timed_rounds, like the sequential loop's
+
+
 def run_sim(
     cfg: SimConfig,
     state: SimState,
@@ -222,6 +270,7 @@ def run_sim(
     flight: FlightRecorder | None = None,
     profile_dir: str | None = None,
     invariants=None,
+    pipeline: bool | None = None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -251,13 +300,25 @@ def run_sim(
     device→host read of the bookkeeping planes per chunk, which is why
     it is opt-in); every violation it finds is annotated into the flight
     record and counted in ``corro_fault_invariant_violations_total``.
+
+    ``pipeline``: overlap device compute with host-side control (module
+    docstring; doc/performance.md). ``None`` follows ``cfg.pipeline``
+    (default on). Forced off under ``donate=True``: a speculative
+    dispatch consumes the donated carry, so a discarded/re-dispatched
+    chunk would have no input left to re-run from.
     """
     schedule = schedule or Schedule()
     if flight is None:
         flight = FlightRecorder()
+    if pipeline is None:
+        pipeline = getattr(cfg, "pipeline", True)
+    pipeline_off_reason = None
+    if pipeline and donate:
+        pipeline = False
+        pipeline_off_reason = "donate"
     flight.set_meta(
         driver="run_sim", nodes=cfg.num_nodes, chunk=chunk, seed=seed,
-        max_rounds=max_rounds,
+        max_rounds=max_rounds, pipeline=bool(pipeline),
         **({"scenario": schedule.name} if schedule.name else {}),
     )
     if min_rounds is None:
@@ -288,12 +349,6 @@ def run_sim(
                            packed=True)
     root = jax.random.PRNGKey(seed)
 
-    def _exec(fn, owner, args):
-        state, i_s, f_s = fn(*args)
-        # exactly two blocking device->host reads per chunk (tunnel
-        # round-trips are ~80 ms each; per-metric reads dominated wall)
-        return state, owner.unpack(np.asarray(i_s), np.asarray(f_s))
-
     # Post-quiesce phase specialization: once the schedule stops writing AND
     # the gossip rings report drained (pend_live == 0), the write/emit/
     # deliver pipeline is a proven no-op — switch to the repair-specialized
@@ -306,7 +361,7 @@ def run_sim(
     repair_runner = None
     repair_compiled = None
 
-    metrics_chunks = []
+    metrics_chunks: list = []
     converged_round = None
     poisoned = False
     rounds = 0
@@ -314,6 +369,10 @@ def run_sim(
     compile_seconds = 0.0
     wall = 0.0
     last_pend_live = None
+    prev_writes = False
+    probe_p99_last = None  # worst per-probe p99 delivery lag seen so far
+    repair_seen = False
+    repair_chunks = 0
 
     # Compile is separated from execution by AOT-lowering the chunk
     # program up front, so EVERY chunk's wall (including the first —
@@ -323,11 +382,244 @@ def run_sim(
     # was the cheapest (wall/round then averaged only the sync-heavy
     # tail but was multiplied by ALL rounds in wall-clock totals).
     compiled = None
-    ci = 0
-    repair_seen = False
-    repair_chunks = 0
-    prev_writes = False
-    probe_p99_last = None  # worst per-probe p99 delivery lag seen so far
+
+    # chunk-pipeline accounting (RunResult.pipeline + corro_pipeline_*)
+    fetch_wait_total = 0.0
+    spec_dispatched = 0
+    spec_wasted = 0
+
+    def _select_repair(pend_live, we) -> bool:
+        """The sequential program-selection rule: repair once the rings
+        report drained and the upcoming chunk schedules no writes."""
+        return bool(
+            repair_eligible and pend_live == 0 and not bool(we.any())
+        )
+
+    def _compile_program(program: str, run_jit, args):
+        """AOT lower+compile one chunk program (+ warmup burn); returns
+        the compiled executable, or None on backends whose AOT path
+        raises (the jit fallback). Books the wall into compile
+        accounting + flight phases either way — on fallback the failed
+        lowering still belongs to compile (ADVICE r3); the mixed first
+        jit chunk adds on later."""
+        nonlocal compile_seconds
+        t0 = time.perf_counter()
+        compiled_ = None
+        try:
+            with tracer.span("aot lower+compile", program=program,
+                             slow_warn=False):
+                compiled_ = run_jit.lower(*args).compile()
+            counters.inc(
+                "corro_compile_total", labels=f'{{program="{program}"}}',
+                help_="XLA chunk-program compiles by program",
+            )
+        except Exception:  # AOT unsupported on some backend
+            counters.inc(
+                "corro_compile_aot_fallback_total",
+                labels=f'{{program="{program}"}}',
+                help_="AOT lower/compile failures falling back to jit",
+            )
+        c_done = time.perf_counter()
+        histograms.observe(
+            "corro_compile_seconds", c_done - t0,
+            labels=f'{{program="{program}"}}',
+            help_="AOT lower+compile wall by program",
+        )
+        # donated args must not be consumed by a throwaway run
+        if compiled_ is not None and warmup and not donate:
+            # first execution of a program pays one-time platform
+            # initialization (~8 s over the tunnel) — burn it on a
+            # discarded run so every timed chunk runs warm
+            with tracer.span("warmup", program=program, slow_warn=False):
+                jax.block_until_ready(compiled_(*args)[0].round)
+            flight.record_phase("warmup", time.perf_counter() - c_done)
+        compile_seconds += time.perf_counter() - t0
+        flight.record_phase("compile", c_done - t0)
+        return compiled_
+
+    def _compile_full(args) -> None:
+        nonlocal compiled
+        compiled = _compile_program("full", runner, args)
+
+    def _compile_repair(args) -> None:
+        nonlocal repair_runner, repair_compiled
+        repair_runner = _chunk_runner(
+            cfg, donate=donate, shardings=shardings, repair=True,
+            packed=True,
+        )
+        repair_compiled = _compile_program("repair", repair_runner, args)
+
+    def _process(ci, base, m, state_now, alive, part, we, use_repair, aot,
+                 chunk_elapsed, annot_extra=None) -> bool:
+        """Host-side bookkeeping for one EXECUTED chunk (both loops route
+        through here, so the pipelined path is structurally the
+        sequential path with only dispatch order changed). Returns False
+        when the run must stop (converged / poisoned)."""
+        nonlocal rounds, prev_writes, last_pend_live, probe_p99_last
+        nonlocal poisoned, converged_round, repair_seen, repair_chunks
+        runner_name = "repair" if use_repair else "full"
+        if use_repair and not repair_seen:
+            counters.inc(
+                "corro_repair_program_switches_total",
+                help_="post-quiesce switches to the repair-specialized "
+                      "chunk program",
+            )
+            flight.annotate(base + 1, "repair_program_switch", aot=aot)
+            repair_seen = True
+        if use_repair:
+            repair_chunks += 1
+        counters.inc(
+            "corro_chunk_dispatch_total",
+            labels=f'{{runner="{runner_name}"}}',
+            help_="chunk dispatches by program",
+        )
+        histograms.observe(
+            "corro_chunk_wall_seconds", chunk_elapsed,
+            labels=f'{{runner="{runner_name}"}}',
+            help_="per-chunk execution wall by program (pipelined mode: "
+                  "the commit-to-commit interval)",
+            buckets=SECONDS_BUCKETS,
+        )
+        metrics_chunks.append(m)
+        flight.record_rounds(base + 1, m)
+        flight.annotate(
+            base + chunk, "chunk", chunk=ci, runner=runner_name,
+            wall_s=round(chunk_elapsed, 6), aot=aot,
+            **(annot_extra or {}),
+        )
+        # scenario fault events (node kill/rejoin, split, heal, loss
+        # windows) land in the flight record at their scheduled round
+        # — the provenance that makes a chaos run's curve readable
+        for ev_r, ev_name, ev_attrs in schedule.events_in(base, chunk):
+            flight.annotate(ev_r + 1, "fault_event", kind=ev_name,
+                            **ev_attrs)
+            counters.inc(
+                "corro_fault_events_total",
+                labels=f'{{kind="{ev_name}"}}',
+                help_="scheduled fault events executed, by kind",
+            )
+        if "fault_lost" in m:
+            for mk, cname in (
+                ("fault_lost", "corro_fault_lost_total"),
+                ("fault_dup", "corro_fault_dup_total"),
+                ("fault_blackholed", "corro_fault_blackholed_total"),
+                ("fault_sync_lost", "corro_fault_sync_lost_total"),
+            ):
+                delta = int(np.asarray(m[mk]).sum()) if mk in m else 0
+                if delta:
+                    counters.inc(
+                        cname, n=delta,
+                        help_="injected fault effects "
+                              "(corro_sim/faults/)",
+                    )
+        if invariants is not None:
+            for v in invariants.on_chunk(state_now, m, alive, part, base):
+                flight.annotate(
+                    v.round + 1 if v.round is not None else base + 1,
+                    "invariant_violation",
+                    invariant=v.invariant, detail=v.detail,
+                )
+                counters.inc(
+                    "corro_fault_invariant_violations_total",
+                    labels=f'{{invariant="{v.invariant}"}}',
+                    help_="soak invariant violations by checker",
+                )
+        if prev_writes and not bool(we.any()):
+            # the schedule stopped writing — the measurement phase begins
+            flight.annotate(
+                base + 1, "schedule_transition", kind="write_phase_end",
+            )
+        prev_writes = bool(we.any())
+        last_pend_live = int(m["pend_live"][-1])
+        if _DEBUG_CHUNKS:
+            import sys
+
+            print(
+                f"# chunk {ci} rounds {base}..{base + chunk}"
+                f" runner={runner_name}"
+                f" wall={chunk_elapsed:.3f}s"
+                f" pend_live={last_pend_live}"
+                f" gap={float(m['gap'][-1]):.0f}"
+                f" sync_pairs={int(m['sync_pairs'].sum())}",
+                file=sys.stderr, flush=True,
+            )
+        rounds = base + chunk
+        if cfg.probes:
+            # per-chunk probe extraction: one small (K, N) transfer. A
+            # probe whose p99 delivery lag WORSENED this chunk (a late
+            # straggler stretched the tail) annotates the flight record
+            # — the curve-level "why was this chunk slow" breadcrumb.
+            # Pipelined, this host work overlaps the next chunk's
+            # device execution instead of stalling it.
+            p99 = ProbeTrace.from_state(cfg, state_now).delivery_p99()
+            if (
+                p99 is not None
+                and probe_p99_last is not None
+                and p99 > probe_p99_last
+            ):
+                flight.annotate(
+                    rounds, "probe_p99_regression",
+                    p99=p99, prev=probe_p99_last,
+                )
+                counters.inc(
+                    "corro_probe_p99_regressions_total",
+                    help_="chunks in which a probe's p99 delivery lag "
+                          "worsened",
+                )
+            if p99 is not None:
+                probe_p99_last = p99
+        if on_chunk is not None:
+            on_chunk({
+                "chunk": ci,
+                "rounds_done": rounds,
+                "chunk_wall_s": round(chunk_elapsed, 3),
+                "wall_s": round(wall, 3),
+                "compile_s": round(compile_seconds, 3),
+                "runner": runner_name,
+                "gap": float(m["gap"][-1]),
+                "pend_live": last_pend_live,
+            })
+        if m["log_wrapped"].any():
+            # Ring-wrap tripwire fired: a live node lagged some actor past
+            # log_capacity, so gathers may have read overwritten slots.
+            # Convergence can no longer be trusted — stop and poison.
+            poisoned = True
+            wrapped_at = base + 1 + int(
+                np.argmax(np.asarray(m["log_wrapped"]) != 0)
+            )
+            flight.annotate(wrapped_at, "log_wrapped")
+            return False
+        # Strictly greater: at rounds == min_rounds the round numbered
+        # min_rounds (e.g. a scheduled rejoin) has not executed yet.
+        if stop_on_convergence and rounds > min_rounds:
+            gaps = m["gap"]
+            if gaps[-1] == 0.0:
+                # Only rounds strictly past min_rounds are convergence
+                # candidates — a transient zero during the write phase (all
+                # deliveries momentarily caught up) is not convergence.
+                idx = np.arange(1, chunk + 1) + base
+                eligible = (gaps == 0.0) & (idx > min_rounds)
+                converged_round = int(idx[np.argmax(eligible)])
+                flight.annotate(converged_round, "converged")
+                if invariants is not None:
+                    # the convergence report itself is checked: no
+                    # report may stand while a live same-partition
+                    # pair still disagrees on table state
+                    for v in invariants.on_converged(
+                        state_now, alive[-1], part[-1]
+                    ):
+                        flight.annotate(
+                            converged_round, "invariant_violation",
+                            invariant=v.invariant, detail=v.detail,
+                        )
+                        counters.inc(
+                            "corro_fault_invariant_violations_total",
+                            labels=f'{{invariant="{v.invariant}"}}',
+                            help_="soak invariant violations by checker",
+                        )
+                return False
+        return True
+
     profiling = False
     if profile_dir is not None:
         # `run --profile-dir`: a jax.profiler trace around the whole scan
@@ -343,281 +635,279 @@ def run_sim(
                 help_="jax.profiler.trace start failures (profile skipped)",
             )
     try:
-        while rounds < max_rounds:
-            alive, part, we = schedule.slice(rounds, chunk, cfg.num_nodes)
-            keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
-            args = (
-                state, keys, jnp.asarray(alive), jnp.asarray(part),
-                jnp.asarray(we),
-            )
-            use_repair = (
-                repair_eligible
-                and last_pend_live == 0
-                and not bool(we.any())
-            )
-            if use_repair and repair_runner is None:
-                repair_runner = _chunk_runner(
-                    cfg, donate=donate, shardings=shardings, repair=True,
-                    packed=True,
+        if not pipeline:
+            # ------------------------------------------ sequential loop
+            ci = 0
+            while rounds < max_rounds:
+                alive, part, we = schedule.slice(rounds, chunk,
+                                                 cfg.num_nodes)
+                keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
+                args = (
+                    state, keys, jnp.asarray(alive), jnp.asarray(part),
+                    jnp.asarray(we),
                 )
-                t0 = time.perf_counter()
-                try:
-                    with tracer.span("aot lower+compile", program="repair",
-                                     slow_warn=False):
-                        repair_compiled = repair_runner.lower(*args).compile()
-                    counters.inc(
-                        "corro_compile_total", labels='{program="repair"}',
-                        help_="XLA chunk-program compiles by program",
-                    )
-                except Exception:  # AOT unsupported on some backend
-                    repair_compiled = None
-                    counters.inc(
-                        "corro_compile_aot_fallback_total",
-                        labels='{program="repair"}',
-                        help_="AOT lower/compile failures falling back to jit",
-                    )
-                c_done = time.perf_counter()
-                histograms.observe(
-                    "corro_compile_seconds", c_done - t0,
-                    labels='{program="repair"}',
-                    help_="AOT lower+compile wall by program",
+                use_repair = _select_repair(last_pend_live, we)
+                if use_repair and repair_runner is None:
+                    _compile_repair(args)
+                first_repair_jit = (
+                    use_repair and repair_compiled is None
+                    and not repair_seen
                 )
-                if repair_compiled is not None and warmup and not donate:
-                    # first execution of a program pays one-time platform
-                    # initialization (~8 s over the tunnel) — burn it on a
-                    # discarded run so every timed chunk runs warm
-                    with tracer.span("warmup", program="repair",
-                                     slow_warn=False):
-                        jax.block_until_ready(repair_compiled(*args)[0].round)
-                    flight.record_phase("warmup", time.perf_counter() - c_done)
-                compile_seconds += time.perf_counter() - t0
-                flight.record_phase("compile", c_done - t0)
-            first_repair_jit = use_repair and repair_compiled is None and not repair_seen
-            if use_repair and not repair_seen:
-                counters.inc(
-                    "corro_repair_program_switches_total",
-                    help_="post-quiesce switches to the repair-specialized "
-                          "chunk program",
-                )
-                flight.annotate(
-                    rounds + 1, "repair_program_switch",
-                    aot=repair_compiled is not None,
-                )
-            if use_repair:
-                repair_seen = True
-                repair_chunks += 1
-            run_compiled = repair_compiled if use_repair else compiled
-            run_jit = repair_runner if use_repair else runner
-            if ci == 0:
-                t0 = time.perf_counter()
-                try:
-                    with tracer.span("aot lower+compile", program="full",
-                                     slow_warn=False):
-                        compiled = runner.lower(*args).compile()
-                    counters.inc(
-                        "corro_compile_total", labels='{program="full"}',
-                        help_="XLA chunk-program compiles by program",
-                    )
-                except Exception:  # AOT unsupported on some backend
-                    compiled = None
-                    counters.inc(
-                        "corro_compile_aot_fallback_total",
-                        labels='{program="full"}',
-                        help_="AOT lower/compile failures falling back to jit",
-                    )
-                c_done = time.perf_counter()
-                histograms.observe(
-                    "corro_compile_seconds", c_done - t0,
-                    labels='{program="full"}',
-                    help_="AOT lower+compile wall by program",
-                )
-                # donated args must not be consumed by a throwaway run
-                if compiled is not None and warmup and not donate:
-                    with tracer.span("warmup", program="full", slow_warn=False):
-                        jax.block_until_ready(compiled(*args)[0].round)
-                    flight.record_phase("warmup", time.perf_counter() - c_done)
-                # On fallback the failed-lowering wall still belongs to
-                # compile accounting (ADVICE r3): chunk 0's mixed run adds on.
-                compile_seconds = time.perf_counter() - t0
-                flight.record_phase("compile", c_done - t0)
-                run_compiled = compiled
-            runner_name = "repair" if use_repair else "full"
-            if run_compiled is None:
-                # fallback: the first chunk through each program pays
-                # compile+exec mixed and is excluded from the steady-state
-                # wall (the pre-AOT accounting)
+                if ci == 0:
+                    _compile_full(args)
+                run_compiled = repair_compiled if use_repair else compiled
+                run_jit = repair_runner if use_repair else runner
+                runner_name = "repair" if use_repair else "full"
+                mode = "jit" if run_compiled is None else "aot"
                 t0 = time.perf_counter()
                 with tracer.span("chunk", ci=ci, runner=runner_name,
-                                 mode="jit"):
-                    state, m = _exec(run_jit, run_jit, args)
+                                 mode=mode):
+                    out = (run_compiled or run_jit)(*args)
+                    t_f = time.perf_counter()
+                    # exactly two blocking device->host reads per chunk
+                    # (tunnel round-trips are ~80 ms each; per-metric
+                    # reads dominated wall) — the stall the pipelined
+                    # loop hides behind the next chunk's execution
+                    m = run_jit.unpack(
+                        np.asarray(out[1]), np.asarray(out[2])
+                    )
+                    fetch_wait = time.perf_counter() - t_f
                 chunk_elapsed = time.perf_counter() - t0
-                if ci == 0 or first_repair_jit:
+                if run_compiled is None and (ci == 0 or first_repair_jit):
+                    # fallback: the first chunk through each program pays
+                    # compile+exec mixed and is excluded from the
+                    # steady-state wall (the pre-AOT accounting) — and
+                    # from the fetch-wait total/histogram, mirroring the
+                    # pipelined loop's untimed-chunk exclusion
+                    compile_seconds += chunk_elapsed
+                    flight.record_phase("compile", chunk_elapsed)
+                else:
+                    fetch_wait_total += fetch_wait
+                    histograms.observe(
+                        PIPELINE_FETCH_WAIT, fetch_wait,
+                        labels='{mode="sequential"}',
+                        help_=PIPELINE_FETCH_WAIT_HELP,
+                        buckets=SECONDS_BUCKETS,
+                    )
+                    wall += chunk_elapsed
+                    timed_rounds += chunk
+                    flight.record_phase("execute", chunk_elapsed)
+                state = out[0]
+                cont = _process(
+                    ci, rounds, m, state, alive, part, we, use_repair,
+                    run_compiled is not None, chunk_elapsed,
+                )
+                ci += 1
+                if not cont:
+                    break
+        else:
+            # ------------------------------------------- pipelined loop
+            # Invariant: at most one unprocessed chunk (`pending`) plus
+            # one speculative look-ahead ride the device queue. Chunk
+            # N+1 is dispatched BEFORE chunk N's metrics are resolved,
+            # so the host's control/bookkeeping for N overlaps the
+            # device executing N+1. Commits (metrics, flight, state
+            # hand-off) happen strictly in order, one chunk behind
+            # dispatch — hence identical results.
+            full_attempted = False
+            full_jit_paid = False
+            repair_jit_paid = False
+            compile_pending = 0.0  # in-loop blocking compile (jit
+            # fallback) to subtract from the next commit interval
+
+            def _dispatch(ci_, base_, state_in, known_pend_live,
+                          blocked_by_writes, speculative) -> _InFlight:
+                """Slice, key and enqueue one chunk; returns without
+                blocking (async dispatch). Program choice follows the
+                sequential rule against ``known_pend_live`` — stale by
+                one chunk when speculative, exact on re-dispatch;
+                ``blocked_by_writes`` vetoes repair while an unprocessed
+                chunk still carries write rounds (drained rings stay
+                drained only while writes stay quiesced, so a clean
+                pend_live reading from chunk N-1 cannot promise chunk
+                N+1 eligibility across a writing chunk N)."""
+                nonlocal full_attempted, full_jit_paid, repair_jit_paid
+                nonlocal compile_pending, compile_seconds
+                alive_, part_, we_ = schedule.slice(base_, chunk,
+                                                    cfg.num_nodes)
+                keys_ = jax.random.split(
+                    jax.random.fold_in(root, ci_), chunk
+                )
+                args_ = (
+                    state_in, keys_, jnp.asarray(alive_),
+                    jnp.asarray(part_), jnp.asarray(we_),
+                )
+                use_repair_ = (
+                    _select_repair(known_pend_live, we_)
+                    and not blocked_by_writes
+                )
+                if not full_attempted:
+                    full_attempted = True
+                    t_c = time.perf_counter()
+                    _compile_full(args_)
+                    # blocking compile inside the loop must not inflate
+                    # the next commit's execution interval
+                    compile_pending += time.perf_counter() - t_c
+                if use_repair_ and repair_runner is None:
+                    t_c = time.perf_counter()
+                    _compile_repair(args_)
+                    compile_pending += time.perf_counter() - t_c
+                run_compiled_ = repair_compiled if use_repair_ else compiled
+                run_jit_ = repair_runner if use_repair_ else runner
+                first_jit = False
+                if run_compiled_ is None:
+                    if use_repair_ and not repair_jit_paid:
+                        repair_jit_paid = first_jit = True
+                    elif not use_repair_ and not full_jit_paid:
+                        full_jit_paid = first_jit = True
+                t_d = time.perf_counter()
+                with tracer.span(
+                    "chunk dispatch", ci=ci_,
+                    runner="repair" if use_repair_ else "full",
+                    mode="jit" if run_compiled_ is None else "aot",
+                    slow_warn=False,
+                ):
+                    out_ = (run_compiled_ or run_jit_)(*args_)
+                if first_jit:
+                    # jit fallback: the first call through a program
+                    # traces+compiles synchronously inside the dispatch
+                    # — book it as compile, not execution (its async
+                    # execution tail is booked at commit via `untimed`)
+                    blocked = time.perf_counter() - t_d
+                    compile_seconds += blocked
+                    compile_pending += blocked
+                    flight.record_phase("compile", blocked)
+                start_async_fetch(out_[1], out_[2])
+                return _InFlight(
+                    ci=ci_, base=base_, state_out=out_[0],
+                    i_s=out_[1], f_s=out_[2], owner=run_jit_,
+                    use_repair=use_repair_,
+                    aot=run_compiled_ is not None,
+                    speculative=speculative,
+                    alive=alive_, part=part_, we=we_,
+                    untimed=first_jit,
+                )
+
+            pending = None
+            if rounds < max_rounds:
+                pending = _dispatch(0, 0, state, last_pend_live, False,
+                                    speculative=False)
+            last_commit_t = time.perf_counter()
+            compile_pending = 0.0  # chunk 0's fallback compile happened
+            # before the clock above — never subtract it twice
+            while pending is not None:
+                nxt = None
+                next_base = pending.base + chunk
+                if next_base < max_rounds:
+                    # speculative dispatch: chunk N+1 enters the device
+                    # queue before chunk N's convergence scalar lands
+                    nxt = _dispatch(
+                        pending.ci + 1, next_base, pending.state_out,
+                        last_pend_live, bool(pending.we.any()),
+                        speculative=True,
+                    )
+                    spec_dispatched += 1
+                    counters.inc(
+                        "corro_pipeline_speculative_total",
+                        help_="chunks dispatched before the previous "
+                              "chunk's convergence scalar landed",
+                    )
+                # resolve pending's metrics — the copy has been in
+                # flight since its dispatch
+                t_f = time.perf_counter()
+                m = pending.owner.unpack(
+                    np.asarray(pending.i_s), np.asarray(pending.f_s)
+                )
+                fetch_wait = time.perf_counter() - t_f
+                if not pending.untimed:
+                    # untimed (jit-fallback first) chunks are excluded
+                    # from the execute wall below, so their compile-
+                    # polluted waits stay out of the overlap total AND
+                    # the blocking-stall histogram alike
+                    fetch_wait_total += fetch_wait
+                    histograms.observe(
+                        PIPELINE_FETCH_WAIT, fetch_wait,
+                        labels='{mode="pipelined"}',
+                        help_=PIPELINE_FETCH_WAIT_HELP,
+                        buckets=SECONDS_BUCKETS,
+                    )
+                now = time.perf_counter()
+                chunk_elapsed = max(
+                    now - last_commit_t - compile_pending, 0.0
+                )
+                last_commit_t = now
+                compile_pending = 0.0
+                if pending.untimed:
+                    # jit-fallback first chunk through a program: the
+                    # interval is compile+exec mixed — all compile, no
+                    # timed rounds, matching the sequential loop's books
+                    # (wall_per_round_ms stays comparable across modes)
                     compile_seconds += chunk_elapsed
                     flight.record_phase("compile", chunk_elapsed)
                 else:
                     wall += chunk_elapsed
                     timed_rounds += chunk
                     flight.record_phase("execute", chunk_elapsed)
-            else:
-                t0 = time.perf_counter()
-                with tracer.span("chunk", ci=ci, runner=runner_name,
-                                 mode="aot"):
-                    state, m = _exec(run_compiled, run_jit, args)
-                chunk_elapsed = time.perf_counter() - t0
-                wall += chunk_elapsed
-                timed_rounds += chunk
-                flight.record_phase("execute", chunk_elapsed)
-            counters.inc(
-                "corro_chunk_dispatch_total",
-                labels=f'{{runner="{runner_name}"}}',
-                help_="chunk dispatches by program",
-            )
-            histograms.observe(
-                "corro_chunk_wall_seconds", chunk_elapsed,
-                labels=f'{{runner="{runner_name}"}}',
-                help_="per-chunk execution wall by program",
-                buckets=SECONDS_BUCKETS,
-            )
-            metrics_chunks.append(m)
-            flight.record_rounds(rounds + 1, m)
-            flight.annotate(
-                rounds + chunk, "chunk", chunk=ci, runner=runner_name,
-                wall_s=round(chunk_elapsed, 6),
-                aot=run_compiled is not None,
-            )
-            # scenario fault events (node kill/rejoin, split, heal, loss
-            # windows) land in the flight record at their scheduled round
-            # — the provenance that makes a chaos run's curve readable
-            for ev_r, ev_name, ev_attrs in schedule.events_in(rounds, chunk):
-                flight.annotate(ev_r + 1, "fault_event", kind=ev_name,
-                                **ev_attrs)
-                counters.inc(
-                    "corro_fault_events_total",
-                    labels=f'{{kind="{ev_name}"}}',
-                    help_="scheduled fault events executed, by kind",
+                state = pending.state_out
+                cont = _process(
+                    pending.ci, pending.base, m, state, pending.alive,
+                    pending.part, pending.we, pending.use_repair,
+                    pending.aot, chunk_elapsed,
+                    annot_extra={
+                        "pipeline": True,
+                        "fetch_wait_s": round(fetch_wait, 6),
+                        "speculative": pending.speculative,
+                    },
                 )
-            if "fault_lost" in m:
-                for mk, cname in (
-                    ("fault_lost", "corro_fault_lost_total"),
-                    ("fault_dup", "corro_fault_dup_total"),
-                    ("fault_blackholed", "corro_fault_blackholed_total"),
-                    ("fault_sync_lost", "corro_fault_sync_lost_total"),
-                ):
-                    delta = int(np.asarray(m[mk]).sum()) if mk in m else 0
-                    if delta:
+                if not cont:
+                    # the run ended at `pending`; the look-ahead chunk
+                    # (if any) is the one wasted dispatch that bought
+                    # overlap on every committed chunk
+                    if nxt is not None:
+                        reason = "poisoned" if poisoned else "converged"
+                        spec_wasted += 1
                         counters.inc(
-                            cname, n=delta,
-                            help_="injected fault effects "
-                                  "(corro_sim/faults/)",
+                            "corro_pipeline_speculative_wasted_total",
+                            labels=f'{{reason="{reason}"}}',
+                            help_="speculative chunk results discarded, "
+                                  "by reason",
                         )
-            if invariants is not None:
-                for v in invariants.on_chunk(
-                    state, m, alive, part, rounds
-                ):
-                    flight.annotate(
-                        v.round + 1 if v.round is not None else rounds + 1,
-                        "invariant_violation",
-                        invariant=v.invariant, detail=v.detail,
-                    )
+                        flight.annotate(
+                            rounds, "pipeline_discard", chunk=nxt.ci,
+                            reason=reason,
+                        )
+                    pending = None
+                    continue
+                if nxt is None:  # round budget exhausted
+                    pending = None
+                    continue
+                # pipeline-aware program switching: verify the
+                # speculative program choice against what the sequential
+                # path — which reads pend_live one chunk fresher — would
+                # have picked. Either direction can mispredict (full
+                # where repair at the switch boundary; repair where full
+                # if e.g. a rejoin raises pend_live with writes still
+                # blocked at speculation time), so compare the full
+                # choice, then discard and re-dispatch on the correct
+                # program so committed chunks always ran the exact
+                # sequential program (tests/test_pipeline.py).
+                actual_repair = _select_repair(last_pend_live, nxt.we)
+                if actual_repair != nxt.use_repair:
+                    spec_wasted += 1
                     counters.inc(
-                        "corro_fault_invariant_violations_total",
-                        labels=f'{{invariant="{v.invariant}"}}',
-                        help_="soak invariant violations by checker",
+                        "corro_pipeline_speculative_wasted_total",
+                        labels='{reason="program_switch"}',
+                        help_="speculative chunk results discarded, "
+                              "by reason",
                     )
-            if prev_writes and not bool(we.any()):
-                # the schedule stopped writing — the measurement phase begins
-                flight.annotate(
-                    rounds + 1, "schedule_transition", kind="write_phase_end",
-                )
-            prev_writes = bool(we.any())
-            last_pend_live = int(m["pend_live"][-1])
-            if _DEBUG_CHUNKS:
-                import sys
-
-                print(
-                    f"# chunk {ci} rounds {rounds}..{rounds + chunk}"
-                    f" runner={'repair' if use_repair else 'full'}"
-                    f" wall={chunk_elapsed:.3f}s"
-                    f" pend_live={last_pend_live}"
-                    f" gap={float(m['gap'][-1]):.0f}"
-                    f" sync_pairs={int(m['sync_pairs'].sum())}",
-                    file=sys.stderr, flush=True,
-                )
-            rounds += chunk
-            ci += 1
-            if cfg.probes:
-                # per-chunk probe extraction: one small (K, N) transfer. A
-                # probe whose p99 delivery lag WORSENED this chunk (a late
-                # straggler stretched the tail) annotates the flight record
-                # — the curve-level "why was this chunk slow" breadcrumb.
-                p99 = ProbeTrace.from_state(cfg, state).delivery_p99()
-                if (
-                    p99 is not None
-                    and probe_p99_last is not None
-                    and p99 > probe_p99_last
-                ):
                     flight.annotate(
-                        rounds, "probe_p99_regression",
-                        p99=p99, prev=probe_p99_last,
+                        rounds, "pipeline_discard", chunk=nxt.ci,
+                        reason="program_switch",
                     )
-                    counters.inc(
-                        "corro_probe_p99_regressions_total",
-                        help_="chunks in which a probe's p99 delivery lag "
-                              "worsened",
-                    )
-                if p99 is not None:
-                    probe_p99_last = p99
-            if on_chunk is not None:
-                on_chunk({
-                    "chunk": ci - 1,
-                    "rounds_done": rounds,
-                    "chunk_wall_s": round(chunk_elapsed, 3),
-                    "wall_s": round(wall, 3),
-                    "compile_s": round(compile_seconds, 3),
-                    "runner": "repair" if use_repair else "full",
-                    "gap": float(m["gap"][-1]),
-                    "pend_live": last_pend_live,
-                })
-            if m["log_wrapped"].any():
-                # Ring-wrap tripwire fired: a live node lagged some actor past
-                # log_capacity, so gathers may have read overwritten slots.
-                # Convergence can no longer be trusted — stop and poison.
-                poisoned = True
-                wrapped_at = rounds - chunk + 1 + int(
-                    np.argmax(np.asarray(m["log_wrapped"]) != 0)
-                )
-                flight.annotate(wrapped_at, "log_wrapped")
-                break
-            # Strictly greater: at rounds == min_rounds the round numbered
-            # min_rounds (e.g. a scheduled rejoin) has not executed yet.
-            if stop_on_convergence and rounds > min_rounds:
-                gaps = m["gap"]
-                if gaps[-1] == 0.0:
-                    # Only rounds strictly past min_rounds are convergence
-                    # candidates — a transient zero during the write phase (all
-                    # deliveries momentarily caught up) is not convergence.
-                    base = rounds - chunk  # chunk covers rounds base+1 … rounds
-                    idx = np.arange(1, chunk + 1) + base
-                    eligible = (gaps == 0.0) & (idx > min_rounds)
-                    converged_round = int(idx[np.argmax(eligible)])
-                    flight.annotate(converged_round, "converged")
-                    if invariants is not None:
-                        # the convergence report itself is checked: no
-                        # report may stand while a live same-partition
-                        # pair still disagrees on table state
-                        for v in invariants.on_converged(
-                            state, alive[-1], part[-1]
-                        ):
-                            flight.annotate(
-                                converged_round, "invariant_violation",
-                                invariant=v.invariant, detail=v.detail,
-                            )
-                            counters.inc(
-                                "corro_fault_invariant_violations_total",
-                                labels=f'{{invariant="{v.invariant}"}}',
-                                help_="soak invariant violations by checker",
-                            )
-                    break
+                    nxt = _dispatch(nxt.ci, nxt.base, state,
+                                    last_pend_live, False,
+                                    speculative=False)
+                pending = nxt
 
         # Drain the pipeline into the measured wall: the axon platform streams
         # per-buffer readiness, so work not on the metric dependency path (the
@@ -635,6 +925,38 @@ def run_sim(
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+
+    if pipeline:
+        exec_wall = max(wall - drain, 0.0)
+        overlap = max(exec_wall - fetch_wait_total, 0.0)
+        overlap_ratio = overlap / exec_wall if exec_wall > 0 else None
+        counters.inc(
+            "corro_pipeline_overlap_seconds_total", n=round(overlap, 6),
+            help_="host control/bookkeeping wall spent concurrent with "
+                  "device chunk execution (execute wall minus fetch wait)",
+        )
+        pipeline_stats = {
+            "enabled": True,
+            "speculative_dispatched": spec_dispatched,
+            "speculative_wasted": spec_wasted,
+            "fetch_wait_s": round(fetch_wait_total, 6),
+            "execute_wall_s": round(exec_wall, 6),
+            "overlap_ratio": (
+                round(overlap_ratio, 4) if overlap_ratio is not None
+                else None
+            ),
+        }
+        flight.annotate(
+            rounds, "pipeline",
+            **{k: v for k, v in pipeline_stats.items() if k != "enabled"},
+        )
+    else:
+        pipeline_stats = {
+            "enabled": False,
+            "fetch_wait_s": round(fetch_wait_total, 6),
+        }
+        if pipeline_off_reason:
+            pipeline_stats["disabled_reason"] = pipeline_off_reason
     metrics = {
         k: np.concatenate([c[k] for c in metrics_chunks])
         for k in metrics_chunks[0]
@@ -656,4 +978,5 @@ def run_sim(
             )
             if cfg.probes else None
         ),
+        pipeline=pipeline_stats,
     )

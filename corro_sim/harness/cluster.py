@@ -53,8 +53,17 @@ from corro_sim.subs.manager import (
     make_matcher,
 )
 from corro_sim.subs.query import QueryError, parse_query, post_process
+from corro_sim.utils.metrics import (
+    PIPELINE_FETCH_WAIT,
+    PIPELINE_FETCH_WAIT_HELP,
+    histograms as _global_histograms,
+)
 from corro_sim.utils.ranks import rank_map, translate_ranks
-from corro_sim.utils.runtime import LockRegistry, Tripwire
+from corro_sim.utils.runtime import (
+    LockRegistry,
+    Tripwire,
+    start_async_fetch as _start_async_fetch,
+)
 
 
 @dataclasses.dataclass
@@ -989,18 +998,8 @@ class LiveCluster:
                 tuple(jnp.asarray(x) for x in w),
             )
             self._rounds_ticked += 1
-            # ONE device->host transfer for all metric scalars: per-leaf
-            # asarray costs a full tunnel round-trip each on the axon
-            # platform (~80 ms x ~18 metrics per tick otherwise)
-            names = sorted(metrics)
-            packed = np.asarray(
-                jnp.stack([metrics[k].astype(jnp.float32) for k in names])
-            )
-            self._observe_stage("step", time.perf_counter() - t0)
-            self._record_metrics(packed[:, None], names)
-            t0 = time.perf_counter()
-            self._notify_subs()
-            self._observe_stage("subs", time.perf_counter() - t0)
+            self._finish_tick(metrics, t0, mode="live_step", per=1,
+                              stage="step")
 
     def _tick_chunk_locked(self) -> None:
         """Advance _CHUNK rounds in ONE jitted dispatch (`lax.scan`).
@@ -1039,15 +1038,43 @@ class LiveCluster:
             tuple(jnp.asarray(x) for x in w),
         )
         self._rounds_ticked += _CHUNK
-        names = sorted(ms)
-        packed = np.asarray(
-            jnp.stack([ms[k].astype(jnp.float32) for k in names])
-        )  # (num_metrics, _CHUNK) — still one transfer
-        self._observe_stage("chunk_step", time.perf_counter() - t0, per=_CHUNK)
-        self._record_metrics(packed, names)
-        t0 = time.perf_counter()
+        self._finish_tick(ms, t0, mode="live_chunk", per=_CHUNK,
+                          stage="chunk_step")
+
+    def _finish_tick(self, metrics, t0: float, mode: str, per: int,
+                     stage: str) -> None:
+        """Shared tail of both tick paths: pack the step metrics into
+        ONE device array (per-leaf asarray costs a full ~80 ms tunnel
+        round-trip each on the axon platform), start its device→host
+        copy async, run the subscription diff UNDER the transfer, then
+        resolve + record. The subs diff reads state, not the metric
+        stack, so the reorder changes nothing observable — it just
+        stops the copy stalling ahead of host work (the driver-side
+        chunk pipeline's async-fetch half; doc/performance.md).
+        ``t0`` is the dispatch start; ``per`` rounds covered."""
+        names = sorted(metrics)
+        stack = jnp.stack(
+            [metrics[k].astype(jnp.float32) for k in names]
+        )
+        _start_async_fetch(stack)
+        t_dispatch = time.perf_counter() - t0
+        t1 = time.perf_counter()
         self._notify_subs()
-        self._observe_stage("subs", time.perf_counter() - t0)
+        subs_s = time.perf_counter() - t1
+        t1 = time.perf_counter()
+        packed = np.asarray(stack)
+        fetch_wait = time.perf_counter() - t1
+        _global_histograms.observe(
+            PIPELINE_FETCH_WAIT, fetch_wait,
+            labels=f'{{mode="{mode}"}}',
+            help_=PIPELINE_FETCH_WAIT_HELP,
+        )
+        self._observe_stage(stage, t_dispatch + fetch_wait, per=per)
+        # scalar-per-metric ticks widen to one (metrics, 1) column
+        self._record_metrics(
+            packed if packed.ndim > 1 else packed[:, None], names
+        )
+        self._observe_stage("subs", subs_s)
 
     def _subs_active(self) -> bool:
         return len(self.subs) > 0 or bool(self._sub_queues)

@@ -10,6 +10,8 @@ override prefix is ``CORRO_SIM__``::
     num_nodes = 1000
     write_rate = 0.3
     swim_enabled = true
+    pipeline = false      # opt out of pipelined chunk dispatch
+                          # (doc/performance.md; default on)
 
     [sim.faults]          # chaos injection (corro_sim/faults/)
     loss = 0.05

@@ -291,6 +291,16 @@ class FlightRecorder:
         runner_wall = self._runner_wall(events)
         if runner_wall:
             out["chunk_wall_s_by_runner"] = runner_wall
+        # chunk-pipeline summary (engine/driver.py pipelined dispatch):
+        # overlap ratio, speculation counts and the fetch-wait wall ride
+        # the run's final "pipeline" annotation — surfaced here so
+        # `corro-sim flight --diag` and the bench artifacts carry the
+        # overlap evidence next to the convergence curve it paid for.
+        pipe = next(
+            (e for e in reversed(events) if e["name"] == "pipeline"), None
+        )
+        if pipe is not None:
+            out["pipeline"] = dict(pipe["attrs"])
         if not gaps:
             return out
         out["final_gap"] = gaps[-1]

@@ -70,6 +70,33 @@ SECONDS_BUCKETS = (
 )
 CHUNK_SIZE_BUCKETS = (1.0, 10.0, 75.0, 250.0, 375.0, 500.0, 650.0)
 
+# ---- corro_pipeline_*: chunk-pipeline observability ------------------
+# The pipelined chunk dispatch (engine/driver.py, doc/performance.md)
+# and the harness tick paths (harness/cluster.py) share one histogram
+# for the host wall spent BLOCKED resolving a chunk's packed metric
+# stacks, labeled by mode:
+#   mode="sequential"  — run_sim --no-pipeline's blocking read (the
+#                        stall the pipeline exists to hide)
+#   mode="pipelined"   — run_sim's resolve of an async fetch started at
+#                        dispatch time, one chunk behind
+#   mode="live_chunk"/"live_step" — LiveCluster tick paths (async fetch
+#                        overlapped with subscription notification)
+# Companion counters written by the driver:
+#   corro_pipeline_speculative_total        chunks dispatched ahead of
+#                                           the convergence scalar
+#   corro_pipeline_speculative_wasted_total discarded results, by
+#                                           reason= converged|poisoned|
+#                                           program_switch
+#   corro_pipeline_overlap_seconds_total    host control/bookkeeping
+#                                           wall concurrent with device
+#                                           chunk execution
+PIPELINE_FETCH_WAIT = "corro_pipeline_fetch_wait_seconds"
+PIPELINE_FETCH_WAIT_HELP = (
+    "host wall blocked resolving a chunk's packed metric stacks "
+    "(device->host), by dispatch mode; sequential mode is the "
+    "blocking-read stall the chunk pipeline hides"
+)
+
 
 class Histogram:
     """A Prometheus histogram with the reference exporter's buckets

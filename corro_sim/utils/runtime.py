@@ -80,6 +80,19 @@ _PENDING_LOCK = threading.Lock()
 _PENDING_ZERO = threading.Condition(_PENDING_LOCK)
 
 
+def start_async_fetch(*bufs) -> None:
+    """Begin device→host copies without blocking (resolved later by
+    ``np.asarray``) — the chunk pipeline's async-fetch half
+    (doc/performance.md): host work rides under the transfer. Duck-typed
+    over jax arrays; platforms without per-array async copy just resolve
+    everything at the blocking read, same semantics."""
+    for b in bufs:
+        try:
+            b.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+
+
 def spawn_counted(fn, *args, name: str | None = None, **kwargs) -> threading.Thread:
     """Run ``fn`` on a daemon thread tracked by the global pending counter."""
     global _PENDING

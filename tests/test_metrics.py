@@ -293,6 +293,38 @@ def test_probe_exposition_validates(probe_cluster):
     _validate_exposition(render_prometheus(probe_cluster))
 
 
+def test_pipeline_family_renders_and_validates(cluster, probe_cluster):
+    """ISSUE 4 satellite: the corro_pipeline_* family. The fetch-wait
+    histogram renders one labeled series per dispatch mode (pipelined /
+    sequential run_sim loops + the LiveCluster tick paths), the
+    speculation/overlap counters render, and the whole exposition still
+    passes the scraper-contract validator."""
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule, run_sim
+    from corro_sim.engine.state import init_state
+
+    cfg = SimConfig(
+        num_nodes=8, num_rows=16, num_cols=1, log_capacity=64,
+        write_rate=0.5, swim_enabled=False, sync_interval=4,
+    )
+    for pipeline in (True, False):
+        run_sim(
+            cfg, init_state(cfg, seed=0), Schedule(write_rounds=4),
+            max_rounds=16, chunk=4, seed=0, pipeline=pipeline,
+        )
+    cluster.tick(1)        # single-round path -> mode="live_step"
+    probe_cluster.tick(16)  # chunked path (no subs) -> mode="live_chunk"
+    text = render_prometheus(cluster)
+    for mode in ("pipelined", "sequential", "live_step", "live_chunk"):
+        assert (
+            f'corro_pipeline_fetch_wait_seconds_bucket'
+            f'{{mode="{mode}",le="+Inf"}}' in text
+        ), f"missing fetch-wait series for mode={mode}"
+    assert "corro_pipeline_speculative_total" in text
+    assert "corro_pipeline_overlap_seconds_total" in text
+    _validate_exposition(text)
+
+
 def test_node_lag_renders_without_probes(cluster):
     """The lag observatory never needs the tracer; only its sync-age
     column does."""

@@ -1,0 +1,231 @@
+"""Pipelined chunk dispatch (engine/driver.py): equivalence + overlap.
+
+The pipelined loop (default on, ``SimConfig.pipeline`` /
+``--no-pipeline``) issues chunk N+1 to the device before chunk N's
+convergence scalar lands on the host (speculative dispatch), resolves
+the packed metric stacks off an async copy one chunk behind dispatch,
+and verifies the speculative program choice against the sequential
+repair-switch rule — discarding and re-dispatching on a mispredict.
+
+The contract these tests pin: results are **bit-identical** to the
+sequential loop — same chunk programs, same keys, same schedule rows;
+only dispatch order changes. Covered: chunk sizes {1, 4, 16}, a fault
+scenario, the repair-program switch boundary, donation gating, and the
+acceptance microbench (64 rounds / 8 chunks: pipelined fetch-wait wall
+strictly below the sequential blocking-read wall).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.driver import Schedule, run_sim
+from corro_sim.engine.state import init_state
+
+CFG = SimConfig(
+    num_nodes=16, num_rows=16, num_cols=2, log_capacity=64,
+    write_rate=0.5, swim_enabled=False, sync_interval=4,
+)
+
+
+def _assert_bit_identical(rp, rs):
+    """Pipelined vs sequential RunResults: state leaves, metric arrays
+    and every convergence-relevant scalar must match exactly."""
+    for a, b in zip(jax.tree.leaves(rp.state), jax.tree.leaves(rs.state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert set(rp.metrics) == set(rs.metrics)
+    for k in rp.metrics:
+        np.testing.assert_array_equal(
+            rp.metrics[k], rs.metrics[k], err_msg=k
+        )
+    assert rp.rounds == rs.rounds
+    assert rp.converged_round == rs.converged_round
+    assert rp.repair_chunks == rs.repair_chunks
+    assert rp.poisoned == rs.poisoned
+
+
+def _pair(cfg, schedule_fn, **kw):
+    rp = run_sim(cfg, init_state(cfg, seed=kw.get("seed", 0)),
+                 schedule_fn(), pipeline=True, **kw)
+    rs = run_sim(cfg, init_state(cfg, seed=kw.get("seed", 0)),
+                 schedule_fn(), pipeline=False, **kw)
+    return rp, rs
+
+
+@pytest.mark.parametrize("chunk", [1, 4, 16])
+def test_equivalence_across_chunk_sizes(chunk):
+    rp, rs = _pair(
+        CFG, lambda: Schedule(write_rounds=4),
+        max_rounds=64, chunk=chunk, seed=0,
+    )
+    _assert_bit_identical(rp, rs)
+    assert rp.pipeline["enabled"] and not rs.pipeline["enabled"]
+    # both modes report the fetch wall under the same key, so a
+    # pipelined-vs-sequential pair is directly comparable
+    assert rp.pipeline["fetch_wait_s"] >= 0
+    assert rs.pipeline["fetch_wait_s"] >= 0
+
+
+def test_equivalence_under_fault_scenario():
+    """Chaos riding along: the compiled fault stream (fold_in-derived
+    keys) must be untouched by dispatch order."""
+    from corro_sim.faults import make_scenario
+
+    base = SimConfig(
+        num_nodes=16, num_rows=16, num_cols=2, log_capacity=64,
+        write_rate=0.5, sync_interval=4,
+    )
+    results = []
+    for pipeline in (True, False):
+        sc = make_scenario("lossy:p=0.15", base.num_nodes, rounds=64,
+                           write_rounds=8, seed=0)
+        cfg = sc.apply(base)
+        results.append(run_sim(
+            cfg, init_state(cfg, seed=0), sc.schedule(),
+            max_rounds=128, chunk=8, seed=0,
+            min_rounds=max(sc.heal_round or 0, 8), pipeline=pipeline,
+        ))
+    rp, rs = results
+    _assert_bit_identical(rp, rs)
+    assert rp.metrics["fault_lost"].sum() > 0  # faults actually fired
+
+
+def test_equivalence_across_repair_switch_boundary():
+    """The speculative program choice reads the repair precondition one
+    chunk late; at the switch boundary the mispredicted chunk must be
+    discarded and re-dispatched on the repair program, so committed
+    chunks ran EXACTLY the sequential path's programs (repair_chunks
+    equal, states bit-identical)."""
+    cfg = SimConfig(
+        num_nodes=24, num_rows=16, num_cols=2, log_capacity=128,
+        write_rate=0.5, swim_enabled=True, swim_interval=2,
+        swim_suspect_rounds=3, sync_interval=4, sync_adaptive=True,
+        sync_actor_topk=8, sync_cap_per_actor=2,
+    )
+
+    def part_fn(r, n):
+        p = np.zeros(n, np.int32)
+        if 4 <= r < 10:
+            p[n // 2:] = 1
+        return p
+
+    rp, rs = _pair(
+        cfg, lambda: Schedule(write_rounds=8, part_fn=part_fn),
+        max_rounds=256, chunk=8, seed=3, min_rounds=48,
+    )
+    _assert_bit_identical(rp, rs)
+    assert rp.repair_chunks == rs.repair_chunks > 0
+    # the boundary itself is pinned: exactly one program-switch discard,
+    # plus the end-of-run convergence discard
+    discards = [
+        e["attrs"]["reason"]
+        for e in rp.flight.timeline()["events"]
+        if e["name"] == "pipeline_discard"
+    ]
+    assert discards.count("program_switch") == 1
+    assert rp.pipeline["speculative_wasted"] == len(discards)
+
+
+def test_donate_disables_pipeline():
+    """A speculative dispatch must not consume donated buffers: a
+    discarded/re-dispatched chunk would have no input left. Donated
+    runs take the sequential loop and say so."""
+    res = run_sim(
+        CFG, init_state(CFG, seed=0), Schedule(write_rounds=4),
+        max_rounds=32, chunk=8, seed=0, donate=True, pipeline=True,
+    )
+    assert res.pipeline["enabled"] is False
+    assert res.pipeline["disabled_reason"] == "donate"
+
+
+def test_speculation_discard_at_convergence():
+    """End-of-run semantics: the look-ahead chunk dispatched past the
+    converged chunk is discarded (counted wasted), and the committed
+    round count matches the sequential path (no phantom rounds)."""
+    rp = run_sim(
+        CFG, init_state(CFG, seed=0), Schedule(write_rounds=4),
+        max_rounds=256, chunk=4, seed=0, pipeline=True,
+    )
+    assert rp.converged_round is not None
+    assert rp.rounds < 256  # stopped at convergence, not the budget
+    assert rp.pipeline["speculative_wasted"] >= 1
+    discards = [
+        e["attrs"]["reason"]
+        for e in rp.flight.timeline()["events"]
+        if e["name"] == "pipeline_discard"
+    ]
+    assert "converged" in discards
+    # flight diagnostics surface the pipeline summary
+    assert rp.flight.diagnostics()["pipeline"]["speculative_wasted"] >= 1
+
+
+def test_fetch_wait_strictly_below_sequential_blocking_read():
+    """The acceptance microbench: 64 rounds / 8 chunks on CPU. The
+    pipelined loop's host-side stall (corro_pipeline_fetch_wait_seconds,
+    RunResult.pipeline['fetch_wait_s']) must be strictly below the
+    sequential path's blocking-read wall on the same trajectory, and the
+    overlap ratio must be positive — the stall went somewhere useful."""
+    cfg = SimConfig(
+        num_nodes=512, num_rows=64, num_cols=2, log_capacity=128,
+        write_rate=0.5, sync_interval=8,
+    )
+    kw = dict(max_rounds=64, chunk=8, seed=0, stop_on_convergence=False)
+    # best-of-two per mode: the systematic advantage (host bookkeeping
+    # overlapped with device compute) survives the min; one-off
+    # scheduler/GC spikes in either run do not flake the strict compare
+    pipes, seqs = [], []
+    for _ in range(2):
+        pipes.append(run_sim(
+            cfg, init_state(cfg, seed=0), Schedule(write_rounds=64),
+            pipeline=True, **kw,
+        ))
+        seqs.append(run_sim(
+            cfg, init_state(cfg, seed=0), Schedule(write_rounds=64),
+            pipeline=False, **kw,
+        ))
+    rp, rs = pipes[0], seqs[0]
+    _assert_bit_identical(rp, rs)
+    assert rp.rounds == rs.rounds == 64
+    # 8 chunks: speculation covers chunks 1..7 (the budget is host-known,
+    # so no chunk past max_rounds is ever dispatched), nothing wasted
+    assert rp.pipeline["speculative_dispatched"] == 7
+    assert rp.pipeline["speculative_wasted"] == 0
+    assert rp.pipeline["overlap_ratio"] is not None
+    assert rp.pipeline["overlap_ratio"] > 0
+    best_pipe = min(r.pipeline["fetch_wait_s"] for r in pipes)
+    best_seq = min(r.pipeline["fetch_wait_s"] for r in seqs)
+    assert best_pipe < best_seq, (
+        [r.pipeline for r in pipes], [r.pipeline for r in seqs],
+    )
+
+
+def test_schedule_materialize_rows_cache():
+    """Satellite: the legacy-callable cache appends per-round rows (O(R)
+    total) and stacks per read — same rows for any chunking, last row
+    held past the callable horizon, identical to precomputed arrays."""
+    calls = []
+
+    def alive_fn(r, n):
+        calls.append(r)
+        a = np.ones(n, bool)
+        a[r % n] = False
+        return a
+
+    s1 = Schedule(write_rounds=4, alive_fn=alive_fn)
+    whole = s1.slice(0, 12, 6)[0]
+    # re-slicing any sub-window never re-evaluates the callable …
+    before = len(calls)
+    for start, length in ((0, 4), (2, 6), (8, 4)):
+        a, _, _ = s1.slice(start, length, 6)
+        np.testing.assert_array_equal(a, whole[start:start + length])
+    assert len(calls) == before, "cached rounds were re-materialized"
+    # … each round was materialized exactly once, in order
+    assert calls == list(range(12))
+    # past the horizon the cache holds the last materialized row only
+    # for precomputed arrays; callables keep materializing — rows stay
+    # a function of the absolute round regardless of chunk boundaries
+    s2 = Schedule(write_rounds=4, alive_fn=alive_fn)
+    chunks = [s2.slice(r, 3, 6)[0] for r in range(0, 12, 3)]
+    np.testing.assert_array_equal(np.concatenate(chunks), whole)
