@@ -1,0 +1,29 @@
+"""corro-lint: static trace-safety analysis + jaxpr audit harness.
+
+Three enforcement layers (ISSUE 5, doc/static_analysis.md):
+
+- :mod:`corro_sim.analysis.rules` / :mod:`corro_sim.analysis.lint` —
+  the AST rule engine (`corro-sim lint`, tools/corro_lint.py): JAX
+  trace hazards (implicit host sync, PRNG reuse, weak scalars, traced
+  branches, trace-time host mutation, use-after-donate) with per-rule
+  ``# corro-lint: ignore[RULE]`` suppressions;
+- :mod:`corro_sim.analysis.jaxpr_audit` — compiles ``sim_step`` under a
+  matrix of feature-off configs and asserts the vacuity invariants +
+  the committed primitive-count golden fingerprint (`corro-sim audit`);
+- :mod:`corro_sim.analysis.transfer_guard` — ``jax.transfer_guard``
+  wiring around the driver's chunk loop (CORRO_SIM_TRANSFER_GUARD),
+  enforcing PR 4's async-copy discipline at runtime.
+
+Heavy imports stay in the submodules: importing this package must not
+pull jax (the lint engine is pure-AST and runs in seconds anywhere).
+"""
+
+from corro_sim.analysis.rules import RULES, Finding  # noqa: F401
+from corro_sim.analysis.lint import (  # noqa: F401
+    LintResult,
+    collect_files,
+    export_metrics,
+    lint_paths,
+    render_json,
+    render_text,
+)

@@ -1,0 +1,998 @@
+"""corro-lint rule catalog: JAX trace-safety analysis over the AST.
+
+The north-star program shape — one fused XLA program per round — only
+survives if nothing on the step path silently re-serializes dispatch or
+perturbs key derivation. These rules encode the hazards that have bitten
+(or would bite) this codebase, each enforceable without executing code:
+
+  CL101 host-sync       ``float()``/``int()``/``bool()``/``.item()``/
+                        ``np.asarray()`` on a traced value inside traced
+                        code — a blocking device→host transfer that
+                        stalls the pipelined dispatch (PR 4) mid-chunk.
+  CL102 prng-reuse      a PRNG key consumed by more than one sampler (or
+                        re-consumed across loop iterations) without
+                        ``split``/``fold_in`` — correlated fault/write
+                        streams, the discipline PR 3's ``fold_in`` lanes
+                        exist to protect.
+  CL103 weak-scalar     ``jnp.array``/``jnp.asarray`` on a bare Python
+                        numeric literal without ``dtype=`` inside traced
+                        code — a weak-typed scalar whose promotion
+                        depends on context and can flip program dtypes
+                        (and the compile-cache key) from a distance.
+  CL104 traced-branch   Python ``if``/``while``/``assert``/ternary on a
+                        traced value — either a TracerBoolConversionError
+                        at trace time or, via ``__bool__``, a hidden
+                        host sync per call.
+  CL105 host-mutation   mutating host state captured by closure inside
+                        traced code — runs at TRACE time, not run time;
+                        silently stale on cache hits.
+  CL106 use-after-donate a buffer passed at a donated argnum and read
+                        again after the call — donated input buffers are
+                        invalidated by XLA aliasing.
+
+Trace context is inferred statically: functions decorated with ``jit``
+(including ``functools.partial(jax.jit, ...)``), callbacks handed to
+``jax.lax`` control-flow entrypoints / ``jax.jit`` / ``jax.vmap``, and —
+transitively — every function they call that resolves inside the
+analyzed tree (module-level call graph over ``from corro_sim.x import
+y`` edges). Tainted ("traced") values are seeded from parameters whose
+annotations are array-like (``jnp.ndarray``, ``jax.Array``) or state
+pytrees (``*State``), plus anything assigned from a ``jnp.*``/
+``jax.lax.*``/``jax.random.*`` call, and flow through arithmetic,
+indexing and attribute access (``.shape``/``.dtype``/``.ndim``/``.size``
+and ``is None`` checks are host-static and strip taint). The analysis
+prefers precision over recall: an unannotated parameter is assumed
+host-static, so the tree lints clean without drowning real hazards.
+
+Suppression: ``# corro-lint: ignore[CL105]`` (comma-separated IDs, or
+bare ``ignore`` for all rules) on the finding's line or the line above.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    severity: str  # "error" | "warning"
+    summary: str
+
+
+RULES: dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule("CL101", "host-sync", "error",
+             "implicit host synchronization on a traced value inside "
+             "traced code"),
+        Rule("CL102", "prng-reuse", "error",
+             "PRNG key consumed more than once without split/fold_in"),
+        Rule("CL103", "weak-scalar", "warning",
+             "weak-typed Python scalar materialized inside traced code "
+             "without an explicit dtype"),
+        Rule("CL104", "traced-branch", "error",
+             "Python control flow on a traced value"),
+        Rule("CL105", "host-mutation", "warning",
+             "mutation of closure-captured host state inside traced "
+             "code (runs at trace time only)"),
+        Rule("CL106", "use-after-donate", "error",
+             "buffer read after being donated to a jit-compiled call"),
+    )
+}
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# annotations that seed taint: array values and state pytrees travel
+# through the traced program; everything else (configs, ints, callables)
+# is host-static at trace time
+_ARRAY_ANNOTATIONS = {
+    "jnp.ndarray", "jax.Array", "jax.numpy.ndarray", "Array", "ndarray",
+    "chex.Array", "ArrayLike",
+}
+# attribute reads that are host-static even on a traced value
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
+# jax.random callables that DERIVE keys rather than consuming entropy
+_KEY_DERIVERS = {"PRNGKey", "key", "split", "fold_in", "clone",
+                 "wrap_key_data", "key_data", "key_impl"}
+# mutating method names on a bare closure-captured name (CL105)
+_MUTATORS = {"append", "extend", "update", "add", "insert", "setdefault",
+             "pop", "popitem", "remove", "clear", "discard"}
+# jax.lax / jax control-flow + transform entrypoints whose function-typed
+# arguments are traced callbacks
+_TRACE_ENTRYPOINT_SUFFIXES = {
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.while_loop",
+    "jax.lax.fori_loop", "jax.lax.switch", "jax.lax.map",
+    "jax.lax.associative_scan", "jax.lax.custom_root",
+    "jax.jit", "jax.pjit", "jax.vmap", "jax.pmap", "jax.grad",
+    "jax.value_and_grad", "jax.remat", "jax.checkpoint",
+    "jax.eval_shape", "jax.make_jaxpr",
+}
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name, anchored at the innermost package root."""
+    parts = path.replace("\\", "/").split("/")
+    name = parts[-1]
+    if name.endswith(".py"):
+        name = name[:-3]
+    pkg: list[str] = []
+    for i in range(len(parts) - 2, -1, -1):
+        if parts[i] == "corro_sim" or parts[i].startswith("corro_"):
+            pkg = parts[i:-1]
+            break
+    return ".".join(pkg + [name]) if pkg else name
+
+
+class _ModuleIndex:
+    """Per-module import aliases + function defs + call edges."""
+
+    def __init__(self, path: str, tree: ast.Module):
+        self.path = path
+        self.module = _module_name(path)
+        self.tree = tree
+        # alias -> dotted path ("jnp" -> "jax.numpy"); from-imports map
+        # name -> "module.attr" so call targets resolve cross-module
+        self.aliases: dict[str, str] = {}
+        self.functions: dict[str, ast.FunctionDef] = {}  # qualname -> def
+        self._index_imports(tree)
+        self._index_functions(tree)
+
+    def _index_imports(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:  # relative: resolve against this package
+                    pkg = self.module.split(".")[: -node.level]
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.aliases[a.asname or a.name] = f"{base}.{a.name}"
+
+    def _index_functions(self, tree: ast.Module) -> None:
+        def visit(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = f"{prefix}{child.name}"
+                    self.functions[qual] = child
+                    visit(child, qual + ".")
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.")
+                else:
+                    visit(child, prefix)
+        visit(tree, "")
+
+    def dotted(self, node: ast.AST) -> str | None:
+        """Resolve an expression to a dotted path through the alias map:
+        ``jnp.repeat`` -> "jax.numpy.repeat"."""
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+
+def _is_jax_value_call(idx: _ModuleIndex, node: ast.Call) -> bool:
+    """A call that produces a traced array value (jnp/lax/random ops)."""
+    d = idx.dotted(node.func)
+    if d is None:
+        return False
+    return d.startswith(("jax.numpy.", "jax.lax.", "jax.random.",
+                         "jax.nn.", "jax.scipy."))
+
+
+def _annotation_taints(ann: ast.AST | None) -> bool:
+    if ann is None:
+        return False
+    text = ast.unparse(ann) if hasattr(ast, "unparse") else ""
+    for t in text.replace("|", " ").replace("Optional[", " ").split():
+        t = t.strip("[], \"'")
+        if t in _ARRAY_ANNOTATIONS or t.split(".")[-1].endswith("State"):
+            return True
+    return False
+
+
+def _ends_in_jump(stmts: list[ast.stmt]) -> bool:
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+# --------------------------------------------------------------- taint
+
+class _Taint:
+    """Forward taint over one function body (two passes for loop-carried
+    flow). ``tainted`` holds names currently bound to traced values."""
+
+    def __init__(self, idx: _ModuleIndex, fn: ast.FunctionDef):
+        self.idx = idx
+        self.tainted: set[str] = set()
+        args = fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs
+                  + ([args.vararg] if args.vararg else [])):
+            if _annotation_taints(a.annotation):
+                self.tainted.add(a.arg)
+
+    def expr(self, node: ast.AST) -> bool:
+        """Is this expression's value traced?"""
+        if node is None or isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr(node.value)
+        if isinstance(node, ast.Subscript):
+            return self.expr(node.value)
+        if isinstance(node, ast.Compare):
+            # identity checks against None are host-static always
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return self.expr(node.left) or any(
+                self.expr(c) for c in node.comparators
+            )
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr(node.left) or self.expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr(v) for v in node.values)
+        if isinstance(node, ast.IfExp):
+            return self.expr(node.body) or self.expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr(e) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self.expr(node.value)
+        if isinstance(node, ast.Call):
+            func = node.func
+            # host-converting calls return HOST values (len/int/float/
+            # bool/np.*) — the conversion itself is CL101's business
+            if isinstance(func, ast.Name) and func.id in (
+                "len", "int", "float", "bool", "range", "min", "max",
+                "isinstance", "getattr", "hasattr", "print", "callable",
+                "type", "id", "repr", "str",
+            ):
+                return False
+            d = self.idx.dotted(func)
+            if d is not None and (d.startswith("numpy.") or d == "numpy"):
+                return False
+            if _is_jax_value_call(self.idx, node):
+                return True
+            if isinstance(func, ast.Attribute) and func.attr in (
+                "item", "tolist",
+            ):
+                return False
+            # a method on a traced value (x.sum(), x.astype(...)) or any
+            # call fed a traced argument conservatively stays traced
+            if isinstance(func, ast.Attribute) and self.expr(func.value):
+                return True
+            return any(self.expr(a) for a in node.args) or any(
+                self.expr(k.value) for k in node.keywords
+            )
+        return False
+
+    def assign(self, target: ast.AST, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self.assign(e, tainted)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, tainted)
+        # subscript/attribute stores don't rebind names
+
+
+# ------------------------------------------------------------ checkers
+
+class _FunctionChecker:
+    """Runs the per-function rules; ``traced`` arms CL101/103/104/105."""
+
+    def __init__(self, idx: _ModuleIndex, fn: ast.FunctionDef,
+                 traced: bool, findings: list[Finding]):
+        self.idx = idx
+        self.fn = fn
+        self.traced = traced
+        self.findings = findings
+        self.taint = _Taint(idx, fn)
+        self.local_names = self._local_bindings(fn)
+        self.param_names = {
+            a.arg for a in (fn.args.posonlyargs + fn.args.args
+                            + fn.args.kwonlyargs)
+        } | ({fn.args.vararg.arg} if fn.args.vararg else set()) \
+          | ({fn.args.kwarg.arg} if fn.args.kwarg else set())
+        self._seen: set[tuple] = set()
+
+    @staticmethod
+    def _local_bindings(fn: ast.FunctionDef) -> set[str]:
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store,)
+            ):
+                names.add(node.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)) and node is not fn:
+                names.add(node.name)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                names.difference_update(node.names)
+        return names
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        key = (rule, node.lineno, node.col_offset)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(Finding(
+            rule=rule, severity=RULES[rule].severity, path=self.idx.path,
+            line=node.lineno, col=node.col_offset, message=message,
+        ))
+
+    # -- driver: two passes, report on the second (loop-carried taint) --
+    def run(self) -> None:
+        for report in (False, True):
+            self._stmts(self.fn.body, report)
+
+    def _stmts(self, stmts: list[ast.stmt], report: bool) -> None:
+        for st in stmts:
+            self._stmt(st, report)
+
+    def _stmt(self, st: ast.stmt, report: bool) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs are checked as their own functions
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = st.value
+            if value is not None:
+                self._expr(value, report)
+                t = self.taint.expr(value)
+                targets = (
+                    st.targets if isinstance(st, ast.Assign)
+                    else [st.target]
+                )
+                for tgt in targets:
+                    if isinstance(st, ast.AugAssign):
+                        t = t or self.taint.expr(tgt)
+                    self._check_host_mutation_store(tgt, report)
+                    self.taint.assign(tgt, t)
+            return
+        if isinstance(st, ast.If):
+            self._branch_test(st.test, report)
+            self._expr(st.test, report)
+            self._stmts(st.body, report)
+            self._stmts(st.orelse, report)
+            return
+        if isinstance(st, ast.While):
+            self._branch_test(st.test, report)
+            self._expr(st.test, report)
+            for _ in range(2):  # second pass: next-iteration hazards
+                self._stmts(st.body, report)
+            return
+        if isinstance(st, ast.For):
+            self._expr(st.iter, report)
+            self.taint.assign(st.target, self.taint.expr(st.iter))
+            for _ in range(2):
+                self._stmts(st.body, report)
+            self._stmts(st.orelse, report)
+            return
+        if isinstance(st, ast.Assert):
+            self._branch_test(st.test, report)
+            self._expr(st.test, report)
+            return
+        if isinstance(st, (ast.Return, ast.Expr)):
+            if st.value is not None:
+                self._expr(st.value, report)
+            return
+        if isinstance(st, ast.With):
+            for item in st.items:
+                self._expr(item.context_expr, report)
+            self._stmts(st.body, report)
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, report)
+            for h in st.handlers:
+                self._stmts(h.body, report)
+            self._stmts(st.orelse, report)
+            self._stmts(st.finalbody, report)
+            return
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, report)
+
+    # -- CL104 -----------------------------------------------------
+    def _branch_test(self, test: ast.AST, report: bool) -> None:
+        if report and self.traced and self.taint.expr(test):
+            self.emit(
+                "CL104", test,
+                "Python control flow on a traced value — jit will raise "
+                "a TracerBoolConversionError (or silently sync the host "
+                "on concrete values); use jnp.where / lax.cond / "
+                "lax.select instead",
+            )
+
+    # -- expression walk: CL101 / CL102 / CL103 / CL105 / ternaries --
+    def _expr(self, node: ast.AST, report: bool) -> None:
+        for call in [n for n in ast.walk(node)
+                     if isinstance(n, ast.Call)]:
+            self._check_call(call, report)
+        if report and self.traced:
+            for ifexp in [n for n in ast.walk(node)
+                          if isinstance(n, ast.IfExp)]:
+                if self.taint.expr(ifexp.test):
+                    self.emit(
+                        "CL104", ifexp,
+                        "ternary on a traced value — use jnp.where",
+                    )
+        for comp in [n for n in ast.walk(node)
+                     if isinstance(n, ast.comprehension)]:
+            for cond in comp.ifs:
+                self._branch_test(cond, report)
+
+    def _check_call(self, call: ast.Call, report: bool) -> None:
+        func = call.func
+        d = self.idx.dotted(func)
+        # CL101: scalar coercions + numpy materialization of traced values
+        if report and self.traced:
+            arg0 = call.args[0] if call.args else None
+            if (
+                isinstance(func, ast.Name)
+                and func.id in ("float", "int", "bool", "complex")
+                and arg0 is not None
+                and self.taint.expr(arg0)
+            ):
+                self.emit(
+                    "CL101", call,
+                    f"{func.id}() on a traced value forces a blocking "
+                    "device->host sync inside traced code (re-serializes "
+                    "dispatch); keep the value on-device or compute it "
+                    "between chunks",
+                )
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("item", "tolist")
+                and self.taint.expr(func.value)
+            ):
+                self.emit(
+                    "CL101", call,
+                    f".{func.attr}() on a traced value is an implicit "
+                    "device->host transfer inside traced code",
+                )
+            if (
+                d in ("numpy.asarray", "numpy.array")
+                and arg0 is not None
+                and self.taint.expr(arg0)
+            ):
+                self.emit(
+                    "CL101", call,
+                    f"{d.replace('numpy', 'np')}() on a traced value "
+                    "materializes it on the host inside traced code; use "
+                    "jnp equivalents",
+                )
+            # CL103: weak-typed scalar literal without dtype
+            if (
+                d in ("jax.numpy.array", "jax.numpy.asarray")
+                and arg0 is not None
+                and self._is_numeric_literal(arg0)
+                and not any(k.arg == "dtype" for k in call.keywords)
+            ):
+                self.emit(
+                    "CL103", call,
+                    "weak-typed Python scalar materialized without an "
+                    "explicit dtype — promotion then depends on context "
+                    "and can flip program dtypes (and the compile-cache "
+                    "key); pass dtype= explicitly",
+                )
+            # CL105: mutating a closure-captured host object
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _MUTATORS
+                and isinstance(func.value, ast.Name)
+                and self._is_free_host_name(func.value.id)
+            ):
+                self.emit(
+                    "CL105", call,
+                    f"'{func.value.id}.{func.attr}(...)' mutates "
+                    "closure-captured host state inside traced code — "
+                    "this runs at trace time only and is silently stale "
+                    "on compile-cache hits",
+                )
+
+    @staticmethod
+    def _is_numeric_literal(node: ast.AST) -> bool:
+        # bools are NOT weak-typed in JAX (only int/float/complex Python
+        # scalars promote contextually) — bool(True) literals are safe
+        if isinstance(node, ast.UnaryOp):
+            node = node.operand
+        if isinstance(node, ast.Constant):
+            return isinstance(
+                node.value, (int, float, complex)
+            ) and not isinstance(node.value, bool)
+        return False
+
+    def _is_free_host_name(self, name: str) -> bool:
+        return (
+            name not in self.local_names
+            and name not in self.param_names
+            and name not in self.taint.tainted
+        )
+
+    # -- CL105 (store form) ---------------------------------------
+    def _check_host_mutation_store(self, tgt: ast.AST,
+                                   report: bool) -> None:
+        if not (report and self.traced):
+            return
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._check_host_mutation_store(e, report)
+            return
+        if isinstance(tgt, ast.Subscript) and isinstance(
+            tgt.value, ast.Name
+        ) and self._is_free_host_name(tgt.value.id):
+            self.emit(
+                "CL105", tgt,
+                f"subscript store into closure-captured '{tgt.value.id}' "
+                "inside traced code — this runs at trace time only and "
+                "is silently stale on compile-cache hits",
+            )
+
+    # -- CL106 helper (used by the donation scanner below) ---------
+    @staticmethod
+    def _donate_argnums(call: ast.Call,
+                        idx: "_ModuleIndex | None" = None,
+                        ) -> tuple[int, ...]:
+        """Donated positions: int constants from ``donate_argnums``,
+        plus ``donate_argnames`` str constants mapped to positions
+        through the jitted function's parameter list (only when that
+        def is visible in this module — an opaque callee leaves the
+        names unresolvable, so they are skipped, not guessed)."""
+        out: list[int] = []
+        params: list[str] | None = None
+        if idx is not None and call.args and isinstance(
+            call.args[0], ast.Name
+        ):
+            fn = idx.functions.get(call.args[0].id)
+            if fn is not None:
+                params = [
+                    a.arg
+                    for a in fn.args.posonlyargs + fn.args.args
+                ]
+        for kw in call.keywords:
+            v = kw.value
+            if kw.arg == "donate_argnums":
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, int
+                ):
+                    out.append(v.value)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    out.extend(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)
+                    )
+            elif kw.arg == "donate_argnames" and params is not None:
+                names: tuple[str, ...] = ()
+                if isinstance(v, ast.Constant) and isinstance(
+                    v.value, str
+                ):
+                    names = (v.value,)
+                elif isinstance(v, (ast.Tuple, ast.List)):
+                    names = tuple(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    )
+                out.extend(
+                    params.index(nm) for nm in names if nm in params
+                )
+        return tuple(sorted(dict.fromkeys(out)))
+
+
+def _check_donation_uses(idx: _ModuleIndex, fn: ast.FunctionDef,
+                         findings: list[Finding]) -> None:
+    """CL106 linear scan: donate at call, flag any later Load before a
+    rebind. Loop bodies are scanned twice so a next-iteration reuse of a
+    donated carry is caught."""
+    donators: dict[str, tuple[int, ...]] = {}
+    pending: dict[str, tuple[str, int]] = {}
+    seen: set[tuple] = set()
+
+    def scan_expr_loads(node: ast.AST, skip: set[int]) -> None:
+        for n in ast.walk(node):
+            if (
+                isinstance(n, ast.Name)
+                and isinstance(n.ctx, ast.Load)
+                and n.id in pending
+                and id(n) not in skip
+            ):
+                callee, line = pending[n.id]
+                key = ("CL106", n.lineno, n.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(Finding(
+                        rule="CL106", severity=RULES["CL106"].severity,
+                        path=idx.path, line=n.lineno, col=n.col_offset,
+                        message=(
+                            f"'{n.id}' was donated to '{callee}' at line "
+                            f"{line} and read again — donated input "
+                            "buffers are invalidated by XLA aliasing; "
+                            "rebind from the call's output instead"
+                        ),
+                    ))
+
+    def handle_call(value: ast.Call, target_names: list[str]) -> None:
+        d = idx.dotted(value.func)
+        if d in ("jax.jit", "jax.pjit"):
+            donated = _FunctionChecker._donate_argnums(value, idx)
+            if donated:
+                for n in target_names:
+                    donators[n] = donated
+            return
+        if isinstance(value.func, ast.Name) and value.func.id in donators:
+            for pos in donators[value.func.id]:
+                if pos < len(value.args) and isinstance(
+                    value.args[pos], ast.Name
+                ):
+                    pending[value.args[pos].id] = (
+                        value.func.id, value.lineno,
+                    )
+            for n in target_names:
+                pending.pop(n, None)
+
+    def scan(stmts: list[ast.stmt]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.Assign, ast.AnnAssign)):
+                value = st.value
+                targets = (
+                    st.targets if isinstance(st, ast.Assign)
+                    else [st.target]
+                )
+                names = [
+                    t.id for t in targets if isinstance(t, ast.Name)
+                ]
+                if isinstance(value, ast.Call):
+                    # donated args at THIS call are consumed, not "used
+                    # after" — skip them in the load sweep, then arm
+                    skip: set[int] = set()
+                    if isinstance(value.func, ast.Name) and (
+                        value.func.id in donators
+                    ):
+                        for pos in donators[value.func.id]:
+                            if pos < len(value.args):
+                                skip.add(id(value.args[pos]))
+                    scan_expr_loads(value, skip)
+                    handle_call(value, names)
+                elif value is not None:
+                    scan_expr_loads(value, set())
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            pending.pop(n.id, None)
+            elif isinstance(st, (ast.For, ast.While)):
+                for _ in range(2):
+                    scan(st.body)
+                scan(st.orelse)
+            elif isinstance(st, ast.If):
+                # each arm scans from the pre-branch state (a donation
+                # armed in one arm must not flag the exclusive other),
+                # then the arm states union: a donation pending on
+                # either path is pending after the join
+                snap = dict(pending)
+                scan(st.body)
+                after_body = dict(pending)
+                pending.clear()
+                pending.update(snap)
+                scan(st.orelse)
+                pending.update(after_body)
+            elif isinstance(st, ast.With):
+                scan(st.body)
+            elif isinstance(st, ast.Try):
+                scan(st.body)
+                for h in st.handlers:
+                    scan(h.body)
+                scan(st.orelse)
+                scan(st.finalbody)
+            else:
+                for child in ast.iter_child_nodes(st):
+                    if isinstance(child, ast.expr):
+                        scan_expr_loads(child, set())
+
+    scan(fn.body)
+
+
+# ------------------------------------------------------ CL102 (PRNG)
+
+def _check_prng_reuse(idx: _ModuleIndex, fn: ast.FunctionDef,
+                      findings: list[Finding]) -> None:
+    """A key name consumed (passed to a sampler, a non-deriver call, or
+    stored into a container) more than once — branch-aware: exclusive
+    ``if``/``else`` arms take the max, loop bodies double uses of keys
+    bound outside the loop."""
+    key_vars: dict[str, ast.stmt] = {}  # name -> binding statement
+
+    def value_is_key(value: ast.AST) -> bool:
+        if isinstance(value, ast.Call):
+            d = idx.dotted(value.func)
+            return d is not None and d.startswith("jax.random.") and (
+                d.rsplit(".", 1)[-1] in _KEY_DERIVERS
+            )
+        if isinstance(value, ast.Subscript):
+            return value_is_key(value.value) or (
+                isinstance(value.value, ast.Name)
+                and value.value.id in key_vars
+            )
+        if isinstance(value, ast.Name):
+            return value.id in key_vars
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and value_is_key(node.value):
+            for t in node.targets:
+                for n in (
+                    t.elts if isinstance(t, (ast.Tuple, ast.List))
+                    else [t]
+                ):
+                    if isinstance(n, ast.Name):
+                        key_vars[n.id] = node
+    if not key_vars:
+        return
+
+    def consumptions(node: ast.AST, name: str) -> list[ast.AST]:
+        """Consuming use sites of ``name`` within one expression."""
+        out = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                d = idx.dotted(n.func)
+                is_deriver = (
+                    d is not None
+                    and d.startswith("jax.random.")
+                    and d.rsplit(".", 1)[-1] in _KEY_DERIVERS
+                )
+                if is_deriver:
+                    continue
+                for a in list(n.args) + [k.value for k in n.keywords]:
+                    if isinstance(a, ast.Name) and a.id == name:
+                        out.append(a)
+                    elif isinstance(a, (ast.Tuple, ast.List)):
+                        out.extend(
+                            e for e in a.elts
+                            if isinstance(e, ast.Name) and e.id == name
+                        )
+        return out
+
+    def in_loop_bound_outside(name: str, loop: ast.stmt) -> bool:
+        binding = key_vars.get(name)
+        if binding is None:
+            return False
+        return not any(b is binding for b in ast.walk(loop))
+
+    def count(stmts: list[ast.stmt], name: str) -> tuple[int, list]:
+        total, sites = 0, []
+        i = 0
+        while i < len(stmts):
+            st = stmts[i]
+            if isinstance(st, ast.If):
+                tb, ts = count_node_exprs(st.test, name)
+                b, bs = count(st.body, name)
+                o, os_ = count(st.orelse, name)
+                if _ends_in_jump(st.body) and not st.orelse:
+                    r, rs = count(stmts[i + 1:], name)
+                    branch, bsites = max(
+                        ((b, bs), (o + r, os_ + rs)),
+                        key=lambda x: x[0],
+                    )
+                    return total + tb + branch, sites + ts + bsites
+                branch, bsites = max(((b, bs), (o, os_)),
+                                     key=lambda x: x[0])
+                total += tb + branch
+                sites += ts + bsites
+            elif isinstance(st, (ast.For, ast.While)):
+                b, bs = count(st.body, name)
+                mult = 2 if (b and in_loop_bound_outside(name, st)) else 1
+                total += b * mult
+                sites += bs
+            elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                pass
+            elif isinstance(st, ast.Try):
+                b, bs = count(st.body, name)
+                total += b
+                sites += bs
+                for h in st.handlers:
+                    hb, hs = count(h.body, name)
+                    total += hb
+                    sites += hs
+            else:
+                c, cs = count_node_exprs(st, name)
+                total += c
+                sites += cs
+            i += 1
+        return total, sites
+
+    def count_node_exprs(node: ast.AST, name: str) -> tuple[int, list]:
+        sites = consumptions(node, name)
+        return len(sites), sites
+
+    for name in key_vars:
+        n, sites = count(fn.body, name)
+        if n > 1 and len(sites) >= 1:
+            site = sites[1] if len(sites) > 1 else sites[0]
+            findings.append(Finding(
+                rule="CL102", severity=RULES["CL102"].severity,
+                path=idx.path, line=site.lineno, col=site.col_offset,
+                message=(
+                    f"PRNG key '{name}' is consumed {n}x without an "
+                    "intervening split/fold_in — reused entropy "
+                    "correlates supposedly-independent streams; derive "
+                    "a fresh subkey per consumer"
+                ),
+            ))
+
+
+# ------------------------------------------------- trace-context graph
+
+def _trace_seeds_and_edges(idx: _ModuleIndex):
+    """Seed traced functions + call edges for one module."""
+    seeds: set[tuple[str, str]] = set()
+    edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    parents: dict[str, str] = {}  # child qual -> parent qual
+
+    qual_by_node = {id(node): q for q, node in idx.functions.items()}
+
+    for qual, fn in idx.functions.items():
+        # decorator-based seeds
+        for dec in fn.decorator_list:
+            d = idx.dotted(dec if not isinstance(dec, ast.Call)
+                           else dec.func)
+            if d in ("jax.jit", "jax.pjit", "jit", "pjit"):
+                seeds.add((idx.module, qual))
+            if isinstance(dec, ast.Call) and idx.dotted(dec.func) in (
+                "functools.partial", "partial",
+            ):
+                if dec.args and idx.dotted(dec.args[0]) in (
+                    "jax.jit", "jax.pjit",
+                ):
+                    seeds.add((idx.module, qual))
+        # nesting: a def inside a traced def is traced
+        for child in ast.walk(fn):
+            if (
+                isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and child is not fn
+                and id(child) in qual_by_node
+            ):
+                parents.setdefault(qual_by_node[id(child)], qual)
+        # call edges + callback seeds
+        key = (idx.module, qual)
+        edges.setdefault(key, set())
+        for call in [n for n in ast.walk(fn) if isinstance(n, ast.Call)]:
+            d = idx.dotted(call.func)
+            if d is not None:
+                # callbacks into tracing entrypoints
+                if any(d == s or d.endswith("." + s.rsplit(".", 1)[-1])
+                       and d.startswith("jax.")
+                       for s in _TRACE_ENTRYPOINT_SUFFIXES) or d in (
+                           "lax.scan", "lax.cond", "lax.while_loop",
+                           "lax.switch", "lax.fori_loop", "lax.map",
+                ):
+                    for a in call.args:
+                        cb = idx.dotted(a)
+                        if cb is None:
+                            continue
+                        if cb in idx.functions:
+                            seeds.add((idx.module, cb))
+                        elif cb in idx.aliases.values():
+                            mod, _, name = cb.rpartition(".")
+                            seeds.add((mod, name))
+                        # local name inside this function scope
+                        elif isinstance(a, ast.Name):
+                            for q in idx.functions:
+                                if q.split(".")[-1] == a.id and (
+                                    q.startswith(qual + ".")
+                                    or "." not in q
+                                ):
+                                    seeds.add((idx.module, q))
+            # plain-call edges to local or imported functions
+            if isinstance(call.func, ast.Name):
+                name = call.func.id
+                target = None
+                # innermost matching local function first
+                cands = [q for q in idx.functions
+                         if q.split(".")[-1] == name]
+                if cands:
+                    target = (idx.module, max(cands, key=len))
+                elif name in idx.aliases:
+                    dotted = idx.aliases[name]
+                    mod, _, attr = dotted.rpartition(".")
+                    if mod:
+                        target = (mod, attr)
+                if target is not None:
+                    edges[key].add(target)
+    return seeds, edges, parents
+
+
+def analyze(trees: dict[str, ast.Module]) -> list[Finding]:
+    """Run every rule over the parsed files; returns unsuppressed-raw
+    findings (suppression filtering happens in :mod:`lint`)."""
+    indexes = [_ModuleIndex(path, tree) for path, tree in trees.items()]
+    by_module: dict[str, _ModuleIndex] = {}
+    for idx in indexes:
+        by_module[idx.module] = idx
+
+    seeds: set[tuple[str, str]] = set()
+    edges: dict[tuple[str, str], set[tuple[str, str]]] = {}
+    parents_all: dict[tuple[str, str], tuple[str, str]] = {}
+    for idx in indexes:
+        s, e, parents = _trace_seeds_and_edges(idx)
+        seeds |= s
+        for k, v in e.items():
+            edges.setdefault(k, set()).update(v)
+        for child, parent in parents.items():
+            parents_all[(idx.module, child)] = (idx.module, parent)
+
+    # propagate traced through the call graph + lexical nesting
+    traced: set[tuple[str, str]] = set()
+    work = list(seeds)
+    while work:
+        node = work.pop()
+        if node in traced:
+            continue
+        traced.add(node)
+        for tgt in edges.get(node, ()):
+            if tgt not in traced:
+                work.append(tgt)
+        for child, parent in parents_all.items():
+            if parent == node and child not in traced:
+                work.append(child)
+
+    findings: list[Finding] = []
+    for idx in indexes:
+        for qual, fn in idx.functions.items():
+            is_traced = (idx.module, qual) in traced
+            _FunctionChecker(idx, fn, is_traced, findings).run()
+            _check_prng_reuse(idx, fn, findings)
+            _check_donation_uses(idx, fn, findings)
+        # module-level statements: PRNG + donation discipline
+        pseudo = ast.FunctionDef(
+            name="<module>", args=ast.arguments(
+                posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                defaults=[],
+            ),
+            body=[st for st in idx.tree.body
+                  if not isinstance(st, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))],
+            decorator_list=[],
+        )
+        _check_prng_reuse(idx, pseudo, findings)
+        _check_donation_uses(idx, pseudo, findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

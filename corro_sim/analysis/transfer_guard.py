@@ -1,0 +1,88 @@
+"""Transfer-guard wiring: enforce the chunk loop's async-copy discipline.
+
+PR 4's pipelined dispatch only pays off while the chunk loop performs
+NO implicit device transfers outside its sanctioned points — one staged
+host→device upload per chunk (schedule rows + keys) and one device→host
+resolve of the packed metric stacks (async, started at dispatch). A
+stray ``float(device_scalar)`` or raw-NumPy jit argument added anywhere
+in the loop silently re-serializes dispatch; this module turns that
+into a hard error instead of a perf regression someone has to bisect.
+
+``guarded(True)`` wraps a region in ``jax.transfer_guard("disallow")``;
+``sanctioned(point)`` re-opens the guard for one of the loop's known
+transfer points and counts it (``corro_lint_sanctioned_transfers_total``
+by point). The driver enables the guard when ``run_sim(...,
+transfer_guard=True)`` or ``CORRO_SIM_TRANSFER_GUARD=1`` (the CI smoke
+sets the env var); default off — the guard costs a context manager per
+chunk and exists to catch regressions, not to run in production.
+
+Empirically (and why the CPU CI smoke is meaningful): under
+``disallow``, jnp.asarray staging counts as an *explicit* transfer and
+passes, while raw-NumPy jit arguments, PRNG key construction from
+Python scalars, and scalar coercions like ``float(x[0])`` all trip the
+guard even on the CPU backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+
+
+def env_enabled() -> bool:
+    """The debug flag: CORRO_SIM_TRANSFER_GUARD=1 arms the guard."""
+    return os.environ.get(
+        "CORRO_SIM_TRANSFER_GUARD", ""
+    ).lower() not in ("", "0", "false")
+
+
+@contextlib.contextmanager
+def guarded(enabled: bool):
+    """``jax.transfer_guard("disallow")`` over the region when enabled;
+    a no-op otherwise (zero overhead on the default path)."""
+    if not enabled:
+        yield False
+        return
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield True
+
+
+@contextlib.contextmanager
+def sanctioned(point: str, enabled: bool = True):
+    """Re-allow transfers at one sanctioned point of a guarded region,
+    counting it so /metrics shows where the loop's transfers happen:
+
+      chunk_stage        host→device: schedule rows, per-chunk keys
+      metric_fetch_start device→host: copy_to_host_async of the packed
+                         metric stacks at dispatch (pipelined loop)
+      metric_resolve     device→host: the packed metric stacks (async
+                         copy started at dispatch; resolve is the
+                         only read)
+      probe_extract      device→host: per-chunk (K, N) probe planes
+      invariants         device→host: bookkeeping planes for the
+                         checkers
+    """
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard("allow"):
+        # the lazy metrics import must happen under "allow": on first
+        # import the utils package builds module-level device constants,
+        # which would trip a still-armed disallow guard
+        from corro_sim.utils.metrics import (
+            LINT_SANCTIONED_TRANSFERS_TOTAL,
+            counters,
+        )
+
+        counters.inc(
+            LINT_SANCTIONED_TRANSFERS_TOTAL,
+            labels=f'{{point="{point}"}}',
+            help_="transfers through the chunk loop's sanctioned points "
+                  "while the transfer guard is armed (analysis/"
+                  "transfer_guard.py)",
+        )
+        yield

@@ -17,6 +17,8 @@ Command surface vs the reference's Command enum
   subs         — list / inspect subscriptions         [Command::Subs]
   locks        — lock registry dump                   [Command::Locks]
   traces       — recent tracer spans                  [telemetry analog]
+  lint         — corro-lint trace-safety analyzer     [corro_sim/analysis/]
+  audit        — jaxpr vacuity + golden fingerprint   [corro_sim/analysis/]
   flight       — per-round telemetry timeline         [flight recorder]
   probes       — gossip provenance + lag observatory  [probe tracer]
   db lock      — hold the write lock around a command [DbCommand::Lock]
@@ -104,6 +106,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
         flight=flight,
         profile_dir=args.profile_dir,
         invariants=invariants,
+        # None defers to the CORRO_SIM_TRANSFER_GUARD env var
+        transfer_guard=True if args.transfer_guard else None,
         min_rounds=(
             max(scenario.heal_round or 0, args.write_rounds)
             if scenario is not None else None
@@ -304,6 +308,31 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     if any_violation:
         return 5
     return 3 if any_unconverged else 0
+
+
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """`corro-sim lint` — the AST trace-safety analyzer
+    (corro_sim/analysis/, doc/static_analysis.md). Pure-AST: no jax
+    import, runs in seconds on any machine. Exit 1 on any error-severity
+    finding (warnings too under --strict)."""
+    from corro_sim.analysis.lint import run_lint
+
+    return run_lint(
+        args.paths, fmt=args.format, strict=args.strict, out=args.out,
+    )
+
+
+def _cmd_audit(args: argparse.Namespace) -> int:
+    """`corro-sim audit` — trace sim_step under the feature-off matrix,
+    assert the vacuity invariants + hazard absence, and verify (or
+    rewrite with --update-golden) the committed primitive-count
+    fingerprint (analysis/golden/jaxpr_fingerprint.json)."""
+    from corro_sim.analysis.jaxpr_audit import run_audit
+
+    return run_audit(
+        update_golden=args.update_golden, out=args.out,
+        as_json=args.json,
+    )
 
 
 def _cmd_bench(args: argparse.Namespace) -> int:
@@ -608,6 +637,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="run the fault invariant checkers (faults/invariants.py) "
              "even without a scenario; violations exit 5",
     )
+    pr.add_argument(
+        "--transfer-guard", action="store_true",
+        help="arm jax.transfer_guard('disallow') around the chunk loop "
+             "(analysis/transfer_guard.py): any device transfer outside "
+             "the sanctioned staging/resolve points raises instead of "
+             "silently re-serializing dispatch (also: "
+             "CORRO_SIM_TRANSFER_GUARD=1)",
+    )
     pr.set_defaults(fn=_cmd_run)
 
     ps = sub.add_parser(
@@ -650,6 +687,49 @@ def build_parser() -> argparse.ArgumentParser:
              "journals + <out>.report.json",
     )
     ps.set_defaults(fn=_cmd_soak)
+
+    pli = sub.add_parser(
+        "lint",
+        help="corro-lint: static trace-safety analysis "
+             "(doc/static_analysis.md)",
+    )
+    pli.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: corro_sim)",
+    )
+    pli.add_argument(
+        "--format", choices=["text", "json"], default="text",
+        help="report format on stdout",
+    )
+    pli.add_argument(
+        "--strict", action="store_true",
+        help="exit 1 on warnings too, not just errors",
+    )
+    pli.add_argument(
+        "--out",
+        help="also write the JSON findings report to this path "
+             "(the CI artifact)",
+    )
+    pli.set_defaults(fn=_cmd_lint)
+
+    pau = sub.add_parser(
+        "audit",
+        help="jaxpr audit: feature-off vacuity + golden op-count "
+             "fingerprint (doc/static_analysis.md)",
+    )
+    pau.add_argument(
+        "--update-golden", action="store_true",
+        help="re-baseline analysis/golden/jaxpr_fingerprint.json from "
+             "the current tree (commit the diff with the change that "
+             "moved the op counts)",
+    )
+    pau.add_argument(
+        "--json", action="store_true", help="print the full JSON report"
+    )
+    pau.add_argument(
+        "--out", help="also write the JSON report to this path"
+    )
+    pau.set_defaults(fn=_cmd_audit)
 
     pb = sub.add_parser(
         "bench",

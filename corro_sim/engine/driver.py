@@ -28,6 +28,7 @@ re-dispatched on the correct program. See doc/performance.md.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import functools
 import os
@@ -40,9 +41,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from corro_sim.analysis.transfer_guard import (
+    env_enabled as _tg_env_enabled,
+    guarded as _tg_guarded,
+    sanctioned as _tg_sanctioned,
+)
 from corro_sim.config import SimConfig
 from corro_sim.engine.state import SimState
-from corro_sim.engine.step import sim_step
+from corro_sim.engine.step import make_step
 from corro_sim.obs.flight import FlightRecorder
 from corro_sim.obs.probes import ProbeTrace
 from corro_sim.utils.metrics import (
@@ -190,9 +196,7 @@ def _chunk_runner(
     repair: bool = False,
     packed: bool = False,
 ):
-    def body(state, inp):
-        key, alive, part, we = inp
-        return sim_step(cfg, state, key, alive, part, we, repair=repair)
+    body = make_step(cfg, repair=repair)
 
     # Buffer donation halves peak memory (state in+out aliased) but the
     # axon TPU-tunnel platform currently miscompiles donated calls; keep it
@@ -218,7 +222,10 @@ def _chunk_runner(
         # (~80 ms on the axon platform), which dominated chunk wall.
         fkeys = sorted(k for k in m if m[k].dtype == jnp.float32)
         ikeys = sorted(k for k in m if k not in fkeys)
-        meta["fkeys"], meta["ikeys"] = fkeys, ikeys
+        # deliberate trace-time side channel: the packed-stack key order
+        # is a pure function of cfg, identical on every (re)trace, so a
+        # compile-cache hit that skips this line still unpacks correctly
+        meta["fkeys"], meta["ikeys"] = fkeys, ikeys  # corro-lint: ignore[CL105]
         i_stack = jnp.stack([m[k].astype(jnp.int32) for k in ikeys])
         f_stack = jnp.stack([m[k].astype(jnp.float32) for k in fkeys])
         return out, i_stack, f_stack
@@ -271,6 +278,7 @@ def run_sim(
     profile_dir: str | None = None,
     invariants=None,
     pipeline: bool | None = None,
+    transfer_guard: bool | None = None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -306,12 +314,22 @@ def run_sim(
     (default on). Forced off under ``donate=True``: a speculative
     dispatch consumes the donated carry, so a discarded/re-dispatched
     chunk would have no input left to re-run from.
+
+    ``transfer_guard``: arm ``jax.transfer_guard("disallow")`` around
+    the chunk loop (analysis/transfer_guard.py) so any device transfer
+    outside the sanctioned points — staged uploads at dispatch, the
+    async metric resolve, probe extraction, invariant reads — raises
+    instead of silently re-serializing dispatch. ``None`` follows the
+    ``CORRO_SIM_TRANSFER_GUARD`` env var (the CI smoke arms it);
+    default off.
     """
     schedule = schedule or Schedule()
     if flight is None:
         flight = FlightRecorder()
     if pipeline is None:
         pipeline = getattr(cfg, "pipeline", True)
+    if transfer_guard is None:
+        transfer_guard = _tg_env_enabled()
     pipeline_off_reason = None
     if pipeline and donate:
         pipeline = False
@@ -513,7 +531,11 @@ def run_sim(
                               "(corro_sim/faults/)",
                     )
         if invariants is not None:
-            for v in invariants.on_chunk(state_now, m, alive, part, base):
+            with _tg_sanctioned("invariants", transfer_guard):
+                violations = list(
+                    invariants.on_chunk(state_now, m, alive, part, base)
+                )
+            for v in violations:
                 flight.annotate(
                     v.round + 1 if v.round is not None else base + 1,
                     "invariant_violation",
@@ -551,7 +573,10 @@ def run_sim(
             # — the curve-level "why was this chunk slow" breadcrumb.
             # Pipelined, this host work overlaps the next chunk's
             # device execution instead of stalling it.
-            p99 = ProbeTrace.from_state(cfg, state_now).delivery_p99()
+            with _tg_sanctioned("probe_extract", transfer_guard):
+                p99 = ProbeTrace.from_state(
+                    cfg, state_now
+                ).delivery_p99()
             if (
                 p99 is not None
                 and probe_p99_last is not None
@@ -605,9 +630,11 @@ def run_sim(
                     # the convergence report itself is checked: no
                     # report may stand while a live same-partition
                     # pair still disagrees on table state
-                    for v in invariants.on_converged(
-                        state_now, alive[-1], part[-1]
-                    ):
+                    with _tg_sanctioned("invariants", transfer_guard):
+                        conv_violations = list(invariants.on_converged(
+                            state_now, alive[-1], part[-1]
+                        ))
+                    for v in conv_violations:
                         flight.annotate(
                             converged_round, "invariant_violation",
                             invariant=v.invariant, detail=v.detail,
@@ -634,6 +661,11 @@ def run_sim(
                 "corro_profile_trace_failures_total",
                 help_="jax.profiler.trace start failures (profile skipped)",
             )
+    # transfer guard armed over the loop region only — setup above and
+    # result assembly below legitimately move data; inside the loops,
+    # only the sanctioned points may (analysis/transfer_guard.py)
+    _guard = contextlib.ExitStack()
+    _guard.enter_context(_tg_guarded(transfer_guard))
     try:
         if not pipeline:
             # ------------------------------------------ sequential loop
@@ -641,11 +673,14 @@ def run_sim(
             while rounds < max_rounds:
                 alive, part, we = schedule.slice(rounds, chunk,
                                                  cfg.num_nodes)
-                keys = jax.random.split(jax.random.fold_in(root, ci), chunk)
-                args = (
-                    state, keys, jnp.asarray(alive), jnp.asarray(part),
-                    jnp.asarray(we),
-                )
+                with _tg_sanctioned("chunk_stage", transfer_guard):
+                    keys = jax.random.split(
+                        jax.random.fold_in(root, ci), chunk
+                    )
+                    args = (
+                        state, keys, jnp.asarray(alive),
+                        jnp.asarray(part), jnp.asarray(we),
+                    )
                 use_repair = _select_repair(last_pend_live, we)
                 if use_repair and repair_runner is None:
                     _compile_repair(args)
@@ -668,9 +703,10 @@ def run_sim(
                     # (tunnel round-trips are ~80 ms each; per-metric
                     # reads dominated wall) — the stall the pipelined
                     # loop hides behind the next chunk's execution
-                    m = run_jit.unpack(
-                        np.asarray(out[1]), np.asarray(out[2])
-                    )
+                    with _tg_sanctioned("metric_resolve", transfer_guard):
+                        m = run_jit.unpack(
+                            np.asarray(out[1]), np.asarray(out[2])
+                        )
                     fetch_wait = time.perf_counter() - t_f
                 chunk_elapsed = time.perf_counter() - t0
                 if run_compiled is None and (ci == 0 or first_repair_jit):
@@ -730,13 +766,14 @@ def run_sim(
                 nonlocal compile_pending, compile_seconds
                 alive_, part_, we_ = schedule.slice(base_, chunk,
                                                     cfg.num_nodes)
-                keys_ = jax.random.split(
-                    jax.random.fold_in(root, ci_), chunk
-                )
-                args_ = (
-                    state_in, keys_, jnp.asarray(alive_),
-                    jnp.asarray(part_), jnp.asarray(we_),
-                )
+                with _tg_sanctioned("chunk_stage", transfer_guard):
+                    keys_ = jax.random.split(
+                        jax.random.fold_in(root, ci_), chunk
+                    )
+                    args_ = (
+                        state_in, keys_, jnp.asarray(alive_),
+                        jnp.asarray(part_), jnp.asarray(we_),
+                    )
                 use_repair_ = (
                     _select_repair(known_pend_live, we_)
                     and not blocked_by_writes
@@ -777,7 +814,8 @@ def run_sim(
                     compile_seconds += blocked
                     compile_pending += blocked
                     flight.record_phase("compile", blocked)
-                start_async_fetch(out_[1], out_[2])
+                with _tg_sanctioned("metric_fetch_start", transfer_guard):
+                    start_async_fetch(out_[1], out_[2])
                 return _InFlight(
                     ci=ci_, base=base_, state_out=out_[0],
                     i_s=out_[1], f_s=out_[2], owner=run_jit_,
@@ -815,9 +853,10 @@ def run_sim(
                 # resolve pending's metrics — the copy has been in
                 # flight since its dispatch
                 t_f = time.perf_counter()
-                m = pending.owner.unpack(
-                    np.asarray(pending.i_s), np.asarray(pending.f_s)
-                )
+                with _tg_sanctioned("metric_resolve", transfer_guard):
+                    m = pending.owner.unpack(
+                        np.asarray(pending.i_s), np.asarray(pending.f_s)
+                    )
                 fetch_wait = time.perf_counter() - t_f
                 if not pending.untimed:
                     # untimed (jit-fallback first) chunks are excluded
@@ -920,6 +959,7 @@ def run_sim(
         wall += drain
         flight.record_phase("drain", drain)
     finally:
+        _guard.close()
         if profiling:
             try:
                 jax.profiler.stop_trace()

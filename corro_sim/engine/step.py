@@ -56,6 +56,20 @@ from corro_sim.membership.swim_window import membership_view
 from corro_sim.sync.sync import sync_round
 
 
+def make_step(cfg: SimConfig, repair: bool = False):
+    """The scan-shaped closure over :func:`sim_step`: ``(state, (key,
+    alive, part, write_enable)) -> (state, metrics)``. The one place the
+    chunk program's body is defined — the driver's ``lax.scan`` and the
+    jaxpr audit harness (:mod:`corro_sim.analysis.jaxpr_audit`) both
+    build from here, so the program they pin is the program that runs."""
+
+    def body(state, inp):
+        key, alive, part, we = inp
+        return sim_step(cfg, state, key, alive, part, we, repair=repair)
+
+    return body
+
+
 def _reachable_fn(alive: jnp.ndarray, part: jnp.ndarray):
     """Ground-truth link predicate: both up and in the same partition."""
 

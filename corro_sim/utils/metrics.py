@@ -97,6 +97,26 @@ PIPELINE_FETCH_WAIT_HELP = (
     "blocking-read stall the chunk pipeline hides"
 )
 
+# ---- corro_lint_*: static analysis + transfer-guard observability ----
+# The corro-lint analyzer (corro_sim/analysis/, `corro-sim lint`)
+# exports its run profile as info counters so a scrape of any process
+# that ran it (CI harness, agent admin) carries the findings picture:
+#   corro_lint_runs_total                    analyzer invocations
+#   corro_lint_files_scanned_total           files parsed
+#   corro_lint_findings_total{rule,severity} findings by rule (CL1xx)
+#   corro_lint_suppressions_total{rule}      `# corro-lint: ignore[...]`
+#                                            hits
+# The transfer guard (analysis/transfer_guard.py, armed by
+# CORRO_SIM_TRANSFER_GUARD=1 / run_sim(transfer_guard=True)) counts
+# every transfer through the chunk loop's sanctioned points:
+#   corro_lint_sanctioned_transfers_total{point=chunk_stage|
+#       metric_fetch_start|metric_resolve|probe_extract|invariants}
+LINT_RUNS_TOTAL = "corro_lint_runs_total"
+LINT_FILES_SCANNED_TOTAL = "corro_lint_files_scanned_total"
+LINT_FINDINGS_TOTAL = "corro_lint_findings_total"
+LINT_SUPPRESSIONS_TOTAL = "corro_lint_suppressions_total"
+LINT_SANCTIONED_TRANSFERS_TOTAL = "corro_lint_sanctioned_transfers_total"
+
 
 class Histogram:
     """A Prometheus histogram with the reference exporter's buckets

@@ -1,0 +1,10 @@
+"""CL101 fixture: implicit host sync inside jitted code (fires once)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x: jnp.ndarray):
+    total = jnp.sum(x)
+    scale = float(total)  # BAD: blocking device->host sync in traced code
+    return x * scale

@@ -1,0 +1,9 @@
+"""CL102 fixture: PRNG key consumed twice without split (fires once)."""
+import jax
+
+
+def two_draws(seed: int):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (4,))
+    b = jax.random.normal(key, (4,))  # BAD: key already consumed above
+    return a + b

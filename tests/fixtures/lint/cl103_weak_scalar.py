@@ -1,0 +1,9 @@
+"""CL103 fixture: weak-typed scalar without dtype (fires once)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scaled(x: jnp.ndarray):
+    half = jnp.asarray(0.5)  # BAD: weak float scalar, promotion contextual
+    return x * half

@@ -1,0 +1,10 @@
+"""CL104 fixture: Python `if` on a traced value (fires once)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clamp(x: jnp.ndarray):
+    if x.sum() > 0:  # BAD: traced value in Python control flow
+        return x
+    return -x

@@ -1,0 +1,11 @@
+"""CL105 fixture: trace-time mutation of captured host state (fires once)."""
+import jax
+import jax.numpy as jnp
+
+_cache = {}
+
+
+@jax.jit
+def remember(x: jnp.ndarray):
+    _cache["last_shape"] = x.shape  # BAD: runs at trace time only
+    return x + 1

@@ -1,0 +1,9 @@
+"""CL106 fixture: donated buffer read after the call (fires once)."""
+import jax
+import jax.numpy as jnp
+
+
+def advance(state: jnp.ndarray):
+    step = jax.jit(lambda s: s + 1, donate_argnums=0)
+    out = step(state)
+    return out + state  # BAD: `state`'s buffer was donated to `step`

@@ -1,0 +1,10 @@
+"""Suppression fixture: a CL101 hazard silenced in place (zero findings)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x: jnp.ndarray):
+    # host read sanctioned here for the fixture's sake
+    scale = float(jnp.sum(x))  # corro-lint: ignore[CL101]
+    return x * scale

@@ -56,47 +56,58 @@ def _run(cfg, rounds=16, write_rounds=4, seed=3, part=None):
 
 
 def test_fault_defaults_trace_nothing():
-    """The static gate: a default SimConfig has faults disabled and its
-    step emits no fault metrics — the program is the pre-chaos one."""
+    """The static gate, asserted through the jaxpr audit harness: a
+    default SimConfig has faults disabled, the step's metric surface
+    carries no fault_* series (abstract eval — nothing compiled), and
+    knob values that do not flip the static ``enabled`` gate must not
+    leak into the traced program.  (Comparing BASE against
+    ``FaultConfig()`` would be the config-equality tautology
+    jaxpr_audit's docstring warns about — the two configs are equal, so
+    the assertion could never fail; the gate-neutral non-default knobs
+    below make it falsifiable.)"""
+    from corro_sim.analysis.jaxpr_audit import (
+        assert_same_program,
+        step_metric_names,
+    )
+
     assert SimConfig().faults.enabled is False
-    assert FaultConfig().enabled is False
-    _, metrics = _run(BASE, rounds=3)
-    assert not any(k.startswith("fault_") for k in metrics[0])
+    knobs = FaultConfig(burst_exit=0.25, burst_loss=0.75, sync_loss=0.0)
+    assert knobs != BASE.faults and knobs.enabled is False
+    assert not any(
+        k.startswith("fault_") for k in step_metric_names(BASE)
+    )
+    assert_same_program(
+        BASE, dataclasses.replace(BASE, faults=knobs),
+        label="faults_off_knobs",
+    )
 
 
 def test_vacuous_faults_do_not_perturb_simulation():
-    """The guard (mirrors tests/test_probes.py): the fault program
-    traced with every knob at zero effect is bit-identical — state and
-    metrics — to the fault-free run. The injection points can never
-    change delivery order, key derivation or merge outcomes."""
-    s0, m0 = _run(BASE)
+    """The guard, asserted through the ONE vacuity oracle (ISSUE 5:
+    corro_sim/analysis/jaxpr_audit.py, shared with tests/test_probes.py):
+    the fault program traced with every knob at zero effect is
+    bit-identical — state and metrics — to the fault-free run, the
+    fault metrics are additive-only and all identically zero. The
+    injection points can never change delivery order, key derivation or
+    merge outcomes."""
+    from corro_sim.analysis.jaxpr_audit import assert_feature_vacuous
+
     cfgv = dataclasses.replace(
         BASE, faults=FaultConfig(trace_vacuous=True)
     ).validate()
-    sv, mv = _run(cfgv)
-    for f in dataclasses.fields(type(s0)):
-        if f.name == "fault_burst":
-            continue
-        for a, b in zip(
-            jax.tree.leaves(getattr(s0, f.name)),
-            jax.tree.leaves(getattr(sv, f.name)),
-        ):
-            assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
-    for r, (a, b) in enumerate(zip(m0, mv)):
-        for k in a:
-            assert np.array_equal(a[k], b[k]), (r, k)
-    # fault metrics are additive-only, and all identically zero here
-    extra = set(mv[0]) - set(m0[0])
-    assert extra == {
-        "fault_lost", "fault_dup", "fault_blackholed",
-        "fault_unreachable", "fault_delivered", "fault_parked",
-        "fault_emit_lost", "fault_matured", "fault_burst_nodes",
-        "fault_sync_lost",
-    }
-    for m in mv:
-        for k in ("fault_lost", "fault_dup", "fault_blackholed",
-                  "fault_sync_lost", "fault_burst_nodes"):
-            assert int(m[k]) == 0, k
+    assert_feature_vacuous(
+        BASE, cfgv,
+        exclude_leaves=("fault_burst",),
+        extra_metrics={
+            "fault_lost", "fault_dup", "fault_blackholed",
+            "fault_unreachable", "fault_delivered", "fault_parked",
+            "fault_emit_lost", "fault_matured", "fault_burst_nodes",
+            "fault_sync_lost",
+        },
+        zero_metrics=("fault_lost", "fault_dup", "fault_blackholed",
+                      "fault_sync_lost", "fault_burst_nodes"),
+        rounds=16, write_rounds=4, seed=3,
+    )
 
 
 def test_loss_drops_and_conservation_holds():

@@ -332,3 +332,37 @@ def test_node_lag_renders_without_probes(cluster):
     assert "corro_node_lag_rows_behind_sum" in text
     assert "corro_probe_count" not in text
     assert "corro_node_lag_last_sync_age_max" not in text
+
+
+def test_lint_family_renders_and_validates(cluster):
+    """ISSUE 5 satellite: the corro_lint_* family — analyzer run/finding
+    counters (corro_sim/analysis/lint.py) and the transfer guard's
+    sanctioned-transfer counters — renders through the exposition and
+    the whole thing still validates."""
+    import os
+
+    from corro_sim.analysis.lint import export_metrics, lint_paths
+    from corro_sim.analysis.transfer_guard import guarded, sanctioned
+
+    fixtures = os.path.join(
+        os.path.dirname(__file__), "fixtures", "lint"
+    )
+    export_metrics(
+        lint_paths([os.path.join(fixtures, "cl101_host_sync.py"),
+                    os.path.join(fixtures, "suppressed_clean.py")])
+    )
+    with guarded(True):
+        with sanctioned("exposition_test"):
+            pass
+    text = render_prometheus(cluster)
+    assert "corro_lint_runs_total" in text
+    assert "corro_lint_files_scanned_total" in text
+    assert (
+        'corro_lint_findings_total{rule="CL101",severity="error"}' in text
+    )
+    assert 'corro_lint_suppressions_total{rule="CL101"}' in text
+    assert (
+        'corro_lint_sanctioned_transfers_total{point="exposition_test"}'
+        in text
+    )
+    _validate_exposition(text)

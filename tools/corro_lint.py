@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Standalone corro-lint runner — `corro-sim lint` without an install.
+
+    python tools/corro_lint.py [paths...] [--format json] [--strict]
+                               [--out report.json]
+
+Pure-AST: no jax, no compiled deps — runs anywhere a Python 3.10+
+interpreter and this checkout exist (pre-commit hooks, bare CI boxes).
+Rule catalog + suppression syntax: doc/static_analysis.md.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from corro_sim.analysis.lint import run_lint  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="corro-lint",
+        description="static trace-safety analysis for corro-sim "
+                    "(AST rules CL101-CL106)",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to lint (default: corro_sim)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on warnings too")
+    p.add_argument("--out", help="write the JSON findings report here")
+    args = p.parse_args(argv)
+    return run_lint(
+        args.paths, fmt=args.format, strict=args.strict, out=args.out,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())
