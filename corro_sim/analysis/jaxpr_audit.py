@@ -354,10 +354,12 @@ def audit(cfg=None) -> dict:
 
 
 def run_audit(update_golden: bool = False, out: str | None = None,
-              as_json: bool = False) -> int:
+              as_json: bool = False, diff: bool = False) -> int:
     """The `corro-sim audit` entrypoint: trace, audit, check (or
     rewrite) the golden fingerprint; returns the exit code. Exit 1 on
-    any vacuity/hazard problem or golden drift."""
+    any vacuity/hazard problem or golden drift. ``diff`` additionally
+    reports the per-primitive eqn delta vs the golden (informational —
+    printed pass or fail, and embedded in the JSON report)."""
     report = audit()
     if update_golden:
         write_golden(report)
@@ -382,6 +384,8 @@ def run_audit(update_golden: bool = False, out: str | None = None,
             drift = check_golden(report)
     report["golden_drift"] = drift
     report["ok"] = report["ok"] and not drift
+    if diff:
+        report["golden_diff"] = golden_diff(report)
     if as_json:
         print(json.dumps(report, indent=2))
     else:
@@ -395,6 +399,24 @@ def run_audit(update_golden: bool = False, out: str | None = None,
         for prog, fp in report["programs"].items():
             print(f"program  {prog:<14} {fp['eqns']} eqns, "
                   f"{len(fp['primitives'])} distinct primitives")
+        if diff:
+            gd = report.get("golden_diff")
+            if gd is None:
+                print("diff     (no golden committed — nothing to diff)")
+            else:
+                for prog, d in gd.items():
+                    if d is None:
+                        print(f"diff     {prog:<14} (not in golden)")
+                        continue
+                    print(
+                        f"diff     {prog:<14} {d['eqns']} eqns vs golden "
+                        f"{d['golden_eqns']} ({d['delta_eqns']:+d})"
+                    )
+                    for prim, delta in sorted(
+                        d["primitives"].items(),
+                        key=lambda kv: (-abs(kv[1]), kv[0]),
+                    ):
+                        print(f"diff       {prim:<24} {delta:+d}")
         for p in report["problems"] + drift:
             print(f"PROBLEM  {p}")
         if report.get("golden_skipped"):
@@ -407,6 +429,36 @@ def run_audit(update_golden: bool = False, out: str | None = None,
             json.dump(report, fh, indent=2)
             fh.write("\n")
     return 0 if report["ok"] else 1
+
+
+def golden_diff(report: dict, path: str = GOLDEN_PATH) -> dict | None:
+    """Per-primitive eqn delta of the report's programs vs the committed
+    golden — the PR's op-budget cost at a glance (``corro-sim audit
+    --diff``; t1.yml ships it in the analysis artifact). Unlike
+    :func:`check_golden` this is informational: it reports the delta
+    whether or not the fingerprints match (a matching fingerprint diffs
+    to all-zero). Returns None when no golden exists yet."""
+    golden = load_golden(path)
+    if golden is None:
+        return None
+    out: dict = {}
+    for prog, fp in report["programs"].items():
+        gold = golden.get("programs", {}).get(prog)
+        if gold is None:
+            out[prog] = None
+            continue
+        prims = set(fp["primitives"]) | set(gold["primitives"])
+        deltas = {
+            p: fp["primitives"].get(p, 0) - gold["primitives"].get(p, 0)
+            for p in sorted(prims)
+        }
+        out[prog] = {
+            "golden_eqns": gold["eqns"],
+            "eqns": fp["eqns"],
+            "delta_eqns": fp["eqns"] - gold["eqns"],
+            "primitives": {p: d for p, d in deltas.items() if d},
+        }
+    return out
 
 
 def load_golden(path: str = GOLDEN_PATH) -> dict | None:

@@ -51,6 +51,47 @@ def _bench_pipeline() -> bool | None:
     return False if raw not in ("", "0", "false") else None
 
 
+def _bench_donate() -> bool:
+    """Buffer donation on the north-star leg: composes with the
+    pipeline since ISSUE 6 (double-buffered carry) and halves the
+    scan's resident footprint. Default ON — except on the axon
+    TPU-tunnel platform, which currently MISCOMPILES donated calls
+    (engine/driver.py's long-standing caveat): there it stays off until
+    the platform bug clears. CORRO_BENCH_NO_DONATE=1 forces it off
+    anywhere (the A/B); CORRO_BENCH_DONATE=1 forces it on even on
+    axon (for re-testing the platform bug)."""
+    raw = os.environ.get("CORRO_BENCH_NO_DONATE", "").lower()
+    if raw not in ("", "0", "false"):
+        return False
+    if os.environ.get("CORRO_BENCH_DONATE", "").lower() not in (
+            "", "0", "false"):
+        return True
+    import jax
+
+    return jax.default_backend() != "axon"
+
+
+def _step_eqns(cfg) -> dict:
+    """Jaxpr eqn counts of the exact chunk-scan body this bench
+    dispatches — the op-budget datum recorded NEXT TO the wall it
+    produced, so the perf trajectory (BENCH_r*.json) is machine-readable
+    round over round (ISSUE 6). Abstract tracing only: nothing compiles."""
+    from corro_sim.analysis.jaxpr_audit import (
+        primitive_fingerprint,
+        step_jaxpr,
+    )
+
+    out = {
+        "step_eqns_full": primitive_fingerprint(step_jaxpr(cfg))["eqns"],
+    }
+    if cfg.inflight_slots == 0 and not cfg.rtt_rings:
+        # the repair specialization exists only under its preconditions
+        out["step_eqns_repair"] = primitive_fingerprint(
+            step_jaxpr(cfg, repair=True)
+        )["eqns"]
+    return out
+
+
 def _atomic_json_dump(path: str, obj) -> None:
     """Write-then-rename so readers never see a torn file. Errors are
     swallowed: progress artifacts must never kill the run they document
@@ -230,6 +271,11 @@ def run_north_star(n: int | None = None) -> dict:
         sync_req_actors=128,
         sync_need_sample=64,
         sync_deal_probes=0,
+        # ISSUE 6 state packing: uint16 SWIM belief plane + int8 probe
+        # hops halve HBM traffic on the widest per-node tensors.
+        # Bit-exact with the wide layout under this config's bounds
+        # (suspect_rounds 6 < 128; tests/test_narrow_state.py)
+        narrow_state=True,
     )
 
     def part_fn(r, num):
@@ -264,6 +310,10 @@ def run_north_star(n: int | None = None) -> dict:
             # exported diagnostics); per-repeat walls ship in `runs`
             flight=_FLIGHT if rep == 0 else None,
             pipeline=_bench_pipeline(),
+            # pipeline + donation together (ISSUE 6): the speculative
+            # carry is double-buffered, so donation's in-place scan no
+            # longer costs the overlap
+            donate=_bench_donate(),
         )
         jax.block_until_ready(res.state.table.vr)
         runs.append({
@@ -305,6 +355,8 @@ def run_north_star(n: int | None = None) -> dict:
             1000.0 * sim_wall / max(converged_round, 1), 3
         ),
         "sim_converged": runs[-1]["converged_round"] is not None,
+        "donate": _bench_donate(),
+        **_step_eqns(cfg),
         "estimator": (
             f"sum of per-chunk-index median walls over {repeats} repeats, "
             "pro-rated to the converged round; all per-chunk walls in "
@@ -439,10 +491,12 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         "value": res.converged_round,
         "unit": "rounds_to_convergence",
         "wall_per_round_ms": round(res.wall_per_round_ms, 3),
+        "sim_wall_per_round_ms": round(res.wall_per_round_ms, 3),
         "converged": res.converged_round is not None,
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
         "pipeline": res.pipeline,
+        **_step_eqns(cfg),
     }
     if scenario is not None:
         out["scenario"] = scenario.spec

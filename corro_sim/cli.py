@@ -331,7 +331,7 @@ def _cmd_audit(args: argparse.Namespace) -> int:
 
     return run_audit(
         update_golden=args.update_golden, out=args.out,
-        as_json=args.json,
+        as_json=args.json, diff=args.diff,
     )
 
 
@@ -725,6 +725,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pau.add_argument(
         "--json", action="store_true", help="print the full JSON report"
+    )
+    pau.add_argument(
+        "--diff", action="store_true",
+        help="print (and embed in the report) the per-primitive eqn "
+             "delta vs the committed golden — the PR's op-budget cost "
+             "at a glance, shown pass or fail",
     )
     pau.add_argument(
         "--out", help="also write the JSON report to this path"

@@ -209,6 +209,22 @@ class SimConfig:
     # the ≤1178-byte SWIM packet bound (broadcast/mod.rs:743) at ~18 B per
     # piggybacked update; >= num_nodes disables the bound (full views)
 
+    # --- state packing (doc/performance.md "state packing & op budget") ---
+    narrow_state: bool = False  # pack the widest per-node planes into
+    # narrow dtypes (the `rtt: uint8` precedent): SWIM belief planes —
+    # full-view (N, N) and windowed (N, K) — drop from uint32 to uint16
+    # (inc 6 bits saturating at 63, status 2 bits, since 8 bits mod-2^8)
+    # and the probe hop plane drops to int8 (saturating at 127), halving
+    # HBM traffic on the biggest state tensor at 10k nodes (400 MB →
+    # 200 MB). Bit-exact against the wide reference while incarnations
+    # stay under 63, suspicions resolve within 256 rounds (validated:
+    # swim_suspect_rounds bound below), gossip paths stay under 127
+    # hops, and concurrent suspicions of one member don't straddle a
+    # multiple of 256 rounds (the wide layout's mod-2^16 wrap caveat,
+    # shrunk with the since field — membership/swim.py). Default off: the
+    # switch changes SimState leaf dtypes, which re-keys every compiled
+    # step program (cold .jax_cache — see doc/performance.md).
+
     # --- merge execution (TPU Pallas kernel, core/merge_kernel.py) ---
     merge_kernel: str = "auto"  # "auto" = Pallas dst-grouped merge for the
     # SYNC sweep on real TPU (single device, 128-aligned cell space;
@@ -310,6 +326,14 @@ class SimConfig:
         assert self.chunks_per_version in (1, 2, 4, 8, 16, 32), (
             "chunks_per_version must divide the 32-bit version window"
         )
+        if self.narrow_state:
+            # the narrow since field is 8 bits: a suspicion must start,
+            # time out and resolve well inside one mod-2^8 window for
+            # the packed-max merge to stay bit-exact with the wide plane
+            assert self.swim_suspect_rounds < 128, (
+                "narrow_state packs the suspicion clock into 8 bits — "
+                "swim_suspect_rounds must stay under 128 rounds"
+            )
         assert self.latency_regions <= 1 or self.latency_intra == 1, (
             "the in-flight delay ring buffers the inter-region class only; "
             "intra-region delivery is same-round (latency_intra must be 1)"

@@ -102,6 +102,10 @@ def deliver_versions(
     n, a = book.head.shape
     bpv = bits_per_version
     vwin = WINDOW_BITS // bpv
+    # single-chunk fast path: with one chunk per version the chunk key
+    # is identically zero — skip its dedupe key, the second dedupe pass
+    # (first_chunk == first_ver) and the offset arithmetic entirely
+    chunkless = bpv == 1 and chunk is None
     if chunk is None:
         chunk = jnp.zeros((m,), jnp.int32)
 
@@ -121,15 +125,28 @@ def deliver_versions(
         s_chunk = chunk[order]
         s_valid = valid[order]
 
-    first_chunk = dedupe_sorted_mask(s_dst, s_actor, s_ver, s_chunk) & s_valid
-    first_ver = dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
+    if chunkless:
+        first_chunk = first_ver = (
+            dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
+        )
+    else:
+        first_chunk = (
+            dedupe_sorted_mask(s_dst, s_actor, s_ver, s_chunk) & s_valid
+        )
+        first_ver = dedupe_sorted_mask(s_dst, s_actor, s_ver) & s_valid
 
     pair_idx = (jnp.where(s_valid, s_dst, -1), s_actor)
     head_g = book.head[pair_idx]
     win_g = book.win[pair_idx]
     voff = s_ver - head_g - 1  # version offset in window; <0 = absorbed
     in_window = (voff >= 0) & (voff < vwin)
-    off = (voff * bpv + s_chunk).clip(0, WINDOW_BITS - 1).astype(jnp.uint32)
+    if chunkless:
+        off = voff.clip(0, WINDOW_BITS - 1).astype(jnp.uint32)
+    else:
+        off = (
+            (voff * bpv + s_chunk).clip(0, WINDOW_BITS - 1)
+            .astype(jnp.uint32)
+        )
     already = in_window & ((win_g >> off) & jnp.uint32(1)).astype(bool)
     fresh_sorted = first_chunk & in_window & ~already
     dropped_sorted = first_chunk & (voff >= vwin)

@@ -1,0 +1,225 @@
+"""Fused broadcast-delivery pass: one sorted stream, every consumer.
+
+The delivery pipeline used to live inline in ``engine/step.py`` as a
+sequence of independent stages — a lane lexsort, the HLC scatter-max,
+the apply-queue rank, bookkeeping dedupe + window bits, the probe
+first-seen/infector scatters, changeset gathers and the CRDT merge —
+each re-deriving masks over the same ``(dst, actor, ver)`` stream. This
+module is that pipeline fused into ONE pass (ISSUE 6 tentpole):
+
+- the lane sort is hoisted once and every stage consumes the sorted
+  stream (bookkeeping's ``presorted`` fast path, the grouped enqueue,
+  the dst-coalesced merge scatter);
+- single-chunk configs (``chunks_per_version == 1``, every tier-1 and
+  bench config) collapse the chunk axis statically: the sort key packs
+  ``(dst, actor)`` into one int, the chunk plane is a constant, and
+  bookkeeping runs its chunkless dedupe (one dedupe pass, no offset
+  arithmetic) — the dead eqns the jaxpr audit exposed;
+- the probe tracer's delivery merge point rides the same stream
+  instead of bracketing it (link-fault masking stays upstream in
+  ``engine/step.py``: the fault draws are keyed by emission lane order
+  and must not see the permuted stream);
+- the CRDT merge routes through the Pallas dst-grouped kernel
+  (``core/merge_kernel.py``: route the lanes into the per-node mailbox
+  with one scatter, merge in VMEM) when ``kernel_supported`` says the
+  backend can, and through the ``lax``-composite scatter fallback
+  otherwise (CPU, sharded meshes).
+
+Semantics are bit-for-bit the unfused pipeline's — the step-program
+equivalence tests (tests/test_engine.py driver/repair, tests/
+test_pipeline.py) and the golden fingerprint pin it.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from corro_sim.core.bookkeeping import deliver_versions
+from corro_sim.core.changelog import gather_changesets
+from corro_sim.core.crdt import NEG, apply_cell_changes
+from corro_sim.core.merge_kernel import (
+    kernel_interpret,
+    kernel_supported,
+    merge_grouped,
+    pick_block_nodes,
+    route_lanes,
+)
+from corro_sim.utils.slots import ranks_within_group_masked
+
+
+class DeliveryResult(NamedTuple):
+    """Everything the rest of the round consumes from the fused pass.
+    Lane arrays are in SORTED order (delivered lanes grouped by dst)."""
+
+    table: object  # merged TableState
+    book: object  # updated Bookkeeping
+    probe: object  # updated ProbeState (untouched when probes off)
+    hlc_recv: jnp.ndarray  # (N,) per-node max sender clock this round
+    dst: jnp.ndarray
+    src: jnp.ndarray
+    actor: jnp.ndarray
+    ver: jnp.ndarray
+    chunk: jnp.ndarray
+    delivered: jnp.ndarray  # post-cap delivery mask
+    delivered_precap: jnp.ndarray  # pre-apply-queue-cap mask (RTT samples
+    # observe every landed packet, capped or not — transport.rs:199-233)
+    fresh_chunk: jnp.ndarray  # first delivery of a not-yet-seen chunk
+    complete: jnp.ndarray  # lane completed its version (merge trigger)
+    dropped: jnp.ndarray  # window/caps drops (metrics)
+    c_cleared: jnp.ndarray  # gathered cleared flag per lane
+    g_actor: jnp.ndarray  # complete-masked actor (changeset gather key)
+    g_slot: jnp.ndarray  # version ring slot per lane
+    cell_live: jnp.ndarray  # (m, S) cells actually merged
+
+
+def delivery_pass(
+    cfg,
+    table,
+    book,
+    log,
+    probe,
+    hlc: jnp.ndarray,  # (N,) current clocks (sender stamps)
+    dst: jnp.ndarray,
+    src: jnp.ndarray,
+    actor: jnp.ndarray,
+    ver: jnp.ndarray,
+    chunk: jnp.ndarray,
+    delivered: jnp.ndarray,
+    round_,
+) -> DeliveryResult:
+    """Sort once; deliver, account, trace and merge off that one order."""
+    n = cfg.num_nodes
+    s = cfg.seqs_per_version
+    cpv = cfg.chunks_per_version
+
+    # ONE lane sort for the whole delivery pipeline: bookkeeping dedupe
+    # (deliver_versions presorted path), changeset gathers, the merge
+    # scatter (coalesced by dst), and ring enqueue (grouped path) all run
+    # in this order — instead of each stage sorting for itself.
+    big = jnp.int32(n + 1)
+    sort_dst = jnp.where(delivered, dst, big)
+    if cpv == 1 and (n + 2) * (n + 2) < 2**31:
+        # pack (dst, actor) into one key; chunk is identically 0
+        order = jnp.lexsort((ver, sort_dst * jnp.int32(n + 2) + actor))
+    else:
+        order = jnp.lexsort((chunk, ver, actor, sort_dst))
+    dst = dst[order]
+    src = src[order]
+    actor = actor[order]
+    ver = ver[order]
+    delivered = delivered[order]
+    if cpv == 1:
+        # single-chunk ring entries always carry chunk 0 — the plane is
+        # a constant, not a permuted gather
+        chunk = jnp.zeros(dst.shape, jnp.int32)
+    else:
+        chunk = chunk[order]
+
+    # ------------------------------------------------------------ HLC merge
+    # Every delivered message carries the sender's clock; the receiver
+    # merges max(local, remote) and ticks at end of round — the uhlc
+    # exchange the reference performs on every contact (broadcast
+    # timestamps, sync Clock messages; setup.rs:91-96, peer.rs:1502-1521).
+    hlc_recv = (
+        jnp.zeros((n,), jnp.int32)
+        .at[jnp.where(delivered, dst, n)]
+        .max(hlc[src], mode="drop")
+    )
+
+    # ------------------------------------- delivery: bookkeeping + merge
+    use_kernel = kernel_supported(cfg, path="delivery")
+    # Bounded apply queue (reference config.rs:10-41): each node processes
+    # at most apply_queue_cap deliveries per round; overflow drops BEFORE
+    # bookkeeping (counted below) and sync repairs it, like the
+    # reference's queue-overflow drops (handlers.rs:866-884). Applied on
+    # BOTH merge paths — a simulation-model bound, not an execution
+    # detail, so results are backend-independent. Lanes are sorted
+    # delivered-first-per-dst, so the masked rank is exact.
+    rankd = ranks_within_group_masked(dst, delivered)
+    delivered_precap = delivered
+    overcap = delivered & (rankd >= cfg.apply_queue_cap)
+    delivered = delivered & ~overcap
+    book, fresh_chunk, complete, dropped = deliver_versions(
+        book, dst, actor, ver, delivered,
+        chunk=None if cpv == 1 else chunk, bits_per_version=cpv,
+        presorted=True,
+    )
+    dropped = dropped | overcap
+    # ------------------------------------------------------- probe tracer
+    # The broadcast merge point (engine/probe.py) rides the same sorted
+    # stream. The flag is static: probes == 0 traces ZERO extra ops and
+    # the step program stays bit-identical to the uninstrumented one.
+    if cfg.probes:
+        # deferred import: engine.probe pulls in the engine package,
+        # which imports engine.step, which imports this module — the
+        # same lazy-import pattern step.py uses for swim_window
+        from corro_sim.engine.probe import probe_delivery_update
+
+        probe = probe_delivery_update(
+            probe, round_, dst, src, actor, ver, delivered, complete
+        )
+    g_actor = jnp.where(complete, actor, 0)
+    g_slot = (jnp.maximum(ver, 1) - 1) % log.capacity
+    c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
+        log, g_actor, jnp.maximum(ver, 1)
+    )
+    m = dst.shape[0]
+    # Cleared versions deliver no cells — the receiver of an emptied
+    # changeset just fast-forwards bookkeeping (handle_emptyset analog).
+    c_cleared = log.cleared[g_actor, g_slot]
+    cell_live = (
+        complete[:, None]
+        & ~c_cleared[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < c_n[:, None])
+    )
+    # The writing site is the actor — except for DELETE entries (logged with
+    # vr == NEG), which are cl-only and must not claim the site slot either.
+    c_site = jnp.where(
+        c_vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (m, s))
+    )
+    if use_kernel:
+        # Pallas dst-grouped merge: route cell lanes into the per-node
+        # mailbox (one scatter) and merge in VMEM — no per-lane
+        # scatter/gather descriptors (core/merge_kernel.py).
+        cap_lanes = cfg.apply_queue_cap * s
+        rank_cell = (rankd[:, None] * s
+                     + jnp.arange(s, dtype=jnp.int32)[None, :])
+        box = route_lanes(
+            jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
+            rank_cell.reshape(-1),
+            (c_row * cfg.num_cols + c_col).reshape(-1),
+            c_cv.reshape(-1),
+            c_vr.reshape(-1),
+            c_site.reshape(-1),
+            c_cl.reshape(-1),
+            cell_live.reshape(-1),
+            n, cap_lanes,
+        )
+        table = merge_grouped(
+            table, box, cap_lanes,
+            block_nodes=pick_block_nodes(n),
+            interpret=kernel_interpret(),
+        )
+    else:
+        table = apply_cell_changes(
+            table,
+            jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
+            c_row.reshape(-1),
+            c_col.reshape(-1),
+            c_cv.reshape(-1),
+            c_vr.reshape(-1),
+            c_site.reshape(-1),
+            c_cl.reshape(-1),
+            cell_live.reshape(-1),
+        )
+
+    return DeliveryResult(
+        table=table, book=book, probe=probe, hlc_recv=hlc_recv,
+        dst=dst, src=src, actor=actor, ver=ver, chunk=chunk,
+        delivered=delivered, delivered_precap=delivered_precap,
+        fresh_chunk=fresh_chunk, complete=complete,
+        dropped=dropped, c_cleared=c_cleared, g_actor=g_actor,
+        g_slot=g_slot, cell_live=cell_live,
+    )

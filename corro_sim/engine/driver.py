@@ -24,6 +24,14 @@ pins this): a speculative chunk that the sequential path would not have
 run (the run converged or poisoned one chunk earlier, or the repair
 program switch landed) is discarded and, for a program mispredict,
 re-dispatched on the correct program. See doc/performance.md.
+
+Donation composes with the pipeline (ISSUE 6): a donating speculative
+dispatch consumes the carry it speculates from, so the committed state
+is **double-buffered** — one device-side copy per chunk stands in as
+the committed carry (and as the re-dispatch input on a mispredict).
+Peak memory matches the non-donated pipeline (two carries), the scan
+itself still runs fully in-place, and results stay bit-identical to
+the sequential non-donated reference (tests/test_pipeline.py).
 """
 
 from __future__ import annotations
@@ -239,6 +247,17 @@ def _chunk_runner(
     return run_chunk
 
 
+@jax.jit
+def _dbuf_copy(tree):
+    """Device-side deep copy of a pytree (the pipeline's donation
+    double-buffer): inputs are NOT donated, so XLA cannot alias them —
+    the outputs are fresh buffers. The donating speculative dispatch
+    consumes the COPY, never the committed carry: copy-output feeding
+    the donated call is a true producer→consumer dependency, so the
+    in-place reuse is ordered by construction."""
+    return jax.tree.map(jnp.copy, tree)
+
+
 @dataclasses.dataclass
 class _InFlight:
     """One dispatched-but-unprocessed chunk riding the device queue."""
@@ -311,9 +330,10 @@ def run_sim(
 
     ``pipeline``: overlap device compute with host-side control (module
     docstring; doc/performance.md). ``None`` follows ``cfg.pipeline``
-    (default on). Forced off under ``donate=True``: a speculative
-    dispatch consumes the donated carry, so a discarded/re-dispatched
-    chunk would have no input left to re-run from.
+    (default on). Composes with ``donate=True``: the committed carry is
+    double-buffered (one device-side copy per chunk) so the donating
+    speculative dispatch can consume the original — a discarded or
+    re-dispatched chunk re-runs from the copy.
 
     ``transfer_guard``: arm ``jax.transfer_guard("disallow")`` around
     the chunk loop (analysis/transfer_guard.py) so any device transfer
@@ -331,9 +351,6 @@ def run_sim(
     if transfer_guard is None:
         transfer_guard = _tg_env_enabled()
     pipeline_off_reason = None
-    if pipeline and donate:
-        pipeline = False
-        pipeline_off_reason = "donate"
     flight.set_meta(
         driver="run_sim", nodes=cfg.num_nodes, chunk=chunk, seed=seed,
         max_rounds=max_rounds, pipeline=bool(pipeline),
@@ -443,13 +460,25 @@ def run_sim(
             labels=f'{{program="{program}"}}',
             help_="AOT lower+compile wall by program",
         )
-        # donated args must not be consumed by a throwaway run
-        if compiled_ is not None and warmup and not donate:
+        if compiled_ is not None and warmup and not (donate and
+                                                    shardings is not None):
             # first execution of a program pays one-time platform
             # initialization (~8 s over the tunnel) — burn it on a
-            # discarded run so every timed chunk runs warm
+            # discarded run so every timed chunk runs warm. Donated args
+            # must not be consumed by the throwaway run, so donating
+            # runs burn on zero buffers allocated from the args' avals
+            # instead of the real carry (ISSUE 6: donated runs get
+            # warm-start too; the transient extra carry is freed at the
+            # end of this statement). Sharded+donated runs still skip —
+            # the AOT executable pins input shardings the plain zeros
+            # would not carry.
+            burn_args = args
+            if donate:
+                burn_args = jax.tree.map(
+                    lambda a: jnp.zeros(a.shape, a.dtype), args
+                )
             with tracer.span("warmup", program=program, slow_warn=False):
-                jax.block_until_ready(compiled_(*args)[0].round)
+                jax.block_until_ready(compiled_(*burn_args)[0].round)
             flight.record_phase("warmup", time.perf_counter() - c_done)
         compile_seconds += time.perf_counter() - t0
         flight.record_phase("compile", c_done - t0)
@@ -837,10 +866,22 @@ def run_sim(
                 nxt = None
                 next_base = pending.base + chunk
                 if next_base < max_rounds:
+                    spec_src = pending.state_out
+                    if donate:
+                        # donation double-buffer: speculate from a
+                        # device-side COPY and donate THAT. The copy's
+                        # output feeding the donated call is a true
+                        # producer→consumer dependency (ordered by
+                        # construction, no reliance on how the runtime
+                        # sequences in-place reuse against pending
+                        # readers), and pending's own carry is never
+                        # consumed — it stays the committed state and
+                        # the re-dispatch source on a mispredict.
+                        spec_src = _dbuf_copy(pending.state_out)
                     # speculative dispatch: chunk N+1 enters the device
                     # queue before chunk N's convergence scalar lands
                     nxt = _dispatch(
-                        pending.ci + 1, next_base, pending.state_out,
+                        pending.ci + 1, next_base, spec_src,
                         last_pend_live, bool(pending.we.any()),
                         speculative=True,
                     )
@@ -943,7 +984,8 @@ def run_sim(
                         rounds, "pipeline_discard", chunk=nxt.ci,
                         reason="program_switch",
                     )
-                    nxt = _dispatch(nxt.ci, nxt.base, state,
+                    nxt = _dispatch(nxt.ci, nxt.base,
+                                    _dbuf_copy(state) if donate else state,
                                     last_pend_live, False,
                                     speculative=False)
                 pending = nxt

@@ -49,23 +49,33 @@ class ProbeState:
     ver: jnp.ndarray  # (K,) int32 — tracked version of that actor
     first_seen: jnp.ndarray  # (K, N) int32 round, -1 = never
     infector: jnp.ndarray  # (K, N) int32 peer id / INFECTOR_* sentinel
-    hop: jnp.ndarray  # (K, N) int32 gossip hops from origin, -1 = n/a
+    hop: jnp.ndarray  # (K, N) int32 (int8 under narrow_state) gossip
+    # hops from origin, -1 = n/a; the narrow plane saturates at 127
     dup: jnp.ndarray  # (K,) int32 duplicate deliveries (redundancy)
     last_sync: jnp.ndarray  # (N,) int32 last sync-sweep round, -1 = never
 
 
-def make_probe_state(num_probes: int, num_nodes: int) -> ProbeState:
+def make_probe_state(
+    num_probes: int, num_nodes: int, narrow: bool = False
+) -> ProbeState:
     """Probe k tracks version 1 of actor ``k * N // K`` — K origins spread
     evenly over the id space. Drivers that want different targets replace
     ``actor``/``ver`` before running. ``num_probes == 0`` returns a
-    (1, 1) placeholder (same trick as the inflight/rtt planes)."""
+    (1, 1) placeholder (same trick as the inflight/rtt planes).
+
+    ``narrow`` (``SimConfig.narrow_state``): the hop plane drops to int8
+    — gossip path lengths are diameter-bounded, and the delivery update
+    saturates at 127 instead of wrapping (tests/test_narrow_state.py
+    pins the boundary). first_seen (round numbers) and infector (node
+    ids) need the full int32 range and stay wide."""
+    hop_dt = jnp.int8 if narrow else jnp.int32
     if num_probes <= 0:
         return ProbeState(
             actor=jnp.zeros((1,), jnp.int32),
             ver=jnp.zeros((1,), jnp.int32),
             first_seen=jnp.full((1, 1), -1, jnp.int32),
             infector=jnp.full((1, 1), INFECTOR_NONE, jnp.int32),
-            hop=jnp.full((1, 1), -1, jnp.int32),
+            hop=jnp.full((1, 1), -1, hop_dt),
             dup=jnp.zeros((1,), jnp.int32),
             last_sync=jnp.full((1,), -1, jnp.int32),
         )
@@ -77,7 +87,7 @@ def make_probe_state(num_probes: int, num_nodes: int) -> ProbeState:
         ver=jnp.ones((k,), jnp.int32),
         first_seen=jnp.full((k, n), -1, jnp.int32),
         infector=jnp.full((k, n), INFECTOR_NONE, jnp.int32),
-        hop=jnp.full((k, n), -1, jnp.int32),
+        hop=jnp.full((k, n), -1, hop_dt),
         dup=jnp.zeros((k,), jnp.int32),
         last_sync=jnp.full((n,), -1, jnp.int32),
     )
@@ -141,10 +151,17 @@ def probe_delivery_update(
     hop_src = jnp.take_along_axis(
         probe.hop, jnp.clip(min_src, 0, n - 1), axis=1
     )
+    # hop + 1 in int32, then saturate at the plane dtype's max before
+    # narrowing — an int8 plane (narrow_state) must clamp at 127, not
+    # wrap to -128 ("never infected"); int32 planes pass through exact
+    hop_dt = probe.hop.dtype
+    hop_next = jnp.maximum(hop_src, 0).astype(jnp.int32) + 1
+    if hop_dt != jnp.int32:
+        hop_next = jnp.minimum(hop_next, jnp.iinfo(hop_dt).max)
     return probe.replace(
         first_seen=jnp.where(newly, round_, probe.first_seen),
         infector=jnp.where(newly, min_src, probe.infector),
-        hop=jnp.where(newly, jnp.maximum(hop_src, 0) + 1, probe.hop),
+        hop=jnp.where(newly, hop_next.astype(hop_dt), probe.hop),
         dup=dup,
     )
 

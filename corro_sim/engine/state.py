@@ -113,10 +113,12 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
         swim=(
             make_swim_window_state(
                 n, cfg.swim_view_size, seed=seed,
-                enabled=cfg.swim_enabled,
+                enabled=cfg.swim_enabled, narrow=cfg.narrow_state,
             )
             if cfg.swim_view_size > 0
-            else make_swim_state(n, enabled=cfg.swim_enabled)
+            else make_swim_state(
+                n, enabled=cfg.swim_enabled, narrow=cfg.narrow_state
+            )
         ),
         ring0=jnp.asarray(_ring0(cfg, seed)),
         row_cdf=jnp.asarray(_row_cdf(cfg)),
@@ -134,7 +136,7 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
             else (1, 6, 1),
             jnp.int32,
         ),
-        probe=make_probe_state(cfg.probes, n),
+        probe=make_probe_state(cfg.probes, n, narrow=cfg.narrow_state),
         fault_burst=jnp.zeros(
             (n,) if cfg.faults.burst_enter > 0 else (1,), bool
         ),

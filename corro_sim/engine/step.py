@@ -23,18 +23,11 @@ import jax
 import jax.numpy as jnp
 
 from corro_sim.config import SimConfig
-from corro_sim.core.bookkeeping import deliver_versions, partial_versions
-from corro_sim.core.changelog import append_changesets, gather_changesets
+from corro_sim.core.bookkeeping import partial_versions
+from corro_sim.core.changelog import append_changesets
 from corro_sim.core.compaction import update_ownership
-from corro_sim.core.crdt import NEG, apply_cell_changes, local_write
-from corro_sim.core.merge_kernel import (
-    kernel_interpret,
-    kernel_supported,
-    merge_grouped,
-    pick_block_nodes,
-    route_lanes,
-)
-from corro_sim.utils.slots import ranks_within_group_masked
+from corro_sim.core.crdt import NEG, local_write
+from corro_sim.core.delivery import delivery_pass
 from corro_sim.faults.inject import (
     blackhole_mask,
     burst_update,
@@ -43,13 +36,16 @@ from corro_sim.faults.inject import (
 )
 from corro_sim.engine.probe import (
     probe_book_update,
-    probe_delivery_update,
     probe_metrics,
     probe_sync_mark,
     probe_write_update,
 )
 from corro_sim.engine.state import SimState
-from corro_sim.gossip.broadcast import broadcast_step, enqueue_broadcasts
+from corro_sim.gossip.broadcast import (
+    broadcast_step,
+    enqueue_broadcasts,
+    enqueue_own,
+)
 from corro_sim.membership.rtt import link_delay, observe_rtt, recompute_ring0
 from corro_sim.membership.swim import swim_step, view_alive  # noqa: F401
 from corro_sim.membership.swim_window import membership_view
@@ -283,6 +279,7 @@ def sim_step(
     gossip, g_dst, g_src, g_actor, g_ver, g_chunk, g_valid = broadcast_step(
         state.gossip, k_bcast, alive, view, cfg.fanout,
         emit_slots=cfg.emit_slots, round_idx=state.round,
+        need_chunk=cpv > 1,
     )
 
     dst = jnp.concatenate([e_dst, g_dst])
@@ -358,41 +355,39 @@ def sim_step(
         f_dup = (delivered & dup_m).sum(dtype=jnp.int32)
         f_delivered = delivered.sum(dtype=jnp.int32)
 
-    # ONE lane sort for the whole delivery pipeline: bookkeeping dedupe
-    # (deliver_versions presorted path), changeset gathers, the merge
-    # scatter (coalesced by dst), and ring enqueue (grouped path) all run
-    # in this order — instead of each stage sorting for itself.
-    big = jnp.int32(n + 1)
-    sort_dst = jnp.where(delivered, dst, big)
-    if cpv == 1 and (n + 2) * (n + 2) < 2**31:
-        # pack (dst, actor) into one key; chunk is identically 0
-        order = jnp.lexsort((ver, sort_dst * jnp.int32(n + 2) + actor))
+    # ------------------------------------------------------- probe origins
+    # Origin seeding ahead of the fused pass (engine/probe.py). The flag
+    # is static: probes == 0 traces ZERO extra ops and the step program
+    # stays bit-identical to the uninstrumented one.
+    if cfg.probes:
+        probe = probe_write_update(state.probe, state.round, writers, w_ver)
     else:
-        order = jnp.lexsort((chunk, ver, actor, sort_dst))
-    dst = dst[order]
-    src = src[order]
-    actor = actor[order]
-    ver = ver[order]
-    chunk = chunk[order]
-    delivered = delivered[order]
+        probe = state.probe
 
-    # ------------------------------------------------------------ HLC merge
-    # Every delivered message carries the sender's clock; the receiver
-    # merges max(local, remote) and ticks at end of round — the uhlc
-    # exchange the reference performs on every contact (broadcast
-    # timestamps, sync Clock messages; setup.rs:91-96, peer.rs:1502-1521).
-    hlc_recv = (
-        jnp.zeros((n,), jnp.int32)
-        .at[jnp.where(delivered, dst, n)]
-        .max(state.hlc[src], mode="drop")
+    # --------------------------------------- fused delivery merge (1 pass)
+    # ONE lane sort feeds the whole delivery pipeline — HLC scatter-max,
+    # apply-queue rank, bookkeeping dedupe, the probe delivery merge
+    # point, changeset gathers and the CRDT merge scatter — instead of
+    # each stage re-deriving masks over its own order (core/delivery.py).
+    dv = delivery_pass(
+        cfg, table, book, log, probe, state.hlc,
+        dst, src, actor, ver, chunk, delivered, state.round,
     )
+    table, book, probe = dv.table, dv.book, dv.probe
+    hlc_recv = dv.hlc_recv
+    dst, src, actor, ver, chunk = dv.dst, dv.src, dv.actor, dv.ver, dv.chunk
+    delivered = dv.delivered
+    fresh_chunk, complete, dropped = dv.fresh_chunk, dv.complete, dv.dropped
+    c_cleared, g_actor, g_slot = dv.c_cleared, dv.g_actor, dv.g_slot
+    cell_live = dv.cell_live
 
     # ------------------------------------------------- RTT samples + rings
-    # Every delivery is an RTT sample (transport.rs:199-233); rings
-    # recompute from observations every ring_update_interval rounds
-    # (members.rs:140-188). Static config → both fully traced out when off.
+    # Every landed packet is an RTT sample, capped or not
+    # (transport.rs:199-233); rings recompute from observations every
+    # ring_update_interval rounds (members.rs:140-188). Static config →
+    # both fully traced out when off.
     if cfg.rtt_rings:
-        rtt = observe_rtt(cfg, state.rtt, dst, src, delivered)
+        rtt = observe_rtt(cfg, state.rtt, dst, src, dv.delivered_precap)
         ring0 = jax.lax.cond(
             (state.round % cfg.ring_update_interval)
             == (cfg.ring_update_interval - 1),
@@ -404,100 +399,31 @@ def sim_step(
         rtt = state.rtt
         ring0 = state.ring0
 
-    # ------------------------------------- delivery: bookkeeping + merge
-    use_kernel = kernel_supported(cfg, path="delivery")
-    # Bounded apply queue (reference config.rs:10-41): each node processes
-    # at most apply_queue_cap deliveries per round; overflow drops BEFORE
-    # bookkeeping (counted below) and sync repairs it, like the
-    # reference's queue-overflow drops (handlers.rs:866-884). Applied on
-    # BOTH merge paths — a simulation-model bound, not an execution
-    # detail, so results are backend-independent. Lanes are sorted
-    # delivered-first-per-dst, so the masked rank is exact.
-    rankd = ranks_within_group_masked(dst, delivered)
-    overcap = delivered & (rankd >= cfg.apply_queue_cap)
-    delivered = delivered & ~overcap
-    book, fresh_chunk, complete, dropped = deliver_versions(
-        book, dst, actor, ver, delivered, chunk=chunk, bits_per_version=cpv,
-        presorted=True,
-    )
-    dropped = dropped | overcap
-    # ------------------------------------------------------- probe tracer
-    # Origin seeding + the broadcast merge point (engine/probe.py). The
-    # flag is static: probes == 0 traces ZERO extra ops and the step
-    # program stays bit-identical to the uninstrumented one.
-    if cfg.probes:
-        probe = probe_write_update(state.probe, state.round, writers, w_ver)
-        probe = probe_delivery_update(
-            probe, state.round, dst, src, actor, ver, delivered, complete
-        )
-    else:
-        probe = state.probe
-    g_actor = jnp.where(complete, actor, 0)
-    g_slot = (jnp.maximum(ver, 1) - 1) % log.capacity
-    c_row, c_col, c_vr, c_cv, c_cl, c_n = gather_changesets(
-        log, g_actor, jnp.maximum(ver, 1)
-    )
-    m = dst.shape[0]
-    # Cleared versions deliver no cells — the receiver of an emptied
-    # changeset just fast-forwards bookkeeping (handle_emptyset analog).
-    c_cleared = log.cleared[g_actor, g_slot]
-    cell_live = (
-        complete[:, None]
-        & ~c_cleared[:, None]
-        & (jnp.arange(s, dtype=jnp.int32)[None, :] < c_n[:, None])
-    )
-    # The writing site is the actor — except for DELETE entries (logged with
-    # vr == NEG), which are cl-only and must not claim the site slot either.
-    c_site = jnp.where(c_vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (m, s)))
-    if use_kernel:
-        # Pallas dst-grouped merge: route cell lanes into the per-node
-        # mailbox (one scatter) and merge in VMEM — no per-lane
-        # scatter/gather descriptors (core/merge_kernel.py).
-        cap_lanes = cfg.apply_queue_cap * s
-        rank_cell = (rankd[:, None] * s
-                     + jnp.arange(s, dtype=jnp.int32)[None, :])
-        box = route_lanes(
-            jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
-            rank_cell.reshape(-1),
-            (c_row * cfg.num_cols + c_col).reshape(-1),
-            c_cv.reshape(-1),
-            c_vr.reshape(-1),
-            c_site.reshape(-1),
-            c_cl.reshape(-1),
-            cell_live.reshape(-1),
-            n, cap_lanes,
-        )
-        table = merge_grouped(
-            table, box, cap_lanes,
-            block_nodes=pick_block_nodes(n),
-            interpret=kernel_interpret(),
-        )
-    else:
-        table = apply_cell_changes(
-            table,
-            jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
-            c_row.reshape(-1),
-            c_col.reshape(-1),
-            c_cv.reshape(-1),
-            c_vr.reshape(-1),
-            c_site.reshape(-1),
-            c_cl.reshape(-1),
-            cell_live.reshape(-1),
-        )
-
     # ------------------------------------------------- rebroadcast + enqueue
     # Fresh foreign chunks re-enter the destination's pending ring
     # (handlers.rs:950-960); a node's own fresh chunks enter its own ring
     # for random dissemination (the eager ring-0 send already happened).
-    wq_dst, wq_actor, wq_ver, wq_valid, wq_chunk = _tile_chunks(
-        cpv, rows_idx, rows_idx, w_ver, writers
-    )
-    # both enqueues take the sort-free grouped path: wq lanes are keyed by
-    # the (sorted) node iota; delivery lanes carry the hoisted sort order
-    gossip = enqueue_broadcasts(
-        gossip, wq_dst, wq_actor, wq_ver, wq_chunk, wq_valid,
-        cfg.max_transmissions, grouped=True,
-    )
+    if cpv <= cfg.pend_slots:
+        # own-write lanes are node-major with a fixed per-node lane count,
+        # so the ring-slot rank is the lane index — no rank/count pass at
+        # all (gossip/broadcast.py enqueue_own; bit-equivalent to the
+        # grouped path while cpv fits the ring)
+        gossip = enqueue_own(
+            gossip, jnp.repeat(rows_idx, cpv), jnp.repeat(w_ver, cpv),
+            jnp.tile(jnp.arange(cpv, dtype=jnp.int32), n), writers,
+            cfg.max_transmissions, cpv,
+        )
+    else:
+        # degenerate ring (cpv > pend_slots): the grouped path's unbiased
+        # overflow rotation must pick which chunks survive
+        wq_dst, wq_actor, wq_ver, wq_valid, wq_chunk = _tile_chunks(
+            cpv, rows_idx, rows_idx, w_ver, writers
+        )
+        gossip = enqueue_broadcasts(
+            gossip, wq_dst, wq_actor, wq_ver, wq_chunk, wq_valid,
+            cfg.max_transmissions, grouped=True,
+        )
+    # delivery lanes carry the fused pass's hoisted sort order
     gossip = enqueue_broadcasts(
         gossip, dst, actor, ver, chunk, fresh_chunk,
         cfg.rebroadcast_transmissions, grouped=True,

@@ -149,6 +149,46 @@ def enqueue_broadcasts(
     )
 
 
+def enqueue_own(
+    gossip: GossipState,
+    actor: jnp.ndarray,  # (N * per_node,) node-major lanes
+    ver: jnp.ndarray,
+    chunk: jnp.ndarray,
+    valid_node: jnp.ndarray,  # (N,) bool — one validity per node
+    transmissions: int,
+    per_node: int,
+) -> GossipState:
+    """Sort-free enqueue for the own-write path: node ``i`` owns lanes
+    ``[i*per_node, (i+1)*per_node)``, so the intra-node lane index IS the
+    ring-slot rank — no sort, no masked-rank cumsum/cummax pass, no
+    group-count scatter and no overflow-rotation phase (a node enqueues
+    at most ``per_node`` = chunks_per_version lanes, far under the ring).
+    Bit-equivalent to ``enqueue_broadcasts(..., grouped=True)`` on the
+    same lanes (tests/test_engine.py pins the step program end to end).
+    """
+    n, p, _ = gossip.pend.shape
+    rank = jnp.tile(jnp.arange(per_node, dtype=jnp.int32), n)
+    dst = jnp.repeat(jnp.arange(n, dtype=jnp.int32), per_node)
+    valid = jnp.repeat(valid_node, per_node)
+    over_capacity = valid & (rank >= p)
+    valid = valid & (rank < p)
+    slot = (jnp.repeat(gossip.cursor, per_node) + rank) % p
+    idx = (jnp.where(valid, dst, n), slot)
+    clobbered = (
+        (gossip.pend[idx][..., PEND_TX] > 0) & valid
+    ) | over_capacity
+    packed = jnp.stack([
+        actor, ver, chunk,
+        jnp.where(valid, transmissions, 0),
+    ], axis=-1)
+    counts = jnp.where(valid_node, min(per_node, p), 0)
+    return GossipState(
+        pend=gossip.pend.at[idx].set(packed, mode="drop"),
+        cursor=(gossip.cursor + counts) % p,
+        overflow=gossip.overflow + clobbered.sum(dtype=jnp.int32),
+    )
+
+
 def broadcast_step(
     gossip: GossipState,
     key: jax.Array,
@@ -157,6 +197,7 @@ def broadcast_step(
     fanout: int,
     emit_slots: int = 0,
     round_idx: jnp.ndarray | int = 0,
+    need_chunk: bool = True,
 ):
     """Emit one round of gossip messages; decrement transmission budgets.
 
@@ -223,9 +264,15 @@ def broadcast_step(
     ver = jnp.broadcast_to(
         pend_e[..., PEND_VER][:, :, None], targets.shape
     ).reshape(-1)
-    chunk = jnp.broadcast_to(
-        pend_e[..., PEND_CHUNK][:, :, None], targets.shape
-    ).reshape(-1)
+    if need_chunk:
+        chunk = jnp.broadcast_to(
+            pend_e[..., PEND_CHUNK][:, :, None], targets.shape
+        ).reshape(-1)
+    else:
+        # single-chunk configs (chunks_per_version == 1): every ring
+        # entry's chunk field is identically zero, so the emission plane
+        # is a constant — skip the broadcast/reshape eqns entirely
+        chunk = jnp.zeros(dst.shape, jnp.int32)
     src_flat = src.reshape(-1)
 
     if e < p:

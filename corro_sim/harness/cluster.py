@@ -1528,24 +1528,30 @@ class LiveCluster:
             self._alive[node] = True
             inc = None
             if self.cfg.swim_enabled:
-                from corro_sim.membership.swim import INC_MAX, pack_swim
+                from corro_sim.membership.swim import pack_swim, swim_layout
 
                 swim = self.state.swim
                 if hasattr(swim, "member"):  # windowed: self = slot 0
-                    new_inc = min(int(swim.self_inc[node]) + 1, INC_MAX)
+                    lo = swim_layout(swim.belief.dtype)
+                    new_inc = min(
+                        int(swim.self_inc[node]) + 1, lo.inc_max
+                    )
                     swim = swim.replace(
                         belief=swim.belief.at[node, 0].set(
-                            pack_swim(0, new_inc, 0)
+                            pack_swim(0, new_inc, 0, dtype=lo.dtype)
                         )
                     )
                 else:
                     # saturate like swim_step's refutation — wrapping the
-                    # 14-bit packed field would reset precedence to zero
-                    new_inc = min(int(swim.inc[node, node]) + 1, INC_MAX)
+                    # packed inc field would reset precedence to zero
+                    lo = swim_layout(swim.p.dtype)
+                    new_inc = min(
+                        int(swim.inc[node, node]) + 1, lo.inc_max
+                    )
                     # packed self-entry: ALIVE at the bumped incarnation
                     swim = swim.replace(
                         p=swim.p.at[node, node].set(
-                            pack_swim(0, new_inc, 0)
+                            pack_swim(0, new_inc, 0, dtype=lo.dtype)
                         )
                     )
                 self.state = self.state.replace(swim=swim)

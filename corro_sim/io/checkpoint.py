@@ -393,6 +393,18 @@ def _install(cluster, meta, flat, node):
                         f"shape mismatch for {k}: checkpoint "
                         f"{tuple(v.shape)} vs cluster {tuple(dst[k].shape)}"
                     )
+                if np.dtype(v.dtype) != np.dtype(dst[k].dtype):
+                    # the packed SWIM/probe planes have the SAME shape
+                    # wide and narrow (SimConfig.narrow_state) but a
+                    # different field layout — coercing would silently
+                    # reinterpret packed bits, so refuse loudly
+                    raise ValueError(
+                        f"dtype mismatch for {k}: checkpoint "
+                        f"{np.dtype(v.dtype)} vs cluster "
+                        f"{np.dtype(dst[k].dtype)} (narrow_state "
+                        "checkpoints restore only into narrow_state "
+                        "clusters, and vice versa)"
+                    )
                 dst[k] = jnp.asarray(v)
 
     merge(base, nested)

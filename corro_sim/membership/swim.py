@@ -19,9 +19,9 @@ consumes its MemberUp/MemberDown notifications to drive the members map
   and merges by ``(incarnation, status-severity)`` — same fixed point,
   bounded per-round traffic.
 
-State is ONE (N, N) uint32 plane — node i's belief about member j, packed
-as ``inc << 18 | status << 16 | since`` — sharded over the observer axis.
-The packing is chosen so that plain integer ``max`` IS the foca
+State is ONE (N, N) unsigned plane — node i's belief about member j,
+packed as ``inc << inc_shift | status << status_shift | since``. The
+packing is chosen so that plain integer ``max`` IS the foca
 update-precedence merge: higher incarnation wins, then higher status
 severity (down > suspect > alive), then the later suspicion start (a
 conservative tie-break — suspicion times out later). Every exchange —
@@ -31,23 +31,47 @@ at 10k nodes that is 400 MB of state instead of 900 MB and ~3x less HBM
 traffic per tick (the round profile had the three-plane SWIM tick at
 167 ms of a 373 ms round).
 
-Field widths: ``since`` is the suspicion-start round mod 2^16 (timeouts
-compare mod-2^16, exact while suspicions resolve within 65k rounds —
-they resolve within ``swim_suspect_rounds``); ``inc`` has 14 bits, and
-refutation saturates at 16383 rather than wrapping (wrap would reset
-precedence to zero and permanently lose every merge). Saturation is not
-free: at equal incarnation the higher SEVERITY wins, so a node pinned at
-16383 can no longer refute a DOWN verdict — but reaching it takes 16k
-suspect/refute cycles of one node, far beyond any simulated scenario,
-and the admin ``cluster rejoin`` path clamps identically
-(``harness/cluster.py``) so the wrap bug cannot be triggered from there.
+Two field layouts share the automaton, selected by the plane's dtype
+(:func:`swim_layout`):
+
+- **wide** (``uint32``, the default): ``since`` is the suspicion-start
+  round mod 2^16 (timeouts compare mod-2^16, exact while suspicions
+  resolve within 65k rounds — they resolve within
+  ``swim_suspect_rounds``); ``inc`` has 14 bits, and refutation
+  saturates at 16383 rather than wrapping (wrap would reset precedence
+  to zero and permanently lose every merge).
+- **narrow** (``uint16``, ``SimConfig.narrow_state``): the same packing
+  squeezed to ``inc`` 6 bits (saturating at 63), status 2 bits,
+  ``since`` mod-2^8 — halving the widest per-node plane's HBM traffic
+  again (200 MB at 10k nodes). Bit-exact with the wide plane while
+  incarnations stay under 63 and suspicions resolve within 256 rounds
+  (``SimConfig.validate`` bounds ``swim_suspect_rounds`` accordingly;
+  tests/test_narrow_state.py pins exactness across the scenario
+  library and the saturation boundary). The wide layout's wrap caveat
+  shrinks with the field: the ``since`` tie-break and the frozen-entry
+  timeout compare mod-2^8 instead of mod-2^16, so two concurrent
+  suspicions of the same member straddling a multiple of 256 rounds, or
+  a belief frozen across one (observer dead > 256 rounds, then
+  revived), can order/time out differently from the wide reference —
+  same failure mode wide has at multiples of 65536, just a smaller
+  window.
+
+Saturation is not free in either layout: at equal incarnation the higher
+SEVERITY wins, so a node pinned at the cap can no longer refute a DOWN
+verdict — but reaching it takes inc_max suspect/refute cycles of one
+node, far beyond any simulated scenario, and the admin ``cluster
+rejoin`` path clamps identically (``harness/cluster.py``) so the wrap
+bug cannot be triggered from either layout.
 """
 
 from __future__ import annotations
 
+import dataclasses
+
 import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from corro_sim.config import SimConfig
 
@@ -55,44 +79,104 @@ ALIVE = jnp.int8(0)
 SUSPECT = jnp.int8(1)
 DOWN = jnp.int8(2)
 
-_STATUS_SHIFT = jnp.uint32(16)
-_INC_SHIFT = jnp.uint32(18)
-_SINCE_MASK = jnp.uint32(0xFFFF)
-_STATUS_MASK = jnp.uint32(3 << 16)
-INC_MAX = (1 << 14) - 1  # saturation bound for the packed inc field
-_INC_MAX = jnp.uint32(INC_MAX)
+
+@dataclasses.dataclass(frozen=True)
+class SwimLayout:
+    """Packed-field geometry of one belief plane dtype. All fields are
+    PYTHON ints so bit arithmetic against the plane stays weakly typed —
+    the ops inherit the plane's dtype instead of promoting to uint32."""
+
+    dtype: object
+    status_shift: int
+    inc_shift: int
+    since_mask: int
+    inc_max: int  # refutation saturation bound for the packed inc field
+
+    @property
+    def status_mask(self) -> int:
+        return 3 << self.status_shift
+
+    @property
+    def down_key(self) -> int:
+        return int(DOWN) << self.status_shift
+
+    @property
+    def full_mask(self) -> int:
+        return (1 << (jnp.dtype(self.dtype).itemsize * 8)) - 1
+
+    # positive-int complements: `~mask` on a python int is negative,
+    # which an unsigned jnp array refuses — these stay in range
+    @property
+    def not_status_mask(self) -> int:
+        return self.full_mask ^ self.status_mask
+
+    @property
+    def inc_only_mask(self) -> int:
+        return self.full_mask ^ (self.status_mask | self.since_mask)
 
 
-def pack_swim(status, inc, since) -> jnp.ndarray:
-    """(status, inc, since) planes → one packed uint32 plane."""
+WIDE_LAYOUT = SwimLayout(
+    dtype=jnp.uint32, status_shift=16, inc_shift=18,
+    since_mask=0xFFFF, inc_max=(1 << 14) - 1,
+)
+NARROW_LAYOUT = SwimLayout(
+    dtype=jnp.uint16, status_shift=8, inc_shift=10,
+    since_mask=0xFF, inc_max=(1 << 6) - 1,
+)
+
+# back-compat: the wide layout's saturation bound (harness rejoin, tests)
+INC_MAX = WIDE_LAYOUT.inc_max
+
+
+def swim_layout(dtype) -> SwimLayout:
+    """The field layout a belief plane uses, keyed by its dtype — state
+    carries the truth, so consumers cannot disagree with the step.
+    Dtypes are static trace-time metadata, never traced values."""
+    if np.dtype(dtype) == np.uint16:
+        return NARROW_LAYOUT
+    return WIDE_LAYOUT
+
+
+def belief_dtype(narrow: bool):
+    return NARROW_LAYOUT.dtype if narrow else WIDE_LAYOUT.dtype
+
+
+def pack_swim(status, inc, since, dtype=jnp.uint32) -> jnp.ndarray:
+    """(status, inc, since) planes → one packed unsigned plane."""
+    lo = swim_layout(dtype)
     return (
-        (jnp.asarray(inc).astype(jnp.uint32) << _INC_SHIFT)
-        | (jnp.asarray(status).astype(jnp.uint32) << _STATUS_SHIFT)
-        | (jnp.asarray(since).astype(jnp.uint32) & _SINCE_MASK)
+        (jnp.asarray(inc).astype(lo.dtype) << lo.inc_shift)
+        | (jnp.asarray(status).astype(lo.dtype) << lo.status_shift)
+        | (jnp.asarray(since).astype(lo.dtype) & lo.since_mask)
     )
 
 
 @flax.struct.dataclass
 class SwimState:
-    p: jnp.ndarray  # (N, N) uint32 — packed (inc, status, since)
+    p: jnp.ndarray  # (N, N) uint32/uint16 — packed (inc, status, since)
 
     # unpacked read-only views (metrics, admin surface, tests)
     @property
     def status(self) -> jnp.ndarray:
-        return ((self.p >> _STATUS_SHIFT) & jnp.uint32(3)).astype(jnp.int8)
+        lo = swim_layout(self.p.dtype)
+        return ((self.p >> lo.status_shift) & 3).astype(jnp.int8)
 
     @property
     def inc(self) -> jnp.ndarray:
-        return (self.p >> _INC_SHIFT).astype(jnp.int32)
+        lo = swim_layout(self.p.dtype)
+        return (self.p >> lo.inc_shift).astype(jnp.int32)
 
     @property
     def since(self) -> jnp.ndarray:
-        return (self.p & _SINCE_MASK).astype(jnp.int32)
+        lo = swim_layout(self.p.dtype)
+        return (self.p & lo.since_mask).astype(jnp.int32)
 
 
-def make_swim_state(num_nodes: int, enabled: bool = True) -> SwimState:
+def make_swim_state(
+    num_nodes: int, enabled: bool = True, narrow: bool = False
+) -> SwimState:
     n = num_nodes if enabled else 1
-    return SwimState(p=jnp.zeros((n, n), jnp.uint32))
+    return SwimState(p=jnp.zeros((n, n), belief_dtype(narrow)))
 
 
 def down_belief_matrix(sw, n: int):
@@ -122,9 +206,8 @@ def view_alive(swim: SwimState) -> jnp.ndarray:
     the reference's members map dropping on MemberDown
     (``handlers.rs:280-330``).
     """
-    return (swim.p & _STATUS_MASK) < (
-        jnp.uint32(DOWN) << _STATUS_SHIFT
-    )
+    lo = swim_layout(swim.p.dtype)
+    return (swim.p & lo.status_mask) < lo.down_key
 
 
 def swim_step(
@@ -137,16 +220,17 @@ def swim_step(
 ):
     """One SWIM protocol round for every node at once."""
     p = swim.p
+    lo = swim_layout(p.dtype)
     n = p.shape[0]
     rows = jnp.arange(n, dtype=jnp.int32)
     k_tgt, k_ind, k_ex = jax.random.split(key, 3)
-    rnd16 = round_idx.astype(jnp.uint32) & _SINCE_MASK
+    rnd = round_idx.astype(lo.dtype) & lo.since_mask
 
     # --- probe: one random target each -------------------------------------
     tgt = jax.random.randint(k_tgt, (n,), 0, n, dtype=jnp.int32)
     cur = p[rows, tgt]  # (N,) packed belief about the probe target
-    cur_status = (cur >> _STATUS_SHIFT) & jnp.uint32(3)
-    probing = alive & (tgt != rows) & (cur_status < jnp.uint32(DOWN))
+    cur_status = (cur >> lo.status_shift) & 3
+    probing = alive & (tgt != rows) & (cur_status < 2)
 
     direct_ack = probing & alive[tgt] & reachable(rows, tgt)
 
@@ -163,33 +247,33 @@ def swim_step(
     failed = probing & ~acked
 
     # --- apply probe outcome to the prober's row ---------------------------
-    newly_suspect = failed & (cur_status == jnp.uint32(ALIVE))
+    newly_suspect = failed & (cur_status == 0)
     # an ack refutes only our own suspicion at the same incarnation
-    refuted = acked & (cur_status == jnp.uint32(SUSPECT))
+    refuted = acked & (cur_status == 1)
     new_status = jnp.where(
         newly_suspect,
-        jnp.uint32(SUSPECT),
-        jnp.where(refuted, jnp.uint32(ALIVE), cur_status),
+        jnp.asarray(1, lo.dtype),
+        jnp.where(refuted, jnp.asarray(0, lo.dtype), cur_status),
     )
-    new_since = jnp.where(newly_suspect, rnd16, cur & _SINCE_MASK)
+    new_since = jnp.where(newly_suspect, rnd, cur & lo.since_mask)
     new_p = (
-        (cur & ~(_STATUS_MASK | _SINCE_MASK))
-        | (new_status << _STATUS_SHIFT)
+        (cur & jnp.asarray(lo.inc_only_mask, lo.dtype))
+        | (new_status << lo.status_shift)
         | new_since
     )
     p = p.at[rows, tgt].set(jnp.where(probing, new_p, cur))
 
     # --- suspicion timeout → down -----------------------------------------
-    status_pl = (p >> _STATUS_SHIFT) & jnp.uint32(3)
-    elapsed = (rnd16 - (p & _SINCE_MASK)) & _SINCE_MASK  # mod-2^16
+    status_pl = (p >> lo.status_shift) & 3
+    elapsed = (rnd - (p & lo.since_mask)) & lo.since_mask  # mod-2^k
     timed_out = (
-        (status_pl == jnp.uint32(SUSPECT))
-        & (elapsed >= jnp.uint32(cfg.swim_suspect_rounds))
+        (status_pl == 1)
+        & (elapsed >= cfg.swim_suspect_rounds)
         & alive[:, None]
     )
     p = jnp.where(
         timed_out,
-        (p & ~_STATUS_MASK) | (jnp.uint32(DOWN) << _STATUS_SHIFT),
+        (p & jnp.asarray(lo.not_status_mask, lo.dtype)) | lo.down_key,
         p,
     )
 
@@ -213,7 +297,6 @@ def swim_step(
     # like foca cycling its piggyback backlog. >= n means full views.
     cols = jnp.arange(n, dtype=jnp.int32)
     bounded = cfg.swim_payload_members < n
-    down_key = jnp.uint32(DOWN) << _STATUS_SHIFT
 
     def payload_block(key_b):
         """(N, N) bool — which member columns each sender's datagram carries."""
@@ -232,7 +315,7 @@ def swim_step(
             & alive[peer]
             & reachable(rows, peer)
             & (peer != rows)
-            & ((p[rows, peer] & _STATUS_MASK) < down_key)
+            & ((p[rows, peer] & lo.status_mask) < lo.down_key)
         )
         can = can1[:, None]
         block = payload_block(kg_bl1)
@@ -248,7 +331,7 @@ def swim_step(
         if block is not None:
             self_of_peer = p[peer, peer]
             p = p.at[rows, peer].max(
-                jnp.where(can1, self_of_peer, jnp.uint32(0))
+                jnp.where(can1, self_of_peer, jnp.asarray(0, lo.dtype))
             )
 
         push_tgt = jax.random.randint(kg_push, (n,), 0, n, dtype=jnp.int32)
@@ -257,17 +340,17 @@ def swim_step(
             & alive[push_tgt]
             & reachable(rows, push_tgt)
             & (push_tgt != rows)
-            & ((p[rows, push_tgt] & _STATUS_MASK) < down_key)
+            & ((p[rows, push_tgt] & lo.status_mask) < lo.down_key)
         )
-        contrib = jnp.where(ok_push[:, None], p, jnp.uint32(0))
+        contrib = jnp.where(ok_push[:, None], p, jnp.asarray(0, lo.dtype))
         block = payload_block(kg_bl2)
         if block is not None:
-            contrib = jnp.where(block, contrib, jnp.uint32(0))
+            contrib = jnp.where(block, contrib, jnp.asarray(0, lo.dtype))
             # sender's own entry always rides the datagram header
             contrib = contrib.at[rows, rows].set(
-                jnp.where(ok_push, p[rows, rows], jnp.uint32(0))
+                jnp.where(ok_push, p[rows, rows], jnp.asarray(0, lo.dtype))
             )
-        best = jnp.zeros((n, n), jnp.uint32).at[
+        best = jnp.zeros((n, n), lo.dtype).at[
             jnp.where(ok_push, push_tgt, n)
         ].max(contrib, mode="drop")
         p = jnp.where(alive[:, None], jnp.maximum(p, best), p)
@@ -309,18 +392,18 @@ def swim_step(
 
     # --- refutation / identity renew --------------------------------------
     self_p = p[rows, rows]
-    need_refute = alive & ((self_p & _STATUS_MASK) > jnp.uint32(0))
-    inc_next = jnp.minimum((self_p >> _INC_SHIFT) + 1, _INC_MAX)
-    refreshed = inc_next << _INC_SHIFT  # status ALIVE, since 0
+    need_refute = alive & ((self_p & lo.status_mask) > 0)
+    inc_next = jnp.minimum((self_p >> lo.inc_shift) + 1, lo.inc_max)
+    refreshed = inc_next << lo.inc_shift  # status ALIVE, since 0
     p = p.at[rows, rows].set(jnp.where(need_refute, refreshed, self_p))
 
-    status_pl = (p >> _STATUS_SHIFT) & jnp.uint32(3)
+    status_pl = (p >> lo.status_shift) & 3
     metrics = {
         "swim_suspects": (
-            (status_pl == jnp.uint32(SUSPECT)) & alive[:, None]
+            (status_pl == 1) & alive[:, None]
         ).sum(dtype=jnp.int32),
         "swim_down": (
-            (status_pl == jnp.uint32(DOWN)) & alive[:, None]
+            (status_pl == 2) & alive[:, None]
         ).sum(dtype=jnp.int32),
         "swim_probe_failures": failed.sum(dtype=jnp.int32),
     }

@@ -9,9 +9,10 @@ belief set is naturally bounded. This module is that bound made
 explicit: each node tracks at most K members,
 
     member (N, K) int32   — tracked member id, -1 = empty (slot 0 = self)
-    belief (N, K) uint32  — the same (inc | status | since) packing as
-                            the full plane, so precedence merges stay
-                            integer max
+    belief (N, K) packed  — the same (inc | status | since) packing as
+                            the full plane (uint32, or uint16 under
+                            ``SimConfig.narrow_state``), so precedence
+                            merges stay integer max
 
 and the protocol per tick:
 
@@ -44,24 +45,13 @@ import jax
 import jax.numpy as jnp
 
 from corro_sim.config import SimConfig
-from corro_sim.membership.swim import (
-    _INC_SHIFT,
-    _SINCE_MASK,
-    _STATUS_MASK,
-    _STATUS_SHIFT,
-    ALIVE,
-    DOWN,
-    INC_MAX,
-    SUSPECT,
-)
-
-_DOWN_KEY = jnp.uint32(DOWN) << _STATUS_SHIFT
+from corro_sim.membership.swim import belief_dtype, swim_layout
 
 
 @flax.struct.dataclass
 class SwimWindowState:
     member: jnp.ndarray  # (N, K) int32, -1 = empty; slot 0 = self
-    belief: jnp.ndarray  # (N, K) uint32 packed (inc | status | since)
+    belief: jnp.ndarray  # (N, K) uint32/uint16 packed (inc|status|since)
     cursor: jnp.ndarray  # (N,) int32 rotating insertion cursor
 
     # unpacked read-only views mirroring SwimState's — admin surfaces,
@@ -70,26 +60,29 @@ class SwimWindowState:
     # ALIVE/0 — mask with ``member >= 0`` where that matters.
     @property
     def status(self) -> jnp.ndarray:
-        return ((self.belief >> _STATUS_SHIFT) & jnp.uint32(3)).astype(
-            jnp.int8
-        )
+        lo = swim_layout(self.belief.dtype)
+        return ((self.belief >> lo.status_shift) & 3).astype(jnp.int8)
 
     @property
     def inc(self) -> jnp.ndarray:
-        return (self.belief >> _INC_SHIFT).astype(jnp.int32)
+        lo = swim_layout(self.belief.dtype)
+        return (self.belief >> lo.inc_shift).astype(jnp.int32)
 
     @property
     def since(self) -> jnp.ndarray:
-        return (self.belief & _SINCE_MASK).astype(jnp.int32)
+        lo = swim_layout(self.belief.dtype)
+        return (self.belief & lo.since_mask).astype(jnp.int32)
 
     @property
     def self_inc(self) -> jnp.ndarray:
         """(N,) each node's own incarnation (slot 0 = self)."""
-        return (self.belief[:, 0] >> _INC_SHIFT).astype(jnp.int32)
+        lo = swim_layout(self.belief.dtype)
+        return (self.belief[:, 0] >> lo.inc_shift).astype(jnp.int32)
 
 
 def make_swim_window_state(
-    num_nodes: int, view_size: int, seed: int = 0, enabled: bool = True
+    num_nodes: int, view_size: int, seed: int = 0, enabled: bool = True,
+    narrow: bool = False,
 ) -> SwimWindowState:
     n = num_nodes if enabled else 1
     k = max(view_size, 2) if enabled else 1
@@ -108,13 +101,13 @@ def make_swim_window_state(
         member = member.at[:, 1:].set((rows + fill) % n)
     return SwimWindowState(
         member=member,
-        belief=jnp.zeros(member.shape, jnp.uint32),
+        belief=jnp.zeros(member.shape, belief_dtype(narrow)),
         cursor=jnp.ones((n,), jnp.int32),
     )
 
 
 def _status(b):
-    return (b >> _STATUS_SHIFT) & jnp.uint32(3)
+    return (b >> swim_layout(b.dtype).status_shift) & 3
 
 
 def membership_view(cfg, swim_state, n):
@@ -140,8 +133,9 @@ def believed_up_pairs(
     may be any equal (broadcastable) shapes; cost is pairs × K dense."""
     mem = st.member[src]  # pairs + (K,)
     bel = st.belief[src]
+    lo = swim_layout(bel.dtype)
     hit = mem == dst[..., None]
-    down = hit & ((bel & _STATUS_MASK) >= _DOWN_KEY)
+    down = hit & ((bel & lo.status_mask) >= lo.down_key)
     return ~down.any(axis=-1)
 
 
@@ -177,7 +171,11 @@ def _merge_block(st, peer, ok, pay_off, pay_k):
         inc_ok, inc_mem, -2
     )[:, None, :]  # (N, K, P)
     best_in = jnp.max(
-        jnp.where(match, inc_bel[:, None, :], jnp.uint32(0)), axis=2
+        jnp.where(
+            match, inc_bel[:, None, :],
+            jnp.asarray(0, dtype=inc_bel.dtype),
+        ),
+        axis=2,
     )
     belief = jnp.maximum(st.belief, best_in)
 
@@ -210,9 +208,10 @@ def swim_window_step(
 ):
     """One windowed SWIM round for every node at once."""
     n, k = st.member.shape
+    lo = swim_layout(st.belief.dtype)
     rows = jnp.arange(n, dtype=jnp.int32)
     k_tgt, k_ind, k_ex, k_ann = jax.random.split(key, 4)
-    rnd16 = round_idx.astype(jnp.uint32) & _SINCE_MASK
+    rnd = round_idx.astype(lo.dtype) & lo.since_mask
     pay = min(max(cfg.swim_payload_members, 2), k)
 
     # --- probe: one random KNOWN target each ---------------------------
@@ -221,7 +220,7 @@ def swim_window_step(
     cur = st.belief[rows, slot]
     probing = (
         alive & (tgt >= 0) & (tgt != rows)
-        & (_status(cur) < jnp.uint32(DOWN))
+        & (_status(cur) < 2)
     )
     tgt_c = jnp.where(tgt >= 0, tgt, 0)
     direct_ack = probing & alive[tgt_c] & reachable(rows, tgt_c)
@@ -240,16 +239,16 @@ def swim_window_step(
     acked = direct_ack | (probing & ind_ok)
     failed = probing & ~acked
 
-    newly_suspect = failed & (_status(cur) == jnp.uint32(ALIVE))
-    refuted_ack = acked & (_status(cur) == jnp.uint32(SUSPECT))
+    newly_suspect = failed & (_status(cur) == 0)
+    refuted_ack = acked & (_status(cur) == 1)
     new_status = jnp.where(
-        newly_suspect, jnp.uint32(SUSPECT),
-        jnp.where(refuted_ack, jnp.uint32(ALIVE), _status(cur)),
+        newly_suspect, jnp.asarray(1, lo.dtype),
+        jnp.where(refuted_ack, jnp.asarray(0, lo.dtype), _status(cur)),
     )
-    new_since = jnp.where(newly_suspect, rnd16, cur & _SINCE_MASK)
+    new_since = jnp.where(newly_suspect, rnd, cur & lo.since_mask)
     new_b = (
-        (cur & ~(_STATUS_MASK | _SINCE_MASK))
-        | (new_status << _STATUS_SHIFT) | new_since
+        (cur & jnp.asarray(lo.inc_only_mask, lo.dtype))
+        | (new_status << lo.status_shift) | new_since
     )
     onehot = jnp.arange(k, dtype=jnp.int32)[None, :] == slot[:, None]
     belief = jnp.where(
@@ -258,15 +257,18 @@ def swim_window_step(
     st = st.replace(belief=belief)
 
     # --- suspicion timeout → down --------------------------------------
-    elapsed = (rnd16 - (st.belief & _SINCE_MASK)) & _SINCE_MASK
+    elapsed = (rnd - (st.belief & lo.since_mask)) & lo.since_mask
     timed_out = (
-        (_status(st.belief) == jnp.uint32(SUSPECT))
-        & (elapsed >= jnp.uint32(cfg.swim_suspect_rounds))
+        (_status(st.belief) == 1)
+        & (elapsed >= cfg.swim_suspect_rounds)
         & alive[:, None]
         & (st.member >= 0)
     )
     st = st.replace(belief=jnp.where(
-        timed_out, (st.belief & ~_STATUS_MASK) | _DOWN_KEY, st.belief
+        timed_out,
+        (st.belief & jnp.asarray(lo.not_status_mask, lo.dtype))
+        | lo.down_key,
+        st.belief,
     ))
 
     # --- pull exchanges with known believed-up members -----------------
@@ -278,7 +280,7 @@ def swim_window_step(
         peer_c = jnp.where(peer >= 0, peer, 0)
         ok = (
             alive & (peer >= 0) & (peer != rows)
-            & ((pb & _STATUS_MASK) < _DOWN_KEY)
+            & ((pb & lo.status_mask) < lo.down_key)
             & alive[peer_c] & reachable(rows, peer_c)
         )
         off = jax.random.randint(kg_o, (n,), 0, k, dtype=jnp.int32)
@@ -301,22 +303,20 @@ def swim_window_step(
 
     # --- refutation / identity renew (slot 0 = self) -------------------
     self_b = st.belief[:, 0]
-    need_refute = alive & ((self_b & _STATUS_MASK) > jnp.uint32(0))
-    inc_next = jnp.minimum(
-        (self_b >> _INC_SHIFT) + 1, jnp.uint32(INC_MAX)
-    )
+    need_refute = alive & ((self_b & lo.status_mask) > 0)
+    inc_next = jnp.minimum((self_b >> lo.inc_shift) + 1, lo.inc_max)
     st = st.replace(belief=st.belief.at[:, 0].set(
-        jnp.where(need_refute, inc_next << _INC_SHIFT, self_b)
+        jnp.where(need_refute, inc_next << lo.inc_shift, self_b)
     ))
 
     tracked = st.member >= 0
     metrics = {
         "swim_suspects": (
-            (_status(st.belief) == jnp.uint32(SUSPECT))
+            (_status(st.belief) == 1)
             & tracked & alive[:, None]
         ).sum(dtype=jnp.int32),
         "swim_down": (
-            (_status(st.belief) >= jnp.uint32(DOWN))
+            (_status(st.belief) >= 2)
             & tracked & alive[:, None]
         ).sum(dtype=jnp.int32),
         "swim_probe_failures": failed.sum(dtype=jnp.int32),

@@ -128,16 +128,48 @@ def test_equivalence_across_repair_switch_boundary():
     assert rp.pipeline["speculative_wasted"] == len(discards)
 
 
-def test_donate_disables_pipeline():
-    """A speculative dispatch must not consume donated buffers: a
-    discarded/re-dispatched chunk would have no input left. Donated
-    runs take the sequential loop and say so."""
-    res = run_sim(
+def test_donate_composes_with_pipeline():
+    """ISSUE 6 acceptance: donation no longer forces the sequential
+    loop. The committed carry is double-buffered (one device-side copy
+    per chunk) so the donating speculative dispatch can consume the
+    original, and the pipelined+donated run is bit-identical to the
+    sequential NON-donated reference — state and metrics."""
+    rd = run_sim(
         CFG, init_state(CFG, seed=0), Schedule(write_rounds=4),
-        max_rounds=32, chunk=8, seed=0, donate=True, pipeline=True,
+        max_rounds=64, chunk=8, seed=0, donate=True, pipeline=True,
     )
-    assert res.pipeline["enabled"] is False
-    assert res.pipeline["disabled_reason"] == "donate"
+    rs = run_sim(
+        CFG, init_state(CFG, seed=0), Schedule(write_rounds=4),
+        max_rounds=64, chunk=8, seed=0, donate=False, pipeline=False,
+    )
+    assert rd.pipeline["enabled"] is True
+    assert "disabled_reason" not in rd.pipeline
+    _assert_bit_identical(rd, rs)
+
+
+def test_donate_pipeline_across_repair_switch():
+    """The donation double-buffer must also survive the program-switch
+    mispredict: the re-dispatch runs from the copy (the original was
+    consumed by the discarded speculative chunk) and still lands on the
+    exact sequential trajectory."""
+    cfg = SimConfig(
+        num_nodes=24, num_rows=16, num_cols=2, log_capacity=128,
+        write_rate=0.5, swim_enabled=True, swim_interval=2,
+        swim_suspect_rounds=3, sync_interval=4, sync_adaptive=True,
+        sync_actor_topk=8, sync_cap_per_actor=2,
+    )
+    rd = run_sim(
+        cfg, init_state(cfg, seed=0), Schedule(write_rounds=8),
+        max_rounds=256, chunk=4, seed=0, min_rounds=16,
+        donate=True, pipeline=True,
+    )
+    rs = run_sim(
+        cfg, init_state(cfg, seed=0), Schedule(write_rounds=8),
+        max_rounds=256, chunk=4, seed=0, min_rounds=16,
+        donate=False, pipeline=False,
+    )
+    assert rd.repair_chunks > 0  # the switch actually happened
+    _assert_bit_identical(rd, rs)
 
 
 def test_speculation_discard_at_convergence():

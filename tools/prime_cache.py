@@ -1,0 +1,92 @@
+"""Prime the persistent XLA compile cache with the tier-1 step matrix.
+
+Dtype packing (``SimConfig.narrow_state``) and op-budget surgery change
+SimState leaves and the step program, which cold-invalidates every
+``.jax_cache`` entry the suite depends on — the first post-merge tier-1
+run would then pay ~30 min of compiles inside pytest and blow the 870 s
+budget. This tool AOT-compiles the hot chunk programs UP FRONT, in its
+own CI step (t1.yml "Prime XLA compile cache"), so the cache is warm
+before the first test collects and the priming wall is visible as its
+own line in the job timeline rather than smeared across test timeouts.
+
+The matrix covers the programs that dominate suite compile wall: the
+canonical audit config and the 32-node CI smoke config, each as
+full + repair chunk programs, wide and narrow state, packed the way
+``run_sim`` dispatches them (``_chunk_runner(packed=True)`` over an
+8-round scan). Compilation is aval-only (``jit(...).lower().compile()``
+— nothing executes, no state is materialized beyond eval_shape).
+
+Usage: ``python tools/prime_cache.py [--chunk 8]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    import jax
+    import jax.numpy as jnp
+
+    from corro_sim.analysis.jaxpr_audit import audit_config
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import _chunk_runner
+    from corro_sim.engine.state import init_state
+
+    smoke = SimConfig(
+        num_nodes=32, num_rows=32, num_cols=2, log_capacity=64,
+        write_rate=0.5, swim_enabled=True, sync_interval=4,
+    )
+    base_cfgs = [("audit", audit_config()), ("smoke", smoke)]
+    walls: list[tuple[str, float]] = []
+    for base_name, base in base_cfgs:
+        for narrow in (False, True):
+            cfg = dataclasses.replace(base, narrow_state=narrow).validate()
+            n = cfg.num_nodes
+            state = jax.eval_shape(lambda cfg=cfg: init_state(cfg, seed=0))
+            keys = jax.ShapeDtypeStruct((chunk, 2), jnp.uint32)
+            alive = jax.ShapeDtypeStruct((chunk, n), jnp.bool_)
+            part = jax.ShapeDtypeStruct((chunk, n), jnp.int32)
+            we = jax.ShapeDtypeStruct((chunk,), jnp.bool_)
+            for repair in (False, True):
+                name = (
+                    f"{base_name}/"
+                    f"{'narrow' if narrow else 'wide'}/"
+                    f"{'repair' if repair else 'full'}"
+                )
+                t0 = time.perf_counter()
+                runner = _chunk_runner(cfg, repair=repair, packed=True)
+                runner.lower(state, keys, alive, part, we).compile()
+                walls.append((name, time.perf_counter() - t0))
+    return walls
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--chunk", type=int, default=8,
+                    help="scan length of the primed chunk programs "
+                         "(t1 smokes and the bench dispatch chunk=8)")
+    args = ap.parse_args(argv)
+    t0 = time.perf_counter()
+    walls = prime_matrix(chunk=args.chunk)
+    for name, w in walls:
+        print(f"primed  {name:<24} {w:6.1f}s")
+    print(f"prime-cache: {len(walls)} programs in "
+          f"{time.perf_counter() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
