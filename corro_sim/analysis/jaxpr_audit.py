@@ -52,21 +52,42 @@ def audit_config():
     )
 
 
-def step_jaxpr(cfg, repair: bool = False):
-    """Trace one ``sim_step`` (or the repair program) to a ClosedJaxpr —
-    abstract avals only, no arrays materialized, nothing compiled."""
+def step_jaxpr(cfg, repair: bool = False, workload: bool = False):
+    """Trace one ``sim_step`` (or the repair / workload-driven program)
+    to a ClosedJaxpr — abstract avals only, no arrays materialized,
+    nothing compiled. ``workload=True`` traces the write-schedule body
+    (:func:`corro_sim.engine.step.make_workload_step`) with one round's
+    schedule arrays as extra inputs — the ON side of the workload
+    vacuity claim."""
     import jax
     import jax.numpy as jnp
 
     from corro_sim.engine.state import init_state
-    from corro_sim.engine.step import make_step
+    from corro_sim.engine.step import make_step, make_workload_step
 
     n = cfg.num_nodes
+    s = cfg.seqs_per_version
     state = jax.eval_shape(lambda: init_state(cfg, seed=0))
     key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
     alive = jax.ShapeDtypeStruct((n,), jnp.bool_)
     part = jax.ShapeDtypeStruct((n,), jnp.int32)
     we = jax.ShapeDtypeStruct((), jnp.bool_)
+
+    if workload:
+        body = make_workload_step(cfg, repair=repair)
+        wl = (
+            jax.ShapeDtypeStruct((n,), jnp.bool_),  # writers
+            jax.ShapeDtypeStruct((n, s), jnp.int32),  # rows
+            jax.ShapeDtypeStruct((n, s), jnp.int32),  # cols
+            jax.ShapeDtypeStruct((n, s), jnp.int32),  # vals
+            jax.ShapeDtypeStruct((n,), jnp.bool_),  # dels
+            jax.ShapeDtypeStruct((n,), jnp.int32),  # ncells
+        )
+
+        def step_wl(st, k, a, p, w, *writes):
+            return body(st, (k, a, p, w, *writes))
+
+        return jax.make_jaxpr(step_wl)(state, key, alive, part, we, *wl)
 
     # the exact scan body the driver iterates (engine/step.py:make_step)
     body = make_step(cfg, repair=repair)
@@ -138,12 +159,16 @@ def vacuity_matrix(cfg) -> tuple[object, list[tuple[str, object, str]]]:
     ]
 
 
-def extra_eqns(cfg_base, cfg_other, repair: bool = False) -> int:
+def extra_eqns(cfg_base, cfg_other, repair: bool = False,
+               workload_other: bool = False) -> int:
     """Eqn-count delta of ``cfg_other``'s step program over the base's
     — the generalized "traces N extra ops" measure the old per-feature
-    guards asserted to be zero."""
+    guards asserted to be zero. ``workload_other`` traces the other
+    side's write-schedule program (the workload feature's ON form)."""
     a = primitive_fingerprint(step_jaxpr(cfg_base, repair=repair))
-    b = primitive_fingerprint(step_jaxpr(cfg_other, repair=repair))
+    b = primitive_fingerprint(
+        step_jaxpr(cfg_other, repair=repair, workload=workload_other)
+    )
     return b["eqns"] - a["eqns"]
 
 
@@ -196,9 +221,12 @@ def step_metric_names(cfg) -> set[str]:
 
 
 def run_step_loop(cfg, rounds: int, write_rounds: int, seed: int,
-                  init_seed: int = 0, part=None):
+                  init_seed: int = 0, part=None, workload=None):
     """The plain jitted step loop the runtime vacuity oracle replays —
-    one canonical runner instead of a private ``_run`` per test file."""
+    one canonical runner instead of a private ``_run`` per test file.
+    ``workload``: a compiled :class:`corro_sim.workload.Workload` whose
+    per-round schedule feeds ``sim_step``'s explicit ``writes=`` port
+    (the workload feature's ON form)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -212,15 +240,29 @@ def run_step_loop(cfg, rounds: int, write_rounds: int, seed: int,
         part if part is not None
         else np.zeros(cfg.num_nodes, np.int32)
     )
-    step = jax.jit(
-        lambda st, k, we: sim_step(cfg, st, k, alive, part, we)
-    )
+    if workload is None:
+        step = jax.jit(
+            lambda st, k, we: sim_step(cfg, st, k, alive, part, we)
+        )
+    else:
+        step = jax.jit(
+            lambda st, k, we, *w: sim_step(
+                cfg, st, k, alive, part, we, writes=w
+            )
+        )
     key = jax.random.PRNGKey(seed)
     metrics = []
     for r in range(rounds):
+        extra = (
+            () if workload is None
+            else tuple(
+                jnp.asarray(x)
+                for x in workload.writes_at(r, cfg.seqs_per_version)
+            )
+        )
         state, m = step(
             state, jax.random.fold_in(key, r),
-            jnp.asarray(r < write_rounds),
+            jnp.asarray(r < write_rounds), *extra,
         )
         metrics.append({k: np.asarray(v) for k, v in m.items()})
     return state, metrics
@@ -230,7 +272,7 @@ def assert_feature_vacuous(base_cfg, on_cfg, *, exclude_leaves=(),
                            extra_metrics=frozenset(),
                            zero_metrics=(), rounds: int = 16,
                            write_rounds: int = 4, seed: int = 3,
-                           part=None) -> None:
+                           part=None, on_workload=None) -> None:
     """THE vacuity oracle (replaces the per-feature guard copies in
     tests/test_probes.py and tests/test_faults.py):
 
@@ -243,19 +285,36 @@ def assert_feature_vacuous(base_cfg, on_cfg, *, exclude_leaves=(),
       own planes) and on every shared metric; its metric surface grows
       by exactly ``extra_metrics``, and ``zero_metrics`` stay zero
       throughout (no phantom effects from a zero-effect config).
+
+    ``on_workload``: the workload engine's form of the claim — the ON
+    side runs the write-schedule program (``sim_step``'s explicit
+    ``writes=`` port) fed by this compiled workload. With an empty
+    schedule the run must be bit-identical to the base sampler with
+    writes disabled — pass ``write_rounds=0`` for that comparison.
     """
     import dataclasses as _dc
 
     import numpy as np
 
-    delta = extra_eqns(base_cfg, on_cfg)
-    assert delta > 0, (
-        "feature-ON config traces the same program as the base — the "
-        "static gate is not actually gating anything"
-    )
+    delta = extra_eqns(base_cfg, on_cfg,
+                       workload_other=on_workload is not None)
+    if on_workload is not None:
+        # the write-schedule program replaces the sampler's RNG draws
+        # with explicit inputs — it must be a DIFFERENT program (either
+        # direction), never silently the same one
+        assert delta != 0, (
+            "workload program traces identical to the sampler program — "
+            "the writes port is not actually a distinct program"
+        )
+    else:
+        assert delta > 0, (
+            "feature-ON config traces the same program as the base — the "
+            "static gate is not actually gating anything"
+        )
     s0, m0 = run_step_loop(base_cfg, rounds, write_rounds, seed,
                            part=part)
-    s1, m1 = run_step_loop(on_cfg, rounds, write_rounds, seed, part=part)
+    s1, m1 = run_step_loop(on_cfg, rounds, write_rounds, seed, part=part,
+                           workload=on_workload)
     for f in _dc.fields(type(s0)):
         if f.name in exclude_leaves:
             continue

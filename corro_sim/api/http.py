@@ -246,11 +246,25 @@ class _Handler(BaseHTTPRequestHandler):
                 name,
                 lambda: self._send_json(self.api.cluster.fault_report()),
             )
+        elif path == "/v1/workload":
+            # the last load-harness run's report (corro_sim/workload/):
+            # sub-delivery latency quantiles, coalescing, query fan — 404
+            # until a load has been driven through this cluster
+            self._traced(name, self._get_workload)
         elif path == "/metrics":
             self._traced(name, self._get_metrics)
         else:
             self._traced(name, lambda: self._send_json(
                 {"error": "not found"}, status=404))
+
+    def _get_workload(self):
+        rep = getattr(self.api.cluster, "workload_report", None)
+        if rep is None:
+            raise _ApiError(
+                404, "no workload has been driven through this cluster "
+                     "(corro-sim load, corro_sim.workload.harness)"
+            )
+        self._send_json(rep)
 
     # POST /v1/transactions — ExecResponse; statement errors come back as
     # per-statement {"error"} results with HTTP 200, like the reference.

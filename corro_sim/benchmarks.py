@@ -719,8 +719,106 @@ def _device_memory_budget(device) -> int:
     return int(0.85 * (limit or 16 * 1024**3))
 
 
+def run_config_6(nodes: int | None = None, subs: int | None = None,
+                 rounds: int | None = None) -> dict:
+    """Config 6 — the production workload leg (ISSUE 7): Zipf+churn
+    traffic from the workload engine driven through BOTH paths.
+
+    - **batched**: ``run_sim(workload=...)`` at ``CORRO_BENCH_NODES``
+      (default 10k) — convergence while the schedule storms, burst/churn
+      onsets annotated into the flight journal;
+    - **live**: the same schedule mapped to SQL against a LiveCluster
+      with ``CORRO_BENCH_SUBS`` (default 1024) live subscriber streams
+      over ``CORRO_BENCH_SUB_QUERIES`` distinct matchers and query fans
+      on the public surfaces, reporting sub-delivery p50/p99 (rounds and
+      wall) — the "subscription latency while the cluster is busy"
+      number the ROADMAP's traffic item calls for. The live half runs at
+      ``CORRO_BENCH_LIVE_NODES`` (default: min(nodes, 256)) — per-round
+      host ticks at 10k nodes are a dissemination measurement, not a
+      serving one; the batched half owns that scale.
+
+    ``CORRO_BENCH_WORKLOAD`` overrides the spec (default Zipf+churn).
+    """
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import run_sim
+    from corro_sim.engine.state import init_state
+    from corro_sim.workload import make_workload
+    from corro_sim.workload.harness import run_live_load
+
+    n = nodes or int(os.environ.get("CORRO_BENCH_NODES", "10000"))
+    rounds = rounds or int(os.environ.get("CORRO_BENCH_ROUNDS", "64"))
+    spec = os.environ.get(
+        "CORRO_BENCH_WORKLOAD",
+        "zipf:alpha=1.1,rate=0.3,keys=2048"
+        "+churn_storm:waves=6,batch=64,keys=2048",
+    )
+    subs_n = subs or int(os.environ.get("CORRO_BENCH_SUBS", "1024"))
+    sub_queries = int(os.environ.get("CORRO_BENCH_SUB_QUERIES", "64"))
+    live_n = int(os.environ.get(
+        "CORRO_BENCH_LIVE_NODES", str(min(n, 256))
+    ))
+
+    # ---- batched: convergence under storm at full scale -----------------
+    wl = make_workload(spec, n, rounds=rounds, seed=0)
+    cfg = SimConfig(
+        num_nodes=n,
+        num_rows=max(wl.key_universe(), 256),
+        num_cols=2,
+        log_capacity=max(rounds * 2, 256),
+        pend_slots=8,
+        emit_slots=4,
+        fanout=3,
+        sync_interval=4,
+        sync_adaptive=True,
+    ).validate()
+    t0 = time.perf_counter()
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), max_rounds=4096, chunk=8, seed=0,
+        workload=wl, flight=_FLIGHT, pipeline=_bench_pipeline(),
+    )
+    batched = {
+        "nodes": n,
+        "spec": wl.spec,
+        "schedule_writes": wl.total_writes,
+        "schedule_deletes": wl.total_deletes,
+        "converged_round": res.converged_round,
+        "rounds_run": res.rounds,
+        "wall_per_round_ms": round(res.wall_per_round_ms, 3),
+        "changes_applied": int(res.metrics["fresh"].sum())
+        + int(res.metrics["sync_versions"].sum()),
+        "workload_events": len(wl.events),
+        "pipeline": res.pipeline,
+        "wall_seconds": round(time.perf_counter() - t0, 1),
+        **_step_eqns(cfg),
+    }
+
+    # ---- live: sub-delivery latency under the same traffic shape --------
+    wl_live = make_workload(spec, live_n, rounds=rounds, seed=0)
+    live = run_live_load(
+        wl_live,
+        subs=sub_queries,
+        subscribers_per_sub=max(1, subs_n // max(sub_queries, 1)),
+        latency_subs=64,
+        queries_per_round=int(
+            os.environ.get("CORRO_BENCH_QUERIES_PER_ROUND", "4")
+        ),
+        seed=0,
+        settle_rounds=512,
+    ).as_json()
+
+    return {
+        "metric": "workload_engine_zipf_churn",
+        "value": live["latency_rounds"]["p99"],
+        "unit": "sub_delivery_p99_rounds",
+        "converged": res.converged_round is not None,
+        "batched": batched,
+        "live": live,
+    }
+
+
 CONFIGS = {0: run_north_star, 1: run_config_1, 2: run_config_2,
-           3: run_config_3, 4: run_config_4, 5: run_config_5}
+           3: run_config_3, 4: run_config_4, 5: run_config_5,
+           6: run_config_6}
 
 
 def _device_preflight(timeout_s: int = 240, attempts: int = 3) -> str | None:

@@ -310,6 +310,114 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     return 3 if any_unconverged else 0
 
 
+def _cmd_load(args: argparse.Namespace) -> int:
+    """`corro-sim load` — drive a production-shaped traffic workload
+    (corro_sim/workload/, doc/workloads.md) through the simulator.
+
+    Paths: `batched` runs the compiled write schedule through
+    ``run_sim``'s scan (convergence under load); `live` maps the same
+    schedule to SQL against a LiveCluster with concurrent subscriptions
+    + query fans (sub-delivery latency under load); `both` (default)
+    runs both and merges the reports. Exit 3 when the batched path fails
+    to converge inside the round budget."""
+    import time as _time
+
+    from corro_sim.workload import assert_workload_vacuous, make_workload
+
+    wl = make_workload(
+        args.spec, args.nodes, rounds=args.rounds, seed=args.seed
+    )
+    report: dict = {
+        "spec": wl.spec,
+        "nodes": args.nodes,
+        "load_rounds": wl.rounds,
+        "schedule": {
+            "writes": wl.total_writes,
+            "deletes": wl.total_deletes,
+            "events": len(wl.events),
+            "key_universe": wl.key_universe(),
+        },
+    }
+    rc = 0
+    if args.verify_vacuous:
+        # the workload-off vacuity claim, verified in-process: the
+        # all-idle schedule runs bit-identical to the disabled sampler
+        # (the OFF program itself is pinned by `corro-sim audit`)
+        t0 = _time.perf_counter()
+        assert_workload_vacuous()
+        report["vacuous"] = True
+        report["vacuity_check_seconds"] = round(
+            _time.perf_counter() - t0, 2
+        )
+    if args.path in ("batched", "both"):
+        import dataclasses
+
+        import numpy as np
+
+        from corro_sim.engine import init_state, run_sim
+        from corro_sim.io.config_file import load_config
+
+        cfg = load_config(args.config)
+        cfg = dataclasses.replace(
+            cfg,
+            num_nodes=args.nodes,
+            num_rows=max(args.rows or 0, wl.key_universe(), 16),
+            num_cols=max(args.cols or cfg.num_cols, 1),
+            seqs_per_version=max(
+                cfg.seqs_per_version, wl.cells_width
+            ),
+        ).validate()
+        res = run_sim(
+            cfg,
+            init_state(cfg, seed=args.seed),
+            max_rounds=args.max_rounds,
+            chunk=args.chunk,
+            seed=args.seed,
+            workload=wl,
+        )
+        report["batched"] = {
+            "converged_round": res.converged_round,
+            "rounds_run": res.rounds,
+            "writes": int(res.metrics["writes"].sum()),
+            "deletes": int(res.metrics["deletes"].sum()),
+            "changes_applied": int(res.metrics["fresh"].sum())
+            + int(res.metrics["sync_versions"].sum()),
+            "final_gap": float(np.asarray(res.metrics["gap"])[-1]),
+            "wall_per_round_ms": round(res.wall_per_round_ms, 3),
+            "workload_events_annotated": len(
+                res.flight.events("workload_event")
+            ),
+            "poisoned": res.poisoned,
+        }
+        if args.flight_out:
+            res.flight.dump(args.flight_out)
+            report["flight"] = args.flight_out
+        if res.poisoned:
+            rc = 4
+        elif res.converged_round is None:
+            rc = 3
+    if args.path in ("live", "both"):
+        from corro_sim.workload.harness import run_live_load
+
+        rep = run_live_load(
+            wl,
+            subs=args.subs,
+            subscribers_per_sub=args.subscribers,
+            queries_per_round=args.queries_per_round,
+            http=args.http,
+            pg=args.pg,
+            seed=args.seed,
+            settle_rounds=args.settle_rounds,
+        )
+        report["live"] = rep.as_json()
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=2)
+            fh.write("\n")
+    print(json.dumps(report, indent=2))
+    return rc
+
+
 def _cmd_lint(args: argparse.Namespace) -> int:
     """`corro-sim lint` — the AST trace-safety analyzer
     (corro_sim/analysis/, doc/static_analysis.md). Pure-AST: no jax
@@ -646,6 +754,58 @@ def build_parser() -> argparse.ArgumentParser:
              "CORRO_SIM_TRANSFER_GUARD=1)",
     )
     pr.set_defaults(fn=_cmd_run)
+
+    plo = sub.add_parser(
+        "load",
+        help="drive a production-shaped traffic workload (Zipf, bursts, "
+             "churn storms) through the batched and/or live paths",
+    )
+    plo.add_argument(
+        "spec",
+        help="workload spec `name[:k=v,...][+name2...]` "
+             "(corro_sim/workload/: zipf, uniform, burst, multiwriter, "
+             "churn_storm; `+` composes — doc/workloads.md)",
+    )
+    plo.add_argument("--config", help="TOML config file ([sim] table)")
+    plo.add_argument("--nodes", type=int, default=32)
+    plo.add_argument("--rounds", type=int, default=32,
+                     help="load-phase rounds to schedule")
+    plo.add_argument("--rows", type=int,
+                     help="row-slot capacity (default: the schedule's "
+                          "key universe)")
+    plo.add_argument("--cols", type=int)
+    plo.add_argument("--seed", type=int, default=0)
+    plo.add_argument(
+        "--path", choices=("batched", "live", "both"), default="both",
+        help="batched = run_sim convergence under load; live = "
+             "LiveCluster + subscriptions + query fans (sub-delivery "
+             "latency)",
+    )
+    plo.add_argument("--max-rounds", type=int, default=4096,
+                     help="batched-path round budget")
+    plo.add_argument("--chunk", type=int, default=16)
+    plo.add_argument("--subs", type=int, default=16,
+                     help="distinct subscription queries (live path)")
+    plo.add_argument("--subscribers", type=int, default=1,
+                     help="subscriber streams per subscription")
+    plo.add_argument("--queries-per-round", type=int, default=0,
+                     help="one-shot queries fanned per round")
+    plo.add_argument("--http", action="store_true",
+                     help="fan queries through a real HTTP API server")
+    plo.add_argument("--pg", action="store_true",
+                     help="fan queries through a real pgwire server")
+    plo.add_argument("--settle-rounds", type=int, default=256,
+                     help="post-load rounds allowed for the live cluster "
+                          "to drain")
+    plo.add_argument("--verify-vacuous", action="store_true",
+                     help="prove the workload-off claim in-process: an "
+                          "all-idle schedule must run bit-identical to "
+                          "the disabled sampler")
+    plo.add_argument("--flight-out",
+                     help="dump the batched run's flight timeline "
+                          "(ND-JSON) with workload_event annotations")
+    plo.add_argument("--out", help="also write the report JSON here")
+    plo.set_defaults(fn=_cmd_load)
 
     ps = sub.add_parser(
         "soak",

@@ -203,8 +203,18 @@ def _chunk_runner(
     shardings=None,
     repair: bool = False,
     packed: bool = False,
+    workload: bool = False,
 ):
-    body = make_step(cfg, repair=repair)
+    # a workload run scans a DIFFERENT program (the write schedule rides
+    # the scan inputs into sim_step's explicit writes= port); with no
+    # workload armed the body below is exactly the pre-workload one, so
+    # the hot step program stays byte-identical (jaxpr golden).
+    if workload:
+        from corro_sim.engine.step import make_workload_step
+
+        body = make_workload_step(cfg, repair=repair)
+    else:
+        body = make_step(cfg, repair=repair)
 
     # Buffer donation halves peak memory (state in+out aliased) but the
     # axon TPU-tunnel platform currently miscompiles donated calls; keep it
@@ -213,8 +223,11 @@ def _chunk_runner(
     meta: dict = {}
 
     @functools.partial(jax.jit, **kwargs)
-    def run_chunk(state, keys, alive, part, we):
-        out, m = jax.lax.scan(body, state, (keys, alive, part, we))
+    def run_chunk(state, keys, alive, part, we, *wl):
+        # `wl` is the workload's round-major write schedule (6 arrays)
+        # when one is armed, empty otherwise — same traced program as the
+        # fixed-arity runner in the empty case
+        out, m = jax.lax.scan(body, state, (keys, alive, part, we, *wl))
         if shardings is not None:
             # Pin the carry's output shardings to the input layout so the
             # AOT-compiled executable accepts chunk N's output as chunk
@@ -298,6 +311,7 @@ def run_sim(
     invariants=None,
     pipeline: bool | None = None,
     transfer_guard: bool | None = None,
+    workload=None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -342,8 +356,27 @@ def run_sim(
     instead of silently re-serializing dispatch. ``None`` follows the
     ``CORRO_SIM_TRANSFER_GUARD`` env var (the CI smoke arms it);
     default off.
+
+    ``workload``: a compiled :class:`corro_sim.workload.Workload` — its
+    precomputed per-round write schedule rides the scan inputs into
+    ``sim_step``'s explicit ``writes=`` port (replacing the uniform
+    sampler), exactly like fault-scenario alive/part rows ride theirs.
+    The schedule's load phase counts as write rounds for convergence
+    gating and the repair-program switch; its burst/churn events land in
+    the flight record as ``workload_event`` annotations. ``None`` (the
+    default) builds the exact pre-workload chunk programs — the step
+    program is byte-identical with no workload armed (jaxpr golden +
+    ``assert_feature_vacuous``).
     """
     schedule = schedule or Schedule()
+    if workload is not None:
+        workload.validate(cfg)
+        # the load phase is the write phase: repair stays vetoed and
+        # convergence is only tested once the schedule stops writing
+        if schedule.write_rounds < workload.rounds:
+            schedule = dataclasses.replace(
+                schedule, write_rounds=workload.rounds
+            )
     if flight is None:
         flight = FlightRecorder()
     if pipeline is None:
@@ -355,6 +388,7 @@ def run_sim(
         driver="run_sim", nodes=cfg.num_nodes, chunk=chunk, seed=seed,
         max_rounds=max_rounds, pipeline=bool(pipeline),
         **({"scenario": schedule.name} if schedule.name else {}),
+        **({"workload": workload.spec} if workload is not None else {}),
     )
     if min_rounds is None:
         min_rounds = schedule.write_rounds
@@ -381,8 +415,32 @@ def run_sim(
         # runs always take the XLA scatter merge path.
         cfg = dataclasses.replace(cfg, merge_kernel="off")
     runner = _chunk_runner(cfg, donate=donate, shardings=shardings,
-                           packed=True)
+                           packed=True, workload=workload is not None)
     root = jax.random.PRNGKey(seed)
+
+    _idle_writes = None
+
+    def _stage_workload(base: int):
+        """The chunk's write-schedule rows, staged for the scan — ()
+        when no workload is armed (args unchanged from the pre-workload
+        drivers). Chunks past the schedule's end all stage the same
+        all-idle arrays; they are uploaded ONCE and reused, so the
+        convergence tail pays no per-chunk host→device schedule
+        transfer (the xs are never donated — reuse is safe)."""
+        nonlocal _idle_writes
+        if workload is None:
+            return ()
+        if base >= workload.rounds:
+            if _idle_writes is None:
+                _idle_writes = tuple(
+                    jnp.asarray(x) for x in
+                    workload.slice(base, chunk, cfg.seqs_per_version)
+                )
+            return _idle_writes
+        return tuple(
+            jnp.asarray(x)
+            for x in workload.slice(base, chunk, cfg.seqs_per_version)
+        )
 
     # Post-quiesce phase specialization: once the schedule stops writing AND
     # the gossip rings report drained (pend_live == 0), the write/emit/
@@ -492,7 +550,7 @@ def run_sim(
         nonlocal repair_runner, repair_compiled
         repair_runner = _chunk_runner(
             cfg, donate=donate, shardings=shardings, repair=True,
-            packed=True,
+            packed=True, workload=workload is not None,
         )
         repair_compiled = _compile_program("repair", repair_runner, args)
 
@@ -545,6 +603,17 @@ def run_sim(
                 labels=f'{{kind="{ev_name}"}}',
                 help_="scheduled fault events executed, by kind",
             )
+        if workload is not None:
+            # burst onsets / churn waves — the traffic-side provenance
+            for ev_r, ev_name, ev_attrs in workload.events_in(base, chunk):
+                flight.annotate(ev_r + 1, "workload_event", kind=ev_name,
+                                **ev_attrs)
+                counters.inc(
+                    "corro_workload_events_total",
+                    labels=f'{{kind="{ev_name}"}}',
+                    help_="scheduled workload events executed, by kind "
+                          "(corro_sim/workload/)",
+                )
         if "fault_lost" in m:
             for mk, cname in (
                 ("fault_lost", "corro_fault_lost_total"),
@@ -709,6 +778,7 @@ def run_sim(
                     args = (
                         state, keys, jnp.asarray(alive),
                         jnp.asarray(part), jnp.asarray(we),
+                        *_stage_workload(rounds),
                     )
                 use_repair = _select_repair(last_pend_live, we)
                 if use_repair and repair_runner is None:
@@ -802,6 +872,7 @@ def run_sim(
                     args_ = (
                         state_in, keys_, jnp.asarray(alive_),
                         jnp.asarray(part_), jnp.asarray(we_),
+                        *_stage_workload(base_),
                     )
                 use_repair_ = (
                     _select_repair(known_pend_live, we_)

@@ -8,10 +8,13 @@ commits version ``r+1`` of every actor locally (write path of
 it for gossip; dissemination, delivery, merge and anti-entropy then run the
 normal :func:`~corro_sim.engine.step.sim_step` machinery until convergence.
 
-Fidelity note: replay enqueues fresh changesets into the writer's pending
-ring only (the batched dissemination path, ``broadcast/mod.rs:501-517``);
-the ring-0 eager fast path is exercised by the synthetic-workload engine,
-not by replay — it changes propagation latency by <1 round, not outcomes.
+Injection is the shared :func:`corro_sim.workload.inject.inject_round`
+helper — the synthetic-workload engine's module owns it, so replayed real
+traces and synthesized load cannot drift apart (the old fidelity caveat —
+replay skipping the eager fast path while synthetic load exercised it —
+is now a tested invariant: tests/test_workload.py pins final-state
+identity between a schedule injected here and the same schedule driven
+through ``sim_step``'s write port).
 """
 
 from __future__ import annotations
@@ -25,107 +28,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from corro_sim.config import SimConfig
-from corro_sim.core.changelog import append_changesets
-from corro_sim.core.compaction import update_ownership
-from corro_sim.core.crdt import NEG, apply_cell_changes
 from corro_sim.engine.state import SimState, init_state
-from corro_sim.engine.step import _tile_chunks, sim_step
-from corro_sim.gossip.broadcast import enqueue_broadcasts
+from corro_sim.engine.step import sim_step
 from corro_sim.io.traces import EncodedTrace
+from corro_sim.workload.inject import inject_round
 
-
-def inject_round(
-    cfg: SimConfig,
-    state: SimState,
-    valid: jnp.ndarray,  # (A,) bool
-    empty: jnp.ndarray,  # (A,) bool
-    ts: jnp.ndarray,  # (A,) int32 — EmptySet ts for cleared lanes (-1 none)
-    ncells: jnp.ndarray,  # (A,) int32
-    row: jnp.ndarray,  # (A, S) int32
-    col: jnp.ndarray,  # (A, S) int32
-    vr: jnp.ndarray,  # (A, S) int32
-    cv: jnp.ndarray,  # (A, S) int32
-    cl: jnp.ndarray,  # (A, S) int32
-) -> SimState:
-    """Commit one trace round: local apply + log append + gossip enqueue.
-
-    ``A`` (the trace's actor count) may be smaller than ``cfg.num_nodes``;
-    actor ordinal == node ordinal (ActorId is the crsql site id,
-    ``corro-types/src/actor.rs:26``). Delete lanes are identified per cell
-    (``vr == NEG`` — cl-only changes), so one changeset may mix a row
-    tombstone with value writes to other rows, as one reference transaction
-    can.
-    """
-    a, s = row.shape
-    actor = jnp.arange(a, dtype=jnp.int32)
-    has_cells = valid & ~empty
-
-    cell_live = (
-        has_cells[:, None]
-        & (jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None])
-    )
-    site = jnp.where(vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (a, s)))
-
-    # Local apply on the writer's own table (trace carries authoritative
-    # cv/cl — no recomputation, unlike the synthetic local_write path).
-    table = apply_cell_changes(
-        state.table,
-        jnp.broadcast_to(actor[:, None], (a, s)).reshape(-1),
-        row.reshape(-1),
-        col.reshape(-1),
-        cv.reshape(-1),
-        vr.reshape(-1),
-        site.reshape(-1),
-        cl.reshape(-1),
-        cell_live.reshape(-1),
-    )
-
-    log, ver = append_changesets(
-        state.log, actor, row, col, vr, cv, cl,
-        jnp.where(empty, 0, ncells), valid,
-    )
-    # Cleared versions occupy their slot but deliver nothing; each keeps
-    # the ts its EmptySet carried (message-granular, handlers.rs:524-719).
-    # Ownership-fold clearings during replay stay unstamped (-1): the
-    # trace carries no clock for them, and an unstamped EmptySet simply
-    # never advances a receiver's last_cleared (conservative).
-    aidx = jnp.where(valid & empty, actor, log.head.shape[0])
-    slot = (ver - 1) % log.capacity
-    log = log.replace(cleared=log.cleared.at[aidx, slot].set(True, mode="drop"))
-    cleared_hlc = state.cleared_hlc.at[aidx, slot].max(ts, mode="drop")
-
-    book = state.book.replace(
-        head=state.book.head.at[actor, actor].add(valid.astype(jnp.int32))
-    )
-
-    own, log = update_ownership(
-        state.own,
-        log,
-        jnp.broadcast_to(actor[:, None], (a, s)).reshape(-1),
-        jnp.broadcast_to(ver[:, None], (a, s)).reshape(-1),
-        row.reshape(-1),
-        col.reshape(-1),
-        cv.reshape(-1),
-        vr.reshape(-1),
-        site.reshape(-1),
-        cl.reshape(-1),
-        cell_live.reshape(-1),
-        (vr == NEG).reshape(-1),  # per-lane tombstone marker
-    )
-
-    # Enqueue every chunk of the fresh version into the writer's own ring.
-    q_dst, q_src, q_ver, q_valid, q_chunk = _tile_chunks(
-        cfg.chunks_per_version, actor, actor, ver, valid
-    )
-    gossip = enqueue_broadcasts(
-        state.gossip, q_dst, q_src, q_ver, q_chunk, q_valid,
-        cfg.max_transmissions,
-    )
-
-    return state.replace(
-        table=table, book=book, log=log, own=own, gossip=gossip,
-        cleared_hlc=cleared_hlc,
-    )
+__all__ = ["ReplayResult", "inject_round", "read_table", "replay"]
 
 
 @dataclasses.dataclass

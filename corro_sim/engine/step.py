@@ -66,6 +66,27 @@ def make_step(cfg: SimConfig, repair: bool = False):
     return body
 
 
+def make_workload_step(cfg: SimConfig, repair: bool = False):
+    """The workload-driven scan body: ``(state, (key, alive, part,
+    write_enable, writers, rows, cols, vals, dels, ncells)) -> (state,
+    metrics)`` — a compiled write schedule (:mod:`corro_sim.workload`)
+    rides the scan inputs into ``sim_step``'s explicit ``writes=`` port
+    (the live agent's port), replacing the uniform sampler. A separate
+    program from :func:`make_step` by construction: with no workload
+    armed the driver builds :func:`make_step` exactly as before, so the
+    hot step program stays byte-identical (the jaxpr golden pins it;
+    ``assert_feature_vacuous`` proves the zero-schedule run bit-equal)."""
+
+    def body(state, inp):
+        key, alive, part, we, *writes = inp
+        return sim_step(
+            cfg, state, key, alive, part, we,
+            writes=None if repair else tuple(writes), repair=repair,
+        )
+
+    return body
+
+
 def _reachable_fn(alive: jnp.ndarray, part: jnp.ndarray):
     """Ground-truth link predicate: both up and in the same partition."""
 

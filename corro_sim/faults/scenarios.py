@@ -32,6 +32,7 @@ import dataclasses
 import numpy as np
 
 from corro_sim.config import SimConfig
+from corro_sim.utils.spec import format_spec, parse_spec
 
 __all__ = [
     "SCENARIOS",
@@ -84,10 +85,7 @@ class Scenario:
 
     @property
     def spec(self) -> str:
-        if not self.params:
-            return self.name
-        kv = ",".join(f"{k}={v}" for k, v in sorted(self.params.items()))
-        return f"{self.name}:{kv}"
+        return format_spec(self.name, self.params)
 
     @property
     def heal_round(self) -> int | None:
@@ -345,31 +343,13 @@ SOAK_DEFAULT = (
 
 
 def parse_scenario_spec(spec: str) -> tuple[str, dict]:
-    """``name[:k=v,...]`` → (name, params). Values parse as int, then
-    float, then bare string."""
-    name, _, kv = spec.partition(":")
-    name = name.strip()
+    """``name[:k=v,...]`` → (name, params) — the shared grammar
+    (:mod:`corro_sim.utils.spec`) validated against the scenario table."""
+    name, params = parse_spec(spec)
     if name not in SCENARIOS:
         raise ValueError(
             f"unknown scenario {name!r} (have: {', '.join(sorted(SCENARIOS))})"
         )
-    params: dict = {}
-    if kv.strip():
-        for item in kv.split(","):
-            k, eq, v = item.partition("=")
-            if not eq:
-                raise ValueError(
-                    f"scenario param {item!r} must be key=value"
-                )
-            v = v.strip()
-            try:
-                parsed: object = int(v)
-            except ValueError:
-                try:
-                    parsed = float(v)
-                except ValueError:
-                    parsed = v
-            params[k.strip()] = parsed
     return name, params
 
 

@@ -166,6 +166,8 @@ class LiveCluster:
         # scenarios never leaks the previous one's knobs
         self._baseline_faults = self.cfg.faults
         self._sub_queues: dict[str, list] = {}  # sub_id -> [deque]
+        self.workload_report: dict | None = None  # last load-harness run
+        # (corro_sim/workload/harness.py) — served at GET /v1/workload
         # per-queue health counters (corro.runtime.channel.* analog)
         from corro_sim.utils.metrics import ChannelMetrics
 
@@ -1122,6 +1124,11 @@ class LiveCluster:
         events = self.subs.step(self.state.table)
         delivered = False
         for sub_id, evs in events.items():
+            for ev in evs:
+                # emit-round stamp: the workload engine's delivery-latency
+                # clock (change commit round -> this round); exact even
+                # when a subscriber drains its queue rounds later
+                ev.round = self._rounds_ticked
             queues = self._sub_queues.get(sub_id, ())
             for q in queues:  # live streams
                 q.extend(evs)
@@ -1140,6 +1147,19 @@ class LiveCluster:
                     for q in qs
                 ),
             )
+
+    @property
+    def converged(self) -> bool:
+        """Every live node caught up RIGHT NOW: version-head gap 0 AND
+        no buffered partial versions AND no host-side pending
+        changesets — THE convergence predicate (``run_until_converged``
+        and the workload load harness both gate on this; keep them on
+        one definition)."""
+        return (
+            self._gap == 0.0
+            and self._partials == 0.0
+            and not any(self._pending)
+        )
 
     def run_until_converged(self, max_rounds: int = 512) -> int | None:
         """Tick until every live node caught up; returns the round count.
@@ -1169,11 +1189,7 @@ class LiveCluster:
                 # reuse the packed transfer instead of re-reading state
                 if self._log_poisoned:
                     return None  # permanent: check .log_poisoned, don't retry
-                if (
-                    self._gap == 0.0
-                    and self._partials == 0.0
-                    and not any(self._pending)
-                ):
+                if self.converged:
                     return done
         return None
 

@@ -83,6 +83,12 @@ class FlightRecorder:
     def sink_path(self) -> str | None:
         return self._sink_path
 
+    @property
+    def meta(self) -> dict:
+        """The run metadata (driver, nodes, scenario/workload specs…)."""
+        with self._lock:
+            return dict(self._meta)
+
     # ------------------------------------------------------------ recording
     def set_meta(self, **kw) -> None:
         with self._lock:
@@ -123,6 +129,17 @@ class FlightRecorder:
             ev = {"r": int(round_idx), "name": name, "attrs": attrs}
             self._events.append(ev)
             self._journal({"t": "event", **ev})
+
+    def events(self, name: str | None = None) -> list[dict]:
+        """Annotation events (optionally filtered by name), oldest
+        first. The event ring is bounded (maxlen 4096): counts derived
+        from this are of RETAINED events — a very long, busy run may
+        have evicted early ones."""
+        with self._lock:
+            return [
+                dict(e) for e in self._events
+                if name is None or e["name"] == name
+            ]
 
     def record_phase(self, name: str, seconds: float) -> None:
         """Accumulate host wall-clock into a named phase bucket."""

@@ -104,6 +104,9 @@ class SubEvent:
     rowid: int  # row slot (stable per run)
     cells: list  # decoded projected values (pk… then selected columns)
     change_id: int
+    round: int | None = None  # simulation round the event was emitted at
+    # (stamped by the harness notify path; not part of the wire shape —
+    # the workload engine's delivery-latency clock, doc/workloads.md)
 
     def as_json(self):
         # QueryEvent::Change serde shape: [type, rowid, cells, change_id];

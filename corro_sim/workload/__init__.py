@@ -1,0 +1,50 @@
+"""Production workload engine: traffic generators + load harness.
+
+See :mod:`corro_sim.workload.generators` for the generator catalog and
+doc/workloads.md for the spec grammar, latency metrics and bench
+workflow. The load harness (:mod:`corro_sim.workload.harness`) imports
+lazily — pulling in the generators must not drag the live-cluster stack
+into jitted contexts.
+"""
+
+from corro_sim.workload.generators import (
+    WORKLOADS,
+    Workload,
+    empty_workload,
+    make_workload,
+    parse_workload_spec,
+)
+
+__all__ = [
+    "WORKLOADS",
+    "Workload",
+    "assert_workload_vacuous",
+    "empty_workload",
+    "make_workload",
+    "parse_workload_spec",
+]
+
+
+def assert_workload_vacuous(cfg=None, rounds: int = 10) -> None:
+    """The workload engine's vacuity claim, runnable anywhere (CLI
+    ``corro-sim load --verify-vacuous``, tests): the write-schedule
+    program is a DISTINCT program, and fed an all-idle schedule it is
+    bit-identical — every state leaf, every metric — to the sampler
+    program with writes disabled. The workload-OFF program itself is
+    pinned byte-for-byte by the jaxpr golden (``corro-sim audit``)."""
+    from corro_sim.analysis.jaxpr_audit import assert_feature_vacuous
+
+    if cfg is None:
+        from corro_sim.config import SimConfig
+
+        # the exact shape tests/test_faults.py's vacuity oracle runs —
+        # the base-side per-round program is then one shared compile
+        # across every vacuity caller (warm .jax_cache discipline)
+        cfg = SimConfig(
+            num_nodes=12, num_rows=16, num_cols=2, log_capacity=128,
+            write_rate=0.6,
+        ).validate()
+    assert_feature_vacuous(
+        cfg, cfg, on_workload=empty_workload(cfg.num_nodes, rounds),
+        write_rounds=0, rounds=rounds,
+    )

@@ -1,0 +1,186 @@
+"""Shared changeset injection: one code path for replay and synthetic load.
+
+Two producers feed committed changesets into the dissemination machinery
+from outside the step's own write sampler:
+
+- **trace replay** (:mod:`corro_sim.engine.replay`) — real-cluster
+  changesets carrying authoritative ``cv``/``cl``/``vr`` stamps, injected
+  between rounds via :func:`inject_round`;
+- **the synthetic workload engine** (:mod:`corro_sim.workload`) — compiled
+  write schedules threaded through ``sim_step``'s explicit ``writes=``
+  port (the live agent's port), where the step's own ``local_write``
+  derives the stamps from the writer's current causal state.
+
+Both used to live apart (replay owned a private ``inject_round``; the
+docstring disclaimed the divergence as a "fidelity note"). This module is
+now the single home: replay imports :func:`inject_round` from here, and
+:func:`workload_as_injection` maps a workload schedule into the exact
+trace form — so "replay a synthesized workload" and "run the workload
+through the step's write port" are provably the same path
+(tests/test_workload.py pins final-state identity between the two).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.core.changelog import append_changesets
+from corro_sim.core.compaction import update_ownership
+from corro_sim.core.crdt import NEG, apply_cell_changes
+from corro_sim.engine.state import SimState
+from corro_sim.gossip.broadcast import enqueue_broadcasts
+
+__all__ = ["inject_round", "workload_as_injection"]
+
+
+def inject_round(
+    cfg: SimConfig,
+    state: SimState,
+    valid: jnp.ndarray,  # (A,) bool
+    empty: jnp.ndarray,  # (A,) bool
+    ts: jnp.ndarray,  # (A,) int32 — EmptySet ts for cleared lanes (-1 none)
+    ncells: jnp.ndarray,  # (A,) int32
+    row: jnp.ndarray,  # (A, S) int32
+    col: jnp.ndarray,  # (A, S) int32
+    vr: jnp.ndarray,  # (A, S) int32
+    cv: jnp.ndarray,  # (A, S) int32
+    cl: jnp.ndarray,  # (A, S) int32
+) -> SimState:
+    """Commit one changeset round: local apply + log append + gossip enqueue.
+
+    ``A`` (the trace's actor count) may be smaller than ``cfg.num_nodes``;
+    actor ordinal == node ordinal (ActorId is the crsql site id,
+    ``corro-types/src/actor.rs:26``). Delete lanes are identified per cell
+    (``vr == NEG`` — cl-only changes), so one changeset may mix a row
+    tombstone with value writes to other rows, as one reference transaction
+    can.
+    """
+    from corro_sim.engine.step import _tile_chunks
+
+    a, s = row.shape
+    actor = jnp.arange(a, dtype=jnp.int32)
+    has_cells = valid & ~empty
+
+    cell_live = (
+        has_cells[:, None]
+        & (jnp.arange(s, dtype=jnp.int32)[None, :] < ncells[:, None])
+    )
+    site = jnp.where(vr == NEG, NEG, jnp.broadcast_to(actor[:, None], (a, s)))
+
+    # Local apply on the writer's own table (trace carries authoritative
+    # cv/cl — no recomputation, unlike the synthetic local_write path).
+    table = apply_cell_changes(
+        state.table,
+        jnp.broadcast_to(actor[:, None], (a, s)).reshape(-1),
+        row.reshape(-1),
+        col.reshape(-1),
+        cv.reshape(-1),
+        vr.reshape(-1),
+        site.reshape(-1),
+        cl.reshape(-1),
+        cell_live.reshape(-1),
+    )
+
+    log, ver = append_changesets(
+        state.log, actor, row, col, vr, cv, cl,
+        jnp.where(empty, 0, ncells), valid,
+    )
+    # Cleared versions occupy their slot but deliver nothing; each keeps
+    # the ts its EmptySet carried (message-granular, handlers.rs:524-719).
+    # Ownership-fold clearings during replay stay unstamped (-1): the
+    # trace carries no clock for them, and an unstamped EmptySet simply
+    # never advances a receiver's last_cleared (conservative).
+    aidx = jnp.where(valid & empty, actor, log.head.shape[0])
+    slot = (ver - 1) % log.capacity
+    log = log.replace(cleared=log.cleared.at[aidx, slot].set(True, mode="drop"))
+    cleared_hlc = state.cleared_hlc.at[aidx, slot].max(ts, mode="drop")
+
+    book = state.book.replace(
+        head=state.book.head.at[actor, actor].add(valid.astype(jnp.int32))
+    )
+
+    own, log = update_ownership(
+        state.own,
+        log,
+        jnp.broadcast_to(actor[:, None], (a, s)).reshape(-1),
+        jnp.broadcast_to(ver[:, None], (a, s)).reshape(-1),
+        row.reshape(-1),
+        col.reshape(-1),
+        cv.reshape(-1),
+        vr.reshape(-1),
+        site.reshape(-1),
+        cl.reshape(-1),
+        cell_live.reshape(-1),
+        (vr == NEG).reshape(-1),  # per-lane tombstone marker
+    )
+
+    # Enqueue every chunk of the fresh version into the writer's own ring.
+    q_dst, q_src, q_ver, q_valid, q_chunk = _tile_chunks(
+        cfg.chunks_per_version, actor, actor, ver, valid
+    )
+    gossip = enqueue_broadcasts(
+        state.gossip, q_dst, q_src, q_ver, q_chunk, q_valid,
+        cfg.max_transmissions,
+    )
+
+    return state.replace(
+        table=table, book=book, log=log, own=own, gossip=gossip,
+        cleared_hlc=cleared_hlc,
+    )
+
+
+def workload_as_injection(workload, cfg: SimConfig):
+    """Map a first-write workload schedule into :func:`inject_round`'s
+    trace form — per round: (valid, empty, ts, ncells, row, col, vr, cv,
+    cl) arrays.
+
+    Valid only for schedules where every ``(node, row, col)`` cell is
+    written at most once and no changeset is a DELETE: the authoritative
+    stamps are then statically known (first write ⇒ ``cv = 1``,
+    ``cl = 1``, ``vr =`` the written value), exactly what ``local_write``
+    would derive in the step's write port. That restriction is what makes
+    the two paths comparable bit for bit — the path-identity test
+    (tests/test_workload.py) drives one such schedule through BOTH and
+    asserts the converged state matches.
+    """
+    if (workload.writers & workload.dels).any():
+        raise ValueError(
+            "workload_as_injection: DELETE changesets need causal history "
+            "the trace form cannot stamp statically"
+        )
+    seen: set = set()
+    for r in range(workload.rounds):
+        for i in np.nonzero(workload.writers[r])[0]:
+            nc = int(workload.ncells[r, i])
+            for c in range(nc):
+                key = (int(i), int(workload.rows[r, i]),
+                       int(workload.cols[r, i, c]))
+                if key in seen:
+                    raise ValueError(
+                        "workload_as_injection requires first-write-only "
+                        f"schedules; cell {key} written twice"
+                    )
+                seen.add(key)
+    n, s = workload.n, max(workload.cells_width, 1)
+    out = []
+    for r in range(workload.rounds):
+        valid = workload.writers[r].copy()
+        rows = np.broadcast_to(
+            workload.rows[r][:, None], (n, s)
+        ).astype(np.int32)
+        cols = workload.cols[r].astype(np.int32)
+        vr = workload.vals[r].astype(np.int32)
+        out.append((
+            valid,
+            np.zeros((n,), bool),  # no EmptySets in a synthetic schedule
+            np.full((n,), -1, np.int32),
+            workload.ncells[r].astype(np.int32),
+            np.ascontiguousarray(rows),
+            cols,
+            vr,
+            np.ones((n, s), np.int32),  # first write: col_version 1
+            np.ones((n, s), np.int32),  # live row: causal length 1
+        ))
+    return out

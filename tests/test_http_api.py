@@ -339,3 +339,22 @@ def test_subscription_params_inlined_dedupe(client):
     finally:
         lit.close()
         par.close()
+
+
+def test_workload_report_route(server, client):
+    """GET /v1/workload: 404 until a load has run, then the last
+    harness report (ISSUE 7)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{server.addr[0]}:{server.addr[1]}/v1/workload"
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(url)
+    assert exc.value.code == 404
+
+    server.cluster.workload_report = {"live": {"observed": 3}}
+    with urllib.request.urlopen(url) as resp:
+        body = json.loads(resp.read())
+    assert body == {"live": {"observed": 3}}
+    server.cluster.workload_report = None

@@ -1,0 +1,316 @@
+"""Workload engine tests (ISSUE 7): generators, spec grammar, the
+batched write-schedule path, vacuity, and replay/synthetic path identity.
+
+The two load-bearing claims:
+
+- **vacuity** — with no workload armed the drivers build the exact
+  pre-workload chunk programs (jaxpr golden pins that separately), and
+  the write-schedule program fed an all-idle schedule is bit-identical
+  to the disabled sampler (``assert_workload_vacuous``);
+- **path identity** — a first-write schedule injected through the shared
+  trace-form helper (:mod:`corro_sim.workload.inject` — the replay path)
+  converges to the SAME state as the identical schedule driven through
+  ``sim_step``'s explicit ``writes=`` port (the workload/live-agent
+  path). The old replay docstring disclaimed this as a fidelity caveat;
+  it is now an invariant.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corro_sim.config import SimConfig
+from corro_sim.utils.spec import format_spec, parse_spec
+from corro_sim.workload import (
+    Workload,
+    empty_workload,
+    make_workload,
+    parse_workload_spec,
+)
+
+pytestmark = pytest.mark.quick
+
+# mirrors tests/test_faults.py BASE — one shared per-round program family
+BASE = SimConfig(
+    num_nodes=12, num_rows=16, num_cols=2, log_capacity=128,
+    write_rate=0.6,
+)
+
+
+# --------------------------------------------------------------- grammar
+def test_spec_roundtrip():
+    name, params = parse_spec("zipf:alpha=1.1,rate=0.4,keys=64")
+    assert name == "zipf"
+    assert params == {"alpha": 1.1, "rate": 0.4, "keys": 64}
+    assert parse_spec(format_spec(name, params)) == (name, params)
+
+
+def test_spec_errors():
+    with pytest.raises(ValueError):
+        parse_spec(":a=1")
+    with pytest.raises(ValueError):
+        parse_spec("zipf:alpha")
+    with pytest.raises(ValueError):
+        parse_workload_spec("no_such_generator")
+
+
+def test_composed_spec_parses_per_part():
+    parts = parse_workload_spec("zipf:alpha=0.9+churn_storm:waves=2")
+    assert [p[0] for p in parts] == ["zipf", "churn_storm"]
+    assert parts[0][1] == {"alpha": 0.9}
+
+
+# ------------------------------------------------------------ generators
+def test_generators_deterministic():
+    for spec in ("zipf:rate=0.5", "burst:on=3,off=5",
+                 "multiwriter:hot=2", "churn_storm:waves=3,keys=24",
+                 "zipf:rate=0.3+churn_storm:waves=2"):
+        a = make_workload(spec, 10, rounds=20, seed=7)
+        b = make_workload(spec, 10, rounds=20, seed=7)
+        assert a.spec == b.spec
+        for f in ("writers", "rows", "cols", "vals", "dels", "ncells"):
+            assert np.array_equal(getattr(a, f), getattr(b, f)), f
+        assert a.events == b.events
+        c = make_workload(spec, 10, rounds=20, seed=8)
+        assert not all(
+            np.array_equal(getattr(a, f), getattr(c, f))
+            for f in ("writers", "rows", "vals")
+        ), "different seeds must draw different schedules"
+
+
+def test_zipf_skew():
+    w = make_workload("zipf:alpha=1.2,rate=0.8,keys=64", 32, rounds=64,
+                      seed=0)
+    keys = w.rows[w.writers]
+    hot = (keys < 4).mean()
+    tail = (keys >= 32).mean()
+    assert hot > tail, (hot, tail)
+    # uniform control: no such concentration
+    u = make_workload("uniform:rate=0.8,keys=64", 32, rounds=64, seed=0)
+    ukeys = u.rows[u.writers]
+    assert (ukeys < 4).mean() < hot
+
+
+def test_burst_shape():
+    w = make_workload("burst:on=4,off=8,rate_hi=0.9,rate_lo=0.02", 16,
+                      rounds=96, seed=2)
+    kinds = [e[1] for e in w.events]
+    assert "burst_on" in kinds
+    # writes concentrate inside burst windows: per-round writer counts
+    # are strongly bimodal
+    per_round = w.writers.sum(axis=1)
+    assert per_round.max() >= 8
+    assert (per_round <= 2).sum() > len(per_round) // 4
+
+
+def test_churn_storm_waves():
+    w = make_workload("churn_storm:waves=3,batch=4,keys=32", 8,
+                      rounds=32, seed=1)
+    assert w.total_deletes > 0
+    waves = [e for e in w.events if e[1] == "churn_wave"]
+    assert len(waves) == 3
+    assert all(ev[2]["ops"] > 0 for ev in waves)
+
+
+def test_composition_sparse_part_survives():
+    w = make_workload(
+        "zipf:alpha=1.1,rate=0.9+churn_storm:waves=2,batch=3,keys=16",
+        8, rounds=16, seed=0,
+    )
+    # the bulk zipf background must not sample away the churn wave's
+    # deregister ops (sparse parts win contended lanes)
+    assert w.total_deletes > 0
+    assert any(e[1] == "churn_wave" for e in w.events)
+    # one changeset per (round, node) lane stays the invariant: writers
+    # is a bool plane, and merged lanes carry exactly one part's write
+    assert w.writers.dtype == bool
+
+
+def test_slice_past_end_is_idle():
+    w = make_workload("zipf:rate=0.9", 6, rounds=4, seed=0)
+    sl = w.slice(4, 8, 2)
+    assert not sl[0].any(), "rounds past the schedule must stay idle"
+    assert not w.writes_in(4, 8)
+    assert w.writes_in(0, 4)
+
+
+# --------------------------------------------------------- batched path
+def _small_cfg():
+    return dataclasses.replace(
+        BASE, sync_interval=4, log_capacity=64
+    ).validate()
+
+
+def test_run_sim_workload_commits_schedule():
+    from corro_sim.engine import init_state, run_sim
+
+    cfg = _small_cfg()
+    wl = make_workload(
+        "zipf:alpha=1.0,rate=0.5,keys=16+churn_storm:waves=2,keys=12",
+        cfg.num_nodes, rounds=10, seed=3,
+    )
+    res = run_sim(cfg, init_state(cfg, seed=0), max_rounds=128, chunk=8,
+                  seed=0, workload=wl)
+    assert res.converged_round is not None
+    assert int(res.metrics["writes"].sum()) == wl.total_writes
+    assert int(res.metrics["deletes"].sum()) == wl.total_deletes
+    assert res.flight.events("workload_event")
+    assert res.flight.meta.get("workload") == wl.spec
+
+
+def test_run_sim_workload_pipeline_equivalence():
+    from corro_sim.engine import init_state, run_sim
+
+    cfg = _small_cfg()
+    wl = make_workload("burst:on=3,off=4,rate_hi=0.8", cfg.num_nodes,
+                       rounds=10, seed=5)
+    a = run_sim(cfg, init_state(cfg, seed=0), max_rounds=96, chunk=8,
+                seed=0, workload=wl)
+    b = run_sim(cfg, init_state(cfg, seed=0), max_rounds=96, chunk=8,
+                seed=0, workload=wl, pipeline=False)
+    assert a.converged_round == b.converged_round
+    for la, lb in zip(jax.tree.leaves(a.state), jax.tree.leaves(b.state)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_workload_validate_bounds():
+    cfg = _small_cfg()
+    wl = make_workload("zipf:keys=512", cfg.num_nodes, rounds=4, seed=0)
+    with pytest.raises(AssertionError):
+        wl.validate(cfg)  # 512 keys > 16 row slots
+
+
+# -------------------------------------------------------------- vacuity
+def test_workload_vacuous_when_idle():
+    """The write-schedule program is a distinct program, and fed an
+    all-idle schedule it is bit-identical — every leaf, every metric —
+    to the disabled sampler (the workload-off program itself is pinned
+    byte-for-byte by the committed jaxpr golden)."""
+    from corro_sim.workload import assert_workload_vacuous
+
+    assert_workload_vacuous()
+
+
+def test_workload_off_program_pinned_by_golden():
+    """No-workload tracing is untouched by this subsystem: the canonical
+    step program still matches the committed golden fingerprint."""
+    from corro_sim.analysis.jaxpr_audit import (
+        audit_config,
+        check_golden,
+        load_golden,
+        primitive_fingerprint,
+        step_jaxpr,
+    )
+
+    golden = load_golden()
+    if golden is None:
+        pytest.skip("no golden committed")
+    report = {
+        "jax_version": golden.get("jax_version"),
+        "programs": {
+            "full": primitive_fingerprint(step_jaxpr(audit_config())),
+        },
+    }
+    import jax as _jax
+
+    if golden.get("jax_version") != _jax.__version__:
+        pytest.skip("jax version differs from the golden's pin")
+    assert not check_golden(report), "step program drifted from golden"
+
+
+# ------------------------------------------------- replay path identity
+def test_replay_and_writes_port_converge_identically():
+    """THE satellite-2 invariant: a first-write schedule injected through
+    the shared trace-form helper (replay's path) converges to the same
+    table/log/bookkeeping state as the identical schedule driven through
+    ``sim_step``'s writes port (the workload path)."""
+    import functools
+
+    from corro_sim.analysis.jaxpr_audit import run_step_loop
+    from corro_sim.engine.state import init_state
+    from corro_sim.engine.step import sim_step
+    from corro_sim.workload.inject import (
+        inject_round,
+        workload_as_injection,
+    )
+
+    cfg = _small_cfg()
+    n, rounds = cfg.num_nodes, 1
+    # disjoint first writes: node i writes row i, column i % C, once
+    a = dict(
+        writers=np.ones((rounds, n), bool),
+        rows=np.arange(n, dtype=np.int32)[None, :].repeat(rounds, 0),
+        cols=(np.arange(n, dtype=np.int32) % cfg.num_cols)[None, :, None],
+        vals=(100 + np.arange(n, dtype=np.int32))[None, :, None],
+        dels=np.zeros((rounds, n), bool),
+        ncells=np.ones((rounds, n), np.int32),
+    )
+    wl = Workload(name="parity", params={}, rounds=rounds, n=n, **a)
+
+    total = 24
+    # path A — the writes port (workload / live-agent path)
+    sa, _ = run_step_loop(cfg, total, 0, seed=11, workload=wl)
+
+    # path B — trace-form injection (replay's path), then quiesced steps
+    # under the SAME round keys
+    state = init_state(cfg, seed=0)
+    inject = jax.jit(functools.partial(inject_round, cfg))
+    alive = jnp.ones((n,), bool)
+    part = jnp.zeros((n,), jnp.int32)
+    step = jax.jit(
+        lambda st, k, we: sim_step(cfg, st, k, alive, part, we)
+    )
+    injections = workload_as_injection(wl, cfg)
+    key = jax.random.PRNGKey(11)
+    for r in range(total):
+        if r < len(injections):
+            state = inject(state, *(jnp.asarray(x)
+                                    for x in injections[r]))
+        state, _ = step(
+            state, jax.random.fold_in(key, r), jnp.asarray(False)
+        )
+    sb = state
+
+    for name in ("vr", "cv", "cl", "site"):
+        assert np.array_equal(
+            np.asarray(getattr(sa.table, name)),
+            np.asarray(getattr(sb.table, name)),
+        ), f"table.{name} diverged between replay and writes-port paths"
+    assert np.array_equal(
+        np.asarray(sa.book.head), np.asarray(sb.book.head)
+    )
+    assert np.array_equal(
+        np.asarray(sa.log.head), np.asarray(sb.log.head)
+    )
+    assert np.array_equal(
+        np.asarray(sa.log.cells), np.asarray(sb.log.cells)
+    )
+
+
+def test_workload_as_injection_rejects_rewrites():
+    from corro_sim.workload.inject import workload_as_injection
+
+    cfg = _small_cfg()
+    n = cfg.num_nodes
+    a = dict(
+        writers=np.ones((2, n), bool),
+        rows=np.zeros((2, n), np.int32),  # every node rewrites row 0
+        cols=np.zeros((2, n, 1), np.int32),
+        vals=np.ones((2, n, 1), np.int32),
+        dels=np.zeros((2, n), bool),
+        ncells=np.ones((2, n), np.int32),
+    )
+    wl = Workload(name="rw", params={}, rounds=2, n=n, **a)
+    with pytest.raises(ValueError):
+        workload_as_injection(wl, cfg)
+
+
+def test_empty_workload_shapes():
+    w = empty_workload(6, rounds=5)
+    assert not w.writers.any()
+    assert w.key_universe() == 1
+    wa = w.writes_at(0, 3)
+    assert wa[1].shape == (6, 3)

@@ -70,7 +70,63 @@ def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
                 runner = _chunk_runner(cfg, repair=repair, packed=True)
                 runner.lower(state, keys, alive, part, we).compile()
                 walls.append((name, time.perf_counter() - t0))
+            if not narrow:
+                # ISSUE 7: the workload-driven chunk program (the write
+                # schedule rides the scan inputs into sim_step's writes=
+                # port) is its OWN compiled program — warm it for the
+                # standard matrix configs too
+                t0 = time.perf_counter()
+                runner = _chunk_runner(cfg, packed=True, workload=True)
+                runner.lower(
+                    state, keys, alive, part, we,
+                    *_workload_avals(jax, jnp, chunk, n,
+                                     cfg.seqs_per_version),
+                ).compile()
+                walls.append(
+                    (f"{base_name}/wide/workload",
+                     time.perf_counter() - t0)
+                )
+
+    # ISSUE 7: the EXACT workload chunk programs tests/test_workload.py
+    # dispatches inside pytest (its `_small_cfg` — the test_faults BASE
+    # shape with sync_interval=4/log_capacity=64), full AND the repair
+    # program its converging runs switch to. The t1 workload smoke's own
+    # config compiles in its own CI step, outside the pytest budget.
+    wltest = SimConfig(
+        num_nodes=12, num_rows=16, num_cols=2, log_capacity=64,
+        write_rate=0.6, sync_interval=4,
+    ).validate()
+    n = wltest.num_nodes
+    state = jax.eval_shape(lambda: init_state(wltest, seed=0))
+    keys = jax.ShapeDtypeStruct((chunk, 2), jnp.uint32)
+    alive = jax.ShapeDtypeStruct((chunk, n), jnp.bool_)
+    part = jax.ShapeDtypeStruct((chunk, n), jnp.int32)
+    we = jax.ShapeDtypeStruct((chunk,), jnp.bool_)
+    for repair in (False, True):
+        t0 = time.perf_counter()
+        runner = _chunk_runner(wltest, repair=repair, packed=True,
+                               workload=True)
+        runner.lower(
+            state, keys, alive, part, we,
+            *_workload_avals(jax, jnp, chunk, n, wltest.seqs_per_version),
+        ).compile()
+        walls.append(
+            (f"wltest/wide/{'workload-repair' if repair else 'workload'}",
+             time.perf_counter() - t0)
+        )
     return walls
+
+
+def _workload_avals(jax, jnp, chunk: int, n: int, s: int) -> tuple:
+    """The write-schedule scan-input avals (Workload.slice shapes)."""
+    return (
+        jax.ShapeDtypeStruct((chunk, n), jnp.bool_),  # writers
+        jax.ShapeDtypeStruct((chunk, n, s), jnp.int32),  # rows
+        jax.ShapeDtypeStruct((chunk, n, s), jnp.int32),  # cols
+        jax.ShapeDtypeStruct((chunk, n, s), jnp.int32),  # vals
+        jax.ShapeDtypeStruct((chunk, n), jnp.bool_),  # dels
+        jax.ShapeDtypeStruct((chunk, n), jnp.int32),  # ncells
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
