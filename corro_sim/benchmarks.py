@@ -496,6 +496,10 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
         "pipeline": res.pipeline,
+        **(
+            {"sharding": _sharding_block(cfg, res)}
+            if res.sharding is not None else {}
+        ),
         **_step_eqns(cfg),
     }
     if scenario is not None:
@@ -696,6 +700,13 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
         "devices": len(devices),
+        "env": _mesh_env(),
+        # bench hygiene (ISSUE 8): mesh shape + shard_log regime +
+        # per-component per-device state bytes in the artifact
+        "sharding": (
+            _sharding_block(cfg, res)
+            if res.sharding is not None else None
+        ),
         "chunks": chunk_log,
         "pipeline": res.pipeline,
     }
@@ -816,9 +827,188 @@ def run_config_6(nodes: int | None = None, subs: int | None = None,
     }
 
 
+def _mesh_env() -> dict:
+    """Bench hygiene (ISSUE 8): every BENCH_r/MULTICHIP_r artifact
+    records where it ran — the MULTICHIP_r05 ``"tail": ""`` told us
+    nothing when the device died. Cheap (no allocation, no device op
+    beyond enumeration)."""
+    import jax
+
+    devices = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "device_count": len(devices),
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+    }
+
+
+def _sharding_block(cfg, res) -> dict:
+    """The placement provenance block bench artifacts journal — the
+    shared composition lives in engine/sharding.py so the CLI run
+    report and the bench artifacts cannot drift."""
+    from corro_sim.engine.sharding import sharding_report
+
+    return sharding_report(cfg, res.sharding or {})
+
+
+def _device_hbm_stats() -> list[dict]:
+    """Per-device live-memory readings, where the backend reports them
+    (TPU does; CPU usually returns nothing — entries are then empty)."""
+    import jax
+
+    out = []
+    for dev in jax.devices():
+        try:
+            stats = dev.memory_stats() or {}
+        except Exception:
+            stats = {}
+        out.append({
+            "device": str(dev.id),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+            "bytes_limit": stats.get("bytes_limit"),
+        })
+    return out
+
+
+def run_config_7(nodes: int | None = None, write_rounds: int = 8) -> dict:
+    """Config 7 — the weak-scaling multichip leg (ISSUE 8 tentpole):
+    100k simulated nodes over 8 devices, actor-sharded change log ON
+    (``shard_log=True`` — the explicit regime, not the size heuristic),
+    windowed O(N·K) SWIM, donate+pipeline composed. Reports ms/round,
+    rounds-to-convergence, and per-device HBM, with the change log's
+    per-device share expected to drop ~mesh-size vs the replicated
+    layout (the analytic sharded-vs-replicated comparison ships in the
+    artifact either way; SWARM is the replication-latency reference
+    point at this scale).
+
+    On a single device the leg is sized DOWN to the per-device share of
+    the 100k/8-device target (weak scaling: constant work per device)
+    and then by measured device memory, and the artifact says which
+    limit bound it — CPU-relative numbers are an honest datum when the
+    real mesh is unreachable (r05/r06 precedent).
+    """
+    import jax
+
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.driver import Schedule, run_sim
+    from corro_sim.engine.sharding import (
+        make_mesh,
+        state_bytes,
+        state_bytes_breakdown,
+    )
+    from corro_sim.engine.state import init_state
+
+    target_nodes = nodes or int(
+        os.environ.get("CORRO_BENCH_NODES", "100000")
+    )
+    devices = jax.devices()
+    mesh = make_mesh(devices) if len(devices) > 1 else None
+    n_dev = len(devices) if mesh is not None else 1
+
+    def mk_cfg(n):
+        return SimConfig(
+            num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
+            write_rate=0.2,
+            # windowed SWIM: O(N*K) belief state — the full (N, N)
+            # plane would be 40 GB at 100k (test_sharding_memory.py)
+            swim_enabled=True, swim_view_size=128, swim_interval=4,
+            sync_interval=4, sync_adaptive=True, sync_floor_rounds=1,
+            sync_peers=4, sync_actor_topk=512, sync_cap_per_actor=16,
+            sync_req_actors=512, sync_hot_actors=8192,
+            # the tentpole: actor-sharded log is the EXPLICIT regime
+            # here, not the SHARD_LOG_ACTORS shape accident
+            shard_log=True,
+        )
+
+    # Weak scaling on ANY mesh size: each device runs its 1/8-of-100k
+    # share — a 2-device host runs 2 shares, not the full leg unsized.
+    run_nodes = target_nodes
+    sized_reason = None
+    share = max(target_nodes // 8, 1024) * n_dev
+    if run_nodes > share:
+        run_nodes = share
+        sized_reason = (
+            f"weak-scaling share ({n_dev} device(s) run {n_dev}/8 of "
+            "the 8-device target)"
+        )
+    budget = _device_memory_budget(devices[0])
+    while run_nodes > 1024 * n_dev:
+        # per-device resident state + ~3 dense (N/D, A'=sync_hot_actors)
+        # int32 sweep temporaries (the config-5 sizing rule, hot-actor
+        # schedule edition)
+        _, per_dev = state_bytes(
+            mk_cfg(run_nodes), sharded_over=n_dev, shard_log=True
+        )
+        if per_dev + 12 * (run_nodes // n_dev) * 8192 <= budget:
+            break
+        run_nodes //= 2
+        sized_reason = "device memory budget"
+    run_nodes -= run_nodes % n_dev  # even node shards
+
+    cfg = mk_cfg(run_nodes)
+
+    chunk_log: list[dict] = []
+    res = run_sim(
+        cfg, init_state(cfg, seed=0),
+        Schedule(write_rounds=write_rounds),
+        max_rounds=2048, chunk=8, seed=0,
+        min_rounds=write_rounds + 1, mesh=mesh,
+        on_chunk=chunk_log.append, flight=_FLIGHT,
+        pipeline=_bench_pipeline(),
+        donate=_bench_donate() if mesh is not None else False,
+    )
+
+    # the log's per-device share, actor-sharded vs replicated, at BOTH
+    # the run size and the 100k/8 target — the artifact carries the
+    # ~mesh-size drop even when the run itself was sized down
+    def log_share(n, d):
+        sharded = state_bytes_breakdown(
+            mk_cfg(n), sharded_over=d, shard_log=True
+        )["log"]["per_device"]
+        repl = state_bytes_breakdown(
+            mk_cfg(n), sharded_over=d, shard_log=False
+        )["log"]["per_device"]
+        return {
+            "actor_sharded": sharded,
+            "replicated": repl,
+            "reduction": round(repl / max(sharded, 1), 2),
+        }
+
+    out = {
+        "metric": f"config7_{run_nodes}_node_weak_scaling_multichip",
+        "value": round(res.wall_per_round_ms, 3),
+        "unit": "ms_per_round",
+        "rounds_to_convergence": res.converged_round,
+        "converged": res.converged_round is not None,
+        "nodes": run_nodes,
+        "nodes_per_device": run_nodes // n_dev,
+        "target_nodes": target_nodes,
+        "devices": n_dev,
+        "env": _mesh_env(),
+        "sharding": (
+            _sharding_block(cfg, res)
+            if res.sharding is not None else None
+        ),
+        "log_per_device_bytes": log_share(run_nodes, max(n_dev, 1)),
+        "log_per_device_bytes_at_target": log_share(target_nodes, 8),
+        "device_hbm": _device_hbm_stats(),
+        "pipeline": res.pipeline,
+        "chunks": chunk_log,
+        **_step_eqns(cfg),
+    }
+    if sized_reason:
+        out["note"] = (
+            f"single-device run sized to {run_nodes} nodes by "
+            f"{sized_reason}; the full {target_nodes}-node leg needs "
+            "the 8-device mesh (doc/multichip.md)"
+        )
+    return out
+
+
 CONFIGS = {0: run_north_star, 1: run_config_1, 2: run_config_2,
            3: run_config_3, 4: run_config_4, 5: run_config_5,
-           6: run_config_6}
+           6: run_config_6, 7: run_config_7}
 
 
 def _device_preflight(timeout_s: int = 240, attempts: int = 3) -> str | None:
@@ -904,7 +1094,12 @@ def main(config: int | None = None, **kw) -> int:
         _FLIGHT = FlightRecorder(sink_path=flight_path)
         _FLIGHT.set_meta(bench_config=cfg_id)
     try:
-        print(json.dumps(fn(**kw)))
+        out = fn(**kw)
+        if isinstance(out, dict) and "env" not in out:
+            # bench hygiene (ISSUE 8): every artifact names the
+            # platform/devices it was measured on
+            out["env"] = _mesh_env()
+        print(json.dumps(out))
     finally:
         if _FLIGHT is not None:
             _FLIGHT.close()

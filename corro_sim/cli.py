@@ -4,7 +4,7 @@ Command surface vs the reference's Command enum
 (``crates/corrosion/src/main.rs:626-801``):
 
   run          — run a simulation config to convergence, print a report
-  bench        — BASELINE benchmark configs 0-5 (default: 0, north star)
+  bench        — BASELINE benchmark configs 0-7 (default: 0, north star)
   agent        — live cluster: HTTP API + admin socket (+ --pg-addr
                  pgwire, + --tls-* for TLS/mTLS)      [Command::Agent]
   devcluster   — run an `A -> B` topology file        [corro-devcluster]
@@ -67,7 +67,27 @@ def _cmd_run(args: argparse.Namespace) -> int:
         for flag, field in _FLAG_TO_FIELD.items()
         if getattr(args, flag) is not None
     }
+    if getattr(args, "shard_log", None) is not None:
+        # tri-state: an explicit regime beats the size heuristic
+        overrides["shard_log"] = {
+            "on": True, "off": False, "auto": None
+        }[args.shard_log]
     cfg = dataclasses.replace(cfg, **overrides).validate()
+    mesh = None
+    if getattr(args, "mesh", False):
+        import jax
+
+        from corro_sim.engine.sharding import make_mesh
+
+        if len(jax.devices()) < 2:
+            print(
+                "error: --mesh needs >1 visible device (force a CPU "
+                "mesh with XLA_FLAGS="
+                "--xla_force_host_platform_device_count=8)",
+                file=sys.stderr,
+            )
+            return 2
+        mesh = make_mesh()
     schedule = Schedule(write_rounds=args.write_rounds)
     scenario = None
     if args.scenario:
@@ -103,6 +123,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         max_rounds=args.max_rounds,
         chunk=args.chunk,
         seed=args.seed,
+        mesh=mesh,
         flight=flight,
         profile_dir=args.profile_dir,
         invariants=invariants,
@@ -134,6 +155,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
         # pipelined-vs-sequential pair is directly comparable
         "pipeline": res.pipeline,
     }
+    if res.sharding is not None:
+        # mesh placement provenance + the per-component state_bytes
+        # placement breakdown (ISSUE 8: the multichip smoke's artifact)
+        from corro_sim.engine.sharding import sharding_report
+
+        report["sharding"] = sharding_report(cfg, res.sharding)
     if args.flight_out:
         # a sink that died mid-run (ENOSPC, deleted dir) must not be
         # reported as a written artifact
@@ -753,6 +780,19 @@ def build_parser() -> argparse.ArgumentParser:
              "silently re-serializing dispatch (also: "
              "CORRO_SIM_TRANSFER_GUARD=1)",
     )
+    pr.add_argument(
+        "--mesh", action="store_true",
+        help="shard the cluster state over ALL visible devices "
+             "(node-axis data parallel, engine/sharding.py; "
+             "doc/multichip.md) — errors if only one device is visible",
+    )
+    pr.add_argument(
+        "--shard-log", choices=("on", "off", "auto"),
+        help="change-log placement on the mesh: on = actor-sharded "
+             "(per-device log HBM drops by the mesh size, delivery/sync "
+             "gathers become collectives), off = replicated, auto = the "
+             "SHARD_LOG_ACTORS size heuristic (default; doc/multichip.md)",
+    )
     pr.set_defaults(fn=_cmd_run)
 
     plo = sub.add_parser(
@@ -903,10 +943,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pb.add_argument(
         "--config", dest="bench_config", type=int,
-        choices=[0, 1, 2, 3, 4, 5],
+        choices=[0, 1, 2, 3, 4, 5, 6, 7],
         help="0=north-star (10k sim convergence wall vs 64-agent "
              "devcluster wall) 1=devcluster 2=64-node slice 3=1k zipf "
-             "4=10k headline 5=50k outage catch-up",
+             "4=10k headline 5=50k outage catch-up 6=workload engine "
+             "7=weak-scaling multichip (100k @ 8 devices, actor-sharded "
+             "log, windowed SWIM; doc/multichip.md)",
     )
     pb.add_argument("--nodes", dest="bench_nodes", type=int,
                     help="override the config's cluster size")

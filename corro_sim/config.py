@@ -225,6 +225,16 @@ class SimConfig:
     # switch changes SimState leaf dtypes, which re-keys every compiled
     # step program (cold .jax_cache — see doc/performance.md).
 
+    # --- device-mesh placement (engine/sharding.py) ---
+    shard_log: bool | None = None  # change-log placement on a device mesh:
+    # True = actor-sharded (each device owns its actors' write history;
+    # delivery/sync gathers become collectives, per-device log HBM drops
+    # by the mesh size), False = replicated (every gather device-local),
+    # None = the SHARD_LOG_ACTORS shape heuristic (sharded at >= 2048
+    # actors). Surfaced as `run --shard-log on|off|auto`,
+    # CORRO_SIM__SHARD_LOG, and `[sim] shard_log` (doc/multichip.md).
+    # Irrelevant off-mesh: single-device runs ignore it.
+
     # --- merge execution (TPU Pallas kernel, core/merge_kernel.py) ---
     merge_kernel: str = "auto"  # "auto" = Pallas dst-grouped merge for the
     # SYNC sweep on real TPU (single device, 128-aligned cell space;
@@ -325,6 +335,10 @@ class SimConfig:
         )
         assert self.chunks_per_version in (1, 2, 4, 8, 16, 32), (
             "chunks_per_version must divide the 32-bit version window"
+        )
+        assert self.shard_log in (None, True, False), (
+            "shard_log is tri-state: True (actor-sharded), False "
+            "(replicated), or None (the SHARD_LOG_ACTORS heuristic)"
         )
         if self.narrow_state:
             # the narrow since field is 8 bits: a suspicion must start,

@@ -45,6 +45,7 @@ from corro_sim.core.merge_kernel import (
     merge_grouped,
     pick_block_nodes,
     route_lanes,
+    route_merge_sharded,
 )
 from corro_sim.utils.slots import ranks_within_group_masked
 
@@ -88,8 +89,18 @@ def delivery_pass(
     chunk: jnp.ndarray,
     delivered: jnp.ndarray,
     round_,
+    mesh=None,
 ) -> DeliveryResult:
-    """Sort once; deliver, account, trace and merge off that one order."""
+    """Sort once; deliver, account, trace and merge off that one order.
+
+    ``mesh``: the run is sharded over this device mesh (ISSUE 8). Only
+    the kernel merge site changes: the per-node mailbox routes through
+    :func:`route_merge_sharded`'s explicit ``all_to_all`` (cross-shard
+    lanes hop the ICI once) and the Pallas kernel runs per shard inside
+    a ``shard_map`` region. Everything else — the hoisted sort, HLC
+    scatter-max, bookkeeping, probes — partitions under GSPMD exactly
+    as before, and ``mesh=None`` traces the byte-identical single-device
+    program (the jaxpr golden pins it)."""
     n = cfg.num_nodes
     s = cfg.seqs_per_version
     cpv = cfg.chunks_per_version
@@ -186,7 +197,9 @@ def delivery_pass(
         cap_lanes = cfg.apply_queue_cap * s
         rank_cell = (rankd[:, None] * s
                      + jnp.arange(s, dtype=jnp.int32)[None, :])
-        box = route_lanes(
+        # ONE flat cell-lane field list feeds both routing paths — the
+        # two arms cannot diverge on lane packing
+        lane_fields = (
             jnp.broadcast_to(dst[:, None], (m, s)).reshape(-1),
             rank_cell.reshape(-1),
             (c_row * cfg.num_cols + c_col).reshape(-1),
@@ -195,13 +208,23 @@ def delivery_pass(
             c_site.reshape(-1),
             c_cl.reshape(-1),
             cell_live.reshape(-1),
-            n, cap_lanes,
         )
-        table = merge_grouped(
-            table, box, cap_lanes,
-            block_nodes=pick_block_nodes(n),
-            interpret=kernel_interpret(),
-        )
+        if mesh is not None:
+            # mesh-partitioned kernel: cross-shard lanes all_to_all to
+            # their dst's shard, then merge per shard — mailbox slots
+            # (dst, rank) are globally precomputed, so the result is
+            # bit-for-bit the single-device kernel's
+            table = route_merge_sharded(
+                table, *lane_fields, cap_lanes, mesh,
+                interpret=kernel_interpret(),
+            )
+        else:
+            box = route_lanes(*lane_fields, n, cap_lanes)
+            table = merge_grouped(
+                table, box, cap_lanes,
+                block_nodes=pick_block_nodes(n),
+                interpret=kernel_interpret(),
+            )
     else:
         table = apply_cell_changes(
             table,

@@ -35,8 +35,11 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
 
 from corro_sim.core.crdt import NEG
+from corro_sim.utils.slots import ranks_within_group_masked
 
 NEG_I = -(2 ** 31)  # python-int NEG: kernels cannot capture device arrays
 
@@ -196,23 +199,175 @@ def merge_grouped(
     cap: int,
     block_nodes: int = 8,
     interpret: bool = False,
+    mesh=None,
+    axis_name: str = "nodes",
 ):
     """`apply_cell_changes` on a dense per-node lane mailbox, via Pallas.
 
     Returns the merged :class:`TableState`.
+
+    ``mesh``: partition the kernel over the node axis (ISSUE 8) — the
+    mailbox's column axis and the table planes' leading axis are both
+    node-major, so a ``shard_map`` over the mesh hands every device its
+    own ``(N/D, cells)`` planes + ``(8, N/D*cap)`` mailbox slice and the
+    Pallas grid runs per-shard with NO collectives: lanes must already
+    be grouped by a destination the caller placed on the right shard
+    (sync lanes are built node-major; delivery lanes route through
+    :func:`route_merge_sharded`'s all_to_all first). ``block_nodes`` is
+    recomputed from the per-shard node count under a mesh.
     """
     from corro_sim.core.crdt import TableState
 
     n, r, c = state.cv.shape
     cells = r * c
     clf = jnp.repeat(state.cl, c, axis=1)
-    ncv, nvr, nsite, nclf = grouped_merge(
+    if mesh is None:
+        merge = functools.partial(
+            grouped_merge, cap=cap, cols=c,
+            block_nodes=block_nodes, interpret=interpret,
+        )
+    else:
+        nl = n // mesh.shape[axis_name]
+
+        def local_merge(cvf, vrf, sitef, clf_, lanes_):
+            return grouped_merge(
+                cvf, vrf, sitef, clf_, lanes_, cap, c,
+                block_nodes=pick_block_nodes(nl), interpret=interpret,
+            )
+
+        merge = shard_map(
+            local_merge, mesh=mesh,
+            in_specs=(
+                P(axis_name), P(axis_name), P(axis_name), P(axis_name),
+                P(None, axis_name),
+            ),
+            out_specs=(P(axis_name),) * 4,
+            # pallas_call has no shard_map replication rule; every
+            # operand/output here is node-sharded, nothing replicated
+            check_rep=False,
+        )
+    ncv, nvr, nsite, nclf = merge(
         state.cv.reshape(n, cells),
         state.vr.reshape(n, cells),
         state.site.reshape(n, cells),
         clf,
-        lanes, cap, c,
-        block_nodes=block_nodes, interpret=interpret,
+        lanes,
+    )
+    return TableState(
+        cv=ncv.reshape(n, r, c),
+        vr=nvr.reshape(n, r, c),
+        site=nsite.reshape(n, r, c),
+        cl=nclf.reshape(n, r, c)[:, :, 0],
+    )
+
+
+def route_merge_sharded(
+    state,  # TableState — (N, R, C) planes, node-sharded over the mesh
+    dst: jnp.ndarray,  # (M,) int32 destination node per cell lane
+    rank: jnp.ndarray,  # (M,) int32 mailbox rank within dst (< cap kept)
+    cell: jnp.ndarray,  # (M,) int32 row * C + col
+    cv: jnp.ndarray,
+    vr: jnp.ndarray,
+    site: jnp.ndarray,
+    cl: jnp.ndarray,
+    valid: jnp.ndarray,  # (M,) bool
+    cap: int,
+    mesh,
+    axis_name: str = "nodes",
+    interpret: bool = False,
+):
+    """Delivery-site sharded merge: route cross-shard lanes with ONE
+    explicit ``all_to_all``, then run the Pallas kernel per shard.
+
+    The flat cell-lane stream arrives evenly sliced over the mesh (the
+    emission layout — lanes are src-major), but a lane's destination is
+    arbitrary: gossip crosses shards. Inside one ``shard_map`` region,
+    each device buckets its slice by destination shard (a stable local
+    sort + within-bucket ranks), the ``(D, m/D)`` bucket tensor rides
+    ``jax.lax.all_to_all`` — the ICI hop that replaces the reference's
+    QUIC fabric for cross-shard gossip — and the receiving shard
+    scatters its now-local lanes into the per-node mailbox at the
+    GLOBALLY precomputed ``(dst, rank)`` slot. Mailbox positions are a
+    pure function of the upstream dst-sorted order, so the merged planes
+    are bit-for-bit the single-device kernel's (and the XLA scatter
+    path's) regardless of which shard sourced a lane.
+
+    Bucket capacity is the per-shard slice length (the worst case: every
+    local lane targets one shard), so no lane is ever dropped by
+    routing; invalid/over-cap lanes park in the drop sentinel row.
+    """
+    from corro_sim.core.crdt import TableState
+
+    n, r, c = state.cv.shape
+    cells = r * c
+    d = mesh.shape[axis_name]
+    nl = n // d
+    m = dst.shape[0]
+    pad = (-m) % d
+    if pad:
+        # pad to an even per-shard slice with parked (invalid) lanes
+        z = jnp.zeros((pad,), jnp.int32)
+        dst = jnp.concatenate([dst, z])
+        rank = jnp.concatenate([rank, z])
+        cell = jnp.concatenate([cell, z])
+        cv = jnp.concatenate([cv, z])
+        vr = jnp.concatenate([vr, z])
+        site = jnp.concatenate([site, z])
+        cl = jnp.concatenate([cl, z])
+        valid = jnp.concatenate([valid, jnp.zeros((pad,), bool)])
+
+    def local(dstl, rankl, celll, cvl, vrl, sitel, cll, validl,
+              cvf, vrf, sitef, clf):
+        ml = dstl.shape[0]
+        keep = validl & (rankl < cap)
+        tgt = jnp.where(keep, dstl // jnp.int32(nl), jnp.int32(d))
+        order = jnp.argsort(tgt, stable=True)
+        # lane fields ride the exchange packed (8, lane)-column style:
+        # rows 0-5 are the mailbox fields, the two pad rows carry the
+        # global dst + rank needed for final mailbox placement
+        fields = jnp.stack([
+            celll, cvl, vrl, sitel, cll, keep.astype(jnp.int32),
+            dstl, rankl,
+        ], axis=1)[order]  # (ml, 8)
+        tgt_s = tgt[order]
+        routed = tgt_s < jnp.int32(d)
+        pos = ranks_within_group_masked(tgt_s, routed)
+        buckets = jnp.zeros((d, ml, LANE_FIELDS), jnp.int32)
+        buckets = buckets.at[
+            jnp.where(routed, tgt_s, jnp.int32(d)), pos
+        ].set(fields, mode="drop")
+        ex = jax.lax.all_to_all(
+            buckets, axis_name, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(-1, LANE_FIELDS)
+        me = jax.lax.axis_index(axis_name)
+        local_dst = ex[:, 6] - me * jnp.int32(nl)
+        ok = ex[:, 5] != 0
+        slot = jnp.where(
+            ok, local_dst * jnp.int32(cap) + ex[:, 7], jnp.int32(nl * cap)
+        )
+        lbox = (
+            jnp.zeros((nl * cap, LANE_FIELDS), jnp.int32)
+            .at[slot]
+            .set(ex.at[:, 6].set(0).at[:, 7].set(0), mode="drop")
+            .T
+        )
+        return grouped_merge(
+            cvf, vrf, sitef, clf, lbox, cap, c,
+            block_nodes=pick_block_nodes(nl), interpret=interpret,
+        )
+
+    clf = jnp.repeat(state.cl, c, axis=1)
+    ncv, nvr, nsite, nclf = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis_name),) * 8 + (P(axis_name),) * 4,
+        out_specs=(P(axis_name),) * 4,
+        check_rep=False,  # pallas_call has no replication rule
+    )(
+        dst, rank, cell, cv, vr, site, cl, valid,
+        state.cv.reshape(n, cells),
+        state.vr.reshape(n, cells),
+        state.site.reshape(n, cells),
+        clf,
     )
     return TableState(
         cv=ncv.reshape(n, r, c),
@@ -236,14 +391,18 @@ def kernel_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def kernel_supported(cfg, mesh_active: bool = False,
-                     path: str = "sync") -> bool:
+def kernel_supported(cfg, path: str = "sync") -> bool:
     """Static gate for routing merges through the kernel.
 
     The kernel needs: a real TPU backend (Mosaic; the interpret path is
-    for tests), a 128-aligned flat cell space, and a single device
-    (pallas_call does not partition under a sharded mesh — sharded runs
-    keep the XLA scatter path).
+    for tests) and a 128-aligned flat cell space. Sharded runs are the
+    FAST path since ISSUE 8, not the degraded one: under a mesh the
+    kernel runs per-shard inside a ``shard_map`` region
+    (:func:`merge_grouped` with ``mesh=``, delivery routing via
+    :func:`route_merge_sharded`'s all_to_all) — the driver gates mesh
+    runs through :func:`sharded_kernel_downgrade` and downgrades
+    EXPLICITLY (flight annotation + counter) when the backend cannot,
+    instead of the old silent ``merge_kernel="off"`` force.
 
     ``path``: which merge site is asking. Under ``merge_kernel="auto"``
     only the SYNC sweep uses the kernel — its 1.28M node-major lanes
@@ -252,7 +411,7 @@ def kernel_supported(cfg, mesh_active: bool = False,
     scatter cheap; the kernel's fixed cost measured ~neutral there).
     ``"on"`` forces the kernel on both paths (equivalence tests).
     """
-    if cfg.merge_kernel == "off" or mesh_active:
+    if cfg.merge_kernel == "off":
         return False
     cells = cfg.num_rows * cfg.num_cols
     if not (cells % 128 == 0 and cells <= 8192):
@@ -264,3 +423,29 @@ def kernel_supported(cfg, mesh_active: bool = False,
     import jax
 
     return jax.default_backend() == "tpu"
+
+
+def sharded_kernel_downgrade(cfg, n_devices: int) -> str | None:
+    """Why a MESH run cannot keep its Pallas merge kernel, or None.
+
+    The driver's explicit-downgrade gate (ISSUE 8): a non-None reason
+    means the run must fall back to the GSPMD scatter path
+    (``merge_kernel="off"``) — and say so (flight ``config_downgrade``
+    annotation + ``corro_config_downgrade_total{reason}``), never
+    silently. ``merge_kernel="off"`` itself is an explicit operator
+    choice, not a downgrade.
+    """
+    if cfg.merge_kernel == "off":
+        return None
+    cells = cfg.num_rows * cfg.num_cols
+    if not (cells % 128 == 0 and cells <= 8192):
+        return "cell_space_unaligned"
+    if cfg.num_nodes % max(n_devices, 1) != 0:
+        return "uneven_node_shards"
+    if cfg.merge_kernel == "on":
+        return None  # forced: interpret per shard off-TPU (tests)
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return "sharded_non_tpu"
+    return None

@@ -60,6 +60,8 @@ from corro_sim.engine.step import make_step
 from corro_sim.obs.flight import FlightRecorder
 from corro_sim.obs.probes import ProbeTrace
 from corro_sim.utils.metrics import (
+    CONFIG_DOWNGRADE_HELP,
+    CONFIG_DOWNGRADE_TOTAL,
     PIPELINE_FETCH_WAIT,
     PIPELINE_FETCH_WAIT_HELP,
     SECONDS_BUCKETS,
@@ -191,6 +193,10 @@ class RunResult:
     pipeline: dict | None = None  # chunk-pipeline stats: enabled, overlap
     # ratio, speculative dispatched/wasted, fetch-wait wall (sequential
     # runs report their blocking-read wall under the same key)
+    sharding: dict | None = None  # mesh placement provenance (ISSUE 8):
+    # device count, mesh shape, change-log regime
+    # (actor_sharded|replicated), effective merge_kernel, and any
+    # explicit config downgrades the backend forced. None off-mesh.
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -204,17 +210,20 @@ def _chunk_runner(
     repair: bool = False,
     packed: bool = False,
     workload: bool = False,
+    mesh=None,
 ):
     # a workload run scans a DIFFERENT program (the write schedule rides
     # the scan inputs into sim_step's explicit writes= port); with no
     # workload armed the body below is exactly the pre-workload one, so
     # the hot step program stays byte-identical (jaxpr golden).
+    # `mesh` (ISSUE 8): the kernel merge sites run per-shard inside
+    # shard_map regions; None traces the golden-pinned program.
     if workload:
         from corro_sim.engine.step import make_workload_step
 
-        body = make_workload_step(cfg, repair=repair)
+        body = make_workload_step(cfg, repair=repair, mesh=mesh)
     else:
-        body = make_step(cfg, repair=repair)
+        body = make_step(cfg, repair=repair, mesh=mesh)
 
     # Buffer donation halves peak memory (state in+out aliased) but the
     # axon TPU-tunnel platform currently miscompiles donated calls; keep it
@@ -396,8 +405,12 @@ def run_sim(
     if mesh is not None:
         from corro_sim.engine.sharding import shard_state, state_shardings
 
-        shardings = state_shardings(state, mesh, cfg.num_nodes)
-        state = shard_state(state, mesh, cfg.num_nodes)
+        shardings = state_shardings(
+            state, mesh, cfg.num_nodes, shard_log=cfg.shard_log
+        )
+        state = shard_state(
+            state, mesh, cfg.num_nodes, shard_log=cfg.shard_log
+        )
     else:
         # The caller may hand in a pre-sharded state (harness, tests). The
         # AOT path needs the carry's output shardings pinned to the input
@@ -410,12 +423,59 @@ def run_sim(
             isinstance(s, jax.sharding.NamedSharding) for s in leaf_sh
         ):
             shardings = jax.tree.map(lambda leaf: leaf.sharding, state)
-    if shardings is not None and cfg.merge_kernel != "off":
-        # pallas_call does not partition over a device mesh — sharded
-        # runs always take the XLA scatter merge path.
-        cfg = dataclasses.replace(cfg, merge_kernel="off")
+    step_mesh = None
+    sharding_info = None
+    if shardings is not None:
+        from corro_sim.core.merge_kernel import sharded_kernel_downgrade
+
+        mesh_obj = mesh if mesh is not None else (
+            jax.tree.leaves(shardings)[0].mesh
+        )
+        log_sharded = (
+            shardings.log.head.spec != jax.sharding.PartitionSpec()
+        )
+        downgrades: list = []
+        if cfg.merge_kernel != "off":
+            reason = sharded_kernel_downgrade(cfg, mesh_obj.size)
+            if reason is not None:
+                # the mesh cannot keep the Pallas merge on this backend
+                # — fall back to the GSPMD scatter path EXPLICITLY
+                # (ISSUE 8: the old silent merge_kernel="off" force)
+                cfg = dataclasses.replace(cfg, merge_kernel="off")
+                downgrades.append({
+                    "field": "merge_kernel", "value": "off",
+                    "reason": reason,
+                })
+                flight.annotate(
+                    0, "config_downgrade", field="merge_kernel",
+                    value="off", reason=reason,
+                )
+                counters.inc(
+                    CONFIG_DOWNGRADE_TOTAL,
+                    labels=(
+                        f'{{field="merge_kernel",reason="{reason}"}}'
+                    ),
+                    help_=CONFIG_DOWNGRADE_HELP,
+                )
+            else:
+                # the sharded FAST path: kernel merge sites run
+                # per-shard (shard_map + explicit collectives)
+                step_mesh = mesh_obj
+        sharding_info = {
+            "devices": int(mesh_obj.size),
+            "mesh_shape": {
+                str(k): int(v) for k, v in dict(mesh_obj.shape).items()
+            },
+            "shard_log": (
+                "actor_sharded" if log_sharded else "replicated"
+            ),
+            "merge_kernel": cfg.merge_kernel,
+            "downgrades": downgrades,
+        }
+        flight.set_meta(sharding=sharding_info)
     runner = _chunk_runner(cfg, donate=donate, shardings=shardings,
-                           packed=True, workload=workload is not None)
+                           packed=True, workload=workload is not None,
+                           mesh=step_mesh)
     root = jax.random.PRNGKey(seed)
 
     _idle_writes = None
@@ -518,8 +578,7 @@ def run_sim(
             labels=f'{{program="{program}"}}',
             help_="AOT lower+compile wall by program",
         )
-        if compiled_ is not None and warmup and not (donate and
-                                                    shardings is not None):
+        if compiled_ is not None and warmup:
             # first execution of a program pays one-time platform
             # initialization (~8 s over the tunnel) — burn it on a
             # discarded run so every timed chunk runs warm. Donated args
@@ -527,14 +586,27 @@ def run_sim(
             # runs burn on zero buffers allocated from the args' avals
             # instead of the real carry (ISSUE 6: donated runs get
             # warm-start too; the transient extra carry is freed at the
-            # end of this statement). Sharded+donated runs still skip —
-            # the AOT executable pins input shardings the plain zeros
-            # would not carry.
+            # end of this statement). Sharded+donated runs burn too
+            # (ISSUE 8): the zeros are device_put to each arg's OWN
+            # sharding, so they satisfy the AOT executable's pinned
+            # input layout on a mesh and on a single device alike.
             burn_args = args
             if donate:
-                burn_args = jax.tree.map(
-                    lambda a: jnp.zeros(a.shape, a.dtype), args
-                )
+                def _burn_zero(a):
+                    z = jnp.zeros(a.shape, a.dtype)
+                    if isinstance(
+                        getattr(a, "sharding", None),
+                        jax.sharding.NamedSharding,
+                    ):
+                        # sharded carry leaves: the AOT executable pins
+                        # their input layout — build the zeros ON the
+                        # mesh. Staged host args stay uncommitted (the
+                        # executable accepts those anywhere, and a
+                        # committed single-device copy would not match)
+                        z = jax.device_put(z, a.sharding)
+                    return z
+
+                burn_args = jax.tree.map(_burn_zero, args)
             with tracer.span("warmup", program=program, slow_warn=False):
                 jax.block_until_ready(compiled_(*burn_args)[0].round)
             flight.record_phase("warmup", time.perf_counter() - c_done)
@@ -550,7 +622,7 @@ def run_sim(
         nonlocal repair_runner, repair_compiled
         repair_runner = _chunk_runner(
             cfg, donate=donate, shardings=shardings, repair=True,
-            packed=True, workload=workload is not None,
+            packed=True, workload=workload is not None, mesh=step_mesh,
         )
         repair_compiled = _compile_program("repair", repair_runner, args)
 
@@ -1132,4 +1204,5 @@ def run_sim(
             if cfg.probes else None
         ),
         pipeline=pipeline_stats,
+        sharding=sharding_info,
     )

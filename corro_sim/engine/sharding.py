@@ -29,6 +29,20 @@ def make_mesh(devices=None) -> Mesh:
 SHARD_LOG_ACTORS = 2048
 
 
+def resolve_shard_log(cfg=None, num_actors: int | None = None,
+                      shard_log: bool | None = None) -> bool:
+    """The one place the change-log regime is decided (ISSUE 8): an
+    explicit ``shard_log`` (the argument, else ``SimConfig.shard_log``)
+    always beats the ``SHARD_LOG_ACTORS`` shape heuristic."""
+    if shard_log is None and cfg is not None:
+        shard_log = getattr(cfg, "shard_log", None)
+    if shard_log is not None:
+        return bool(shard_log)
+    if num_actors is None:
+        num_actors = cfg.num_actors
+    return num_actors >= SHARD_LOG_ACTORS
+
+
 def state_shardings(
     state: SimState, mesh: Mesh, num_nodes: int, shard_log: bool | None = None
 ):
@@ -48,8 +62,9 @@ def state_shardings(
 
     ``own`` is the global (R, C) ownership fold — small, stays replicated.
     """
-    if shard_log is None:
-        shard_log = state.log.head.shape[0] >= SHARD_LOG_ACTORS
+    shard_log = resolve_shard_log(
+        num_actors=state.log.head.shape[0], shard_log=shard_log
+    )
     node_sharded = NamedSharding(mesh, P("nodes"))
     replicated = NamedSharding(mesh, P())
 
@@ -117,24 +132,66 @@ def state_bytes(cfg, sharded_over: int = 1, shard_log: bool | None = None):
     Shape-only (``jax.eval_shape``) — nothing is allocated. Used to size
     single-chip runs honestly and to prove a 50k-node config fits a v5e
     core's HBM once meshed (VERDICT r1 next #4)."""
+    breakdown = state_bytes_breakdown(
+        cfg, sharded_over=sharded_over, shard_log=shard_log
+    )
+    return (
+        sum(c["total"] for c in breakdown.values()),
+        sum(c["per_device"] for c in breakdown.values()),
+    )
+
+
+def sharding_report(cfg, sharding: dict) -> dict:
+    """A run's placement-provenance artifact block: the driver's
+    ``RunResult.sharding`` dict + the per-component ``state_bytes``
+    placement breakdown at the run's OWN mesh size. One composition
+    shared by the CLI run report and every bench artifact (ISSUE 8
+    bench hygiene) so the two cannot drift."""
+    return dict(
+        sharding,
+        state_bytes=state_bytes_breakdown(
+            cfg,
+            sharded_over=max(int(sharding.get("devices", 1)), 1),
+            shard_log=sharding.get("shard_log") == "actor_sharded",
+        ),
+    )
+
+
+def state_bytes_breakdown(
+    cfg, sharded_over: int = 1, shard_log: bool | None = None
+) -> dict:
+    """Per-component placement breakdown: ``{component: {total,
+    per_device, placement}}`` bytes under the node-axis mesh layout.
+
+    Shape-only like :func:`state_bytes`. This is what the bench
+    artifacts journal (ISSUE 8 bench hygiene): the MULTICHIP_r05
+    ``"tail": ""`` told us nothing when the device died — every
+    multichip artifact now carries which component holds how many bytes
+    on each device, and under which regime."""
     import jax.numpy as jnp  # noqa: F401  (init_state imports lazily)
 
     from corro_sim.engine.state import init_state
 
     shapes = jax.eval_shape(lambda: init_state(cfg, seed=0))
-    if shard_log is None:
-        shard_log = cfg.num_actors >= SHARD_LOG_ACTORS
+    shard_log = resolve_shard_log(cfg, shard_log=shard_log)
 
-    total = 0
-    per_device = 0
+    out: dict = {}
     for path, leaf in jax.tree_util.tree_flatten_with_path(shapes)[0]:
         nbytes = leaf.size * leaf.dtype.itemsize
-        total += nbytes
         name = path[0].name if path else ""
         is_log = name == "log"
         node_axis = leaf.ndim >= 1 and leaf.shape[0] == cfg.num_nodes
-        if (node_axis and not is_log) or (is_log and shard_log and node_axis):
-            per_device += nbytes // sharded_over
-        else:
-            per_device += nbytes
-    return total, per_device
+        sharded = (node_axis and not is_log) or (
+            is_log and shard_log and node_axis
+        )
+        comp = out.setdefault(
+            name or "<root>",
+            {"total": 0, "per_device": 0, "placement": "replicated"},
+        )
+        comp["total"] += nbytes
+        comp["per_device"] += nbytes // sharded_over if sharded else nbytes
+        if sharded:
+            comp["placement"] = (
+                "actor_sharded" if is_log else "node_sharded"
+            )
+    return out

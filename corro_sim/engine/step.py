@@ -52,21 +52,28 @@ from corro_sim.membership.swim_window import membership_view
 from corro_sim.sync.sync import sync_round
 
 
-def make_step(cfg: SimConfig, repair: bool = False):
+def make_step(cfg: SimConfig, repair: bool = False, mesh=None):
     """The scan-shaped closure over :func:`sim_step`: ``(state, (key,
     alive, part, write_enable)) -> (state, metrics)``. The one place the
     chunk program's body is defined — the driver's ``lax.scan`` and the
     jaxpr audit harness (:mod:`corro_sim.analysis.jaxpr_audit`) both
-    build from here, so the program they pin is the program that runs."""
+    build from here, so the program they pin is the program that runs.
+
+    ``mesh``: the sharded fast path (ISSUE 8) — the kernel merge sites
+    run per-shard inside ``shard_map`` regions with explicit collectives
+    for cross-shard lanes. ``None`` (every single-device caller) traces
+    the byte-identical program the jaxpr golden pins."""
 
     def body(state, inp):
         key, alive, part, we = inp
-        return sim_step(cfg, state, key, alive, part, we, repair=repair)
+        return sim_step(
+            cfg, state, key, alive, part, we, repair=repair, mesh=mesh
+        )
 
     return body
 
 
-def make_workload_step(cfg: SimConfig, repair: bool = False):
+def make_workload_step(cfg: SimConfig, repair: bool = False, mesh=None):
     """The workload-driven scan body: ``(state, (key, alive, part,
     write_enable, writers, rows, cols, vals, dels, ncells)) -> (state,
     metrics)`` — a compiled write schedule (:mod:`corro_sim.workload`)
@@ -82,6 +89,7 @@ def make_workload_step(cfg: SimConfig, repair: bool = False):
         return sim_step(
             cfg, state, key, alive, part, we,
             writes=None if repair else tuple(writes), repair=repair,
+            mesh=mesh,
         )
 
     return body
@@ -113,6 +121,7 @@ def sim_step(
     write_enable: jnp.ndarray,  # () bool — workload phase switch
     writes: tuple | None = None,  # explicit write batch (live agent path)
     repair: bool = False,  # static: the post-quiesce specialization
+    mesh=None,  # device mesh: shard_map the kernel merge sites (ISSUE 8)
 ):
     """Advance the cluster one round.
 
@@ -137,7 +146,7 @@ def sim_step(
     (``agent/handlers.rs``, ``broadcast/mod.rs:532-597``).
     """
     if repair:
-        return _repair_step(cfg, state, key, alive, part)
+        return _repair_step(cfg, state, key, alive, part, mesh=mesh)
     n = cfg.num_nodes
     s = cfg.seqs_per_version
     cpv = cfg.chunks_per_version
@@ -392,7 +401,7 @@ def sim_step(
     # each stage re-deriving masks over its own order (core/delivery.py).
     dv = delivery_pass(
         cfg, table, book, log, probe, state.hlc,
-        dst, src, actor, ver, chunk, delivered, state.round,
+        dst, src, actor, ver, chunk, delivered, state.round, mesh=mesh,
     )
     table, book, probe = dv.table, dv.book, dv.probe
     hlc_recv = dv.hlc_recv
@@ -480,7 +489,7 @@ def sim_step(
         cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
         k_sync, alive, view, part,
         rtt=rtt if cfg.rtt_rings else None, round_idx=state.sync_rounds,
-        fault_key=k_fsync,
+        fault_key=k_fsync, mesh=mesh,
     )
     if cfg.probes:
         # the anti-entropy merge point: heads that now cover a probe's
@@ -625,6 +634,7 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
 def _sync_block(
     cfg, is_sync, book, log, table, hlc, last_cleared, cleared_hlc,
     k_sync, alive, view, part, rtt, round_idx=0, fault_key=None,
+    mesh=None,
 ):
     """The sync cond: one anti-entropy sweep when ``is_sync``.
 
@@ -641,7 +651,7 @@ def _sync_block(
             # reachability as a matrix-free pair of masks: same-partition
             # check happens inside via gathered part ids
             _pairwise_mask(alive, part),
-            rtt=rtt, round_idx=round_idx, fault_key=fault_key,
+            rtt=rtt, round_idx=round_idx, fault_key=fault_key, mesh=mesh,
         )
 
     def no_sync(args):
@@ -689,6 +699,7 @@ def _repair_step(
     key: jax.Array,
     alive: jnp.ndarray,
     part: jnp.ndarray,
+    mesh=None,
 ):
     """The post-quiesce round: SWIM + sync + bookkeeping only.
 
@@ -750,7 +761,7 @@ def _repair_step(
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,
         state.cleared_hlc, k_sync, alive, view, part, rtt=None,
-        round_idx=state.sync_rounds, fault_key=k_fsync,
+        round_idx=state.sync_rounds, fault_key=k_fsync, mesh=mesh,
     )
     probe = state.probe
     if cfg.probes:

@@ -12,6 +12,9 @@ override prefix is ``CORRO_SIM__``::
     swim_enabled = true
     pipeline = false      # opt out of pipelined chunk dispatch
                           # (doc/performance.md; default on)
+    shard_log = true      # mesh change-log regime: true = actor-sharded,
+                          # false = replicated, "auto" = size heuristic
+                          # (doc/multichip.md; CORRO_SIM__SHARD_LOG)
 
     [sim.faults]          # chaos injection (corro_sim/faults/)
     loss = 0.05
@@ -42,17 +45,27 @@ ENV_PREFIX = "CORRO_SIM__"
 FAULTS_ENV_PREFIX = ENV_PREFIX + "FAULTS__"
 
 
+def _parse_bool(name: str, raw: str) -> bool:
+    if raw.lower() in ("1", "true", "yes", "on"):
+        return True
+    if raw.lower() in ("0", "false", "no", "off"):
+        return False
+    raise ValueError(f"invalid bool for {name}: {raw!r}")
+
+
 def _coerce(field: dataclasses.Field, raw: str):
     if field.type in ("int", int):
         return int(raw)
     if field.type in ("float", float):
         return float(raw)
+    if field.type in ("bool | None",):
+        # tri-state knobs (shard_log): auto/none = defer to the
+        # heuristic, else the usual bool spellings
+        if raw.lower() in ("auto", "none", ""):
+            return None
+        return _parse_bool(field.name, raw)
     if field.type in ("bool", bool):
-        if raw.lower() in ("1", "true", "yes", "on"):
-            return True
-        if raw.lower() in ("0", "false", "no", "off"):
-            return False
-        raise ValueError(f"invalid bool for {field.name}: {raw!r}")
+        return _parse_bool(field.name, raw)
     return raw
 
 
@@ -119,6 +132,14 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
                 continue
             if k not in fields:
                 raise KeyError(f"unknown config key in {path}: {k!r}")
+            if fields[k].type in ("bool | None",) and isinstance(v, str):
+                # tri-state knobs (shard_log): TOML spells them as a
+                # bool or the "auto"/"none" string — same type-driven
+                # rule as _coerce's env path, so the next bool|None
+                # field gets it for free
+                v = None if v.lower() in ("auto", "none") else (
+                    _parse_bool(k, v)
+                )
             values[k] = v
 
     for k, field in fields.items():

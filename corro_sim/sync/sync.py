@@ -348,6 +348,7 @@ def sync_round(
     rtt: jnp.ndarray | None = None,
     round_idx: jnp.ndarray | int = 0,
     fault_key: jax.Array | None = None,
+    mesh=None,
 ):
     """One anti-entropy sweep (multi-peer).
 
@@ -597,6 +598,10 @@ def sync_round(
             table, box, lanes_per_node + pad,
             block_nodes=pick_block_nodes(n),
             interpret=kernel_interpret(),
+            # sync lanes are requester-major: the mailbox is already
+            # dst-sharded exactly like the table planes, so the
+            # mesh-partitioned kernel needs NO collectives (ISSUE 8)
+            mesh=mesh,
         )
     else:
         table = apply_cell_changes(

@@ -97,6 +97,22 @@ PIPELINE_FETCH_WAIT_HELP = (
     "blocking-read stall the chunk pipeline hides"
 )
 
+# ---- corro_config_downgrade_total: explicit config fallbacks ---------
+# A run that cannot honor a requested config knob on this backend must
+# SAY so (ISSUE 8: the old driver silently forced merge_kernel="off"
+# under a sharded mesh). Every such fallback increments this counter
+# and lands a `config_downgrade` flight annotation:
+#   corro_config_downgrade_total{field,reason}
+# known reasons: sharded_non_tpu (Pallas merge under a mesh needs TPU
+# or the forced "on" interpret mode), cell_space_unaligned,
+# uneven_node_shards (core/merge_kernel.py sharded_kernel_downgrade).
+CONFIG_DOWNGRADE_TOTAL = "corro_config_downgrade_total"
+CONFIG_DOWNGRADE_HELP = (
+    "config knobs downgraded at run time because the backend cannot "
+    "honor them, by field and reason (flight `config_downgrade` "
+    "annotations carry the same provenance)"
+)
+
 # ---- corro_lint_*: static analysis + transfer-guard observability ----
 # The corro-lint analyzer (corro_sim/analysis/, `corro-sim lint`)
 # exports its run profile as info counters so a scrape of any process

@@ -325,6 +325,30 @@ def test_pipeline_family_renders_and_validates(cluster, probe_cluster):
     _validate_exposition(text)
 
 
+def test_config_downgrade_family_renders_and_validates(cluster):
+    """ISSUE 8 satellite: corro_config_downgrade_total{field,reason} —
+    the explicit config-downgrade counter the driver bumps instead of
+    the old silent sharded merge_kernel="off" force — renders through
+    the exposition and the whole thing still validates."""
+    from corro_sim.utils.metrics import (
+        CONFIG_DOWNGRADE_HELP,
+        CONFIG_DOWNGRADE_TOTAL,
+        counters,
+    )
+
+    counters.inc(
+        CONFIG_DOWNGRADE_TOTAL,
+        labels='{field="merge_kernel",reason="sharded_non_tpu"}',
+        help_=CONFIG_DOWNGRADE_HELP,
+    )
+    text = render_prometheus(cluster)
+    assert (
+        'corro_config_downgrade_total'
+        '{field="merge_kernel",reason="sharded_non_tpu"}' in text
+    )
+    _validate_exposition(text)
+
+
 def test_node_lag_renders_without_probes(cluster):
     """The lag observatory never needs the tracer; only its sync-age
     column does."""

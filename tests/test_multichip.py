@@ -1,0 +1,292 @@
+"""Multi-chip fast path (ISSUE 8): cross-device equivalence + regimes.
+
+The contract: an 8-device sharded run — EITHER change-log regime, with
+or without the shard_map'd Pallas merge, narrow state, faults, or a
+workload schedule — is bit-identical in state AND metrics to the
+single-device run of the same config. The mesh changes placement and
+collectives, never results (the conftest forces 8 host CPU devices).
+
+Keep the config literals here in lockstep with the sharded prime matrix
+in tools/prime_cache.py — these exact programs are AOT-warmed so the
+first post-merge tier-1 run stays inside the 870 s budget. The
+reference/sharded BASE runs are module-scoped fixtures: several tests
+read the same three runs instead of re-dispatching them.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from corro_sim.config import FaultConfig, SimConfig
+from corro_sim.engine.driver import Schedule, run_sim
+from corro_sim.engine.sharding import (
+    SHARD_LOG_ACTORS,
+    make_mesh,
+    resolve_shard_log,
+    state_bytes_breakdown,
+    state_shardings,
+)
+from corro_sim.engine.state import init_state
+
+# == tools/prime_cache.py `mc-base` (and test_sharding_memory's config)
+BASE = SimConfig(num_nodes=16, num_rows=8, num_cols=2, log_capacity=64)
+
+
+def _mesh():
+    assert len(jax.devices()) == 8, "conftest should force 8 CPU devices"
+    return make_mesh()
+
+
+def _run(cfg, seed=9, mesh=None, shard_log=None, workload=None,
+         schedule=None, phase_specialize=False, **kw):
+    if shard_log is not None:
+        cfg = dataclasses.replace(cfg, shard_log=shard_log)
+    return run_sim(
+        cfg.validate(), init_state(cfg, seed=seed),
+        schedule or Schedule(write_rounds=8),
+        max_rounds=16, chunk=8, seed=seed, stop_on_convergence=False,
+        mesh=mesh, workload=workload, phase_specialize=phase_specialize,
+        **kw,
+    )
+
+
+@pytest.fixture(scope="module")
+def ref_base():
+    """Single-device BASE run — the reference several tests compare to."""
+    return _run(BASE)
+
+
+@pytest.fixture(scope="module")
+def mesh_actor():
+    """8-device BASE run, change log FORCED actor-sharded."""
+    return _run(BASE, mesh=_mesh(), shard_log=True)
+
+
+@pytest.fixture(scope="module")
+def mesh_repl():
+    """8-device BASE run, change log FORCED replicated."""
+    return _run(BASE, mesh=_mesh(), shard_log=False)
+
+
+def _assert_identical(ref, res):
+    assert sorted(ref.metrics) == sorted(res.metrics)
+    for k in ref.metrics:
+        np.testing.assert_array_equal(ref.metrics[k], res.metrics[k], k)
+    for f in ("cv", "vr", "site", "cl"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ref.state.table, f)),
+            np.asarray(getattr(res.state.table, f)),
+        )
+    np.testing.assert_array_equal(
+        np.asarray(ref.state.log.cells), np.asarray(res.state.log.cells)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(ref.state.book.head), np.asarray(res.state.book.head)
+    )
+
+
+# ---------------------------------------------------- regime switching
+
+def test_explicit_shard_log_override_beats_heuristic():
+    """SimConfig.shard_log (ISSUE 8) replaces the shape-implicit
+    SHARD_LOG_ACTORS switch: an explicit regime wins in BOTH directions,
+    None keeps the heuristic."""
+    small, big = 64, SHARD_LOG_ACTORS
+    # the heuristic
+    assert resolve_shard_log(num_actors=small) is False
+    assert resolve_shard_log(num_actors=big) is True
+    # explicit override beats it in both directions
+    assert resolve_shard_log(num_actors=small, shard_log=True) is True
+    assert resolve_shard_log(num_actors=big, shard_log=False) is False
+    # the config field feeds the same resolution
+    cfg_on = dataclasses.replace(BASE, shard_log=True)
+    cfg_off = SimConfig(num_nodes=big, shard_log=False)
+    assert resolve_shard_log(cfg_on) is True
+    assert resolve_shard_log(cfg_off) is False
+
+    # and the sharding specs follow the explicit regime, not the shape
+    mesh = _mesh()
+    state = jax.eval_shape(lambda: init_state(BASE, seed=0))
+    P = jax.sharding.PartitionSpec
+    forced_on = state_shardings(state, mesh, BASE.num_nodes,
+                                shard_log=True)
+    forced_off = state_shardings(state, mesh, BASE.num_nodes,
+                                 shard_log=False)
+    assert forced_on.log.cells.spec == P("nodes")
+    assert forced_off.log.cells.spec == P()
+
+
+def test_state_bytes_breakdown_log_share_drops_with_mesh():
+    """The artifact datum bench config 7 journals: actor-sharding the
+    log drops its per-device share by ~the mesh size."""
+    cfg = SimConfig(num_nodes=4096, num_rows=128, num_cols=2,
+                    log_capacity=256)
+    sharded = state_bytes_breakdown(cfg, sharded_over=8, shard_log=True)
+    repl = state_bytes_breakdown(cfg, sharded_over=8, shard_log=False)
+    assert sharded["log"]["placement"] == "actor_sharded"
+    assert repl["log"]["placement"] == "replicated"
+    assert sharded["log"]["total"] == repl["log"]["total"]
+    assert sharded["log"]["per_device"] * 8 == repl["log"]["per_device"]
+    # node-sharded components are split either way
+    assert sharded["book"]["per_device"] * 8 == sharded["book"]["total"]
+
+
+# ------------------------------------------- cross-device equivalence
+
+def test_sharded_bit_identical_both_log_regimes(ref_base, mesh_actor,
+                                                mesh_repl):
+    """8-device runs, actor-sharded AND replicated log, == the
+    single-device run: state + every metric series."""
+    assert mesh_actor.sharding["shard_log"] == "actor_sharded"
+    assert mesh_repl.sharding["shard_log"] == "replicated"
+    _assert_identical(ref_base, mesh_actor)
+    _assert_identical(ref_base, mesh_repl)
+
+
+@pytest.mark.slow  # the variant legs (narrow/lossy/workload) ride the
+# t1.yml multichip smoke step instead of the 870 s tier-1 pytest lane
+# (the fetch-wait precedent); the core matrix above/below stays tier-1
+def test_sharded_bit_identical_narrow_windowed_swim():
+    """narrow_state (uint16 SWIM planes) under the mesh — the packed
+    layout shards and stays bit-exact."""
+    # == tools/prime_cache.py `mc-swim-narrow`
+    cfg = dataclasses.replace(
+        BASE, swim_enabled=True, swim_view_size=8, sync_interval=4,
+        narrow_state=True,
+    )
+    _assert_identical(_run(cfg), _run(cfg, mesh=_mesh(), shard_log=True))
+
+
+@pytest.mark.slow  # t1.yml multichip smoke runs the slow variants
+def test_sharded_bit_identical_lossy_scenario():
+    """Seeded link faults draw identically on the mesh — loss/dup masks
+    are keyed by emission lane order, which sharding must not permute."""
+    # == tools/prime_cache.py `mc-lossy`
+    cfg = dataclasses.replace(BASE, faults=FaultConfig(loss=0.2))
+    ref = _run(cfg)
+    assert int(np.asarray(ref.metrics["fault_lost"]).sum()) > 0
+    _assert_identical(ref, _run(cfg, mesh=_mesh(), shard_log=True))
+
+
+@pytest.mark.slow  # t1.yml multichip smoke runs the slow variants
+def test_sharded_bit_identical_workload_schedule():
+    """A compiled write schedule through the sharded scan — the
+    workload chunk program composes with the mesh."""
+    from corro_sim.workload import make_workload
+
+    wl = make_workload("zipf:alpha=1.1,rate=0.5,keys=8", BASE.num_nodes,
+                       rounds=6, seed=4)
+    ref = _run(BASE, workload=wl)
+    assert int(np.asarray(ref.metrics["writes"]).sum()) == wl.total_writes
+    _assert_identical(
+        ref, _run(BASE, mesh=_mesh(), shard_log=True, workload=wl)
+    )
+
+
+# ------------------------------------------- the shard_map'd kernel
+
+def test_sharded_pallas_merge_kernel_bit_identical():
+    """merge_kernel="on" under the mesh: the dst-grouped Pallas kernel
+    runs per-shard inside shard_map (delivery lanes routed by an
+    explicit all_to_all, sync lanes already requester-major), interpret
+    mode off-TPU — bit-identical to the single-device kernel run (which
+    tests/test_merge_kernel.py pins against the scatter path) and NOT
+    downgraded."""
+    # == tools/prime_cache.py `mc-kernel` (cells = 64*2 = 128-aligned)
+    kcfg = SimConfig(
+        num_nodes=16, num_rows=64, num_cols=2, log_capacity=64,
+        merge_kernel="on", sync_interval=4,
+    )
+    ref = _run(kcfg)
+    res = _run(kcfg, mesh=_mesh(), shard_log=True)
+    assert res.sharding["merge_kernel"] == "on"
+    assert res.sharding["downgrades"] == []
+    _assert_identical(ref, res)
+
+
+def test_sharded_auto_kernel_downgrade_is_explicit(mesh_actor):
+    """The old silent merge_kernel="off" force is gone: a sharded run
+    that cannot keep its kernel (auto on CPU, BASE's unaligned cell
+    space) downgrades OBSERVABLY — sharding report + flight annotation
+    + counter — while an operator's explicit "off" stays a choice."""
+    from corro_sim.utils.metrics import CONFIG_DOWNGRADE_TOTAL, counters
+
+    assert mesh_actor.sharding["merge_kernel"] == "off"
+    assert mesh_actor.sharding["downgrades"] == [{
+        "field": "merge_kernel", "value": "off",
+        "reason": "cell_space_unaligned",
+    }]
+    evs = mesh_actor.flight.events("config_downgrade")
+    assert len(evs) == 1 and evs[0]["attrs"]["field"] == "merge_kernel"
+    assert sum(
+        v for (name, _), v in counters._c.items()
+        if name == CONFIG_DOWNGRADE_TOTAL
+    ) >= 1
+    # an explicit operator "off" is a choice, not a downgrade
+    res_off = _run(dataclasses.replace(BASE, merge_kernel="off"),
+                   mesh=_mesh(), shard_log=True)
+    assert res_off.sharding["downgrades"] == []
+    assert not res_off.flight.events("config_downgrade")
+
+
+# ------------------------------------- donate + pipeline + sharding
+
+def test_donate_pipeline_sharded_compose_bit_identical():
+    """ISSUE 8 tentpole: run_sim(donate=True, pipeline) on the mesh —
+    the speculative double-buffer and the sharded warmup burn compose;
+    no sequential fallback, results == the sequential non-donated
+    single-device run, including across the repair switch."""
+    # min_rounds holds the convergence report past round 24 so the
+    # rings drain and the repair-specialized program actually runs
+    ref = run_sim(
+        BASE, init_state(BASE, seed=5), Schedule(write_rounds=8),
+        max_rounds=40, chunk=8, seed=5, min_rounds=24, pipeline=False,
+    )
+    res = run_sim(
+        dataclasses.replace(BASE, shard_log=True),
+        init_state(BASE, seed=5), Schedule(write_rounds=8),
+        max_rounds=40, chunk=8, seed=5, min_rounds=24, donate=True,
+        pipeline=True, mesh=_mesh(),
+    )
+    assert res.pipeline["enabled"] is True
+    assert res.sharding["shard_log"] == "actor_sharded"
+    assert ref.converged_round == res.converged_round
+    assert ref.converged_round is not None
+    assert res.repair_chunks > 0  # the sharded repair program ran
+    _assert_identical(ref, res)
+
+
+def test_sharded_runs_report_mesh_provenance(ref_base, mesh_repl):
+    """RunResult.sharding carries the placement provenance every bench
+    artifact journals (devices, mesh shape, regime, effective kernel)."""
+    assert mesh_repl.sharding["devices"] == 8
+    assert mesh_repl.sharding["mesh_shape"] == {"nodes": 8}
+    assert mesh_repl.sharding["shard_log"] == "replicated"
+    assert ref_base.sharding is None
+
+
+def test_shard_log_config_surfaces():
+    """--shard-log / env / TOML all reach SimConfig.shard_log."""
+    from corro_sim.io.config_file import load_config
+
+    assert load_config(env={"CORRO_SIM__SHARD_LOG": "on"}).shard_log \
+        is True
+    assert load_config(env={"CORRO_SIM__SHARD_LOG": "0"}).shard_log \
+        is False
+    assert load_config(env={"CORRO_SIM__SHARD_LOG": "auto"}).shard_log \
+        is None
+    with pytest.raises(ValueError):
+        load_config(env={"CORRO_SIM__SHARD_LOG": "maybe"})
+
+
+def test_shard_log_toml(tmp_path):
+    toml = tmp_path / "c.toml"
+    toml.write_text("[sim]\nnum_nodes = 32\nshard_log = true\n")
+    from corro_sim.io.config_file import load_config
+
+    cfg = load_config(str(toml), env={})
+    assert cfg.shard_log is True and cfg.num_nodes == 32
+    toml.write_text('[sim]\nshard_log = "auto"\n')
+    assert load_config(str(toml), env={}).shard_log is None

@@ -31,6 +31,18 @@ sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
 
+# The sharded chunk programs (ISSUE 8) compile against an 8-device mesh
+# — force the host platform to expose one BEFORE jax initializes, the
+# same posture tests/conftest.py gives pytest. Single-device programs
+# keep their cache keys: an unsharded jit pins device 0 regardless of
+# how many host devices exist (today's CI already primes under 1 device
+# and hits under 8).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 
 def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
     from corro_sim.utils.compile_cache import enable_compile_cache
@@ -114,6 +126,139 @@ def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
             (f"wltest/wide/{'workload-repair' if repair else 'workload'}",
              time.perf_counter() - t0)
         )
+
+    # ISSUE 8: the SHARDED chunk programs, AOT-compiled against the
+    # 8-device host mesh (aval-only — ShapeDtypeStructs carry the
+    # NamedShardings, nothing allocates). Covers the CI multichip smoke
+    # config (shard_log on/off × full/repair) and the exact equivalence
+    # matrix tests/test_multichip.py dispatches inside pytest — keep the
+    # config literals below in lockstep with that file.
+    walls.extend(_prime_sharded_matrix(jax, jnp, smoke, chunk))
+    return walls
+
+
+def _prime_sharded_matrix(jax, jnp, smoke, chunk: int):
+    import dataclasses
+
+    from corro_sim.config import SimConfig
+    from corro_sim.core.merge_kernel import sharded_kernel_downgrade
+    from corro_sim.engine.driver import _chunk_runner
+    from corro_sim.engine.sharding import make_mesh, state_shardings
+    from corro_sim.engine.state import init_state
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        return [("sharded/SKIPPED (need 8 devices)", 0.0)]
+    mesh = make_mesh(devices[:8])
+    walls: list[tuple[str, float]] = []
+
+    def prime(name, cfg, shard_log, repair=False, donate=False,
+              workload=False):
+        cfg = cfg.validate()
+        n = cfg.num_nodes
+        state = jax.eval_shape(lambda cfg=cfg: init_state(cfg, seed=0))
+        sh = state_shardings(state, mesh, n, shard_log=shard_log)
+        state_avals = jax.tree.map(
+            lambda leaf, s: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=s
+            ),
+            state, sh,
+        )
+        # the driver's explicit-downgrade rule (engine/driver.py):
+        # a mesh run keeps its kernel only when the backend can run it
+        # per-shard; otherwise merge_kernel drops to "off" and the body
+        # is built mesh-free (sharding via input specs alone)
+        step_mesh = None
+        if cfg.merge_kernel != "off":
+            if sharded_kernel_downgrade(cfg, mesh.size) is not None:
+                cfg = dataclasses.replace(cfg, merge_kernel="off")
+            else:
+                step_mesh = mesh
+        keys = jax.ShapeDtypeStruct((chunk, 2), jnp.uint32)
+        alive = jax.ShapeDtypeStruct((chunk, n), jnp.bool_)
+        part = jax.ShapeDtypeStruct((chunk, n), jnp.int32)
+        we = jax.ShapeDtypeStruct((chunk,), jnp.bool_)
+        wl = (
+            _workload_avals(jax, jnp, chunk, n, cfg.seqs_per_version)
+            if workload else ()
+        )
+        t0 = time.perf_counter()
+        runner = _chunk_runner(
+            cfg, donate=donate, shardings=sh, repair=repair,
+            packed=True, workload=workload, mesh=step_mesh,
+        )
+        runner.lower(state_avals, keys, alive, part, we, *wl).compile()
+        walls.append((name, time.perf_counter() - t0))
+
+    # the CI multichip smoke config: shard_log on/off × full/repair
+    for shard_log in (True, False):
+        for repair in (False, True):
+            prime(
+                f"smoke/sharded-{'actor' if shard_log else 'repl'}/"
+                f"{'repair' if repair else 'full'}",
+                smoke, shard_log, repair=repair,
+            )
+
+    # tests/test_multichip.py BASE (== test_sharding_memory's 16-node
+    # config): both regimes + the donated pipeline pair
+    base = SimConfig(num_nodes=16, num_rows=8, num_cols=2,
+                     log_capacity=64)
+    prime("mc-base/sharded-actor/full", base, True)
+    prime("mc-base/sharded-repl/full", base, False)
+    prime("mc-base/sharded-actor/repair", base, True, repair=True)
+    prime("mc-base/sharded-actor/donate-full", base, True, donate=True)
+    prime("mc-base/sharded-actor/donate-repair", base, True, repair=True,
+          donate=True)
+
+    # narrow windowed-SWIM variant
+    swim = dataclasses.replace(
+        base, swim_enabled=True, swim_view_size=8, sync_interval=4,
+        narrow_state=True,
+    )
+    prime("mc-swim-narrow/sharded-actor/full", swim, True)
+
+    # lossy-scenario variant (the faults block re-keys the program)
+    from corro_sim.config import FaultConfig
+
+    lossy = dataclasses.replace(base, faults=FaultConfig(loss=0.2))
+    prime("mc-lossy/sharded-actor/full", lossy, True)
+
+    # workload-schedule variant (its own scan-input arity)
+    prime("mc-base/sharded-actor/workload", base, True, workload=True)
+
+    # forced-kernel variant: the shard_map'd Pallas merge (interpret
+    # per shard on CPU)
+    kcfg = SimConfig(
+        num_nodes=16, num_rows=64, num_cols=2, log_capacity=64,
+        merge_kernel="on", sync_interval=4,
+    )
+    prime("mc-kernel/sharded-actor/full", kcfg, True)
+
+    # the tests' single-device REFERENCE programs (every sharded
+    # equivalence run is compared against one of these)
+    def prime_single(name, cfg, repair=False, workload=False):
+        cfg = cfg.validate()
+        n = cfg.num_nodes
+        state = jax.eval_shape(lambda cfg=cfg: init_state(cfg, seed=0))
+        keys = jax.ShapeDtypeStruct((chunk, 2), jnp.uint32)
+        alive = jax.ShapeDtypeStruct((chunk, n), jnp.bool_)
+        part = jax.ShapeDtypeStruct((chunk, n), jnp.int32)
+        we = jax.ShapeDtypeStruct((chunk,), jnp.bool_)
+        wl = (
+            _workload_avals(jax, jnp, chunk, n, cfg.seqs_per_version)
+            if workload else ()
+        )
+        t0 = time.perf_counter()
+        runner = _chunk_runner(cfg, repair=repair, packed=True,
+                               workload=workload)
+        runner.lower(state, keys, alive, part, we, *wl).compile()
+        walls.append((name, time.perf_counter() - t0))
+
+    prime_single("mc-base/single/repair", base, repair=True)
+    prime_single("mc-swim-narrow/single/full", swim)
+    prime_single("mc-lossy/single/full", lossy)
+    prime_single("mc-base/single/workload", base, workload=True)
+    prime_single("mc-kernel/single/full", kcfg)
     return walls
 
 
