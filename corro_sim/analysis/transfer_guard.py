@@ -63,6 +63,9 @@ def sanctioned(point: str, enabled: bool = True):
       probe_extract      device→host: per-chunk (K, N) probe planes
       invariants         device→host: bookkeeping planes for the
                          checkers
+      checkpoint         device→host: the full state snapshot a
+                         chunk-boundary soak checkpoint serializes
+                         (io/checkpoint.py save_sim_checkpoint)
     """
     if not enabled:
         yield

@@ -93,17 +93,14 @@ def _step_eqns(cfg) -> dict:
 
 
 def _atomic_json_dump(path: str, obj) -> None:
-    """Write-then-rename so readers never see a torn file. Errors are
-    swallowed: progress artifacts must never kill the run they document
-    (a transient ENOSPC at chunk N would otherwise abort a multi-hour
+    """Write-then-rename so readers never see a torn file (the shared
+    crash-path idiom, corro_sim/utils/runtime.py). Errors are swallowed:
+    progress artifacts must never kill the run they document (a
+    transient ENOSPC at chunk N would otherwise abort a multi-hour
     benchmark with all its state)."""
-    try:
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(obj, f)
-        os.replace(tmp, path)
-    except OSError:
-        pass
+    from corro_sim.utils.runtime import atomic_json_dump
+
+    atomic_json_dump(path, obj)
 
 
 def run_headline_bench(
@@ -324,6 +321,10 @@ def run_north_star(n: int | None = None) -> dict:
             # per-repeat chunk-pipeline stats: the overlap the artifact
             # claims must be visible next to the walls it shaped
             "pipeline": res.pipeline,
+            # compile wall vs sim wall (ISSUE 10): repeat 0 pays any
+            # cold compiles, repeats 1+ must be all hits
+            "compile_seconds": round(res.compile_seconds, 3),
+            "compile_cache": res.compile_cache,
         })
         converged_round = res.converged_round or res.rounds
 
@@ -492,6 +493,12 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         "unit": "rounds_to_convergence",
         "wall_per_round_ms": round(res.wall_per_round_ms, 3),
         "sim_wall_per_round_ms": round(res.wall_per_round_ms, 3),
+        # compile wall separated from sim wall (ISSUE 10): total AOT
+        # compile seconds + the persistent-cache hit/miss split with
+        # the COLD share broken out, so a BENCH trajectory can tell a
+        # slow device from a cold cache
+        "compile_seconds": round(res.compile_seconds, 3),
+        "compile_cache": res.compile_cache,
         "converged": res.converged_round is not None,
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
@@ -696,6 +703,8 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
         "value": res.converged_round,
         "unit": "rounds_to_convergence",
         "wall_per_round_ms": round(res.wall_per_round_ms, 3),
+        "compile_seconds": round(res.compile_seconds, 3),
+        "compile_cache": res.compile_cache,
         "converged": res.converged_round is not None,
         "changes_applied": int(res.metrics["fresh"].sum())
         + int(res.metrics["sync_versions"].sum()),
@@ -800,6 +809,8 @@ def run_config_6(nodes: int | None = None, subs: int | None = None,
         "workload_events": len(wl.events),
         "pipeline": res.pipeline,
         "wall_seconds": round(time.perf_counter() - t0, 1),
+        "compile_seconds": round(res.compile_seconds, 3),
+        "compile_cache": res.compile_cache,
         **_step_eqns(cfg),
     }
 
@@ -994,6 +1005,8 @@ def run_config_7(nodes: int | None = None, write_rounds: int = 8) -> dict:
         "log_per_device_bytes_at_target": log_share(target_nodes, 8),
         "device_hbm": _device_hbm_stats(),
         "pipeline": res.pipeline,
+        "compile_seconds": round(res.compile_seconds, 3),
+        "compile_cache": res.compile_cache,
         "chunks": chunk_log,
         **_step_eqns(cfg),
     }
@@ -1066,7 +1079,7 @@ def main(config: int | None = None, **kw) -> int:
         err = _device_preflight()
         if err is not None:
             fn_name = CONFIGS.get(cfg_id, run_north_star).__name__
-            print(json.dumps({
+            out = {
                 "metric": f"bench_{fn_name}_unmeasured",
                 "value": None,
                 "vs_baseline": None,
@@ -1075,7 +1088,15 @@ def main(config: int | None = None, **kw) -> int:
                         "measurement is possible (last good north-star "
                         "capture: doc/round5.md, 5.90 s, "
                         "vs_baseline 0.192)",
-            }))
+            }
+            # BENCH_r05 fix (ISSUE 10): a preflight-dead round still
+            # leaves a partial artifact pointing at whatever state an
+            # earlier attempt left — the progress trail and the flight
+            # journal — plus the resume recipe, instead of rc=1 alone
+            out["partial_artifact"] = _write_partial_artifact(
+                cfg_id, out["error"]
+            )
+            print(json.dumps(out))
             return 1
     from corro_sim.utils.compile_cache import enable_compile_cache
 
@@ -1100,8 +1121,68 @@ def main(config: int | None = None, **kw) -> int:
             # platform/devices it was measured on
             out["env"] = _mesh_env()
         print(json.dumps(out))
+    except Exception as e:
+        # a leg dying mid-run (the r05 "device unresponsive" class)
+        # leaves a partial artifact naming the flight journal — which
+        # holds the curve up to the last completed chunk — and the
+        # resume trail, then reports the failure as ONE honest JSON
+        # line (the stdout contract) with rc=1
+        err = f"{type(e).__name__}: {e}"
+        print(json.dumps({
+            "metric": f"bench_config{cfg_id}_died",
+            "value": None,
+            "vs_baseline": None,
+            "error": err,
+            "partial_artifact": _write_partial_artifact(cfg_id, err),
+        }))
+        return 1
     finally:
         if _FLIGHT is not None:
             _FLIGHT.close()
             _FLIGHT = None
     return 0
+
+
+def _write_partial_artifact(cfg_id: int, error: str) -> str | None:
+    """BENCH_partial_config<N>.json: the state a dead bench run leaves
+    behind — last completed chunk (from the flight journal), the
+    journal path, any config-5 progress trail, and the resume recipe.
+    Returns the path, or None when even the artifact write failed."""
+    flight_path = (
+        _FLIGHT.sink_path if _FLIGHT is not None else None
+    )
+    last_round = None
+    if _FLIGHT is not None:
+        diag = _FLIGHT.diagnostics()
+        last_round = diag.get("last_round")
+    progress = None
+    prog_path = f"BENCH_config{cfg_id}_PROGRESS.json"
+    if os.path.exists(prog_path):
+        try:
+            with open(prog_path) as f:
+                progress = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            progress = None
+    partial = {
+        "status": "died",
+        "config": cfg_id,
+        "error": error,
+        "last_round_recorded": last_round,
+        "flight": flight_path,
+        "progress": progress,
+        "resume": {
+            # the bench legs are seeded + deterministic: re-running the
+            # same config continues the measurement series; soak-style
+            # state resume is `corro-sim soak --resume <ckpt>`
+            "note": "re-run `corro-sim bench --config "
+                    f"{cfg_id}` once the device returns; the flight "
+                    "journal holds the curve up to the last completed "
+                    "chunk",
+        },
+    }
+    path = f"BENCH_partial_config{cfg_id}.json"
+    try:
+        _atomic_json_dump(path, partial)
+        return path if os.path.exists(path) else None
+    except OSError:
+        return None

@@ -54,6 +54,16 @@ def _cmd_run(args: argparse.Namespace) -> int:
 
     import numpy as np
 
+    # the persistent cache the bench/tools entry points already share
+    # (ISSUE 10): repeat runs hit instead of recompiling, and the
+    # report's compile_cache block reads hit/miss instead of "unknown".
+    # MUST run before the engine imports below: they jit at import
+    # time, and jax latches its cache as uninitialized for every later
+    # write if the first compile happens with no cache dir configured.
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     from corro_sim.engine import init_state, run_sim
     from corro_sim.engine.driver import Schedule
     from corro_sim.io.config_file import load_config
@@ -145,6 +155,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
         "dropped_window": int(res.metrics["dropped_window"].sum()),
         "wall_per_round_ms": round(res.wall_per_round_ms, 3),
         "compile_seconds": round(res.compile_seconds, 2),
+        # compile-cost provenance (ISSUE 10): persistent-cache hits vs
+        # cold compiles, with cold wall separated from sim wall
+        "compile_cache": res.compile_cache,
         "sim_seconds_per_round": cfg.round_ms / 1000.0,
         "final_gap": float(np.asarray(res.metrics["gap"])[-1]),
         # curve-shaped convergence diagnostics off the flight record
@@ -235,41 +248,109 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     armed; the report carries per-scenario recovery time (rounds from the
     scheduled heal to re-convergence), injected-fault totals and the
     invariant verdicts. Exit codes: 0 all green; 5 an invariant broke;
-    3 a scenario failed to re-converge within the round budget."""
+    3 a scenario failed to re-converge within the round budget.
+
+    Multi-hour soaks survive device loss (ISSUE 10): with an artifact
+    prefix (``--out``) or an explicit ``--checkpoint``, a resumable
+    checkpoint is written every ``--checkpoint-every`` chunks and a run
+    that dies leaves ``<prefix>.partial.json`` (last completed chunk +
+    the resume token) instead of rc=1 with no state. ``soak --resume
+    <ckpt>`` reconstructs the sweep from the token — same config, seed
+    and chunking — and continues the killed scenario BIT-IDENTICALLY
+    (state, metrics and flight timeline match the uninterrupted run;
+    tests/test_soak_resume.py), then finishes the remaining scenarios.
+    """
     import dataclasses
+    import os
 
     import numpy as np
 
+    # before the engine imports — they jit at import time (see _cmd_run)
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     from corro_sim.engine import init_state, run_sim
     from corro_sim.faults import InvariantChecker, make_scenario
+    from corro_sim.io.checkpoint import (
+        _cfg_json,
+        _simconfig_from_dict,
+        load_sim_checkpoint,
+    )
     from corro_sim.io.config_file import load_config
     from corro_sim.obs.flight import FlightRecorder
 
-    base = load_config(args.config)
-    overrides = {
-        field: getattr(args, flag)
-        for flag, field in _FLAG_TO_FIELD.items()
-        if getattr(args, flag) is not None
-    }
-    base = dataclasses.replace(base, **overrides).validate()
-    from corro_sim.faults.scenarios import SOAK_DEFAULT
+    resume_ck = None
+    runs: list = []
+    if args.resume:
+        resume_ck = load_sim_checkpoint(args.resume)
+        soak_meta = resume_ck.meta.get("soak") or {}
+        if not soak_meta:
+            print(
+                f"{args.resume!r} is a sim checkpoint but carries no "
+                "soak sweep cursor — resume it via run_sim(resume=...)",
+                file=sys.stderr,
+            )
+            return 2
+        # the token is self-contained: CLI shape flags are ignored and
+        # the killed sweep's own args/config continue (anything else
+        # would break the bit-identity contract)
+        base = _simconfig_from_dict(soak_meta["base_cfg"]).validate()
+        sweep = dict(soak_meta["args"])
+        specs = list(soak_meta["specs"])
+        start_idx = int(soak_meta["index"])
+        runs = list(soak_meta.get("completed", []))
+        print(
+            f"# resuming soak from {args.resume} — scenario "
+            f"{start_idx + 1}/{len(specs)} at round {resume_ck.rounds}",
+            file=sys.stderr, flush=True,
+        )
+    else:
+        base = load_config(args.config)
+        overrides = {
+            field: getattr(args, flag)
+            for flag, field in _FLAG_TO_FIELD.items()
+            if getattr(args, flag) is not None
+        }
+        base = dataclasses.replace(base, **overrides).validate()
+        from corro_sim.faults.scenarios import SOAK_DEFAULT
 
-    # the default sweep covers the RECOVERABLE catalog — scenarios whose
-    # faults persist forever by design (blackhole_one_way, ring/star
-    # topology studies) can never re-converge and are opt-in by name
-    specs = args.scenario or list(SOAK_DEFAULT)
-    runs = []
-    any_violation = False
-    any_unconverged = False
-    for i, spec in enumerate(specs):
+        # the default sweep covers the RECOVERABLE catalog — scenarios
+        # whose faults persist forever by design (blackhole_one_way,
+        # ring/star topology studies) can never re-converge and are
+        # opt-in by name
+        specs = args.scenario or list(SOAK_DEFAULT)
+        start_idx = 0
+        sweep = {
+            "rounds": args.rounds,
+            "write_rounds": args.write_rounds,
+            "max_rounds": args.max_rounds,
+            "chunk": args.chunk,
+            "seed": args.seed,
+            "out": args.out,
+            "checkpoint": args.checkpoint,
+            "checkpoint_every": args.checkpoint_every,
+        }
+    out = sweep.get("out")
+    ckpt_path = sweep.get("checkpoint") or (
+        f"{out}.ckpt.npz" if out else None
+    )
+    ckpt_every = int(sweep.get("checkpoint_every") or 0)
+
+    any_violation = any(
+        not r.get("invariants", {}).get("ok", True) for r in runs
+    )
+    any_unconverged = any(r.get("converged_round") is None for r in runs)
+    for i in range(start_idx, len(specs)):
+        spec = specs[i]
         sc = make_scenario(
-            spec, base.num_nodes, rounds=args.rounds,
-            write_rounds=args.write_rounds, seed=args.seed,
+            spec, base.num_nodes, rounds=sweep["rounds"],
+            write_rounds=sweep["write_rounds"], seed=sweep["seed"],
         )
         cfg = sc.apply(base)
         inv = InvariantChecker(cfg)
         flight = None
-        if args.out:
+        if out:
             # filename from the FULL spec (sanitized), indexed — two
             # parameterizations of one scenario must not share a journal
             safe = "".join(
@@ -277,14 +358,75 @@ def _cmd_soak(args: argparse.Namespace) -> int:
                 for ch in sc.spec
             )
             flight = FlightRecorder(
-                sink_path=f"{args.out}.{i:02d}.{safe}.ndjson"
+                sink_path=f"{out}.{i:02d}.{safe}.ndjson"
             )
-        res = run_sim(
-            cfg, init_state(cfg, seed=args.seed), sc.schedule(),
-            max_rounds=args.max_rounds, chunk=args.chunk, seed=args.seed,
-            min_rounds=max(sc.heal_round or 0, args.write_rounds),
-            flight=flight, invariants=inv,
-        )
+        ck_meta = {"soak": {
+            "specs": specs,
+            "index": i,
+            "completed": list(runs),
+            "base_cfg": _cfg_json(base),
+            "args": sweep,
+        }}
+        try:
+            res = run_sim(
+                cfg, init_state(cfg, seed=sweep["seed"]), sc.schedule(),
+                max_rounds=sweep["max_rounds"], chunk=sweep["chunk"],
+                seed=sweep["seed"],
+                min_rounds=max(sc.heal_round or 0, sweep["write_rounds"]),
+                flight=flight, invariants=inv,
+                resume=resume_ck if i == start_idx else None,
+                checkpoint_path=ckpt_path,
+                checkpoint_every=ckpt_every if ckpt_path else 0,
+                checkpoint_meta=ck_meta,
+            )
+        except Exception as e:  # device loss / kill-adjacent failures
+            # the BENCH_r05 fix: a dying soak leaves a partial artifact
+            # naming how far it got and the token that resumes it,
+            # instead of rc=1 with no state
+            # only advertise a resume token that actually exists on
+            # disk — a death before the first checkpoint write must not
+            # hand the operator a recovery command that FileNotFounds.
+            # The token may predate this scenario (died before ITS
+            # first checkpoint): resuming is still correct — it replays
+            # the tokened scenario's tail and re-derives everything
+            # after — but the artifact says which scenario restarts.
+            token = None
+            token_index = None
+            if ckpt_path and os.path.exists(ckpt_path):
+                token = ckpt_path
+                try:
+                    token_index = load_sim_checkpoint(ckpt_path).meta[
+                        "soak"]["index"]
+                except Exception:
+                    token_index = None
+            partial = {
+                "status": "died",
+                "error": f"{type(e).__name__}: {e}",
+                "scenario": sc.spec,
+                "scenario_index": i,
+                "scenarios_total": len(specs),
+                "completed": runs,
+                "resume_token": token,
+                "resume_resumes_scenario_index": token_index,
+                "resume_cmd": (
+                    f"corro-sim soak --resume {token}"
+                    if token else None
+                ),
+                "flight": (
+                    flight.sink_path
+                    if flight is not None and flight.sink_active else None
+                ),
+            }
+            from corro_sim.utils.runtime import atomic_json_dump
+
+            path = f"{out or 'soak'}.partial.json"
+            if atomic_json_dump(path, partial, indent=2):
+                partial["partial_artifact"] = path
+            if flight is not None:
+                flight.close()
+            print(json.dumps(partial, indent=2))
+            return 1
+        resume_ck = None
         heal = sc.heal_round
         recovery = (
             res.converged_round - heal
@@ -305,6 +447,7 @@ def _cmd_soak(args: argparse.Namespace) -> int:
             "poisoned": res.poisoned,
             "fault_totals": fault_totals,
             "invariants": inv.report(),
+            "compile_cache": res.compile_cache,
         }
         if flight is not None:
             run["flight"] = (
@@ -322,15 +465,19 @@ def _cmd_soak(args: argparse.Namespace) -> int:
         )
     report = {
         "nodes": base.num_nodes,
-        "rounds": args.rounds,
-        "seed": args.seed,
+        "rounds": sweep["rounds"],
+        "seed": sweep["seed"],
         "scenarios": runs,
         "ok": not (any_violation or any_unconverged),
     }
-    if args.out:
-        with open(f"{args.out}.report.json", "w") as f:
+    if resume_ck is not None or args.resume:
+        report["resumed_from"] = args.resume
+    if ckpt_path:
+        report["checkpoint"] = ckpt_path
+    if out:
+        with open(f"{out}.report.json", "w") as f:
             json.dump(report, f, indent=2)
-        report["report"] = f"{args.out}.report.json"
+        report["report"] = f"{out}.report.json"
     print(json.dumps(report, indent=2))
     if any_violation:
         return 5
@@ -348,6 +495,12 @@ def _cmd_load(args: argparse.Namespace) -> int:
     runs both and merges the reports. Exit 3 when the batched path fails
     to converge inside the round budget."""
     import time as _time
+
+    # before the workload/engine imports — they jit at import time
+    # (see _cmd_run)
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
 
     from corro_sim.workload import assert_workload_vacuous, make_workload
 
@@ -884,7 +1037,25 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument(
         "--out",
         help="artifact path prefix: <out>.<scenario>.ndjson flight "
-             "journals + <out>.report.json",
+             "journals + <out>.report.json (+ <out>.ckpt.npz resume "
+             "token and <out>.partial.json if the run dies)",
+    )
+    ps.add_argument(
+        "--checkpoint",
+        help="resumable-checkpoint path (default: <out>.ckpt.npz when "
+             "--out is set; io/checkpoint.py sim checkpoints)",
+    )
+    ps.add_argument(
+        "--checkpoint-every", type=int, default=4,
+        help="chunks between resumable checkpoints (0 disables; only "
+             "active when a checkpoint path resolves)",
+    )
+    ps.add_argument(
+        "--resume",
+        help="continue a killed soak from its checkpoint file — the "
+             "token reconstructs the sweep (config, seed, chunking, "
+             "remaining scenarios) and the killed scenario continues "
+             "bit-identically; other flags are ignored",
     )
     ps.set_defaults(fn=_cmd_soak)
 

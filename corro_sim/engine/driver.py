@@ -68,6 +68,7 @@ from corro_sim.utils.metrics import (
     counters,
     histograms,
 )
+from corro_sim.utils.compile_cache import CompileCacheProbe
 from corro_sim.utils.runtime import start_async_fetch
 from corro_sim.utils.tracing import tracer
 
@@ -197,6 +198,11 @@ class RunResult:
     # device count, mesh shape, change-log regime
     # (actor_sharded|replicated), effective merge_kernel, and any
     # explicit config downgrades the backend forced. None off-mesh.
+    compile_cache: dict | None = None  # compile-cost provenance (ISSUE
+    # 10): persistent-cache hits/misses and COLD compile seconds for
+    # this run's AOT chunk-program compiles, total + by program
+    # (utils/compile_cache.py CompileCacheProbe.summary()). Separates
+    # the cache-miss tax from sim wall in every report/bench artifact.
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -321,6 +327,10 @@ def run_sim(
     pipeline: bool | None = None,
     transfer_guard: bool | None = None,
     workload=None,
+    resume=None,
+    checkpoint_path: str | None = None,
+    checkpoint_every: int = 0,
+    checkpoint_meta: dict | None = None,
 ) -> RunResult:
     """``min_rounds``: don't test convergence before this round — needed when
     the schedule brings nodes back later (a cluster can be momentarily
@@ -376,6 +386,25 @@ def run_sim(
     default) builds the exact pre-workload chunk programs — the step
     program is byte-identical with no workload armed (jaxpr golden +
     ``assert_feature_vacuous``).
+
+    ``resume``: a :class:`corro_sim.io.checkpoint.SimCheckpoint` — pick a
+    killed run back up at its last chunk boundary and continue
+    **bit-identically** to the uninterrupted run: the per-chunk keys are
+    ``fold_in(root, ci)`` with ``ci`` continuing from the checkpoint,
+    the schedule rows are a function of the absolute round only, and the
+    repair-selection cursor (``last_pend_live``/``prev_writes``) is
+    restored, so every remaining chunk dispatches the exact program the
+    unkilled run would have (tests/test_soak_resume.py pins final state
+    AND stitched metrics). The caller passes the SAME cfg/schedule/seed/
+    chunk the original run used (``check_compatible`` refuses others)
+    and an ``init_state``-shaped template as ``state``. Walls restart at
+    zero (wall is per-process); metrics and the flight timeline stitch.
+
+    ``checkpoint_path``/``checkpoint_every``: write a resumable
+    checkpoint to ``checkpoint_path`` every ``checkpoint_every``
+    committed chunks (atomic write-then-rename — a kill mid-save never
+    corrupts the resume token). ``checkpoint_meta`` rides the file
+    verbatim (the soak CLI stores its sweep cursor there).
     """
     schedule = schedule or Schedule()
     if workload is not None:
@@ -526,6 +555,46 @@ def run_sim(
     probe_p99_last = None  # worst per-probe p99 delivery lag seen so far
     repair_seen = False
     repair_chunks = 0
+    cache_probe = CompileCacheProbe()  # persistent-cache hit/miss per
+    # AOT compile (ISSUE 10) — RunResult.compile_cache
+    start_ci = 0
+
+    if resume is not None:
+        # continue a checkpointed run at its chunk boundary: state,
+        # PRNG position (ci), repair-selection cursor, metrics tail and
+        # flight timeline all restore; everything downstream of here
+        # then behaves as if the earlier chunks ran in this process.
+        if workload is not None:
+            raise ValueError(
+                "resume does not compose with workload runs "
+                "(the schedule cursor is not checkpointed)"
+            )
+        resume.check_compatible(cfg, seed=seed, chunk=chunk)
+        # pre-loop: the transfer guard is not armed yet — the install's
+        # host→device uploads need no sanction point
+        state = resume.install_state(state)
+        rounds = resume.rounds
+        start_ci = resume.next_chunk
+        cur = resume.cursor
+        last_pend_live = cur.get("last_pend_live")
+        prev_writes = bool(cur.get("prev_writes", False))
+        repair_seen = bool(cur.get("repair_seen", False))
+        repair_chunks = int(cur.get("repair_chunks", 0))
+        probe_p99_last = cur.get("probe_p99_last")
+        if resume.metrics:
+            metrics_chunks.append(resume.metrics)
+        flight.ingest_ndjson(resume.flight_lines)
+        flight.set_meta(
+            resumed_from=resume.path, resumed_at_round=rounds,
+        )
+        flight.annotate(rounds, "resume", chunk=start_ci)
+        counters.inc(
+            "corro_soak_resumes_total",
+            help_="runs continued from a chunk-boundary checkpoint "
+                  "(run_sim resume=)",
+        )
+        if checkpoint_meta is None:
+            checkpoint_meta = resume.meta
 
     # Compile is separated from execution by AOT-lowering the chunk
     # program up front, so EVERY chunk's wall (including the first —
@@ -558,10 +627,21 @@ def run_sim(
         nonlocal compile_seconds
         t0 = time.perf_counter()
         compiled_ = None
+        cache_status = None
+        t_compile = 0.0
         try:
             with tracer.span("aot lower+compile", program=program,
                              slow_warn=False):
-                compiled_ = run_jit.lower(*args).compile()
+                lowered = run_jit.lower(*args)
+                # hit/miss detection brackets the compile() ALONE: the
+                # persistence threshold it reasons about gates on XLA
+                # compile time, so lowering wall must not be counted
+                # toward it (a slow lower over a fast cold compile
+                # would otherwise read as a hit)
+                cache_probe.begin()
+                t_c = time.perf_counter()
+                compiled_ = lowered.compile()
+                t_compile = time.perf_counter() - t_c
             counters.inc(
                 "corro_compile_total", labels=f'{{program="{program}"}}',
                 help_="XLA chunk-program compiles by program",
@@ -573,6 +653,17 @@ def run_sim(
                 help_="AOT lower/compile failures falling back to jit",
             )
         c_done = time.perf_counter()
+        if compiled_ is not None:
+            # persistent-cache hit/miss (ISSUE 10): a hit-served compile
+            # is warm overhead, a miss is the cold tax the cache-key
+            # manifest exists to keep off the books — report them as
+            # separate quantities everywhere this run is measured
+            cache_status = cache_probe.end(program, t_compile)
+        flight.annotate(
+            rounds, "compile", program=program,
+            wall_s=round(c_done - t0, 6),
+            **({"cache": cache_status} if cache_status else {}),
+        )
         histograms.observe(
             "corro_compile_seconds", c_done - t0,
             labels=f'{{program="{program}"}}',
@@ -815,6 +906,45 @@ def run_sim(
                             help_="soak invariant violations by checker",
                         )
                 return False
+        if (
+            checkpoint_path and checkpoint_every
+            and (ci + 1) % checkpoint_every == 0
+        ):
+            # chunk-boundary resume point (ISSUE 10): only reached for a
+            # CONTINUING run — a converged/poisoned run returned above,
+            # so a resume token never re-animates a finished run. The
+            # save blocks on this chunk's state (one device→host
+            # snapshot); pipelined mode still overlaps it with chunk
+            # N+1's device execution.
+            from corro_sim.io.checkpoint import save_sim_checkpoint
+
+            with _tg_sanctioned("checkpoint", transfer_guard):
+                save_sim_checkpoint(
+                    checkpoint_path, cfg=cfg, state=state_now, seed=seed,
+                    chunk=chunk, rounds=rounds, next_chunk=ci + 1,
+                    cursor={
+                        "last_pend_live": last_pend_live,
+                        "prev_writes": prev_writes,
+                        "repair_seen": repair_seen,
+                        "repair_chunks": repair_chunks,
+                        "probe_p99_last": probe_p99_last,
+                    },
+                    metrics={
+                        k: np.concatenate(
+                            [np.asarray(c[k]) for c in metrics_chunks]
+                        )
+                        for k in metrics_chunks[0]
+                    },
+                    flight=flight,
+                    meta=checkpoint_meta,
+                )
+            flight.annotate(rounds, "checkpoint", chunk=ci,
+                            path=checkpoint_path)
+            counters.inc(
+                "corro_soak_checkpoints_total",
+                help_="chunk-boundary soak checkpoints written "
+                      "(run_sim checkpoint_every=)",
+            )
         return True
 
     profiling = False
@@ -839,7 +969,7 @@ def run_sim(
     try:
         if not pipeline:
             # ------------------------------------------ sequential loop
-            ci = 0
+            ci = start_ci
             while rounds < max_rounds:
                 alive, part, we = schedule.slice(rounds, chunk,
                                                  cfg.num_nodes)
@@ -859,7 +989,7 @@ def run_sim(
                     use_repair and repair_compiled is None
                     and not repair_seen
                 )
-                if ci == 0:
+                if ci == start_ci:
                     _compile_full(args)
                 run_compiled = repair_compiled if use_repair else compiled
                 run_jit = repair_runner if use_repair else runner
@@ -880,7 +1010,9 @@ def run_sim(
                         )
                     fetch_wait = time.perf_counter() - t_f
                 chunk_elapsed = time.perf_counter() - t0
-                if run_compiled is None and (ci == 0 or first_repair_jit):
+                if run_compiled is None and (
+                    ci == start_ci or first_repair_jit
+                ):
                     # fallback: the first chunk through each program pays
                     # compile+exec mixed and is excluded from the
                     # steady-state wall (the pre-AOT accounting) — and
@@ -1000,7 +1132,8 @@ def run_sim(
 
             pending = None
             if rounds < max_rounds:
-                pending = _dispatch(0, 0, state, last_pend_live, False,
+                pending = _dispatch(start_ci, rounds, state,
+                                    last_pend_live, False,
                                     speculative=False)
             last_commit_t = time.perf_counter()
             compile_pending = 0.0  # chunk 0's fallback compile happened
@@ -1205,4 +1338,5 @@ def run_sim(
         ),
         pipeline=pipeline_stats,
         sharding=sharding_info,
+        compile_cache=cache_probe.summary(),
     )

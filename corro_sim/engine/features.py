@@ -1,0 +1,150 @@
+"""Feature-leaf registry: the extension contract for optional SimState.
+
+Every SimState leaf change cold-invalidates the whole persistent XLA
+compile cache (~30 min of recompiles — doc/performance.md "compile-cache
+lifecycle"), which taxed exactly the state-touching work the ROADMAP
+needs: protocol variants, packing experiments, new observability planes.
+The tax existed because optional planes were hard fields on the pytree —
+adding one changed the avals of EVERY configuration, enabled or not.
+
+This module makes optional state a *registry*: a feature registers a
+name, an enabled predicate over :class:`SimConfig`, a builder for its
+leaf pytree, and a checkpoint-volatility flag. Enabled features live in
+``SimState.features[name]``; a disabled feature contributes **nothing**
+— no placeholder, no leaf, no aval — so registering a new feature leaves
+the pytree structure, the traced jaxpr, and the compiled-program cache
+keys of every non-enabling configuration byte-identical
+(tests/test_cache_stability.py pins this; the cache-key manifest in
+``analysis/golden/cache_keys.json`` enforces it in CI).
+
+Two pre-registry features — the probe tracer and the Gilbert burst
+plane — predate this contract and keep their original placeholder-field
+layout (``SimState.probe`` / ``SimState.fault_burst``, a (1, ...) stub
+when disabled) because moving them into the dict would itself re-key
+every committed program, the exact cost this refactor removes. They
+register as ``field=``-style entries so the one registry still owns
+their builders and scrub rules; **new** features must use the dict form.
+
+Registry contract for adding a feature leaf (doc/performance.md §7):
+
+- ``enabled(cfg)`` must be a pure function of the config — the step
+  program is keyed by config, and a leaf that appears for some seeds
+  but not others would break the chunk-program ABI mid-run;
+- the step must thread a feature it does not consume through unchanged
+  (``state.replace`` without naming ``features`` already does);
+- ``volatile=True`` (the default) scrubs the leaf from portable
+  backups/restores, like gossip/SWIM/probe state; a non-volatile leaf
+  rides warm-boot checkpoints but must not carry actor-indexed values
+  (``backup``'s actor relabel does not visit feature leaves).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureLeaf:
+    """One registered optional state plane."""
+
+    name: str
+    enabled: Callable[[Any], bool]  # SimConfig -> bool (pure in cfg)
+    build: Callable[[Any, int], Any]  # (cfg, seed) -> leaf pytree
+    # Legacy placeholder-field layout (probe / fault_burst only): the
+    # leaf is a hard SimState field that exists even when disabled, as
+    # a minimal stub. None (the default for new features) = the leaf
+    # exists only in SimState.features when enabled.
+    placeholder: Callable[[Any], Any] | None = None
+    field: str | None = None  # legacy SimState attribute name
+    volatile: bool = True  # scrubbed from portable backups/restores
+
+    def materialize(self, cfg, seed: int):
+        """Build the leaf for ``cfg`` — the enabled form, or the legacy
+        placeholder for field-style entries (dict-style disabled
+        features materialize to nothing and must not call this)."""
+        if self.enabled(cfg):
+            return self.build(cfg, seed)
+        if self.placeholder is None:
+            raise ValueError(
+                f"feature {self.name!r} is disabled and has no "
+                "placeholder — it contributes no leaf"
+            )
+        return self.placeholder(cfg)
+
+
+_REGISTRY: dict[str, FeatureLeaf] = {}
+
+
+def register_feature(leaf: FeatureLeaf, *, replace: bool = False) -> FeatureLeaf:
+    """Register a feature leaf. Name collisions raise unless ``replace``
+    (tests re-registering a dummy leaf use it)."""
+    if not replace and leaf.name in _REGISTRY:
+        raise ValueError(f"feature leaf {leaf.name!r} already registered")
+    if leaf.field is not None and leaf.placeholder is None:
+        raise ValueError(
+            f"field-style feature {leaf.name!r} needs a placeholder "
+            "(the pre-registry layout keeps a stub when disabled)"
+        )
+    _REGISTRY[leaf.name] = leaf
+    return leaf
+
+
+def unregister_feature(name: str) -> None:
+    """Remove a registered leaf (test teardown)."""
+    _REGISTRY.pop(name, None)
+
+
+def feature_registry() -> dict[str, FeatureLeaf]:
+    """Snapshot of the registry, insertion-ordered."""
+    return dict(_REGISTRY)
+
+
+def get_feature(name: str) -> FeatureLeaf:
+    return _REGISTRY[name]
+
+
+def build_features(cfg, seed: int = 0) -> dict:
+    """The ``SimState.features`` dict for ``cfg``: one entry per enabled
+    dict-style feature, NOTHING for disabled ones. Sorted by name so the
+    pytree structure is a pure function of the enabled set, never of
+    registration order."""
+    out = {}
+    for name in sorted(_REGISTRY):
+        leaf = _REGISTRY[name]
+        if leaf.field is not None:
+            continue  # legacy field-style — built by init_state directly
+        if leaf.enabled(cfg):
+            out[name] = leaf.build(cfg, seed)
+    return out
+
+
+def build_field(name: str, cfg, seed: int = 0):
+    """Build a legacy field-style leaf (enabled form or placeholder)."""
+    return _REGISTRY[name].materialize(cfg, seed)
+
+
+def volatile_scrub_prefixes() -> tuple[str, ...]:
+    """Flattened state-dict key prefixes of every volatile feature leaf —
+    what the checkpoint scrub/restore filters drop (io/checkpoint.py).
+    Field-style leaves scrub under their field name; dict-style under
+    ``features/<name>``. Exact-or-slash matching happens at the caller
+    (a prefix here must not catch an unrelated leaf sharing the spelling
+    as a prefix)."""
+    out = []
+    for name in sorted(_REGISTRY):
+        leaf = _REGISTRY[name]
+        if not leaf.volatile:
+            continue
+        out.append(leaf.field if leaf.field is not None
+                   else f"features/{name}")
+    return tuple(out)
+
+
+def enabled_feature_names(cfg) -> tuple[str, ...]:
+    """Names of every enabled feature under ``cfg`` (field- and
+    dict-style) — the config's feature-scope line, for tests and
+    introspection tooling."""
+    return tuple(
+        name for name in sorted(_REGISTRY) if _REGISTRY[name].enabled(cfg)
+    )

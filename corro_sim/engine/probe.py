@@ -36,6 +36,8 @@ import flax.struct
 import jax.numpy as jnp
 import numpy as np
 
+from corro_sim.engine.features import FeatureLeaf, register_feature
+
 # infector sentinels
 INFECTOR_NONE = -1  # origin (or not yet infected)
 INFECTOR_SYNC = -2  # joined via an anti-entropy range transfer
@@ -91,6 +93,25 @@ def make_probe_state(
         dup=jnp.zeros((k,), jnp.int32),
         last_sync=jnp.full((n,), -1, jnp.int32),
     )
+
+
+# Pre-registry feature (engine/features.py): the probe planes keep
+# their placeholder-field layout (SimState.probe, (1, 1) stubs when
+# off) because moving them into the features dict would re-key every
+# committed step program. The registry still owns the builder + scrub
+# rule, so checkpoint filters and audits read ONE source of truth.
+register_feature(FeatureLeaf(
+    name="probe",
+    enabled=lambda cfg: cfg.probes > 0,
+    build=lambda cfg, seed: make_probe_state(
+        cfg.probes, cfg.num_nodes, narrow=cfg.narrow_state
+    ),
+    placeholder=lambda cfg: make_probe_state(
+        0, cfg.num_nodes, narrow=cfg.narrow_state
+    ),
+    field="probe",
+    volatile=True,
+))
 
 
 def probe_write_update(

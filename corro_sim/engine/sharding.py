@@ -114,6 +114,12 @@ def state_shardings(
             if state.fault_burst.shape[0] == num_nodes
             else replicated  # (1,) placeholder when burst loss is off
         ),
+        # registry-backed feature leaves (engine/features.py): the
+        # generic placement rule — node-leading axes shard, everything
+        # else replicates. A feature needing a different layout earns
+        # an explicit entry here when it lands. Empty dict when no
+        # dict-style feature is enabled (zero leaves, zero effect).
+        features=node_major(state.features),
     )
 
 

@@ -9,17 +9,24 @@ that axis is what gets sharded over the TPU mesh.
 
 from __future__ import annotations
 
+import dataclasses
+
 import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+import corro_sim.faults.inject  # noqa: F401  (registers the fault_burst
+# feature leaf at import time — engine/features.py)
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping, make_bookkeeping
 from corro_sim.core.changelog import ChangeLog, make_changelog
 from corro_sim.core.compaction import CellOwnership, make_ownership
 from corro_sim.core.crdt import TableState, make_table_state
-from corro_sim.engine.probe import ProbeState, make_probe_state
+from corro_sim.engine.features import build_features, build_field
+from corro_sim.engine.probe import ProbeState, make_probe_state  # noqa: F401
+# (make_probe_state re-exported for drivers that re-aim probes; the
+# import also registers the probe feature leaf)
 from corro_sim.gossip.broadcast import GossipState, make_gossip_state
 from corro_sim.membership.rtt import make_rtt
 from corro_sim.membership.swim import SwimState, make_swim_state
@@ -67,6 +74,17 @@ class SimState:
     # state per node's receive path (corro_sim/faults/): True = the
     # node's incoming links lose at faults.burst_loss this round. (1,)
     # placeholder when cfg.faults.burst_enter == 0 — untouched then.
+    features: dict = dataclasses.field(default_factory=dict)
+    # Registry-backed optional planes (engine/features.py): one entry
+    # per ENABLED dict-style feature leaf, keyed by feature name;
+    # disabled features contribute NOTHING — no placeholder, no aval —
+    # so registering a new feature leaves every non-enabling config's
+    # pytree structure, jaxpr, and compiled-program cache keys
+    # byte-identical (an empty dict flattens to zero leaves). The step
+    # threads unconsumed features through unchanged (state.replace
+    # without naming them). probe/fault_burst above predate the
+    # registry and keep their placeholder-field ABI; new optional
+    # state goes HERE (doc/performance.md "compile-cache lifecycle").
 
 
 def _row_cdf(cfg: SimConfig) -> np.ndarray:
@@ -136,8 +154,9 @@ def init_state(cfg: SimConfig, seed: int = 0) -> SimState:
             else (1, 6, 1),
             jnp.int32,
         ),
-        probe=make_probe_state(cfg.probes, n, narrow=cfg.narrow_state),
-        fault_burst=jnp.zeros(
-            (n,) if cfg.faults.burst_enter > 0 else (1,), bool
-        ),
+        # the two pre-registry feature leaves build through the registry
+        # (ONE owner for builders + scrub rules — engine/features.py)
+        probe=build_field("probe", cfg, seed),
+        fault_burst=build_field("fault_burst", cfg, seed),
+        features=build_features(cfg, seed),
     )

@@ -62,7 +62,14 @@ def make_step(cfg: SimConfig, repair: bool = False, mesh=None):
     ``mesh``: the sharded fast path (ISSUE 8) — the kernel merge sites
     run per-shard inside ``shard_map`` regions with explicit collectives
     for cross-shard lanes. ``None`` (every single-device caller) traces
-    the byte-identical program the jaxpr golden pins."""
+    the byte-identical program the jaxpr golden pins.
+
+    Program scope (ISSUE 10): the body traces from ONLY the leaves the
+    config enables — registry feature leaves (``SimState.features``,
+    engine/features.py) a config does not enable simply do not exist in
+    the carry, so each chunk program's cache key covers exactly its own
+    feature set. Unconsumed enabled features thread through
+    ``state.replace`` untouched (``replace`` keeps unnamed fields)."""
 
     def body(state, inp):
         key, alive, part, we = inp

@@ -18,7 +18,22 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from corro_sim.engine.features import FeatureLeaf, register_feature
 from corro_sim.faults.masks import pairs_to_mask
+
+# Pre-registry feature (engine/features.py): the Gilbert burst-loss
+# Markov plane keeps its placeholder-field layout (SimState.fault_burst,
+# a (1,) stub when burst loss is off) — re-homing it into the features
+# dict would re-key every committed step program. Builder + scrub rule
+# live here so the faults module owns its plane end to end.
+register_feature(FeatureLeaf(
+    name="fault_burst",
+    enabled=lambda cfg: cfg.faults.burst_enter > 0,
+    build=lambda cfg, seed: jnp.zeros((cfg.num_nodes,), bool),
+    placeholder=lambda cfg: jnp.zeros((1,), bool),
+    field="fault_burst",
+    volatile=True,
+))
 
 # fold_in tag for the fault key lane (arbitrary constant, fixed forever:
 # changing it changes every seeded fault stream)

@@ -35,6 +35,7 @@ import base64
 import dataclasses
 import io as _io
 import json
+import os
 
 import flax.serialization
 import jax.numpy as jnp
@@ -42,6 +43,34 @@ import numpy as np
 
 FORMAT_VERSION = 4  # 4: per-version (A, L) cleared_hlc ts plane
 # 3: packed changelog cell tensor (log/cells)
+
+
+# Core volatile per-run state (non-feature): gossip buffers, SWIM
+# membership, RTT observations and the in-flight delay ring never travel
+# in a portable backup (__corro_members/__corro_subs scrub analog).
+# Feature-leaf volatility comes from the registry (engine/features.py)
+# so a new optional plane gets the right scrub rule by declaring it,
+# not by editing three filter tuples here.
+_CORE_SCRUB = ("gossip/", "swim/", "rtt", "inflight")
+# restore() additionally re-derives topology/sampling constants:
+_RESTORE_SCRUB = _CORE_SCRUB + ("ring0", "row_cdf")
+
+
+def _drop_volatile(flat: dict, core: tuple) -> dict:
+    from corro_sim.engine.features import volatile_scrub_prefixes
+
+    feature_keys = volatile_scrub_prefixes()
+
+    def volatile(k: str) -> bool:
+        if k.startswith(core):
+            return True
+        # feature entries match exact-or-slash so a feature named
+        # "probe" cannot catch an unrelated "probe_foo" leaf
+        return any(
+            k == p or k.startswith(p + "/") for p in feature_keys
+        )
+
+    return {k: v for k, v in flat.items() if not volatile(k)}
 
 
 # ------------------------------------------------------------- value codec
@@ -223,11 +252,9 @@ def save_checkpoint(cluster, path, *, scrub: bool = False,
         flat = _flatten(sd)
         if scrub:
             # __corro_members / __corro_subs / in-flight buffers scrub:
-            # gossip + swim state do not travel in a portable backup
-            flat = {
-                k: v for k, v in flat.items()
-                if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "probe/", "fault_burst"))
-            }
+            # gossip + swim state and every volatile feature leaf
+            # (registry-declared) do not travel in a portable backup
+            flat = _drop_volatile(flat, _CORE_SCRUB)
             if origin_node != 0:
                 nested = _unflatten(flat)
                 nested = _permute_actors(nested, origin_node, 0)
@@ -382,37 +409,40 @@ def _install(cluster, meta, flat, node):
     if node is not None and node != 0:
         nested = _permute_actors(nested, 0, node)
     base = flax.serialization.to_state_dict(cluster.state)
-
-    def merge(dst, src):
-        for k, v in src.items():
-            if isinstance(v, dict):
-                merge(dst[k], v)
-            else:
-                if tuple(dst[k].shape) != tuple(v.shape):
-                    raise ValueError(
-                        f"shape mismatch for {k}: checkpoint "
-                        f"{tuple(v.shape)} vs cluster {tuple(dst[k].shape)}"
-                    )
-                if np.dtype(v.dtype) != np.dtype(dst[k].dtype):
-                    # the packed SWIM/probe planes have the SAME shape
-                    # wide and narrow (SimConfig.narrow_state) but a
-                    # different field layout — coercing would silently
-                    # reinterpret packed bits, so refuse loudly
-                    raise ValueError(
-                        f"dtype mismatch for {k}: checkpoint "
-                        f"{np.dtype(v.dtype)} vs cluster "
-                        f"{np.dtype(dst[k].dtype)} (narrow_state "
-                        "checkpoints restore only into narrow_state "
-                        "clusters, and vice versa)"
-                    )
-                dst[k] = jnp.asarray(v)
-
-    merge(base, nested)
+    _merge_tensors(base, nested)
     cluster.state = flax.serialization.from_state_dict(cluster.state, base)
     cluster._rounds_ticked = meta["rounds_ticked"]
     cluster._totals = dict(meta["totals"])
     cluster._alive = np.asarray(meta["alive"], bool)
     cluster._part = np.asarray(meta["partition"], np.int32)
+
+
+def _merge_tensors(dst: dict, src: dict) -> None:
+    """Write checkpoint tensors over a template state-dict in place,
+    refusing shape or dtype drift (shared by the LiveCluster installer
+    and the sim-checkpoint resume path)."""
+    for k, v in src.items():
+        if isinstance(v, dict):
+            _merge_tensors(dst[k], v)
+        else:
+            if tuple(dst[k].shape) != tuple(v.shape):
+                raise ValueError(
+                    f"shape mismatch for {k}: checkpoint "
+                    f"{tuple(v.shape)} vs cluster {tuple(dst[k].shape)}"
+                )
+            if np.dtype(v.dtype) != np.dtype(dst[k].dtype):
+                # the packed SWIM/probe planes have the SAME shape
+                # wide and narrow (SimConfig.narrow_state) but a
+                # different field layout — coercing would silently
+                # reinterpret packed bits, so refuse loudly
+                raise ValueError(
+                    f"dtype mismatch for {k}: checkpoint "
+                    f"{np.dtype(v.dtype)} vs cluster "
+                    f"{np.dtype(dst[k].dtype)} (narrow_state "
+                    "checkpoints restore only into narrow_state "
+                    "clusters, and vice versa)"
+                )
+            dst[k] = jnp.asarray(v)
 
 
 def backup(cluster, path, node: int = 0) -> None:
@@ -426,13 +456,11 @@ def restore(path, node: int = 0, tripwire=None):
     (``corrosion restore`` analog: site_id swap-back + subs wipe)."""
     meta, flat = _read(path)
     # restore() treats any file as a portable backup: volatile per-run
-    # state (subs, gossip buffers, SWIM membership, topology) never
-    # survives a restore — the target re-derives its own.
+    # state (subs, gossip buffers, SWIM membership, topology, volatile
+    # feature leaves) never survives a restore — the target re-derives
+    # its own.
     meta = {**meta, "subs": []}
-    flat = {
-        k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/", "fault_burst"))
-    }
+    flat = _drop_volatile(flat, _RESTORE_SCRUB)
     cluster = _cluster_from_meta(meta, tripwire)
     if node >= cluster.cfg.num_nodes:
         raise ValueError(
@@ -461,10 +489,7 @@ def restore_into(cluster, path, node: int = 0) -> None:
     meta, flat = _read(path)
     # volatile per-run state never crosses a restore (same filter as
     # restore()): the running cluster keeps its own topology + membership
-    flat = {
-        k: v for k, v in flat.items()
-        if not k.startswith(("gossip/", "swim/", "rtt", "inflight", "ring0", "row_cdf", "probe/", "fault_burst"))
-    }
+    flat = _drop_volatile(flat, _RESTORE_SCRUB)
     with cluster.locks.tracked(cluster._lock, "restore", "write"):
         new_layout = _rebuild_layout(meta)
         # validate EVERYTHING before mutating: a failure below this block
@@ -495,3 +520,167 @@ def restore_into(cluster, path, node: int = 0) -> None:
         cluster.subs.layout._layout = new_layout
         cluster._schema_history = list(meta["schema_history"])
         _install(cluster, meta, flat, node=node)
+
+
+# ------------------------------------------------- sim (soak) checkpoints
+#
+# Chunk-boundary resume points for `run_sim` (ISSUE 10): a multi-hour
+# chaos soak must survive device loss (BENCH_r05 died to an unresponsive
+# device with NO way to resume). Distinct from the LiveCluster
+# checkpoints above — no schema/universe/subs surface, instead the full
+# batched-run cursor: state tensors, PRNG position (the next chunk
+# index — per-chunk keys are fold_in(root, ci)), the repair-selection
+# cursor, the metrics arrays so far, and the flight timeline, so
+# `run_sim(resume=...)` continues BIT-IDENTICALLY to the uninterrupted
+# run (tests/test_soak_resume.py).
+
+SIM_CKPT_FORMAT = 1
+
+
+def _simconfig_from_dict(d: dict):
+    """Rebuild a SimConfig from its JSON-round-tripped asdict form."""
+    from corro_sim.config import FaultConfig, SimConfig
+
+    d = dict(d)
+    faults = d.pop("faults", None)
+    if faults:
+        faults = dict(faults)
+        faults["blackhole"] = tuple(
+            tuple(int(x) for x in p) for p in faults.get("blackhole", ())
+        )
+        d["faults"] = FaultConfig(**faults)
+    return SimConfig(**d)
+
+
+def _cfg_json(cfg) -> dict:
+    """JSON-normalized asdict (tuples become lists, exactly what a
+    checkpoint header round-trips to) — the comparable form."""
+    return json.loads(json.dumps(dataclasses.asdict(cfg)))
+
+
+@dataclasses.dataclass
+class SimCheckpoint:
+    """One loaded resume token (:func:`load_sim_checkpoint`)."""
+
+    cfg_dict: dict
+    seed: int
+    chunk: int
+    rounds: int  # rounds completed (== next chunk's first round)
+    next_chunk: int  # the chunk index the resumed loop dispatches first
+    cursor: dict  # repair-selection cursor (last_pend_live, prev_writes,
+    # repair_seen/chunks, probe_p99_last)
+    metrics: dict  # name -> (rounds,) np.ndarray — the tail to stitch
+    flight_lines: list  # the flight timeline's ND-JSON export
+    meta: dict  # caller extras (the soak CLI's sweep cursor)
+    state_flat: dict  # flat state-dict key -> np.ndarray
+    path: str | None = None
+
+    @property
+    def cfg(self):
+        return _simconfig_from_dict(self.cfg_dict)
+
+    def check_compatible(self, cfg, seed: int, chunk: int) -> None:
+        """Refuse to resume under a different config/seed/chunking —
+        any of those changes the key stream or the schedule alignment,
+        and the continuation would silently not be the killed run."""
+        if _cfg_json(cfg) != self.cfg_dict:
+            raise ValueError(
+                "resume config differs from the checkpointed one — a "
+                "resumed soak must run the exact killed config "
+                "(checkpoint: corro-sim soak --resume reconstructs it)"
+            )
+        if seed != self.seed or chunk != self.chunk:
+            raise ValueError(
+                f"resume seed/chunk ({seed}/{chunk}) differ from the "
+                f"checkpoint's ({self.seed}/{self.chunk}) — the "
+                "per-chunk key stream would diverge"
+            )
+
+    def install_state(self, template):
+        """The checkpointed tensors over an ``init_state``-shaped
+        template (shape/dtype drift refuses loudly)."""
+        base = flax.serialization.to_state_dict(template)
+        _merge_tensors(base, _unflatten(self.state_flat))
+        return flax.serialization.from_state_dict(template, base)
+
+
+def save_sim_checkpoint(
+    path: str, *, cfg, state, seed: int, chunk: int, rounds: int,
+    next_chunk: int, cursor: dict, metrics: dict, flight=None,
+    meta: dict | None = None,
+) -> None:
+    """Write a resume token atomically (write-then-rename): a kill
+    mid-save leaves the PREVIOUS checkpoint intact, never a torn file."""
+    import time as _time
+
+    from corro_sim.utils.metrics import histograms as _histograms
+
+    _t0 = _time.perf_counter()
+    sd = flax.serialization.to_state_dict(state)
+    flat = {f"state/{k}": np.asarray(v) for k, v in _flatten(sd).items()}
+    for k, v in metrics.items():
+        flat[f"metrics/{k}"] = np.asarray(v)
+    header = {
+        "format": SIM_CKPT_FORMAT,
+        "kind": "sim",
+        "cfg": _cfg_json(cfg),
+        "seed": int(seed),
+        "chunk": int(chunk),
+        "rounds": int(rounds),
+        "next_chunk": int(next_chunk),
+        "cursor": cursor,
+        "meta": meta or {},
+    }
+    fl = flight.to_ndjson() if flight is not None else ""
+    buf = _io.BytesIO()
+    np.savez_compressed(
+        buf,
+        __meta__=np.frombuffer(json.dumps(header).encode(), np.uint8),
+        __flight__=np.frombuffer(fl.encode(), np.uint8),
+        **flat,
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+    _histograms.observe(
+        "corro_soak_checkpoint_seconds", _time.perf_counter() - _t0,
+        help_="chunk-boundary soak checkpoint wall (state snapshot + "
+              "serialize + atomic rename)",
+    )
+
+
+def load_sim_checkpoint(path: str) -> SimCheckpoint:
+    with np.load(path) as z:
+        header = json.loads(bytes(z["__meta__"]).decode())
+        flight_lines = bytes(z["__flight__"]).decode().splitlines()
+        state_flat = {
+            k[len("state/"):]: z[k]
+            for k in z.files if k.startswith("state/")
+        }
+        metrics = {
+            k[len("metrics/"):]: z[k]
+            for k in z.files if k.startswith("metrics/")
+        }
+    if header.get("kind") != "sim":
+        raise ValueError(
+            f"{path!r} is not a sim checkpoint (use load_checkpoint/"
+            "restore for LiveCluster files)"
+        )
+    if header.get("format") != SIM_CKPT_FORMAT:
+        raise ValueError(
+            f"unsupported sim checkpoint format {header.get('format')!r}"
+        )
+    return SimCheckpoint(
+        cfg_dict=header["cfg"],
+        seed=header["seed"],
+        chunk=header["chunk"],
+        rounds=header["rounds"],
+        next_chunk=header["next_chunk"],
+        cursor=header.get("cursor", {}),
+        metrics=metrics,
+        flight_lines=flight_lines,
+        meta=header.get("meta", {}),
+        state_flat=state_flat,
+        path=path,
+    )

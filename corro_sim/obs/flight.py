@@ -225,6 +225,31 @@ class FlightRecorder:
             f.write(self.to_ndjson())
         os.replace(tmp, path)
 
+    def ingest_ndjson(self, path_or_lines) -> None:
+        """Replay a prior run's exported timeline into this recorder —
+        the soak-resume stitch (run_sim ``resume=``): the killed run's
+        rounds/events/phases land ahead of anything this run records
+        (and journal to the active sink), meta merges with THIS run's
+        keys winning. Call before recording any new rounds so the
+        stitched timeline stays round-ordered."""
+        other = FlightRecorder.load(path_or_lines)
+        with self._lock:
+            for k, v in other._meta.items():
+                self._meta.setdefault(k, v)
+            for name, s in other._phases.items():
+                # phase walls accumulate across the kill boundary: the
+                # stitched record reports TOTAL compile/execute wall
+                self._phases[name] = self._phases.get(name, 0.0) + s
+                self._journal(
+                    {"t": "phase", "name": name, "s": self._phases[name]}
+                )
+            for rec in other._rounds:
+                self._rounds.append(rec)
+                self._journal({"t": "round", "r": rec[0], "m": rec[1]})
+            for ev in other._events:
+                self._events.append(ev)
+                self._journal({"t": "event", **ev})
+
     @classmethod
     def load(cls, path_or_lines) -> "FlightRecorder":
         """Rebuild a recorder from an ND-JSON export or journal. Accepts

@@ -41,6 +41,7 @@ from corro_sim.subs.query import (
     eval_predicate_py,
     fold_aggregate,
     parse_query,
+    predicate_batch_plan,
     predicate_columns,
     predicate_intern_values,
     rewrite_columns,
@@ -280,6 +281,30 @@ class Matcher(_EventStream):
             prj = vr[:, jnp.asarray(proj, jnp.int32)] if proj else vr[:, :0]
             return match, prj
 
+        # Batch plan (ROADMAP "matcher evals are per-matcher jits —
+        # batch them"): the predicate's structure skeleton + flat
+        # constant vectors. Matchers sharing (skeleton, table range,
+        # projection width, default count) ride ONE vmapped jit in
+        # SubsManager.step — the observer node, columns, literals and
+        # defaults all travel as batched inputs. Rebuilt here so
+        # rebind() (rank respace) refreshes the constants with the
+        # compiled predicate.
+        plan = predicate_batch_plan(
+            self._dev_where, self.universe,
+            lambda c: layout.col_index(select.table, c),
+        )
+        if plan is not None:
+            skeleton, consts = plan
+            self._batch_sig = (
+                skeleton, start, cap, len(proj), len(col_defaults),
+            )
+            self._batch_consts = consts
+            self._batch_proj = np.asarray(proj, np.int32)
+            self._batch_dflt_planes = dflt_planes_np
+            self._batch_dflt_ranks = dflt_ranks_np
+        else:
+            self._batch_sig = None
+
         return evaluate
 
     def rebind(self, old_ranks, new_ranks) -> None:
@@ -341,10 +366,16 @@ class Matcher(_EventStream):
         self._pk_mask_cache = (gen, mask)
         return mask
 
-    def _evaluate(self, table_state):
-        match, proj = jax.tree.map(
-            np.asarray, self._eval(table_state.vr, table_state.cl)
-        )
+    def _evaluate(self, table_state, precomputed=None):
+        if precomputed is not None:
+            # this matcher's rows of a batched group eval
+            # (SubsManager._batched_precompute) — device work and the
+            # device→host transfer already happened, once per GROUP
+            match, proj = precomputed
+        else:
+            match, proj = jax.tree.map(
+                np.asarray, self._eval(table_state.vr, table_state.cl)
+            )
         pk_mask = self._pk_mask()
         if pk_mask is not None:
             match = match & pk_mask
@@ -385,11 +416,11 @@ class Matcher(_EventStream):
                 return t.pk
         return ("pk",) * len(key_probe[1]) if key_probe[1] else ()
 
-    def step(self, table_state) -> list:
+    def step(self, table_state, precomputed=None) -> list:
         """Re-evaluate and emit change events for the delta."""
         if not self._primed:
             raise RuntimeError("matcher not primed — call prime() first")
-        match, proj = self._evaluate(table_state)
+        match, proj = self._evaluate(table_state, precomputed=precomputed)
         events = []
         ins = match & ~self._prev_match
         dele = ~match & self._prev_match
@@ -1582,13 +1613,17 @@ class SubsManager:
     the ``SubsManager::get_or_insert`` surface (``pubsub.rs:52-118``)."""
 
     def __init__(self, layout_adapter: LayoutAdapter, universe,
-                 max_buffer: int = 512):
+                 max_buffer: int = 512, batch: bool = True):
         self.layout = layout_adapter
         self.universe = universe
         self.max_buffer = max_buffer
+        self.batch = batch  # group same-skeleton matchers into one
+        # vmapped jit per step (False = the per-matcher-jit path, kept
+        # for the equivalence tests)
         self._by_id: dict[str, Matcher] = {}
         self._by_query: dict[tuple, str] = {}
         self._next_id = 0
+        self._batched_cache: dict = {}  # batch sig -> compiled evaluator
 
     def get_or_insert(self, sql: str, node: int, table_state):
         """Returns (matcher, initial_events | None) — None when deduped to
@@ -1649,13 +1684,149 @@ class SubsManager:
         if m is not None:
             self._by_query.pop((m.select.normalized(), m.node), None)
 
-    def step(self, table_state, touched=None) -> dict:
-        """Advance every (candidate) matcher; returns {sub_id: [events]}."""
-        out = {}
-        for sub_id, m in self._by_id.items():
-            if not m.is_candidate(touched):
+    def _build_batched_eval(self, sig):
+        """One vmapped jit for a batch signature: evaluates EVERY
+        matcher of the group in a single dispatch — the per-matcher
+        device program (slice → defaults → predicate → projection) with
+        node/projection/defaults/predicate-constants as batched inputs."""
+        skeleton, start, cap, proj_w, n_dflt = sig
+        from corro_sim.subs.query import compile_predicate_batched
+
+        pred_fn = compile_predicate_batched(skeleton)
+
+        @jax.jit
+        def evaluate(vr_all, cl_all, nodes, projs, dplanes, dranks,
+                     *consts):
+            def one(node, proj_i, dp, dr, *c):
+                vr = jax.lax.dynamic_slice_in_dim(
+                    jnp.take(vr_all, node, axis=0), start, cap, 0
+                )
+                cl = jax.lax.dynamic_slice_in_dim(
+                    jnp.take(cl_all, node, axis=0), start, cap, 0
+                )
+                if n_dflt:
+                    fill = jnp.full((vr.shape[1],), NEG, vr.dtype)
+                    fill = fill.at[dp].set(dr.astype(vr.dtype))
+                    vr = jnp.where(vr == NEG, fill[None, :], vr)
+                unset = vr == NEG
+                live = (cl % 2) == 1
+                match = pred_fn(vr, unset, list(c)) & live
+                prj = (
+                    jnp.take(vr, proj_i, axis=1) if proj_w
+                    else vr[:, :0]
+                )
+                return match, prj
+
+            return jax.vmap(one)(nodes, projs, dplanes, dranks, *consts)
+
+        return evaluate
+
+    def _batched_precompute(self, table_state, matchers) -> dict:
+        """{id(matcher): (match, proj)} for every plain matcher riding
+        a batched group this step (groups of >= 2 sharing a batch
+        signature); singletons and structured matchers fall through to
+        their own jits. One dispatch + ONE device→host transfer pair
+        per group instead of per matcher — the live leg's path to 10k+
+        subscribers (doc/workloads.md)."""
+        if not self.batch:
+            return {}
+        groups: dict = {}
+        for m in matchers:
+            sig = getattr(m, "_batch_sig", None)
+            if type(m) is Matcher and sig is not None:
+                groups.setdefault(sig, []).append(m)
+        out: dict = {}
+        for sig, ms in groups.items():
+            if len(ms) < 2:
                 continue
-            ev = m.step(table_state)
+            ev = self._batched_cache.get(sig)
+            if ev is None:
+                ev = self._batched_cache[sig] = self._build_batched_eval(
+                    sig
+                )
+            # pad the group to the next power of two (edge-repeat, rows
+            # sliced back off below): the candidate filter makes group
+            # size vary round to round, and an exact-size vmap would
+            # retrace per distinct size — bucketing bounds retraces to
+            # O(log subscribers) per skeleton
+            b = len(ms)
+            reps = (1 << (b - 1).bit_length()) - b
+
+            def stack(arrs):
+                a = np.stack(arrs)
+                if reps:
+                    a = np.concatenate(
+                        [a, np.repeat(a[-1:], reps, axis=0)]
+                    )
+                return a
+
+            nodes = stack([np.int32(m.node) for m in ms])
+            projs = stack([m._batch_proj for m in ms])
+            dpl = stack([m._batch_dflt_planes for m in ms])
+            drk = stack([m._batch_dflt_ranks for m in ms])
+            consts = [
+                stack(cs)
+                for cs in zip(*(m._batch_consts for m in ms))
+            ]
+            match, proj = ev(
+                table_state.vr, table_state.cl, nodes, projs, dpl, drk,
+                *consts,
+            )
+            match = np.asarray(match)
+            proj = np.asarray(proj)
+            from corro_sim.utils.metrics import (
+                SUBS_BATCH_GROUPS_TOTAL,
+                SUBS_MATCHER_EVALS_TOTAL,
+                counters,
+            )
+
+            counters.inc(
+                SUBS_BATCH_GROUPS_TOTAL,
+                help_="batched matcher-group dispatches (one jit per "
+                      "predicate skeleton per step)",
+            )
+            counters.inc(
+                SUBS_MATCHER_EVALS_TOTAL, n=len(ms),
+                labels='{mode="batched"}',
+                help_="matcher evaluations by dispatch mode (batched = "
+                      "rode a vmapped group jit)",
+            )
+            for i, m in enumerate(ms):
+                out[id(m)] = (match[i], proj[i])
+        return out
+
+    def step(self, table_state, touched=None) -> dict:
+        """Advance every (candidate) matcher; returns {sub_id: [events]}.
+
+        Plain matchers sharing a predicate skeleton evaluate as one
+        vmapped jit (``_batched_precompute``); host-side diffing and
+        event materialization stay per matcher and bit-identical to the
+        unbatched path (tests/test_subs_load.py)."""
+        cands = [
+            (sub_id, m) for sub_id, m in self._by_id.items()
+            if m.is_candidate(touched)
+        ]
+        pre = self._batched_precompute(
+            table_state, [m for _, m in cands]
+        )
+        singles = sum(1 for _, m in cands if id(m) not in pre)
+        if singles:
+            from corro_sim.utils.metrics import (
+                SUBS_MATCHER_EVALS_TOTAL,
+                counters,
+            )
+
+            counters.inc(
+                SUBS_MATCHER_EVALS_TOTAL, n=singles,
+                labels='{mode="single"}',
+                help_="matcher evaluations by dispatch mode (batched = "
+                      "rode a vmapped group jit)",
+            )
+        out = {}
+        for sub_id, m in cands:
+            p = pre.get(id(m))
+            ev = m.step(table_state, precomputed=p) if type(m) is Matcher \
+                else m.step(table_state)
             if ev:
                 out[sub_id] = ev
         return out

@@ -1338,3 +1338,210 @@ def compile_predicate(pred, universe: RankUniverse, col_index):
     if pred is None:
         return lambda vr, unset: jnp.ones(vr.shape[:1], bool)
     return comp(pred)
+
+
+# ------------------------------------- batched (structure-keyed) compile
+#
+# One registered query = one jit was the r1 shape; at 1k+ live
+# subscriptions that is 1k jit dispatches + 2k device→host reads per
+# tick, and the live leg stops scaling (ROADMAP: "matcher evals are
+# per-matcher jits — batch them"). The observation: workload-shaped
+# subscriber populations differ only in their CONSTANTS (literals,
+# columns, observer node) while sharing the predicate's structure. So a
+# predicate compiles in two pieces:
+#
+# - a **skeleton** (:func:`predicate_batch_plan`): the hashable AST
+#   structure — node kinds, ops, negations, range counts/open-endedness
+#   — everything that shapes the traced program;
+# - a **constants vector**: one flat int32 array per AST node carrying
+#   the column index, NULL band and rank bounds, consumed positionally
+#   by the structure-compiled evaluator
+#   (:func:`compile_predicate_batched`).
+#
+# Matchers sharing a skeleton evaluate as ONE vmapped jit over their
+# stacked constants (subs/manager.py) — bit-identical to the per-matcher
+# path (tests/test_subs_load.py pins it), with the per-tick dispatch
+# count dropping from O(subscriptions) to O(distinct structures).
+
+
+def predicate_batch_plan(pred, universe, col_index):
+    """``(skeleton, consts)`` for the batched evaluator, or None when a
+    node cannot batch (JsonContains — host-side anyway). ``consts`` is a
+    list of 1-D int32 arrays, one per constant-bearing node in walk
+    order; layout per node: ``[ci, nlo, nhi, lo..., hi...]``."""
+    import numpy as np
+
+    def null_band():
+        lo, hi = universe.rank_of(None)
+        return int(lo), int(hi)
+
+    def _open(hi):
+        return hi is None
+
+    def walk(p):
+        if p is None:
+            return ("true",), []
+        if isinstance(p, Cmp):
+            if p.lit is None:
+                return ("false",), []
+            if p.op in ("=", "!="):
+                ranges = tuple(universe.eq_ranges(p.lit))
+                negate = p.op == "!="
+            else:
+                ranges = tuple(universe.sql_ranges(p.lit, p.op))
+                negate = False
+            nlo, nhi = null_band()
+            open_pat = tuple(_open(hi) for _, hi in ranges)
+            consts = np.asarray(
+                [col_index(p.col), nlo, nhi]
+                + [int(lo) for lo, _ in ranges]
+                + [0 if _open(hi) else int(hi) for _, hi in ranges],
+                np.int32,
+            )
+            return ("cmp", negate, len(ranges), open_pat), [consts]
+        if isinstance(p, IsNull):
+            nlo, nhi = null_band()
+            return ("isnull", p.negated), [
+                np.asarray([col_index(p.col), nlo, nhi], np.int32)
+            ]
+        if isinstance(p, InList):
+            bounds = tuple(
+                rng
+                for v in p.lits if v is not None
+                for rng in universe.eq_ranges(v)
+            )
+            has_null = any(v is None for v in p.lits)
+            nlo, nhi = null_band()
+            consts = np.asarray(
+                [col_index(p.col), nlo, nhi]
+                + [int(lo) for lo, _ in bounds]
+                + [int(hi) for _, hi in bounds],
+                np.int32,
+            )
+            return ("inlist", p.negated, has_null, len(bounds)), [consts]
+        if isinstance(p, Like):
+            ranges = like_prefix_ranges(p.pattern)
+            if ranges is None:
+                return None
+            edges = tuple(
+                (universe.rank_of(lo)[0], universe.rank_of(hi)[0])
+                for lo, hi in ranges
+            )
+            nlo, nhi = null_band()
+            consts = np.asarray(
+                [col_index(p.col), nlo, nhi]
+                + [int(lo) for lo, _ in edges]
+                + [int(hi) for _, hi in edges],
+                np.int32,
+            )
+            return ("like", p.negated, len(edges)), [consts]
+        if isinstance(p, (And, Or)):
+            subs, consts = [], []
+            for q in p.parts:
+                r = walk(q)
+                if r is None:
+                    return None
+                subs.append(r[0])
+                consts.extend(r[1])
+            tag = "and" if isinstance(p, And) else "or"
+            return (tag, tuple(subs)), consts
+        if isinstance(p, Not):
+            r = walk(p.inner)
+            if r is None:
+                return None
+            return ("not", r[0]), r[1]
+        return None  # JsonContains / unknown node — no batch form
+
+    return walk(pred)
+
+
+def compile_predicate_batched(skeleton):
+    """Structure-only compile of a :func:`predicate_batch_plan` skeleton:
+    ``fn(vr, unset, consts) -> (R,) bool`` with every constant read from
+    the ``consts`` arrays — the SAME function evaluates every matcher
+    sharing the skeleton, so it vmaps over stacked constants."""
+    pos_counter = [0]
+
+    def take_pos():
+        p = pos_counter[0]
+        pos_counter[0] += 1
+        return p
+
+    def build(sk):
+        tag = sk[0]
+        if tag == "true":
+            return lambda vr, unset, c: jnp.ones(vr.shape[:1], bool)
+        if tag == "false":
+            return lambda vr, unset, c: jnp.zeros(vr.shape[:1], bool)
+        if tag == "cmp":
+            _, negate, k, open_pat = sk
+            pos = take_pos()
+
+            def f(vr, unset, c, pos=pos, negate=negate, k=k,
+                  open_pat=open_pat):
+                a = c[pos]
+                r = jnp.take(vr, a[0], axis=1)
+                known = ~jnp.take(unset, a[0], axis=1) & ~(
+                    (r >= a[1]) & (r < a[2])
+                )
+                m = jnp.zeros(r.shape, bool)
+                for j in range(k):
+                    part = r >= a[3 + j]
+                    if not open_pat[j]:
+                        part = part & (r < a[3 + k + j])
+                    m = m | part
+                return (~m if negate else m) & known
+
+            return f
+        if tag == "isnull":
+            _, neg = sk
+            pos = take_pos()
+
+            def f(vr, unset, c, pos=pos, neg=neg):
+                a = c[pos]
+                r = jnp.take(vr, a[0], axis=1)
+                isnull = jnp.take(unset, a[0], axis=1) | (
+                    (r >= a[1]) & (r < a[2])
+                )
+                return ~isnull if neg else isnull
+
+            return f
+        if tag in ("inlist", "like"):
+            if tag == "inlist":
+                _, neg, has_null, k = sk
+            else:
+                _, neg, k = sk
+                has_null = False
+            pos = take_pos()
+
+            def f(vr, unset, c, pos=pos, neg=neg, k=k,
+                  has_null=has_null, tag=tag):
+                a = c[pos]
+                r = jnp.take(vr, a[0], axis=1)
+                known = ~jnp.take(unset, a[0], axis=1) & ~(
+                    (r >= a[1]) & (r < a[2])
+                )
+                hit = jnp.zeros(r.shape, bool)
+                for j in range(k):
+                    hit = hit | ((r >= a[3 + j]) & (r < a[3 + k + j]))
+                if tag == "inlist" and neg and has_null:
+                    return jnp.zeros(r.shape, bool)  # NOT IN w/ NULL
+                return known & (~hit if neg else hit)
+
+            return f
+        if tag == "and":
+            fs = [build(q) for q in sk[1]]
+            return lambda vr, unset, c: jnp.stack(
+                [f(vr, unset, c) for f in fs]
+            ).all(0)
+        if tag == "or":
+            fs = [build(q) for q in sk[1]]
+            return lambda vr, unset, c: jnp.stack(
+                [f(vr, unset, c) for f in fs]
+            ).any(0)
+        if tag == "not":
+            f = build(sk[1])
+            return lambda vr, unset, c: ~f(vr, unset, c)
+        raise QueryError(f"bad batch skeleton {sk!r}")
+
+    return build(skeleton)

@@ -1,14 +1,34 @@
-"""Persistent XLA compile cache for non-test entry points.
+"""Persistent XLA compile cache + compile-cost observability.
 
 The 10k-node chunk program costs tens of seconds to compile; tests already
 cache compiles on disk (tests/conftest.py) but the bench / CLI / tools
 entry points paid it on every process launch. One shared cache directory
 keeps bench re-runs and tool iterations warm. Safe to call repeatedly;
 honors an explicit JAX_COMPILATION_CACHE_DIR if the user set one.
+
+Compile cost used to be an *invisible tax*: a SimState leaf change
+cold-invalidated every cache entry and the ~30 min of recompiles smeared
+into whatever ran first (doc/performance.md "compile-cache lifecycle").
+This module makes it a measured quantity:
+
+- :func:`program_cache_key` — a deterministic fingerprint of a lowered
+  chunk program (sha-256 of its StableHLO text). Two lowerings share a
+  persistent-cache entry iff their program text matches, so this key
+  *is* the unit of cache identity the manifest in
+  ``analysis/golden/cache_keys.json`` pins (tools/prime_cache.py), the
+  ``audit --diff`` analog for cache keys instead of jaxprs.
+- :class:`CompileCacheProbe` — hit/miss detection around a compile,
+  riding jax's own monitoring events (a cache request served = hit; a
+  request NOT served = cold compile, even one jax skips persisting).
+  Feeds ``corro_compile_cache_hits_total`` /
+  ``corro_compile_cache_misses_total`` and the
+  ``corro_compile_cold_seconds`` histogram (utils/metrics.py), and the
+  per-run ``RunResult.compile_cache`` block.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 
@@ -28,3 +48,191 @@ def enable_compile_cache() -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass  # older jax without these flags: compile cache is best-effort
+    # Un-latch jax's once-only cache initialization. Importing
+    # corro_sim.utils triggers module-scope jits (utils/bits.py weak
+    # constants) BEFORE any entry point can run this function, and
+    # jax's _initialize_cache latches permanently on that first compile
+    # — with no dir configured yet, every later lookup AND write is
+    # silently disabled for the whole process (the compile-cache
+    # hit/miss events exposed this: bench/CLI processes cold-compiled
+    # on every launch while the directory sat warm). reset_cache()
+    # clears the latch so the next compile re-initializes against the
+    # directory configured above. Only needed when nothing is cached
+    # yet — a live cache object means initialization already saw a dir.
+    try:
+        from jax._src import compilation_cache as _cc
+
+        if _cc._cache is None:
+            _cc.reset_cache()
+    except Exception:
+        pass  # private API moved: worst case is the pre-fix behavior
+
+
+def cache_dir() -> str | None:
+    """The ACTIVE persistent-cache directory, or None when no cache is
+    configured (hit/miss detection is then unavailable)."""
+    env = os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    if env:
+        return env
+    try:
+        import jax
+
+        return jax.config.jax_compilation_cache_dir or None
+    except Exception:
+        return None
+
+
+def program_cache_key(lowered) -> str:
+    """Deterministic fingerprint of a ``jit(...).lower(...)`` result:
+    sha-256 over the StableHLO module text. Stable across processes for
+    a fixed (program, jax version, visible-device layout); changes
+    exactly when the compiled program would re-key the persistent cache.
+    Truncated to 16 hex chars — collision-safe at manifest scale and
+    short enough to diff by eye."""
+    return hashlib.sha256(
+        lowered.as_text().encode()
+    ).hexdigest()[:16]
+
+
+# jax's own persistent-cache instrumentation (jax._src.compiler /
+# compilation_cache): every compile that CONSULTS the cache records
+# compile_requests_use_cache, and every retrieval records cache_hits —
+# so "consulted but not served" is an exact cold-compile signal, with
+# no directory heuristics and no persistence-threshold blind spot (a
+# fast cold compile that jax chooses not to persist still shows up as
+# request-without-hit). The listener is process-global and counts
+# forever; probes read deltas.
+_EVENT_HITS = "/jax/compilation_cache/cache_hits"
+_EVENT_REQUESTS = "/jax/compilation_cache/compile_requests_use_cache"
+_CACHE_EVENTS = {"hits": 0, "requests": 0}
+_LISTENER_STATE = {"registered": False}
+
+
+def _on_jax_event(event, **kwargs) -> None:
+    if event == _EVENT_HITS:
+        _CACHE_EVENTS["hits"] += 1
+    elif event == _EVENT_REQUESTS:
+        _CACHE_EVENTS["requests"] += 1
+
+
+def _ensure_listener() -> bool:
+    if _LISTENER_STATE["registered"]:
+        return True
+    try:
+        import jax.monitoring
+
+        jax.monitoring.register_event_listener(_on_jax_event)
+        _LISTENER_STATE["registered"] = True
+    except Exception:
+        pass
+    return _LISTENER_STATE["registered"]
+
+
+class CompileCacheProbe:
+    """Hit/miss observation around persistent-cache compiles.
+
+    Usage::
+
+        probe = CompileCacheProbe()
+        ...
+        probe.begin()
+        compiled = lowered.compile()
+        status = probe.end("full", seconds)   # "hit"|"miss"|"unknown"
+
+    Detection rides jax's monitoring events (above): zero cache
+    requests between begin/end means the persistent cache was not in
+    play (``"unknown"``); a request served from the cache is a
+    ``"hit"``; any consulted compile NOT served is a ``"miss"`` (the
+    conservative reading when one program triggers several backend
+    compiles — any cold one makes the compile cold). Compiles are
+    assumed serial between begin/end (the driver's are). Counters land
+    in the process-wide registries (utils/metrics.py) under
+    ``corro_compile_cache_{hits,misses}_total{program=...}`` and cold
+    walls in ``corro_compile_cold_seconds{program=...}``.
+    """
+
+    def __init__(self, emit_metrics: bool = True):
+        self.emit_metrics = emit_metrics
+        self.hits = 0
+        self.misses = 0
+        self.unknown = 0
+        self.cold_seconds = 0.0
+        self.by_program: dict[str, dict] = {}
+        self._before: tuple[int, int] | None = None
+
+    def begin(self) -> None:
+        if _ensure_listener():
+            self._before = (
+                _CACHE_EVENTS["requests"], _CACHE_EVENTS["hits"]
+            )
+        else:
+            self._before = None
+
+    def end(self, program: str, seconds: float) -> str:
+        before, self._before = self._before, None
+        if before is None:
+            status = "unknown"
+            self.unknown += 1
+        else:
+            d_req = _CACHE_EVENTS["requests"] - before[0]
+            d_hit = _CACHE_EVENTS["hits"] - before[1]
+            if d_req == 0:
+                status = "unknown"  # cache disabled / not consulted
+                self.unknown += 1
+            elif d_hit >= d_req:
+                status = "hit"
+                self.hits += 1
+            else:
+                status = "miss"
+                self.misses += 1
+                self.cold_seconds += seconds
+        prog = self.by_program.setdefault(
+            program, {"hits": 0, "misses": 0, "unknown": 0,
+                      "cold_seconds": 0.0},
+        )
+        if status == "miss":
+            prog["misses"] += 1
+            prog["cold_seconds"] = round(
+                prog["cold_seconds"] + seconds, 6
+            )
+        elif status == "hit":
+            prog["hits"] += 1
+        else:
+            prog["unknown"] += 1
+        if self.emit_metrics and status != "unknown":
+            from corro_sim.utils.metrics import (
+                COMPILE_CACHE_HITS_TOTAL,
+                COMPILE_CACHE_MISSES_TOTAL,
+                COMPILE_COLD_SECONDS,
+                COMPILE_COLD_SECONDS_HELP,
+                SECONDS_BUCKETS,
+                counters,
+                histograms,
+            )
+
+            counters.inc(
+                COMPILE_CACHE_HITS_TOTAL if status == "hit"
+                else COMPILE_CACHE_MISSES_TOTAL,
+                labels=f'{{program="{program}"}}',
+                help_="persistent XLA compile-cache "
+                      f"{'hits' if status == 'hit' else 'misses'} by "
+                      "chunk program",
+            )
+            if status == "miss":
+                histograms.observe(
+                    COMPILE_COLD_SECONDS, seconds,
+                    labels=f'{{program="{program}"}}',
+                    help_=COMPILE_COLD_SECONDS_HELP,
+                    buckets=SECONDS_BUCKETS,
+                )
+        return status
+
+    def summary(self) -> dict:
+        """The ``RunResult.compile_cache`` / bench-artifact block."""
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "unknown": self.unknown,
+            "cold_seconds": round(self.cold_seconds, 6),
+            "by_program": {k: dict(v) for k, v in self.by_program.items()},
+        }

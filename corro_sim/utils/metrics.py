@@ -113,6 +113,45 @@ CONFIG_DOWNGRADE_HELP = (
     "annotations carry the same provenance)"
 )
 
+# ---- corro_compile_cache_*: compile-cost observability ----------------
+# Compile cost used to be an invisible tax (a SimState leaf change cold
+# the whole .jax_cache and the ~30 min smeared into whatever ran first).
+# Every AOT lower+compile in the driver and the prime-cache warm layer
+# (tools/prime_cache.py) now reports against the persistent cache
+# (utils/compile_cache.py CompileCacheProbe — detection via jax's own
+# cache-request/cache-hit monitoring events):
+#   corro_compile_cache_hits_total{program}    compiles served from the
+#                                              persistent cache
+#   corro_compile_cache_misses_total{program}  cold compiles (a new
+#                                              cache entry was written)
+#   corro_compile_cold_seconds{program}        wall of the cold compiles
+#                                              only — the COLD share of
+#                                              corro_compile_seconds, so
+#                                              bench trajectories can
+#                                              separate compile wall
+#                                              from sim wall
+# The same numbers ride RunResult.compile_cache, flight `compile`
+# annotations, and every bench artifact (ISSUE 10).
+COMPILE_CACHE_HITS_TOTAL = "corro_compile_cache_hits_total"
+COMPILE_CACHE_MISSES_TOTAL = "corro_compile_cache_misses_total"
+COMPILE_COLD_SECONDS = "corro_compile_cold_seconds"
+COMPILE_COLD_SECONDS_HELP = (
+    "cold (cache-missing) AOT compile wall by chunk program — the "
+    "persistent-cache-miss share of corro_compile_seconds"
+)
+
+# ---- corro_subs_matcher_*: batched subscription matcher evals ---------
+# SubsManager.step used to dispatch ONE jit per registered matcher per
+# tick (1k subscribers = 1k dispatches + 2k device->host reads). Plain
+# single-table matchers whose device predicates share a structure
+# skeleton now evaluate as ONE vmapped jit per skeleton
+# (subs/manager.py SubsManager._batched_precompute):
+#   corro_subs_matcher_evals_total{mode="batched"|"single"}  matcher
+#       evaluations by dispatch mode (batched = rode a group jit)
+#   corro_subs_batch_groups_total    batched group dispatches
+SUBS_MATCHER_EVALS_TOTAL = "corro_subs_matcher_evals_total"
+SUBS_BATCH_GROUPS_TOTAL = "corro_subs_batch_groups_total"
+
 # ---- corro_lint_*: static analysis + transfer-guard observability ----
 # The corro-lint analyzer (corro_sim/analysis/, `corro-sim lint`)
 # exports its run profile as info counters so a scrape of any process

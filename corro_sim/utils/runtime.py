@@ -80,6 +80,27 @@ _PENDING_LOCK = threading.Lock()
 _PENDING_ZERO = threading.Condition(_PENDING_LOCK)
 
 
+def atomic_json_dump(path: str, obj, indent: int | None = None) -> bool:
+    """Write-then-rename JSON dump so readers never see a torn file.
+
+    The crash-path artifact idiom (bench progress trails, soak partial
+    artifacts): these files exist precisely because the process may die,
+    so a second kill mid-write must not corrupt them. Never raises —
+    returns False on OSError (an artifact write must not kill the run
+    it documents)."""
+    import json
+    import os
+
+    try:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=indent)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False
+
+
 def start_async_fetch(*bufs) -> None:
     """Begin device→host copies without blocking (resolved later by
     ``np.asarray``) — the chunk pipeline's async-fetch half

@@ -1,0 +1,240 @@
+"""ISSUE 10 tentpole guard: the compile-cache-stable SimState ABI.
+
+The feature-leaf registry's load-bearing claim: registering a NEW
+(disabled-by-default) feature leaf changes NOTHING about existing
+configurations — not the pytree structure, not the traced jaxpr, not the
+compiled-program cache key. That is what lets protocol variants and
+observability planes land without cold-invalidating the whole
+``.jax_cache`` (doc/performance.md "compile-cache lifecycle"). Each test
+registers a dummy leaf in-process and proves a stability layer; the
+committed manifest (``analysis/golden/cache_keys.json``,
+tools/prime_cache.py --check) enforces the same claim across PRs in CI.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.features import (
+    FeatureLeaf,
+    build_features,
+    enabled_feature_names,
+    feature_registry,
+    register_feature,
+    unregister_feature,
+    volatile_scrub_prefixes,
+)
+from corro_sim.engine.state import init_state
+
+# small — the stability claims are structural, not scale-dependent
+CFG = SimConfig(
+    num_nodes=8, num_rows=16, num_cols=2, log_capacity=64,
+    write_rate=0.5, sync_interval=4,
+).validate()
+
+# the dummy leaf enables ONLY on this sentinel shape, so registering it
+# cannot perturb any other test's configuration in this process
+_ENABLE_NODES = 11
+
+
+def _dummy(volatile=True, name="dummy_cache_test"):
+    return FeatureLeaf(
+        name=name,
+        enabled=lambda cfg: cfg.num_nodes == _ENABLE_NODES,
+        build=lambda cfg, seed: {
+            "acc": jnp.zeros((cfg.num_nodes,), jnp.int32),
+            "stamp": jnp.full((cfg.num_nodes, 2), -1, jnp.int16),
+        },
+        volatile=volatile,
+    )
+
+
+@contextlib.contextmanager
+def registered(leaf):
+    register_feature(leaf)
+    try:
+        yield leaf
+    finally:
+        unregister_feature(leaf.name)
+
+
+def _step_text(cfg, repair=False) -> str:
+    from corro_sim.analysis.jaxpr_audit import program_text, step_jaxpr
+
+    return program_text(step_jaxpr(cfg, repair=repair))
+
+
+def _chunk_key(cfg, chunk=4) -> str:
+    from corro_sim.engine.driver import _chunk_runner
+    from corro_sim.utils.compile_cache import program_cache_key
+
+    n = cfg.num_nodes
+    state = jax.eval_shape(lambda: init_state(cfg, seed=0))
+    runner = _chunk_runner(cfg, packed=True)
+    lowered = runner.lower(
+        state,
+        jax.ShapeDtypeStruct((chunk, 2), jnp.uint32),
+        jax.ShapeDtypeStruct((chunk, n), jnp.bool_),
+        jax.ShapeDtypeStruct((chunk, n), jnp.int32),
+        jax.ShapeDtypeStruct((chunk,), jnp.bool_),
+    )
+    return program_cache_key(lowered)
+
+
+def test_disabled_feature_is_invisible_to_the_pytree():
+    """Registering a disabled leaf leaves init_state's structure AND
+    leaves byte-identical — the no-placeholder contract."""
+    before = init_state(CFG, seed=0)
+    with registered(_dummy()):
+        after = init_state(CFG, seed=0)
+    assert jax.tree.structure(before) == jax.tree.structure(after)
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert after.features == {}
+
+
+def test_disabled_feature_leaves_step_jaxpr_identical():
+    """The traced program (full AND repair) is textually identical with
+    the dummy leaf registered — the jaxpr layer of the stability claim
+    (the golden fingerprint pins the same program across PRs)."""
+    before_full = _step_text(CFG)
+    before_repair = _step_text(CFG, repair=True)
+    with registered(_dummy()):
+        assert _step_text(CFG) == before_full
+        assert _step_text(CFG, repair=True) == before_repair
+
+
+def test_disabled_feature_leaves_cache_key_identical():
+    """The COMPILED-program cache key (sha-256 of the lowered StableHLO
+    — the persistent-cache identity tools/prime_cache.py pins to the
+    committed manifest) does not move when a disabled feature leaf is
+    registered. This is the acceptance criterion verbatim."""
+    before = _chunk_key(CFG)
+    with registered(_dummy()):
+        assert _chunk_key(CFG) == before
+
+
+def test_enabled_feature_adds_leaves_and_threads_through():
+    """The flip side: an ENABLING config gets the leaf (so only enabling
+    configs re-key), the step threads it through untouched, and shared
+    leaves stay bit-identical to the featureless run."""
+    import dataclasses
+
+    from corro_sim.engine.driver import Schedule, run_sim
+
+    cfg_on = dataclasses.replace(CFG, num_nodes=_ENABLE_NODES).validate()
+    plain = run_sim(
+        cfg_on, init_state(cfg_on, seed=0), Schedule(write_rounds=4),
+        max_rounds=8, chunk=4, seed=0, stop_on_convergence=False,
+    )
+    with registered(_dummy()):
+        assert enabled_feature_names(cfg_on) == ("dummy_cache_test",)
+        assert enabled_feature_names(CFG) == ()
+        state = init_state(cfg_on, seed=0)
+        assert set(state.features) == {"dummy_cache_test"}
+        res = run_sim(
+            cfg_on, state, Schedule(write_rounds=4),
+            max_rounds=8, chunk=4, seed=0, stop_on_convergence=False,
+        )
+        # the step never consumes the leaf: it comes back untouched
+        assert np.array_equal(
+            np.asarray(res.state.features["dummy_cache_test"]["acc"]),
+            np.zeros(_ENABLE_NODES, np.int32),
+        )
+        # and every SHARED leaf is bit-identical to the featureless run
+        for f_name in (
+            "table", "book", "log", "gossip", "swim", "hlc", "round",
+        ):
+            for a, b in zip(
+                jax.tree.leaves(getattr(plain.state, f_name)),
+                jax.tree.leaves(getattr(res.state, f_name)),
+            ):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+        for k in plain.metrics:
+            assert np.array_equal(plain.metrics[k], res.metrics[k]), k
+
+
+def test_registry_contract():
+    """Collisions refuse, field-style entries need placeholders, the
+    built-ins are registered, and build_features sorts by name."""
+    reg = feature_registry()
+    assert {"probe", "fault_burst"} <= set(reg)
+    assert reg["probe"].field == "probe" and reg["probe"].volatile
+    with registered(_dummy()):
+        with pytest.raises(ValueError):
+            register_feature(_dummy())
+    with pytest.raises(ValueError):
+        register_feature(FeatureLeaf(
+            name="bad_field_style",
+            enabled=lambda cfg: False,
+            build=lambda cfg, seed: None,
+            field="bad_field_style",  # field-style w/o placeholder
+        ))
+    with registered(_dummy(name="zz_last")), registered(_dummy(name="aa_first")):
+        import dataclasses
+
+        cfg_on = dataclasses.replace(
+            CFG, num_nodes=_ENABLE_NODES
+        ).validate()
+        assert list(build_features(cfg_on)) == ["aa_first", "zz_last"]
+
+
+def test_volatile_scrub_prefixes_drive_checkpoint_filters():
+    """The checkpoint scrub reads the registry: a volatile dict-style
+    leaf drops from portable backups under features/<name>, the legacy
+    field-style leaves under their field names, and prefix matching is
+    exact-or-slash (a feature named 'probe' must not catch 'probe_x')."""
+    from corro_sim.io.checkpoint import _CORE_SCRUB, _drop_volatile
+
+    with registered(_dummy()):
+        pref = volatile_scrub_prefixes()
+        assert "features/dummy_cache_test" in pref
+        assert "probe" in pref and "fault_burst" in pref
+        flat = {
+            "table/vr": 1,
+            "probe/first_seen": 2,
+            "probe_unrelated": 3,
+            "fault_burst": 4,
+            "features/dummy_cache_test/acc": 5,
+            "gossip/pend_tx": 6,
+        }
+        kept = _drop_volatile(flat, _CORE_SCRUB)
+        assert set(kept) == {"table/vr", "probe_unrelated"}
+
+
+def test_nonvolatile_feature_survives_scrub():
+    with registered(_dummy(volatile=False)):
+        assert "features/dummy_cache_test" not in volatile_scrub_prefixes()
+
+
+def test_manifest_diff_reports_rekeys():
+    """tools/prime_cache.py manifest_diff — the `audit --diff` analog
+    for cache keys: rekeyed / added / removed programs, empty = clean."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "prime_cache",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "prime_cache.py"),
+    )
+    pc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pc)
+    golden = {"programs": {"a/full": "k1", "b/full": "k2"}}
+    same = pc.manifest_diff(
+        {"programs": {"a/full": "k1", "b/full": "k2"}}, golden
+    )
+    assert not any(same.values())
+    drift = pc.manifest_diff(
+        {"programs": {"a/full": "k9", "c/full": "k3"}}, golden
+    )
+    assert drift["rekeyed"] == {"a/full": {"golden": "k1", "now": "k9"}}
+    assert drift["added"] == {"c/full": "k3"}
+    assert drift["removed"] == {"b/full": "k2"}

@@ -426,3 +426,59 @@ def test_workload_and_sub_latency_families_render_and_validate():
     assert c.workload_report is not None
     assert c.workload_report["live"]["latency_rounds"]["count"] > 0
     _validate_exposition(text)
+
+
+def test_compile_cache_and_batched_subs_families_render_and_validate(
+    cluster,
+):
+    """ISSUE 10 satellite: the compile-cost observability family
+    (corro_compile_cache_{hits,misses}_total + corro_compile_cold_seconds
+    via utils/compile_cache.CompileCacheProbe) and the batched-matcher
+    counters (corro_subs_matcher_evals_total{mode},
+    corro_subs_batch_groups_total) render through the exposition and
+    the whole thing still passes the scraper-contract validator."""
+    from corro_sim.utils.compile_cache import CompileCacheProbe
+    from corro_sim.utils.metrics import (
+        SUBS_BATCH_GROUPS_TOTAL,
+        SUBS_MATCHER_EVALS_TOTAL,
+        counters,
+    )
+
+    from corro_sim.utils import compile_cache as cc
+
+    probe = CompileCacheProbe()
+    # synthetic begin/end driving the jax monitoring events the probe
+    # counts (request+hit = served from cache; request w/o hit = cold
+    # compile even when jax skips persisting it; no request = cache not
+    # in play)
+    probe.begin()
+    cc._on_jax_event(cc._EVENT_REQUESTS)
+    cc._on_jax_event(cc._EVENT_HITS)
+    assert probe.end("full", 1.25) == "hit"
+    probe.begin()
+    cc._on_jax_event(cc._EVENT_REQUESTS)
+    assert probe.end("full", 2.5) == "miss"
+    probe.begin()
+    assert probe.end("full", 0.01) == "unknown"
+    s = probe.summary()
+    assert s["hits"] == 1 and s["misses"] == 1
+    assert s["unknown"] == 1
+    assert s["cold_seconds"] == 2.5  # ONLY the miss wall counts as cold
+    assert s["by_program"]["full"]["cold_seconds"] == 2.5
+
+    counters.inc(SUBS_MATCHER_EVALS_TOTAL, n=4, labels='{mode="batched"}',
+                 help_="matcher evaluations by dispatch mode")
+    counters.inc(SUBS_BATCH_GROUPS_TOTAL,
+                 help_="batched matcher-group dispatches")
+    text = render_prometheus(cluster)
+    # presence only: the registries are process-wide, so earlier tests'
+    # driver compiles may have already bumped these series
+    assert 'corro_compile_cache_hits_total{program="full"}' in text
+    assert 'corro_compile_cache_misses_total{program="full"}' in text
+    assert (
+        'corro_compile_cold_seconds_bucket{program="full",le="+Inf"}'
+        in text
+    )
+    assert 'corro_subs_matcher_evals_total{mode="batched"}' in text
+    assert "corro_subs_batch_groups_total" in text
+    _validate_exposition(text)

@@ -1,0 +1,212 @@
+"""ISSUE 10 tentpole guard: checkpoint/resume for long (soak) runs.
+
+The contract: a run killed at a chunk boundary and resumed from its
+checkpoint finishes with a final state, metric arrays and flight
+timeline BIT-IDENTICAL to the run that was never killed — the per-chunk
+keys are ``fold_in(root, ci)`` with ``ci`` continuing, the schedule rows
+are a function of the absolute round, and the repair-selection cursor is
+restored, so the remaining chunks dispatch the exact programs the
+unkilled run would have (engine/driver.py ``resume=``). The slow-marked
+test does it for real: SIGKILL against a ``corro-sim soak`` subprocess,
+then ``soak --resume``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from corro_sim.config import FaultConfig, SimConfig
+from corro_sim.engine.driver import Schedule, run_sim
+from corro_sim.engine.state import init_state
+from corro_sim.io.checkpoint import load_sim_checkpoint
+
+# matches tools/prime_cache.py "resume-lossy" so the chunk programs come
+# out of the warm cache in CI
+CFG = SimConfig(
+    num_nodes=12, num_rows=16, num_cols=2, log_capacity=64,
+    write_rate=0.6, sync_interval=4, faults=FaultConfig(loss=0.2),
+).validate()
+
+
+class _Kill(Exception):
+    pass
+
+
+def _run(state_seed=0, resume=None, ckpt=None, every=0, kill_after=None,
+         pipeline=None):
+    """One driver run of the shared scenario; ``kill_after`` raises out
+    of on_chunk after that chunk commits (the in-process stand-in for a
+    device loss / SIGKILL between checkpoints)."""
+
+    def bomb(info):
+        if kill_after is not None and info["chunk"] >= kill_after:
+            raise _Kill
+
+    return run_sim(
+        CFG, init_state(CFG, seed=state_seed), Schedule(write_rounds=8),
+        max_rounds=64, chunk=8, seed=0,
+        resume=resume,
+        checkpoint_path=ckpt, checkpoint_every=every,
+        on_chunk=bomb if kill_after is not None else None,
+        pipeline=pipeline,
+    )
+
+
+def _assert_bit_identical(ref, res):
+    assert jax.tree.structure(ref.state) == jax.tree.structure(res.state)
+    for a, b in zip(jax.tree.leaves(ref.state), jax.tree.leaves(res.state)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert set(ref.metrics) == set(res.metrics)
+    for k in ref.metrics:
+        assert np.array_equal(ref.metrics[k], res.metrics[k]), k
+    assert res.converged_round == ref.converged_round
+    assert res.rounds == ref.rounds
+
+
+@pytest.mark.parametrize("pipeline", [True, False])
+def test_resume_bit_identical(tmp_path, pipeline):
+    """Kill after chunk 1, resume from the chunk-boundary checkpoint:
+    final state, every metric array (stitched across the kill), and the
+    flight gap curve match the uninterrupted run exactly — in BOTH
+    dispatch modes (the pipelined loop restarts its speculation chain
+    from the restored cursor)."""
+    ref = _run(pipeline=pipeline)
+    ckpt = str(tmp_path / "soak.ckpt.npz")
+    with pytest.raises(_Kill):
+        _run(ckpt=ckpt, every=1, kill_after=1, pipeline=pipeline)
+    ck = load_sim_checkpoint(ckpt)
+    assert ck.rounds == ck.next_chunk * 8
+    assert 0 < ck.rounds < ref.rounds
+    res = _run(resume=ck, pipeline=pipeline)
+    _assert_bit_identical(ref, res)
+    # flight timeline stitched: the pre-kill rounds ride the resumed
+    # recorder ahead of the new ones, and the resume point is annotated
+    assert res.flight.series("gap") == ref.flight.series("gap")
+    assert res.flight.events("resume")
+    assert res.flight.meta.get("resumed_at_round") == ck.rounds
+
+
+def test_checkpoint_cursor_carries_repair_selection(tmp_path):
+    """The restored cursor must reproduce the repair-program switch: a
+    checkpoint taken before the rings drain resumes into the same
+    full->repair chunk sequence (repair_chunks totals line up)."""
+    ref = _run()
+    ckpt = str(tmp_path / "soak.ckpt.npz")
+    with pytest.raises(_Kill):
+        # on_chunk fires before the chunk's checkpoint write, so the
+        # earliest token a kill can leave is chunk 0's (next_chunk=1)
+        _run(ckpt=ckpt, every=1, kill_after=1)
+    ck = load_sim_checkpoint(ckpt)
+    assert ck.next_chunk == 1  # checkpointed before the rings drain
+    res = _run(resume=ck)
+    assert res.repair_chunks + ck.cursor["repair_chunks"] == \
+        ref.repair_chunks
+    _assert_bit_identical(ref, res)
+
+
+def test_resume_refuses_mismatches(tmp_path):
+    """A resume under a different config, seed or chunking would
+    silently not be the killed run — it must refuse loudly."""
+    import dataclasses
+
+    ckpt = str(tmp_path / "soak.ckpt.npz")
+    with pytest.raises(_Kill):
+        _run(ckpt=ckpt, every=1, kill_after=1)
+    ck = load_sim_checkpoint(ckpt)
+    other = dataclasses.replace(CFG, write_rate=0.5).validate()
+    with pytest.raises(ValueError, match="config"):
+        run_sim(other, init_state(other, seed=0),
+                Schedule(write_rounds=8), max_rounds=64, chunk=8,
+                seed=0, resume=ck)
+    with pytest.raises(ValueError, match="seed/chunk"):
+        run_sim(CFG, init_state(CFG, seed=0), Schedule(write_rounds=8),
+                max_rounds=64, chunk=8, seed=1, resume=ck)
+    with pytest.raises(ValueError, match="seed/chunk"):
+        run_sim(CFG, init_state(CFG, seed=0), Schedule(write_rounds=8),
+                max_rounds=64, chunk=4, seed=0, resume=ck)
+    with pytest.raises(ValueError, match="workload"):
+        from corro_sim.workload import make_workload
+
+        wl = make_workload("zipf:alpha=1.0,rate=0.2,keys=8",
+                           CFG.num_nodes, rounds=4, seed=0)
+        run_sim(CFG, init_state(CFG, seed=0), Schedule(write_rounds=8),
+                max_rounds=64, chunk=8, seed=0, resume=ck, workload=wl)
+
+
+def test_checkpoint_is_atomic(tmp_path):
+    """save never leaves a torn file: the .tmp staging file is gone
+    after a successful save and the token always loads."""
+    ckpt = str(tmp_path / "soak.ckpt.npz")
+    _run(ckpt=ckpt, every=1)
+    assert os.path.exists(ckpt)
+    assert not os.path.exists(ckpt + ".tmp")
+    ck = load_sim_checkpoint(ckpt)
+    assert ck.cfg.num_nodes == CFG.num_nodes
+    assert ck.metrics["gap"].shape[0] == ck.rounds
+
+
+@pytest.mark.slow  # three subprocess jax launches; the t1.yml chaos
+# step runs the same resume flow as a CI smoke
+def test_soak_cli_sigkill_resume(tmp_path):
+    """The real thing: SIGKILL a `corro-sim soak` mid-scenario, then
+    `soak --resume <ckpt>` — the resumed sweep's report must carry the
+    same convergence/recovery/fault numbers as an uninterrupted one."""
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+    )
+    args = [
+        sys.executable, "-m", "corro_sim", "soak",
+        "--scenario", "lossy:p=0.1", "--nodes", "16", "--rows", "16",
+        "--rounds", "32", "--write-rounds", "8", "--chunk", "8",
+        "--checkpoint-every", "1",
+    ]
+    full_out = str(tmp_path / "FULL")
+    r = subprocess.run(
+        args + ["--out", full_out], env=env, cwd=str(tmp_path),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    full = json.load(open(full_out + ".report.json"))
+
+    kill_out = str(tmp_path / "KILL")
+    ckpt = kill_out + ".ckpt.npz"
+    proc = subprocess.Popen(
+        args + ["--out", kill_out], env=env, cwd=str(tmp_path),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 600
+        while not os.path.exists(ckpt) and time.time() < deadline:
+            assert proc.poll() is None, "soak exited before checkpoint"
+            time.sleep(0.25)
+        assert os.path.exists(ckpt), "no checkpoint appeared"
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    r = subprocess.run(
+        [sys.executable, "-m", "corro_sim", "soak", "--resume", ckpt],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    resumed = json.loads(r.stdout)
+    a = resumed["scenarios"][-1]
+    b = full["scenarios"][-1]
+    for k in ("scenario", "converged_round", "rounds_run", "heal_round",
+              "recovery_rounds", "fault_totals", "poisoned"):
+        assert a[k] == b[k], (k, a[k], b[k])
+    assert resumed["resumed_from"] == ckpt

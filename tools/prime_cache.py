@@ -1,4 +1,4 @@
-"""Prime the persistent XLA compile cache with the tier-1 step matrix.
+"""Prime the persistent XLA compile cache + pin the cache-key manifest.
 
 Dtype packing (``SimConfig.narrow_state``) and op-budget surgery change
 SimState leaves and the step program, which cold-invalidates every
@@ -9,26 +9,48 @@ own CI step (t1.yml "Prime XLA compile cache"), so the cache is warm
 before the first test collects and the priming wall is visible as its
 own line in the job timeline rather than smeared across test timeouts.
 
+Since ISSUE 10 it is also the **persistent AOT warm layer**: every
+primed program records its cache key (sha-256 of the lowered StableHLO,
+``utils/compile_cache.program_cache_key`` — the unit of persistent-cache
+identity) and its hit/miss against the persistent cache, and the keys pin
+to a committed manifest (``corro_sim/analysis/golden/cache_keys.json``).
+That gives cache keys the same drift discipline ``corro-sim audit
+--diff`` gives jaxprs: a PR that re-keys a program shows EXACTLY which
+ones and must re-baseline with ``--update``; a PR that claims to leave
+programs alone proves it (``--check`` fails on any drift — and, run
+against a cache the previous step just warmed, on any unexpected miss).
+
 The matrix covers the programs that dominate suite compile wall: the
 canonical audit config and the 32-node CI smoke config, each as
 full + repair chunk programs, wide and narrow state, packed the way
 ``run_sim`` dispatches them (``_chunk_runner(packed=True)`` over an
-8-round scan). Compilation is aval-only (``jit(...).lower().compile()``
-— nothing executes, no state is materialized beyond eval_shape).
+8-round scan), plus the workload, sharded-mesh and soak-resume test
+programs. Compilation is aval-only (``jit(...).lower().compile()`` —
+nothing executes, no state is materialized beyond eval_shape).
 
-Usage: ``python tools/prime_cache.py [--chunk 8]``
+Usage::
+
+    python tools/prime_cache.py [--chunk 8] [--report PRIME.json]
+    python tools/prime_cache.py --check     # drift/miss = exit 2
+    python tools/prime_cache.py --update    # re-baseline the manifest
 """
 
 from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 import sys
 import time
 
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+MANIFEST_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "corro_sim", "analysis", "golden", "cache_keys.json",
 )
 
 # The sharded chunk programs (ISSUE 8) compile against an 8-device mesh
@@ -44,7 +66,44 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 
-def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
+class ProgramRecorder:
+    """One row per primed program: name, cache key, hit/miss, wall."""
+
+    def __init__(self):
+        from corro_sim.utils.compile_cache import CompileCacheProbe
+
+        self.probe = CompileCacheProbe()
+        self.rows: list[dict] = []
+
+    def compile(self, name: str, runner, *avals) -> None:
+        from corro_sim.utils.compile_cache import program_cache_key
+
+        t0 = time.perf_counter()
+        lowered = runner.lower(*avals)
+        key = program_cache_key(lowered)
+        self.probe.begin()
+        t_c = time.perf_counter()
+        lowered.compile()
+        done = time.perf_counter()
+        # hit/miss reasoning uses the compile() wall alone (the
+        # persistence threshold gates on XLA compile time, not
+        # lowering); the reported wall stays lower+compile
+        status = self.probe.end(name, done - t_c)
+        self.rows.append({
+            "name": name,
+            "key": key,
+            "cache": status,
+            "wall_s": round(done - t0, 3),
+        })
+
+    def skip(self, name: str, reason: str) -> None:
+        self.rows.append({
+            "name": name, "key": None, "cache": "skipped",
+            "wall_s": 0.0, "reason": reason,
+        })
+
+
+def prime_matrix(chunk: int = 8) -> ProgramRecorder:
     from corro_sim.utils.compile_cache import enable_compile_cache
 
     enable_compile_cache()
@@ -53,50 +112,49 @@ def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
     import jax.numpy as jnp
 
     from corro_sim.analysis.jaxpr_audit import audit_config
-    from corro_sim.config import SimConfig
+    from corro_sim.config import FaultConfig, SimConfig
     from corro_sim.engine.driver import _chunk_runner
     from corro_sim.engine.state import init_state
+
+    rec = ProgramRecorder()
+
+    def std_avals(n):
+        return (
+            jax.ShapeDtypeStruct((chunk, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((chunk, n), jnp.bool_),
+            jax.ShapeDtypeStruct((chunk, n), jnp.int32),
+            jax.ShapeDtypeStruct((chunk,), jnp.bool_),
+        )
 
     smoke = SimConfig(
         num_nodes=32, num_rows=32, num_cols=2, log_capacity=64,
         write_rate=0.5, swim_enabled=True, sync_interval=4,
     )
     base_cfgs = [("audit", audit_config()), ("smoke", smoke)]
-    walls: list[tuple[str, float]] = []
     for base_name, base in base_cfgs:
         for narrow in (False, True):
             cfg = dataclasses.replace(base, narrow_state=narrow).validate()
             n = cfg.num_nodes
             state = jax.eval_shape(lambda cfg=cfg: init_state(cfg, seed=0))
-            keys = jax.ShapeDtypeStruct((chunk, 2), jnp.uint32)
-            alive = jax.ShapeDtypeStruct((chunk, n), jnp.bool_)
-            part = jax.ShapeDtypeStruct((chunk, n), jnp.int32)
-            we = jax.ShapeDtypeStruct((chunk,), jnp.bool_)
+            avals = std_avals(n)
             for repair in (False, True):
                 name = (
                     f"{base_name}/"
                     f"{'narrow' if narrow else 'wide'}/"
                     f"{'repair' if repair else 'full'}"
                 )
-                t0 = time.perf_counter()
                 runner = _chunk_runner(cfg, repair=repair, packed=True)
-                runner.lower(state, keys, alive, part, we).compile()
-                walls.append((name, time.perf_counter() - t0))
+                rec.compile(name, runner, state, *avals)
             if not narrow:
                 # ISSUE 7: the workload-driven chunk program (the write
                 # schedule rides the scan inputs into sim_step's writes=
                 # port) is its OWN compiled program — warm it for the
                 # standard matrix configs too
-                t0 = time.perf_counter()
                 runner = _chunk_runner(cfg, packed=True, workload=True)
-                runner.lower(
-                    state, keys, alive, part, we,
+                rec.compile(
+                    f"{base_name}/wide/workload", runner, state, *avals,
                     *_workload_avals(jax, jnp, chunk, n,
                                      cfg.seqs_per_version),
-                ).compile()
-                walls.append(
-                    (f"{base_name}/wide/workload",
-                     time.perf_counter() - t0)
                 )
 
     # ISSUE 7: the EXACT workload chunk programs tests/test_workload.py
@@ -110,21 +168,29 @@ def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
     ).validate()
     n = wltest.num_nodes
     state = jax.eval_shape(lambda: init_state(wltest, seed=0))
-    keys = jax.ShapeDtypeStruct((chunk, 2), jnp.uint32)
-    alive = jax.ShapeDtypeStruct((chunk, n), jnp.bool_)
-    part = jax.ShapeDtypeStruct((chunk, n), jnp.int32)
-    we = jax.ShapeDtypeStruct((chunk,), jnp.bool_)
+    avals = std_avals(n)
     for repair in (False, True):
-        t0 = time.perf_counter()
         runner = _chunk_runner(wltest, repair=repair, packed=True,
                                workload=True)
-        runner.lower(
-            state, keys, alive, part, we,
+        rec.compile(
+            f"wltest/wide/{'workload-repair' if repair else 'workload'}",
+            runner, state, *avals,
             *_workload_avals(jax, jnp, chunk, n, wltest.seqs_per_version),
-        ).compile()
-        walls.append(
-            (f"wltest/wide/{'workload-repair' if repair else 'workload'}",
-             time.perf_counter() - t0)
+        )
+
+    # ISSUE 10: the soak kill/resume test programs
+    # (tests/test_soak_resume.py drives the wltest shape under a lossy
+    # scenario — the faults block re-keys the program) and the resume
+    # smoke in t1.yml's chaos step.
+    lossy_resume = dataclasses.replace(
+        wltest, faults=FaultConfig(loss=0.2)
+    ).validate()
+    state = jax.eval_shape(lambda: init_state(lossy_resume, seed=0))
+    for repair in (False, True):
+        runner = _chunk_runner(lossy_resume, repair=repair, packed=True)
+        rec.compile(
+            f"resume-lossy/wide/{'repair' if repair else 'full'}",
+            runner, state, *avals,
         )
 
     # ISSUE 8: the SHARDED chunk programs, AOT-compiled against the
@@ -133,11 +199,11 @@ def prime_matrix(chunk: int = 8) -> list[tuple[str, float]]:
     # config (shard_log on/off × full/repair) and the exact equivalence
     # matrix tests/test_multichip.py dispatches inside pytest — keep the
     # config literals below in lockstep with that file.
-    walls.extend(_prime_sharded_matrix(jax, jnp, smoke, chunk))
-    return walls
+    _prime_sharded_matrix(jax, jnp, smoke, chunk, rec)
+    return rec
 
 
-def _prime_sharded_matrix(jax, jnp, smoke, chunk: int):
+def _prime_sharded_matrix(jax, jnp, smoke, chunk: int, rec: ProgramRecorder):
     import dataclasses
 
     from corro_sim.config import SimConfig
@@ -148,9 +214,9 @@ def _prime_sharded_matrix(jax, jnp, smoke, chunk: int):
 
     devices = jax.devices()
     if len(devices) < 8:
-        return [("sharded/SKIPPED (need 8 devices)", 0.0)]
+        rec.skip("sharded", "need 8 devices")
+        return
     mesh = make_mesh(devices[:8])
-    walls: list[tuple[str, float]] = []
 
     def prime(name, cfg, shard_log, repair=False, donate=False,
               workload=False):
@@ -182,13 +248,11 @@ def _prime_sharded_matrix(jax, jnp, smoke, chunk: int):
             _workload_avals(jax, jnp, chunk, n, cfg.seqs_per_version)
             if workload else ()
         )
-        t0 = time.perf_counter()
         runner = _chunk_runner(
             cfg, donate=donate, shardings=sh, repair=repair,
             packed=True, workload=workload, mesh=step_mesh,
         )
-        runner.lower(state_avals, keys, alive, part, we, *wl).compile()
-        walls.append((name, time.perf_counter() - t0))
+        rec.compile(name, runner, state_avals, keys, alive, part, we, *wl)
 
     # the CI multichip smoke config: shard_log on/off × full/repair
     for shard_log in (True, False):
@@ -248,18 +312,15 @@ def _prime_sharded_matrix(jax, jnp, smoke, chunk: int):
             _workload_avals(jax, jnp, chunk, n, cfg.seqs_per_version)
             if workload else ()
         )
-        t0 = time.perf_counter()
         runner = _chunk_runner(cfg, repair=repair, packed=True,
                                workload=workload)
-        runner.lower(state, keys, alive, part, we, *wl).compile()
-        walls.append((name, time.perf_counter() - t0))
+        rec.compile(name, runner, state, keys, alive, part, we, *wl)
 
     prime_single("mc-base/single/repair", base, repair=True)
     prime_single("mc-swim-narrow/single/full", swim)
     prime_single("mc-lossy/single/full", lossy)
     prime_single("mc-base/single/workload", base, workload=True)
     prime_single("mc-kernel/single/full", kcfg)
-    return walls
 
 
 def _workload_avals(jax, jnp, chunk: int, n: int, s: int) -> tuple:
@@ -274,19 +335,154 @@ def _workload_avals(jax, jnp, chunk: int, n: int, s: int) -> tuple:
     )
 
 
+# ----------------------------------------------------- cache-key manifest
+
+def build_manifest(rec: ProgramRecorder, chunk: int) -> dict:
+    import jax
+
+    return {
+        "jax_version": jax.__version__,
+        "platform": jax.default_backend(),
+        "device_count": len(jax.devices()),
+        "chunk": chunk,
+        "programs": {
+            row["name"]: row["key"]
+            for row in rec.rows if row["key"] is not None
+        },
+    }
+
+
+def load_manifest(path: str = MANIFEST_PATH) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def write_manifest(manifest: dict, path: str = MANIFEST_PATH) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def manifest_diff(manifest: dict, golden: dict) -> dict:
+    """The cache-key drift report (``audit --diff`` for cache keys):
+    which programs re-keyed, appeared, or vanished vs the committed
+    manifest. Empty dicts everywhere = no drift."""
+    cur = manifest["programs"]
+    gold = golden.get("programs", {})
+    return {
+        "rekeyed": {
+            name: {"golden": gold[name], "now": cur[name]}
+            for name in sorted(set(cur) & set(gold))
+            if cur[name] != gold[name]
+        },
+        "added": {n: cur[n] for n in sorted(set(cur) - set(gold))},
+        "removed": {n: gold[n] for n in sorted(set(gold) - set(cur))},
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--chunk", type=int, default=8,
                     help="scan length of the primed chunk programs "
                          "(t1 smokes and the bench dispatch chunk=8)")
+    ap.add_argument("--manifest", default=MANIFEST_PATH,
+                    help="committed cache-key manifest to diff against")
+    ap.add_argument("--update", action="store_true",
+                    help="re-baseline the manifest from this run "
+                         "(commit the diff with the change that re-keyed "
+                         "the programs)")
+    ap.add_argument("--check", action="store_true",
+                    help="pass-or-fail mode (CI): exit 2 on any manifest "
+                         "drift OR any cache miss — run it against a "
+                         "cache the previous priming step warmed")
+    ap.add_argument("--report",
+                    help="write the per-program JSON report (keys, "
+                         "hit/miss, walls) to this path — the CI "
+                         "artifact")
     args = ap.parse_args(argv)
     t0 = time.perf_counter()
-    walls = prime_matrix(chunk=args.chunk)
-    for name, w in walls:
-        print(f"primed  {name:<24} {w:6.1f}s")
-    print(f"prime-cache: {len(walls)} programs in "
-          f"{time.perf_counter() - t0:.1f}s")
-    return 0
+    rec = prime_matrix(chunk=args.chunk)
+    manifest = build_manifest(rec, args.chunk)
+    for row in rec.rows:
+        print(
+            f"primed  {row['name']:<34} {row['cache']:<8} "
+            f"{row['wall_s']:6.1f}s  {row['key'] or row.get('reason')}"
+        )
+    misses = sum(1 for r in rec.rows if r["cache"] == "miss")
+    hits = sum(1 for r in rec.rows if r["cache"] == "hit")
+    print(
+        f"prime-cache: {len(rec.rows)} programs in "
+        f"{time.perf_counter() - t0:.1f}s "
+        f"({hits} cache hits, {misses} misses, "
+        f"{rec.probe.cold_seconds:.1f}s cold)"
+    )
+
+    rc = 0
+    diff = None
+    golden = load_manifest(args.manifest)
+    if args.update:
+        write_manifest(manifest, args.manifest)
+        print(f"manifest updated: {args.manifest}")
+    elif golden is None:
+        print(
+            f"no cache-key manifest at {args.manifest} — baseline with "
+            "--update and commit the file"
+        )
+        if args.check:
+            rc = 2
+    elif (
+        golden.get("jax_version") != manifest["jax_version"]
+        or golden.get("platform") != manifest["platform"]
+        or golden.get("device_count") != manifest["device_count"]
+    ):
+        # StableHLO text legitimately shifts across jax releases and
+        # device layouts; CI pins jax to the jaxpr golden's version and
+        # forces the 8-device CPU host, so the gate bites where it is
+        # enforced (the jaxpr-golden posture).
+        print(
+            "manifest comparison skipped: written under jax "
+            f"{golden.get('jax_version')}/{golden.get('platform')}/"
+            f"{golden.get('device_count')}dev, running "
+            f"{manifest['jax_version']}/{manifest['platform']}/"
+            f"{manifest['device_count']}dev"
+        )
+    else:
+        diff = manifest_diff(manifest, golden)
+        drift = any(diff.values())
+        for name, d in diff["rekeyed"].items():
+            print(f"REKEYED  {name}: {d['golden']} -> {d['now']}")
+        for name in diff["added"]:
+            print(f"ADDED    {name} (not in manifest — --update to pin)")
+        for name in diff["removed"]:
+            print(f"REMOVED  {name} (manifest pins it — --update to drop)")
+        if not drift:
+            print("manifest: every program cache key matches")
+        if args.check and drift:
+            rc = 2
+    if args.check and misses:
+        print(
+            f"CHECK FAILED: {misses} unexpected cache miss(es) on a "
+            "supposedly warm cache"
+        )
+        rc = 2
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            json.dump({
+                "programs": rec.rows,
+                "manifest": manifest,
+                "diff": diff,
+                "hits": hits,
+                "misses": misses,
+                "cold_seconds": round(rec.probe.cold_seconds, 3),
+                "check": bool(args.check),
+                "ok": rc == 0,
+            }, fh, indent=2)
+            fh.write("\n")
+    return rc
 
 
 if __name__ == "__main__":
