@@ -467,6 +467,7 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
     scenario_spec = os.environ.get("CORRO_BENCH_SCENARIO", "") or None
     scenario = None
     invariants = None
+    scorecard = None
     if scenario_spec:
         from corro_sim.faults import InvariantChecker, make_scenario
 
@@ -477,6 +478,13 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
         cfg = scenario.apply(cfg)
         schedule = scenario.schedule()
         invariants = InvariantChecker(cfg)
+        if cfg.node_faults.enabled:
+            # node-fault scenarios grade themselves: the bench artifact
+            # carries the resilience block (recovery_rounds, rows_lost,
+            # resync_rows) next to the convergence headline
+            from corro_sim.faults import ResilienceScorecard
+
+            scorecard = ResilienceScorecard(cfg, scenario=scenario)
         if min_rounds is None or (scenario.heal_round or 0) > min_rounds:
             min_rounds = max(
                 scenario.heal_round or 0, schedule.write_rounds
@@ -484,7 +492,7 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
     res = run_sim(
         cfg, init_state(cfg, seed=0), schedule,
         max_rounds=max_rounds, chunk=8, seed=0, min_rounds=min_rounds,
-        flight=_FLIGHT, invariants=invariants,
+        flight=_FLIGHT, invariants=invariants, scorecard=scorecard,
         pipeline=_bench_pipeline(),
     )
     out = {
@@ -527,6 +535,8 @@ def _sim_report(cfg, schedule, label, max_rounds=4096, min_rounds=None):
             out["invariant_violations"] = [
                 v.as_dict() for v in invariants.violations[:8]
             ]
+        if res.resilience is not None:
+            out["resilience"] = res.resilience
     if res.probe is not None and _FLIGHT is not None and _FLIGHT.sink_path:
         prefix = _FLIGHT.sink_path + ".probes"
         res.probe.dump_ndjson(prefix + ".ndjson")

@@ -109,11 +109,35 @@ def _cmd_run(args: argparse.Namespace) -> int:
         )
         cfg = scenario.apply(cfg)
         schedule = scenario.schedule()
+    workload = None
+    if getattr(args, "workload", None):
+        # the unified spec surface: --scenario X --workload Y in ONE run
+        # (load + faults overlapping is where the latency-under-load
+        # story lives) — coupling validated up front, not after compile
+        from corro_sim.workload import make_workload
+
+        workload = make_workload(
+            args.workload, cfg.num_nodes, rounds=args.write_rounds,
+            seed=args.seed,
+        )
+        if scenario is not None:
+            scenario.check_workload(workload)
     invariants = None
     if args.check_invariants or args.scenario:
         from corro_sim.faults import InvariantChecker
 
         invariants = InvariantChecker(cfg)
+    scorecard = None
+    if getattr(args, "scorecard", False) or (
+        scenario is not None and cfg.node_faults.enabled
+    ):
+        # node-fault scenarios are graded by default — the scorecard is
+        # the evidence their catalog entry exists to produce
+        from corro_sim.faults import ResilienceScorecard
+
+        scorecard = ResilienceScorecard(
+            cfg, scenario=scenario, workload=workload
+        )
     flight = None
     if args.flight_out:
         from corro_sim.obs.flight import FlightRecorder
@@ -137,10 +161,15 @@ def _cmd_run(args: argparse.Namespace) -> int:
         flight=flight,
         profile_dir=args.profile_dir,
         invariants=invariants,
+        scorecard=scorecard,
+        workload=workload,
         # None defers to the CORRO_SIM_TRANSFER_GUARD env var
         transfer_guard=True if args.transfer_guard else None,
         min_rounds=(
-            max(scenario.heal_round or 0, args.write_rounds)
+            max(
+                scenario.heal_round or 0, args.write_rounds,
+                workload.rounds if workload is not None else 0,
+            )
             if scenario is not None else None
         ),
     )
@@ -219,6 +248,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
             report["recovery_rounds"] = (
                 res.converged_round - scenario.heal_round
             )
+    if workload is not None:
+        report["workload"] = workload.spec
+    if res.resilience is not None:
+        report["resilience"] = res.resilience
     if cfg.faults.enabled:
         fault_keys = [
             k for k in res.metrics
@@ -259,6 +292,17 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     and chunking — and continues the killed scenario BIT-IDENTICALLY
     (state, metrics and flight timeline match the uninterrupted run;
     tests/test_soak_resume.py), then finishes the remaining scenarios.
+
+    ``--workload SPEC`` couples a traffic schedule into EVERY scenario
+    run (load + faults in one spec — the SWARM latency-under-load
+    posture); coupling is validated up front (the fault window and the
+    write range must overlap) and checkpoints are disabled for coupled
+    runs (the workload cursor is not checkpointed). ``--scorecard
+    [PATH]`` arms the resilience scorecard on every scenario, writes the
+    per-scenario blocks as a JSON artifact, and gates them against the
+    committed threshold golden
+    (``corro_sim/analysis/golden/resilience_thresholds.json``) —
+    breaches exit 6.
     """
     import dataclasses
     import os
@@ -282,6 +326,13 @@ def _cmd_soak(args: argparse.Namespace) -> int:
 
     resume_ck = None
     runs: list = []
+    if args.resume and getattr(args, "workload", None):
+        print(
+            "--resume does not compose with --workload (coupled runs "
+            "are not checkpointed; re-run the sweep)",
+            file=sys.stderr,
+        )
+        return 2
     if args.resume:
         resume_ck = load_sim_checkpoint(args.resume)
         soak_meta = resume_ck.meta.get("soak") or {}
@@ -337,10 +388,56 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     )
     ckpt_every = int(sweep.get("checkpoint_every") or 0)
 
+    workload = None
+    if getattr(args, "workload", None):
+        from corro_sim.workload import make_workload
+
+        workload = make_workload(
+            args.workload, base.num_nodes,
+            rounds=sweep["write_rounds"], seed=sweep["seed"],
+        )
+        # validate EVERY scenario's coupling up front (cheap host-side
+        # compiles) — a bad spec at index 3 must fail in seconds, not
+        # after minutes of earlier scenarios whose results would then
+        # be discarded without a report
+        for spec in specs[start_idx:]:
+            try:
+                make_scenario(
+                    spec, base.num_nodes, rounds=sweep["rounds"],
+                    write_rounds=sweep["write_rounds"],
+                    seed=sweep["seed"],
+                ).check_workload(workload)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        if ckpt_path:
+            print(
+                "# --workload coupled: checkpointing disabled (the "
+                "workload cursor is not checkpointed)",
+                file=sys.stderr,
+            )
+            ckpt_path = None
+    scorecard_path = getattr(args, "scorecard", None)
+
     any_violation = any(
         not r.get("invariants", {}).get("ok", True) for r in runs
     )
     any_unconverged = any(r.get("converged_round") is None for r in runs)
+    # a resumed sweep must keep gating on breaches its pre-kill
+    # scenarios already recorded — the exit-6 tripwire survives resume
+    breaches: list = [
+        b for r in runs for b in r.get("threshold_breaches", [])
+    ]
+    from corro_sim.faults import check_thresholds, load_thresholds
+
+    thresholds = load_thresholds()  # raises on a corrupt golden
+    if thresholds is None and scorecard_path:
+        print(
+            "warning: no resilience threshold golden committed — the "
+            "scorecard artifact is written but nothing gates it "
+            "(analysis/golden/resilience_thresholds.json)",
+            file=sys.stderr,
+        )
     for i in range(start_idx, len(specs)):
         spec = specs[i]
         sc = make_scenario(
@@ -349,6 +446,15 @@ def _cmd_soak(args: argparse.Namespace) -> int:
         )
         cfg = sc.apply(base)
         inv = InvariantChecker(cfg)
+        card = None
+        if scorecard_path or cfg.node_faults.enabled:
+            # node-fault scenarios are graded by default; --scorecard
+            # grades the whole sweep and writes the artifact
+            from corro_sim.faults import ResilienceScorecard
+
+            card = ResilienceScorecard(
+                cfg, scenario=sc, workload=workload
+            )
         flight = None
         if out:
             # filename from the FULL spec (sanitized), indexed — two
@@ -372,8 +478,12 @@ def _cmd_soak(args: argparse.Namespace) -> int:
                 cfg, init_state(cfg, seed=sweep["seed"]), sc.schedule(),
                 max_rounds=sweep["max_rounds"], chunk=sweep["chunk"],
                 seed=sweep["seed"],
-                min_rounds=max(sc.heal_round or 0, sweep["write_rounds"]),
-                flight=flight, invariants=inv,
+                min_rounds=max(
+                    sc.heal_round or 0, sweep["write_rounds"],
+                    workload.rounds if workload is not None else 0,
+                ),
+                flight=flight, invariants=inv, scorecard=card,
+                workload=workload,
                 resume=resume_ck if i == start_idx else None,
                 checkpoint_path=ckpt_path,
                 checkpoint_every=ckpt_every if ckpt_path else 0,
@@ -449,6 +559,14 @@ def _cmd_soak(args: argparse.Namespace) -> int:
             "invariants": inv.report(),
             "compile_cache": res.compile_cache,
         }
+        if workload is not None:
+            run["workload"] = workload.spec
+        if res.resilience is not None:
+            run["resilience"] = res.resilience
+            if thresholds is not None:
+                run_breaches = check_thresholds(res.resilience, thresholds)
+                run["threshold_breaches"] = run_breaches
+                breaches.extend(run_breaches)
         if flight is not None:
             run["flight"] = (
                 flight.sink_path if flight.sink_active else None
@@ -468,12 +586,38 @@ def _cmd_soak(args: argparse.Namespace) -> int:
         "rounds": sweep["rounds"],
         "seed": sweep["seed"],
         "scenarios": runs,
-        "ok": not (any_violation or any_unconverged),
+        "ok": not (any_violation or any_unconverged or breaches),
     }
+    if workload is not None:
+        report["workload"] = workload.spec
     if resume_ck is not None or args.resume:
         report["resumed_from"] = args.resume
     if ckpt_path:
         report["checkpoint"] = ckpt_path
+    if breaches:
+        report["threshold_breaches"] = breaches
+    if scorecard_path:
+        # the scorecard artifact: per-scenario resilience blocks + the
+        # threshold verdict, one JSON the CI leg uploads and asserts on
+        scorecard_doc = {
+            "nodes": base.num_nodes,
+            "seed": sweep["seed"],
+            "workload": workload.spec if workload is not None else None,
+            "scenarios": [
+                {
+                    "scenario": r["scenario"],
+                    "resilience": r.get("resilience"),
+                    "threshold_breaches": r.get("threshold_breaches", []),
+                }
+                for r in runs
+            ],
+            "thresholds_ok": not breaches,
+            "breaches": breaches,
+        }
+        with open(scorecard_path, "w", encoding="utf-8") as f:
+            json.dump(scorecard_doc, f, indent=2)
+            f.write("\n")
+        report["scorecard"] = scorecard_path
     if out:
         with open(f"{out}.report.json", "w") as f:
             json.dump(report, f, indent=2)
@@ -481,7 +625,9 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     print(json.dumps(report, indent=2))
     if any_violation:
         return 5
-    return 3 if any_unconverged else 0
+    if any_unconverged:
+        return 3
+    return 6 if breaches else 0
 
 
 def _cmd_load(args: argparse.Namespace) -> int:
@@ -921,6 +1067,22 @@ def build_parser() -> argparse.ArgumentParser:
              "checkers and reports recovery time",
     )
     pr.add_argument(
+        "--workload",
+        help="couple a traffic workload spec `name[:k=v,...][+...]` "
+             "(corro_sim/workload/) into the run — accepted TOGETHER "
+             "with --scenario (load + faults in one spec); the fault "
+             "window and the write range must overlap, validated up "
+             "front",
+    )
+    pr.add_argument(
+        "--scorecard", action="store_true",
+        help="arm the resilience scorecard (faults/scorecard.py): the "
+             "report gains a `resilience` block (recovery_rounds, "
+             "rows_lost, resync_rows, SWIM churn, sub-delivery "
+             "degradation under a coupled --workload); armed "
+             "automatically for node-fault scenarios",
+    )
+    pr.add_argument(
         "--check-invariants", action="store_true",
         help="run the fault invariant checkers (faults/invariants.py) "
              "even without a scenario; violations exit 5",
@@ -1025,6 +1187,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="scenario spec `name[:k=v,...]`; repeatable (default: sweep "
              "the recoverable catalog — permanent-fault scenarios like "
              "blackhole_one_way and ring/star are opt-in by name)",
+    )
+    ps.add_argument(
+        "--workload",
+        help="couple a traffic workload spec into EVERY scenario run "
+             "(load + faults in one spec; corro_sim/workload/). "
+             "Coupling is validated (fault window must overlap the "
+             "write range) and checkpointing is disabled for coupled "
+             "runs",
+    )
+    ps.add_argument(
+        "--scorecard", nargs="?", const="SCORECARD.json", metavar="PATH",
+        help="arm the resilience scorecard on every scenario, write the "
+             "per-scenario blocks + threshold verdict to PATH (default "
+             "SCORECARD.json), and gate against analysis/golden/"
+             "resilience_thresholds.json — breaches exit 6",
     )
     ps.add_argument(
         "--rounds", type=int, default=128,

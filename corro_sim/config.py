@@ -104,6 +104,134 @@ class FaultConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class NodeFaultConfig:
+    """Node-lifecycle fault knobs (corro_sim/faults/nodes.py): crashes
+    that lose state, restarts from stale snapshots, per-node clock skew
+    and stragglers — the *agent*-level failure modes, where
+    :class:`FaultConfig` above models the *link*-level ones. Corrosion's
+    production failure mode is exactly this: an agent restarts with an
+    empty or stale SQLite DB and must full-resync via anti-entropy
+    (PAPER.md §survey). Everything is a static schedule over the round
+    counter, so both step programs (full and repair-specialized) derive
+    identical masks from ``state.round`` with ZERO new random draws —
+    the repair-program equivalence the driver's post-quiesce switch
+    depends on. Disabled (the default) traces zero extra ops and
+    contributes zero SimState leaves (the ``engine/features.py``
+    registry: ``node_epoch``/``node_snapshot`` appear only for enabling
+    configs, so every non-enabling config's pytree/jaxpr/cache keys stay
+    byte-identical).
+
+    Amnesia recoverability bound: a wiped node full-resyncs from the
+    change log, which is a ring of ``log_capacity`` versions per actor —
+    if any actor has written more than that when the wipe lands, the
+    ring-wrap tripwire fires and the run is POISONED. That is correct
+    physics, not a bug: history evicted from every surviving replica is
+    unrecoverable (doc/fault_injection.md §node faults).
+    """
+
+    crash: tuple = ()  # (node, round) pairs — crash-restart with
+    # AMNESIA: at the start of `round` the node's replica state (table
+    # rows, bookkeeping row, gossip rings, SWIM beliefs, HLC) is wiped
+    # to the empty-DB state and the node rejoins with an epoch-bumped
+    # HLC + SWIM incarnation; anti-entropy must full-resync it. The
+    # global change log survives (peers hold the actor's history — the
+    # reference's surviving replicas serve a rejoining node its own
+    # rows back). Schedule the wipe round at the node's scheduled
+    # *rejoin* (scenarios.crash_amnesia pairs it with a down window).
+    stale: tuple = ()  # (node, snap_round, round) triples — STALE
+    # REJOIN: at `snap_round` the node's (table, bookkeeping) rows are
+    # captured into the ``node_snapshot`` feature leaf; at `round` the
+    # wipe restores FROM that snapshot instead of zero (restart from an
+    # old backup), and sync repays only the delta (resync_rows).
+    skew: tuple = ()  # (node, offset) pairs — per-node wall-clock
+    # offset plane perturbing HLC timestamp generation (the physical
+    # floor becomes round + offset), exercising LWW tie-breaks and the
+    # EmptySet ts gating under clock skew. Static for the run.
+    straggle: tuple = ()  # (node, period, active) triples — per-node
+    # activation slowdown: the node participates in broadcast emission
+    # and anti-entropy sweeps only on rounds with
+    # ``(round + node) % period < active`` (duty cycle active/period).
+    # It still receives, still answers SWIM probes (it is alive, just
+    # slow) and still commits local writes — they disseminate on its
+    # next active round, exactly like an overloaded agent whose flush
+    # loop falls behind.
+    epoch_jump: int = 0  # HLC jump a rejoining node boots with:
+    # hlc = round + epoch_jump * restart_epoch (uhlc seeds from the
+    # wall clock; a restarted node's clock may be ahead). 0 = clean
+    # wall-clock reboot.
+    trace_vacuous: bool = False  # force the node-fault program to TRACE
+    # with zero scheduled effect — the non-perturbation guard's lever
+    # (tests/test_node_faults.py): the injection points themselves must
+    # not change state, metrics or key derivation.
+
+    @property
+    def enabled(self) -> bool:
+        """Static gate: False traces zero node-fault ops (the
+        cfg.probes discipline)."""
+        return bool(
+            self.crash or self.stale or self.skew or self.straggle
+            or self.trace_vacuous
+        )
+
+    @property
+    def wipe_enabled(self) -> bool:
+        """Whether any wipe (amnesia or stale restore) is scheduled —
+        the ``node_epoch`` leaf's enabling condition rides
+        ``enabled`` so the vacuous trace threads the plane too."""
+        return bool(self.crash or self.stale)
+
+    def wipe_schedule(self) -> tuple:
+        """Every scheduled ``(node, round)`` wipe, amnesia and stale
+        alike — the host-side consumers' one source of truth (invariant
+        checker exemptions, scorecard resync accounting)."""
+        return tuple(
+            [(int(n), int(r)) for n, r in self.crash]
+            + [(int(n), int(r)) for n, _s, r in self.stale]
+        )
+
+    def validate(self, num_nodes: int) -> "NodeFaultConfig":
+        for n, r in self.crash:
+            assert 0 <= int(n) < num_nodes, (
+                f"node_faults.crash node {n} out of range"
+            )
+            assert int(r) >= 0, "node_faults.crash round must be >= 0"
+        for n, s, r in self.stale:
+            assert 0 <= int(n) < num_nodes, (
+                f"node_faults.stale node {n} out of range"
+            )
+            assert 0 <= int(s) < int(r), (
+                "node_faults.stale snapshots must predate the restore "
+                f"round (got snap={s}, restore={r})"
+            )
+        for n, _off in self.skew:
+            assert 0 <= int(n) < num_nodes, (
+                f"node_faults.skew node {n} out of range"
+            )
+        for n, period, active in self.straggle:
+            assert 0 <= int(n) < num_nodes, (
+                f"node_faults.straggle node {n} out of range"
+            )
+            assert int(period) >= 1 and 1 <= int(active) <= int(period), (
+                "node_faults.straggle needs 1 <= active <= period "
+                f"(got period={period}, active={active}) — a node with "
+                "no active rounds never drains its rings"
+            )
+        return self
+
+
+def node_faults_from_dict(d: dict) -> NodeFaultConfig:
+    """Rebuild a NodeFaultConfig from its JSON-round-tripped asdict form
+    (checkpoint headers, resume tokens): the schedule tuples come back
+    as lists-of-lists and must re-tuple, like FaultConfig.blackhole."""
+    d = dict(d)
+    for key in ("crash", "stale", "skew", "straggle"):
+        d[key] = tuple(
+            tuple(int(x) for x in row) for row in d.get(key, ())
+        )
+    return NodeFaultConfig(**d)
+
+
+@dataclasses.dataclass(frozen=True)
 class SimConfig:
     # --- cluster shape ---
     num_nodes: int = 64
@@ -268,6 +396,14 @@ class SimConfig:
     # disabled: zero extra traced ops, bit-identical step program
     # (tests/test_faults.py non-perturbation guard).
 
+    # --- node-lifecycle faults (corro_sim/faults/nodes.py) ---
+    node_faults: NodeFaultConfig = NodeFaultConfig()  # crash-restart
+    # with amnesia, stale rejoin from a snapshot leaf, HLC clock skew
+    # and straggler duty cycles — agent-level failures where `faults`
+    # above is link-level. Defaults disabled: zero extra traced ops,
+    # zero extra SimState leaves (registry features), bit-identical
+    # step program (tests/test_node_faults.py non-perturbation guard).
+
     # --- host-side driver (engine/driver.py) ---
     pipeline: bool = True  # pipelined chunk dispatch: overlap device
     # compute with host-side control/transfers/bookkeeping (speculative
@@ -353,4 +489,5 @@ class SimConfig:
             "intra-region delivery is same-round (latency_intra must be 1)"
         )
         self.faults.validate(self.num_nodes)
+        self.node_faults.validate(self.num_nodes)
         return self

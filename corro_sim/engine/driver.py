@@ -203,6 +203,11 @@ class RunResult:
     # this run's AOT chunk-program compiles, total + by program
     # (utils/compile_cache.py CompileCacheProbe.summary()). Separates
     # the cache-miss tax from sim wall in every report/bench artifact.
+    resilience: dict | None = None  # the resilience scorecard block
+    # (faults/scorecard.py) when a scorecard was armed: recovery_rounds,
+    # rows_lost, resync_rows, SWIM false-down/flap counts, and — with a
+    # coupled workload — sub-delivery p50/p99 degradation during the
+    # fault window vs steady state. None when no scorecard ran.
 
     @property
     def wall_per_round_ms(self) -> float:
@@ -324,6 +329,7 @@ def run_sim(
     flight: FlightRecorder | None = None,
     profile_dir: str | None = None,
     invariants=None,
+    scorecard=None,
     pipeline: bool | None = None,
     transfer_guard: bool | None = None,
     workload=None,
@@ -360,6 +366,12 @@ def run_sim(
     device→host read of the bookkeeping planes per chunk, which is why
     it is opt-in); every violation it finds is annotated into the flight
     record and counted in ``corro_fault_invariant_violations_total``.
+
+    ``scorecard``: an opt-in :class:`corro_sim.faults.scorecard.
+    ResilienceScorecard` — fed on the invariant checker's cadence and
+    sanction point; its finalized block rides out as
+    ``RunResult.resilience`` + a ``resilience`` flight annotation and
+    the ``corro_resilience_*`` metric families.
 
     ``pipeline``: overlap device compute with host-side control (module
     docstring; doc/performance.md). ``None`` follows ``cfg.pipeline``
@@ -791,6 +803,27 @@ def run_sim(
                         help_="injected fault effects "
                               "(corro_sim/faults/)",
                     )
+        if "node_fault_wipes" in m:
+            # node-lifecycle fault flow (faults/nodes.py): additive
+            # node-round counters by series, corro_node_fault_* family
+            for mk, cname, chelp in (
+                ("node_fault_wipes", "corro_node_fault_wipes_total",
+                 "crash-restart wipes executed (amnesia + stale)"),
+                ("node_fault_straggling",
+                 "corro_node_fault_straggling_total",
+                 "straggler node-rounds parked by the duty cycle"),
+                ("node_fault_recovering",
+                 "corro_node_fault_recovering_total",
+                 "node-rounds spent resyncing a wiped write cursor"),
+            ):
+                delta = int(np.asarray(m[mk]).sum())
+                if delta:
+                    counters.inc(cname, n=delta, help_=chelp)
+        if scorecard is not None:
+            # same cadence + sanction point as the invariant checker —
+            # the scorecard reads the same chunk-boundary state snapshot
+            with _tg_sanctioned("invariants", transfer_guard):
+                scorecard.on_chunk(state_now, m, alive, part, base)
         if invariants is not None:
             with _tg_sanctioned("invariants", transfer_guard):
                 violations = list(
@@ -887,6 +920,13 @@ def run_sim(
                 eligible = (gaps == 0.0) & (idx > min_rounds)
                 converged_round = int(idx[np.argmax(eligible)])
                 flight.annotate(converged_round, "converged")
+                if scorecard is not None:
+                    # rows_lost is measured AT the convergence report —
+                    # the moment the claim "everyone agrees" is made
+                    with _tg_sanctioned("invariants", transfer_guard):
+                        scorecard.on_converged(
+                            state_now, alive[-1], part[-1]
+                        )
                 if invariants is not None:
                     # the convergence report itself is checked: no
                     # report may stand while a live same-partition
@@ -1319,6 +1359,19 @@ def run_sim(
         k: np.concatenate([c[k] for c in metrics_chunks])
         for k in metrics_chunks[0]
     }
+    resilience = None
+    if scorecard is not None:
+        # outside the guard region: the final-state reads here are
+        # result assembly, like the metric concat above
+        resilience = scorecard.finalize(
+            converged_round=None if poisoned else converged_round,
+            rounds=rounds, final_state=state,
+        )
+        flight.annotate(
+            rounds, "resilience",
+            **{k: v for k, v in resilience.items()
+               if isinstance(v, (int, float, str, bool)) or v is None},
+        )
     return RunResult(
         state=state,
         metrics=metrics,
@@ -1339,4 +1392,5 @@ def run_sim(
         pipeline=pipeline_stats,
         sharding=sharding_info,
         compile_cache=cache_probe.summary(),
+        resilience=resilience,
     )

@@ -18,6 +18,8 @@ import numpy as np
 
 import corro_sim.faults.inject  # noqa: F401  (registers the fault_burst
 # feature leaf at import time — engine/features.py)
+import corro_sim.faults.nodes  # noqa: F401  (registers the node_epoch /
+# node_snapshot dict-style feature leaves — node-lifecycle fault domain)
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping, make_bookkeeping
 from corro_sim.core.changelog import ChangeLog, make_changelog

@@ -34,6 +34,12 @@ from corro_sim.faults.inject import (
     fault_keys,
     link_fault_masks,
 )
+from corro_sim.faults.nodes import (
+    apply_node_faults,
+    recovering_mask,
+    skew_plane,
+    straggler_active,
+)
 from corro_sim.engine.probe import (
     probe_book_update,
     probe_metrics,
@@ -163,6 +169,23 @@ def sim_step(
     )
     reach = _reachable_fn(alive, part)
 
+    # ---------------------------------------------- node-lifecycle faults
+    # (faults/nodes.py): scheduled crash-restart wipes / stale-rejoin
+    # restores rebind the carry BEFORE anything reads it, plus the
+    # straggler duty mask and the HLC skew plane. Static gate — off
+    # traces ZERO extra ops (the cfg.probes discipline) — and every
+    # mask is a pure function of the round counter and baked constants
+    # (no new key draws), so the repair step derives the identical
+    # fault timeline.
+    nf_on = cfg.node_faults.enabled
+    if nf_on:
+        state, nf_wiped = apply_node_faults(cfg, state, state.round)
+        nf_active = straggler_active(cfg.node_faults, n, state.round)
+        nf_skew = skew_plane(cfg.node_faults, n)
+    else:
+        nf_active = None
+        nf_skew = None
+
     # ----------------------------------------------------- chaos injection
     # Static gate (cfg.probes discipline): faults off traces ZERO extra
     # ops and the program is bit-identical to the fault-free one. The
@@ -229,6 +252,19 @@ def sim_step(
             k_val, (n, s), 0, cfg.value_universe, dtype=jnp.int32
         )
         w_row_s = jnp.broadcast_to(w_row[:, None], (n, s))
+
+    if nf_on:
+        # post-wipe write gate (faults/nodes.py module docstring): a
+        # restarted node must not mint fresh versions until anti-entropy
+        # has served its own actor's history back — self-bookkeeping
+        # assumes head[i, i] == log.head[i] at write time, and breaking
+        # it would stamp old version numbers onto new content.
+        # Identically all-pass absent wipes, so the vacuous trace is a
+        # bit-identical no-op.
+        writers = writers & ~(
+            recovering_mask(state.book, state.log) & alive
+        )
+        w_del = w_del & writers
 
     table, ch_cv, ch_cl, ch_vr = local_write(
         state.table, rows_idx, w_row_s, w_col, w_val, w_del, w_ncells, writers
@@ -311,10 +347,18 @@ def sim_step(
         jnp.repeat(writers, r0),
     )
     e_actor = e_src
+    if nf_active is not None:
+        # straggler duty mask: an inactive node skips this round's eager
+        # sends; the write already sat down in its own pending ring
+        # (enqueue_own below), so dissemination is DELAYED to its next
+        # active round, never lost — the emit_slots saturation semantics
+        e_valid = e_valid & nf_active[e_src]
 
     # ------------------------------------------------- gossip dissemination
     gossip, g_dst, g_src, g_actor, g_ver, g_chunk, g_valid = broadcast_step(
-        state.gossip, k_bcast, alive, view, cfg.fanout,
+        state.gossip, k_bcast,
+        alive if nf_active is None else alive & nf_active,
+        view, cfg.fanout,
         emit_slots=cfg.emit_slots, round_idx=state.round,
         need_chunk=cpv > 1,
     )
@@ -492,11 +536,21 @@ def sim_step(
         )
         is_sync = is_sync | (quiesced & behind_pre & floor_hit)
 
+    # straggler sync gating (faults/nodes.py): a parked node initiates
+    # no sweep but still serves inbound requests. The duty cycle ticks
+    # on the SWEEP counter, not the round counter — a round-based phase
+    # could deterministically alias with sync_interval and starve the
+    # node's client side forever, which is a scheduler artifact, not a
+    # slow agent.
+    nf_sync_ok = (
+        None if nf_active is None
+        else straggler_active(cfg.node_faults, n, state.sync_rounds)
+    )
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
         k_sync, alive, view, part,
         rtt=rtt if cfg.rtt_rings else None, round_idx=state.sync_rounds,
-        fault_key=k_fsync, mesh=mesh,
+        fault_key=k_fsync, mesh=mesh, client_ok=nf_sync_ok,
     )
     if cfg.probes:
         # the anti-entropy merge point: heads that now cover a probe's
@@ -511,7 +565,7 @@ def sim_step(
     gap = jnp.where(
         alive[:, None], (log.head[None, :] - book.head).astype(jnp.float32), 0.0
     ).sum()
-    hlc, skew = _hlc_tick(alive, hlc_s, hlc_recv, state.round)
+    hlc, skew = _hlc_tick(alive, hlc_s, hlc_recv, state.round, nf_skew)
     metrics = {
         "writes": writers.sum(dtype=jnp.int32),
         "deletes": w_del.sum(dtype=jnp.int32),
@@ -554,6 +608,13 @@ def sim_step(
                 if cfg.faults.burst_enter > 0 else jnp.int32(0)
             ),
         } if fault_on else {}),
+        # node-lifecycle fault accounting (faults/nodes.py; additive):
+        # wipes executed this round, straggler node-rounds parked, and
+        # node-rounds still resyncing their own write cursor — the
+        # scorecard and the corro_node_fault_* exposition read these
+        **(_node_fault_metrics(
+            nf_wiped, nf_active, alive, book, log
+        ) if nf_on else {}),
     }
 
     new_state = state.replace(
@@ -641,23 +702,32 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
 def _sync_block(
     cfg, is_sync, book, log, table, hlc, last_cleared, cleared_hlc,
     k_sync, alive, view, part, rtt, round_idx=0, fault_key=None,
-    mesh=None,
+    mesh=None, client_ok=None,
 ):
     """The sync cond: one anti-entropy sweep when ``is_sync``.
 
     ``fault_key``: the per-round sync-fault subkey (faults/inject.py)
     when chaos injection is on — admitted connections then drop with
     ``faults.resolved_sync_loss`` and across blackholed edges. Static:
-    None (faults off) traces the pre-fault program exactly."""
+    None (faults off) traces the pre-fault program exactly.
+
+    ``client_ok``: the straggler duty mask (faults/nodes.py) — a parked
+    node initiates no sweep this round (its sync_loop backoff has
+    stretched) but still SERVES inbound requests: the reference's sync
+    server is a passive semaphore-guarded responder, so only the client
+    side slows down. Gating the pair-mask rows gates exactly that.
+    None (node faults off) traces the pre-fault program exactly."""
 
     def do_sync(args):
         book, table, hlc, lc = args
+        # reachability as a matrix-free pair of masks: same-partition
+        # check happens inside via gathered part ids
+        pairs = _pairwise_mask(alive, part)
+        if client_ok is not None:
+            pairs = pairs & client_ok[:, None]
         return sync_round(
             cfg, book, log, table, hlc, lc, cleared_hlc, k_sync, alive,
-            view,
-            # reachability as a matrix-free pair of masks: same-partition
-            # check happens inside via gathered part ids
-            _pairwise_mask(alive, part),
+            view, pairs,
             rtt=rtt, round_idx=round_idx, fault_key=fault_key, mesh=mesh,
         )
 
@@ -681,13 +751,34 @@ def _sync_block(
     )
 
 
-def _hlc_tick(alive, hlc_s, hlc_recv, round_):
+def _node_fault_metrics(nf_wiped, nf_active, alive, book, log):
+    """The node-fault metric block, shared verbatim by both step
+    programs (the repair step must compute bit-identical series under
+    its precondition). All additive: node-rounds, not gauges."""
+    return {
+        "node_fault_wipes": nf_wiped.sum(dtype=jnp.int32),
+        "node_fault_straggling": (
+            (alive & ~nf_active).sum(dtype=jnp.int32)
+            if nf_active is not None else jnp.int32(0)
+        ),
+        # end-of-round resync window: the write gate's own predicate
+        # (faults/nodes.py — one definition, no drift)
+        "node_fault_recovering": (
+            recovering_mask(book, log) & alive
+        ).sum(dtype=jnp.int32),
+    }
+
+
+def _hlc_tick(alive, hlc_s, hlc_recv, round_, skew=None):
     """uhlc max+tick: merged clocks from this round's deliveries + sync
-    contacts, physical floor = the round counter. Down nodes freeze.
-    Returns (hlc, skew)."""
+    contacts, physical floor = the round counter — raised per node by
+    the ``skew`` offset plane when the node-fault clock-skew knob is on
+    (faults/nodes.py; None traces the pre-skew expression exactly).
+    Down nodes freeze. Returns (hlc, skew)."""
+    floor = round_ if skew is None else round_ + skew
     hlc = jnp.where(
         alive,
-        jnp.maximum(jnp.maximum(hlc_s, hlc_recv), round_) + 1,
+        jnp.maximum(jnp.maximum(hlc_s, hlc_recv), floor) + 1,
         hlc_s,
     )
     int_min = jnp.int32(-(2**31) + 1)
@@ -724,6 +815,19 @@ def _repair_step(
     (_k_write, _k_row, _k_col, _k_val, _k_del, _k_ncell, _k_bcast, k_swim,
      k_sync) = jax.random.split(key, 9)
     reach = _reachable_fn(alive, part)
+
+    # node-lifecycle faults: the identical prologue the full step runs
+    # (masks are pure functions of the round counter — no keys), so a
+    # wipe landing in the convergence tail executes bit-for-bit on this
+    # program too and the driver's specialization stays equivalence-safe
+    nf_on = cfg.node_faults.enabled
+    if nf_on:
+        state, nf_wiped = apply_node_faults(cfg, state, state.round)
+        nf_active = straggler_active(cfg.node_faults, n, state.round)
+        nf_skew = skew_plane(cfg.node_faults, n)
+    else:
+        nf_active = None
+        nf_skew = None
 
     # same fold_in-derived fault lane as the full step: the burst Markov
     # state keeps evolving and the sync grant keeps failing through the
@@ -765,10 +869,15 @@ def _repair_step(
         )
         is_sync = is_sync | (behind_pre & floor_hit)
 
+    nf_sync_ok = (
+        None if nf_active is None
+        else straggler_active(cfg.node_faults, n, state.sync_rounds)
+    )
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,
         state.cleared_hlc, k_sync, alive, view, part, rtt=None,
         round_idx=state.sync_rounds, fault_key=k_fsync, mesh=mesh,
+        client_ok=nf_sync_ok,
     )
     probe = state.probe
     if cfg.probes:
@@ -784,7 +893,7 @@ def _repair_step(
         alive[:, None], (log.head[None, :] - book.head).astype(jnp.float32),
         0.0,
     ).sum()
-    hlc, skew = _hlc_tick(alive, hlc_s, hlc_recv, state.round)
+    hlc, skew = _hlc_tick(alive, hlc_s, hlc_recv, state.round, nf_skew)
     metrics = {
         "writes": zero,
         "deletes": zero,
@@ -821,6 +930,12 @@ def _repair_step(
                 if cfg.faults.burst_enter > 0 else zero
             ),
         } if fault_on else {}),
+        # node-fault series stay LIVE through the tail (wipes can land
+        # here; recovery is exactly what the tail repairs) — the shared
+        # helper keeps the expressions bit-identical to the full step's
+        **(_node_fault_metrics(
+            nf_wiped, nf_active, alive, book, log
+        ) if nf_on else {}),
     }
 
     new_state = state.replace(

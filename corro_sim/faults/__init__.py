@@ -22,7 +22,15 @@ package makes faults first-class:
 - :mod:`invariants` — per-chunk assertions that must hold under ANY fault
   mix (applied-head monotonicity, bookkeeping conservation, no
   convergence while a live pair disagrees, SWIM never falsely DOWN), and
-  the soak harness behind ``corro-sim soak``.
+  the soak harness behind ``corro-sim soak``;
+- :mod:`nodes` — the node-lifecycle fault domain: crash-restart with
+  amnesia, stale rejoin from a snapshot leaf, per-node HLC clock skew,
+  and straggler duty cycles, landing as registry feature leaves
+  (``engine/features.py``) so disabled configs stay byte-identical;
+- :mod:`scorecard` — the resilience scorecard grading recovery
+  (recovery_rounds, rows_lost, resync_rows, SWIM false-down/flaps,
+  sub-delivery degradation under a coupled workload) against the
+  committed threshold golden.
 """
 
 from corro_sim.faults.invariants import InvariantChecker, InvariantViolation
@@ -32,12 +40,20 @@ from corro_sim.faults.scenarios import (
     make_scenario,
     parse_scenario_spec,
 )
+from corro_sim.faults.scorecard import (
+    ResilienceScorecard,
+    check_thresholds,
+    load_thresholds,
+)
 
 __all__ = [
     "SCENARIOS",
     "Scenario",
     "InvariantChecker",
     "InvariantViolation",
+    "ResilienceScorecard",
+    "check_thresholds",
+    "load_thresholds",
     "make_scenario",
     "parse_scenario_spec",
 ]

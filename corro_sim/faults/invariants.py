@@ -59,6 +59,12 @@ class InvariantChecker:
         # (N, N) rounds each directed pair has been continuously
         # mutually-reachable with both ends up — the SWIM check's clock
         self._reach_streak: np.ndarray | None = None
+        # scheduled node wipes (faults/nodes.py): the ONE sanctioned way
+        # an applied head may decrease — a crash-restart losing its DB is
+        # the fault being injected, not a bookkeeping bug. Only the
+        # scheduled (node, round) entries are exempt, and only for the
+        # chunk the wipe lands in; any other decrease still violates.
+        self._wipe_schedule = tuple(cfg.node_faults.wipe_schedule())
 
     # ------------------------------------------------------------- checks
     def on_chunk(self, state, metrics, alive, part, start_round):
@@ -77,6 +83,9 @@ class InvariantChecker:
         head = np.asarray(state.book.head)
         if self._prev_head is not None:
             dec = head < self._prev_head
+            for node, r in self._wipe_schedule:
+                if start_round <= r < start_round + chunk:
+                    dec[node, :] = False  # scheduled crash-restart wipe
             if dec.any():
                 i, a = np.argwhere(dec)[0]
                 new.append(InvariantViolation(

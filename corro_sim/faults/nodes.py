@@ -1,0 +1,281 @@
+"""Node-lifecycle fault kernels: the jax side of :class:`NodeFaultConfig`.
+
+Where :mod:`corro_sim.faults.inject` fails *links* (loss, bursts,
+blackholes at the two transport points), this module fails *nodes* —
+corrosion's real production failure mode: an agent crashes and restarts
+with an empty or stale SQLite DB and must full-resync via anti-entropy
+(PAPER.md §survey: agents, SWIM, anti-entropy). Four fault kinds, all
+compiled from STATIC schedules over the round counter:
+
+- **crash-restart with amnesia** — at a scheduled round the node's
+  replica state (table rows, bookkeeping row, gossip rings, SWIM
+  beliefs, HLC, last-cleared stamp) wipes to the empty-DB state; the
+  node rejoins with an epoch-bumped HLC and SWIM incarnation and
+  anti-entropy serves its history back from peers (the global change
+  log survives — surviving replicas hold every actor's rows, exactly
+  why a rejoining corrosion agent can be rebuilt from the cluster);
+- **stale rejoin** — the wipe restores from a row-state snapshot leaf
+  captured at an earlier scheduled round (restart from an old backup)
+  instead of zero, so sync repays only the delta;
+- **HLC clock skew** — a per-node wall-clock offset plane raises the
+  physical floor of timestamp generation (``engine/step.py _hlc_tick``),
+  exercising LWW tie-breaks and EmptySet-ts gating under skew;
+- **stragglers** — per-node duty-cycle masks that skip broadcast
+  emission and anti-entropy participation on inactive rounds (the
+  overloaded agent whose flush loop falls behind); the node still
+  receives, still answers SWIM probes, still commits local writes.
+
+Discipline (the PR 3 pattern): zero new random draws — every mask is a
+pure function of ``state.round`` and baked config constants, so the
+full and repair-specialized step programs derive IDENTICAL fault
+timelines and the driver's post-quiesce program switch stays
+bit-for-bit (tests/test_node_faults.py). Disabled knobs trace zero ops
+and contribute zero SimState leaves: the two state planes
+(``node_epoch`` restart counter, ``node_snapshot`` stale-rejoin
+capture) register through :mod:`corro_sim.engine.features` as
+dict-style feature leaves, so every non-enabling config's pytree,
+jaxpr and compiled-program cache keys stay byte-identical
+(tests/test_cache_stability.py pattern; the cache-key manifest enforces
+it in CI).
+
+Write-gate soundness note: in this simulator node ordinal == actor id,
+so a wiped node cannot mint fresh versions while its own actor column
+is still behind the log head — self-bookkeeping assumes
+``book.head[i, i] == log.head[i]`` at write time, and breaking it would
+claim old version numbers for new content (silently-wrong state, the
+exact sharp edge ``io/checkpoint.restore_into`` documents). The step
+therefore gates local commits on the node having recovered its own
+write cursor (``recovering_mask``) — the reference's agents likewise
+reload ``BookedVersions`` before serving writes
+(``agent.rs:1334-1403``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.core.crdt import NEG
+from corro_sim.engine.features import FeatureLeaf, register_feature
+
+__all__ = [
+    "apply_node_faults",
+    "recovering_mask",
+    "skew_plane",
+    "straggler_active",
+]
+
+
+def _snapshot_leaf(cfg, seed):
+    """The stale-rejoin capture plane: the node-indexed replica state a
+    restart-from-backup restores — table cell planes + bookkeeping rows,
+    initialized to the empty-DB values so a restore scheduled before its
+    snapshot round degenerates to amnesia instead of garbage."""
+    n, r, c, a = (
+        cfg.num_nodes, cfg.num_rows, cfg.num_cols, cfg.num_actors,
+    )
+    return {
+        "cv": jnp.zeros((n, r, c), jnp.int32),
+        "vr": jnp.full((n, r, c), NEG, jnp.int32),
+        "site": jnp.full((n, r, c), -1, jnp.int32),
+        "cl": jnp.zeros((n, r), jnp.int32),
+        "head": jnp.zeros((n, a), jnp.int32),
+        "win": jnp.zeros((n, a), jnp.uint32),
+    }
+
+
+# Registry features (engine/features.py): disabled configs contribute
+# NOTHING — no placeholder, no aval — so registering these planes leaves
+# every non-enabling config's pytree/jaxpr/cache keys byte-identical.
+register_feature(FeatureLeaf(
+    name="node_epoch",
+    # the vacuous trace threads the plane too — the guard must exercise
+    # the real carry, not a special-cased one
+    enabled=lambda cfg: bool(
+        cfg.node_faults.wipe_enabled or cfg.node_faults.trace_vacuous
+    ),
+    build=lambda cfg, seed: jnp.zeros((cfg.num_nodes,), jnp.int32),
+    volatile=True,
+))
+register_feature(FeatureLeaf(
+    name="node_snapshot",
+    enabled=lambda cfg: bool(cfg.node_faults.stale),
+    build=_snapshot_leaf,
+    volatile=True,
+))
+
+
+def _mask_at(nodes, rounds, n: int, round_) -> jnp.ndarray:
+    """(N,) bool: which of the scheduled ``(node, round)`` entries fire
+    this round. ``nodes``/``rounds`` are baked host constants (the
+    int32 arrays :func:`_sched` builds); duplicates combine via
+    scatter-max. Sentinel form (node 0, round -1) never fires but still
+    traces the compare + scatter — the vacuous guard's lever."""
+    hit = jnp.asarray(rounds) == round_
+    return (
+        jnp.zeros((n,), bool).at[jnp.asarray(nodes)].max(hit, mode="drop")
+    )
+
+
+def _sched(pairs, vacuous: bool, width: int = 2):
+    """Schedule tuples → per-column int32 host constants, substituting a
+    never-firing sentinel row when the schedule is empty but the program
+    must trace (``trace_vacuous``)."""
+    rows = [tuple(int(x) for x in p) for p in pairs]
+    if not rows:
+        assert vacuous
+        rows = [tuple([0] + [-1] * (width - 1))]
+    return tuple(np.asarray(col, np.int32) for col in zip(*rows))
+
+
+def skew_plane(nf, n: int):
+    """(N,) int32 per-node wall-clock offset constant for ``_hlc_tick``'s
+    physical floor, or None when skew is statically off (the None path
+    traces the pre-skew expression exactly)."""
+    if not (nf.skew or nf.trace_vacuous):
+        return None
+    plane = np.zeros((n,), np.int32)
+    for node, off in nf.skew:
+        plane[int(node)] = int(off)
+    return jnp.asarray(plane)
+
+
+def straggler_active(nf, n: int, round_):
+    """(N,) bool participation mask: False while a straggler's duty
+    cycle parks it — ``(round + node) % period < active`` (the node-id
+    phase decorrelates stragglers so they do not all stall the same
+    rounds). None when statically off. Consumers gate broadcast
+    emission and sync participation; delivery, SWIM probes and local
+    commits stay ungated (a straggler is alive, just slow)."""
+    if not (nf.straggle or nf.trace_vacuous):
+        return None
+    nodes, period, active = _sched(nf.straggle, nf.trace_vacuous, width=3)
+    if not nf.straggle:
+        # sentinel: period 1 / active 1 — always participating
+        period = np.ones_like(period)
+        active = np.ones_like(active)
+    nodes_a = jnp.asarray(nodes)
+    act = ((round_ + nodes_a) % jnp.asarray(period)) < jnp.asarray(active)
+    return jnp.ones((n,), bool).at[nodes_a].min(act, mode="drop")
+
+
+def recovering_mask(book, log) -> jnp.ndarray:
+    """(N,) bool: nodes whose own actor column is still behind the log
+    head — the post-wipe resync window during which local commits are
+    gated (module docstring) and the ``node_fault_recovering`` metric's
+    definition (ONE expression, shared so the write gate and the metric
+    cannot drift). Identically False absent wipes (every node's
+    self-bookkeeping tracks its own writes exactly), so the vacuous
+    trace is a bit-identical no-op."""
+    n = book.head.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    return book.head[rows, rows] < log.head
+
+
+def apply_node_faults(cfg, state, round_):
+    """The node-fault prologue, applied at the START of a round by BOTH
+    step programs: capture stale-rejoin snapshots, then execute every
+    wipe scheduled for this round. Returns ``(state, wiped)`` where
+    ``wiped`` is the (N,) bool mask of nodes restarted this round (a
+    zeros constant when no wipe plane is armed, so the metric surface
+    stays static).
+
+    Wipe semantics (the empty-SQLite restart): table cell planes and the
+    bookkeeping row reset to init values (or the snapshot's, for stale
+    entries — amnesia wins if both fire), gossip pending rings drop
+    (the in-memory queue dies with the process), SWIM membership renews
+    with a bumped incarnation (:func:`membership.swim.renew_membership`),
+    the HLC reboots from the wall clock plus the epoch jump, and the
+    last-cleared stamp forgets. NOT wiped: the global change log and
+    per-version cleared stamps (actor history survives at peers), the
+    in-flight delay ring (packets already on the wire), link-level fault
+    state, RTT observations (link properties), and the probe tracer
+    (an observer, not node state)."""
+    nf = cfg.node_faults
+    if not (nf.wipe_enabled or nf.trace_vacuous):
+        return state, jnp.zeros((cfg.num_nodes,), bool)
+    n = cfg.num_nodes
+    feats = dict(state.features)
+    table, book = state.table, state.book
+
+    # ---- stale-rejoin snapshot capture (before any wipe this round:
+    # a same-round capture+restore degenerates to an identity wipe)
+    stale_on = bool(nf.stale)
+    if stale_on:
+        s_nodes = [int(x[0]) for x in nf.stale]
+        s_caps = [int(x[1]) for x in nf.stale]
+        s_restores = [int(x[2]) for x in nf.stale]
+        cap = _mask_at(s_nodes, s_caps, n, round_)
+        snap = feats["node_snapshot"]
+        snap = {
+            "cv": jnp.where(cap[:, None, None], table.cv, snap["cv"]),
+            "vr": jnp.where(cap[:, None, None], table.vr, snap["vr"]),
+            "site": jnp.where(
+                cap[:, None, None], table.site, snap["site"]
+            ),
+            "cl": jnp.where(cap[:, None], table.cl, snap["cl"]),
+            "head": jnp.where(cap[:, None], book.head, snap["head"]),
+            "win": jnp.where(cap[:, None], book.win, snap["win"]),
+        }
+        feats["node_snapshot"] = snap
+        sv = _mask_at(s_nodes, s_restores, n, round_)
+    else:
+        sv = None
+
+    # ---- wipe masks: amnesia + stale restores
+    if nf.crash or (nf.trace_vacuous and not stale_on):
+        c_nodes, c_rounds = _sched(nf.crash, nf.trace_vacuous)
+        am = _mask_at(c_nodes, c_rounds, n, round_)
+    else:
+        am = jnp.zeros((n,), bool)
+    wiped = am | sv if sv is not None else am
+
+    # ---- restore sources: empty-DB init values, snapshot where stale
+    # (amnesia wins a same-round collision — the fresher failure)
+    def pick(live, zero, snap_v=None, expand=1):
+        w = wiped.reshape(wiped.shape + (1,) * expand)
+        if snap_v is None:
+            return jnp.where(w, zero, live)
+        a = am.reshape(am.shape + (1,) * expand)
+        return jnp.where(w, jnp.where(a, zero, snap_v), live)
+
+    snap = feats.get("node_snapshot")
+    table = table.replace(
+        cv=pick(table.cv, 0, snap["cv"] if stale_on else None, 2),
+        vr=pick(table.vr, NEG, snap["vr"] if stale_on else None, 2),
+        site=pick(table.site, -1, snap["site"] if stale_on else None, 2),
+        cl=pick(table.cl, 0, snap["cl"] if stale_on else None, 1),
+    )
+    book = book.replace(
+        head=pick(book.head, 0, snap["head"] if stale_on else None, 1),
+        win=pick(
+            book.win, jnp.uint32(0),
+            snap["win"] if stale_on else None, 1,
+        ),
+    )
+    # the in-memory broadcast queue dies with the process (amnesia and
+    # stale alike — a disk backup never holds it)
+    gossip = state.gossip.replace(
+        pend=jnp.where(wiped[:, None, None], 0, state.gossip.pend),
+        cursor=jnp.where(wiped, 0, state.gossip.cursor),
+    )
+    swim = state.swim
+    if cfg.swim_enabled:
+        from corro_sim.membership.swim import renew_membership
+
+        swim = renew_membership(swim, wiped)
+    # epoch-bumped HLC reboot: the restart epoch rides the node_epoch
+    # leaf; the clock restarts at the wall clock (round) plus the
+    # configured per-epoch jump, and _hlc_tick's max keeps it monotone
+    epoch = feats["node_epoch"] + wiped.astype(jnp.int32)
+    feats["node_epoch"] = epoch
+    hlc = jnp.where(
+        wiped,
+        (round_ + jnp.int32(nf.epoch_jump) * epoch).astype(jnp.int32),
+        state.hlc,
+    )
+    last_cleared = jnp.where(wiped, -1, state.last_cleared)
+    return state.replace(
+        table=table, book=book, gossip=gossip, swim=swim, hlc=hlc,
+        last_cleared=last_cleared, features=feats,
+    ), wiped

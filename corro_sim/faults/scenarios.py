@@ -56,6 +56,9 @@ class Scenario:
     alive: np.ndarray | None = None  # (rounds, n) bool
     part: np.ndarray | None = None  # (rounds, n) int32
     events: list = dataclasses.field(default_factory=list)
+    node_faults: dict = dataclasses.field(default_factory=dict)
+    # NodeFaultConfig field overrides (faults/nodes.py): crash/stale
+    # wipe schedules, skew planes, straggler duty cycles
 
     def __post_init__(self):
         # round-sorted invariant: LiveCluster's event cursor and the
@@ -76,12 +79,66 @@ class Scenario:
         )
 
     def apply(self, cfg: SimConfig) -> SimConfig:
-        """``cfg`` with this scenario's fault knobs merged in."""
-        if not self.faults:
+        """``cfg`` with this scenario's fault knobs merged in — the
+        link-level FaultConfig overrides and the node-level
+        NodeFaultConfig ones alike."""
+        if not self.faults and not self.node_faults:
             return cfg
-        return dataclasses.replace(
-            cfg, faults=dataclasses.replace(cfg.faults, **self.faults)
-        ).validate()
+        kw = {}
+        if self.faults:
+            kw["faults"] = dataclasses.replace(cfg.faults, **self.faults)
+        if self.node_faults:
+            kw["node_faults"] = dataclasses.replace(
+                cfg.node_faults, **self.node_faults
+            )
+        return dataclasses.replace(cfg, **kw).validate()
+
+    def fault_window(self) -> tuple[int, int] | None:
+        """The ``[first, last]`` round range this scenario's faults are
+        actually in effect — from the event timeline when present, else
+        the whole run for always-on fault knobs (loss, skew, duty
+        cycles). Bookkeeping events that happen on a HEALTHY cluster
+        (the stale-rejoin snapshot capture) do not open the window — a
+        window starting there would grade fault-free rounds as faulted.
+        None only for a scenario with neither events nor overrides."""
+        onsets = [ev for ev in self.events if ev[1] != "snapshot"]
+        if onsets:
+            return (
+                int(min(ev[0] for ev in onsets)),
+                int(self.heal_round
+                    if self.heal_round is not None
+                    else max(ev[0] for ev in onsets)),
+            )
+        if self.faults or self.node_faults:
+            return (0, self.rounds - 1)
+        return None
+
+    def check_workload(self, workload) -> None:
+        """The coupled-spec validation (`run/soak --scenario X
+        --workload Y`): the fault window and the workload's write range
+        must OVERLAP, or the run is two experiments glued end to end —
+        latency-under-load numbers during the fault window would be
+        measured against zero traffic (SWARM's
+        replication-latency-under-load story needs both at once). ONE
+        error message, raised at spec time, not after minutes of
+        compile."""
+        w = np.asarray(workload.writers)
+        if not w.any():
+            lo_w, hi_w = 0, -1
+        else:
+            rows = np.nonzero(w.any(axis=1))[0]
+            lo_w, hi_w = int(rows[0]), int(rows[-1])
+        fw = self.fault_window()
+        if fw is None or (lo_w <= fw[1] and hi_w >= fw[0]):
+            return
+        raise ValueError(
+            f"scenario {self.spec!r} schedules its faults in rounds "
+            f"[{fw[0]}, {fw[1]}] but workload {workload.spec!r} writes "
+            f"only in rounds [{lo_w}, {hi_w}] — the ranges never "
+            "overlap, so no fault would ever land under load; extend "
+            "the workload's --write-rounds/rounds or move the "
+            "scenario's fault window"
+        )
 
     @property
     def spec(self) -> str:
@@ -264,6 +321,143 @@ def churn(n, rounds, write_rounds, seed, rate: float = 0.02,
     )
 
 
+# ------------------------------------------------ node-lifecycle scenarios
+# (corro_sim/faults/nodes.py): the agent-level failure catalog — state
+# loss, stale restores, clock skew, stragglers — compiled into the same
+# (alive schedule + config override + event) shape as the link catalog.
+
+
+def _pick_nodes(n: int, count: int, seed: int, tag: int) -> list[int]:
+    rng = np.random.default_rng(int(seed) ^ tag)
+    return sorted(
+        int(v) for v in rng.choice(n, size=min(int(count), n),
+                                   replace=False)
+    )
+
+
+def crash_amnesia(n, rounds, write_rounds, seed, nodes: int = 3,
+                  at: int = -1, down: int = 4, jump: int = 0):
+    """Corrosion's production failure mode: ``nodes`` agents crash at
+    round ``at`` (default mid-write-phase), stay down ``down`` rounds,
+    and restart with an EMPTY database — table, bookkeeping, gossip
+    rings, SWIM membership all wiped at the rejoin round
+    (faults/nodes.py). They rejoin with an epoch-bumped HLC (+ ``jump``
+    per restart) and must full-resync via anti-entropy; the scorecard's
+    rows_lost==0 / recovery_rounds numbers are this scenario's whole
+    point."""
+    at = int(at) if int(at) >= 0 else max(2, write_rounds // 2)
+    down = max(1, int(down))
+    rejoin = min(at + down, rounds - 1)
+    victims = _pick_nodes(n, nodes, seed, 0xA3E1)
+    alive, part = _base(n, rounds)
+    alive[at:rejoin, victims] = False
+    events = [
+        (at, "kill", {"nodes": victims, "fault": "crash_amnesia"}),
+        (rejoin, "rejoin", {"nodes": victims, "amnesia": True}),
+        (rejoin, "heal", {"phase": "heal"}),
+    ]
+    return Scenario(
+        name="crash_amnesia",
+        params={"nodes": int(nodes), "at": at, "down": down,
+                "jump": int(jump)},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        alive=alive, part=part, events=events,
+        node_faults={
+            "crash": tuple((v, rejoin) for v in victims),
+            "epoch_jump": int(jump),
+        },
+    )
+
+
+def stale_rejoin(n, rounds, write_rounds, seed, nodes: int = 2,
+                 snap: int = -1, at: int = -1, down: int = 4):
+    """Restart from an old backup: the victims' row state is snapshotted
+    at round ``snap`` (default: a quarter into the write phase), they
+    crash at ``at`` and rejoin restored FROM THE SNAPSHOT instead of
+    empty — anti-entropy repays only the delta (the scorecard's
+    resync_rows)."""
+    snap = int(snap) if int(snap) >= 0 else max(1, write_rounds // 4)
+    at = int(at) if int(at) >= 0 else max(snap + 1, write_rounds // 2)
+    down = max(1, int(down))
+    rejoin = min(at + down, rounds - 1)
+    victims = _pick_nodes(n, nodes, seed, 0x57A1)
+    alive, part = _base(n, rounds)
+    alive[at:rejoin, victims] = False
+    events = [
+        (snap, "snapshot", {"nodes": victims}),
+        (at, "kill", {"nodes": victims, "fault": "stale_rejoin"}),
+        (rejoin, "rejoin", {"nodes": victims, "snapshot_round": snap}),
+        (rejoin, "heal", {"phase": "heal"}),
+    ]
+    return Scenario(
+        name="stale_rejoin",
+        params={"nodes": int(nodes), "snap": snap, "at": at,
+                "down": down},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        alive=alive, part=part, events=events,
+        node_faults={
+            "stale": tuple((v, snap, rejoin) for v in victims),
+        },
+    )
+
+
+def clock_skew(n, rounds, write_rounds, seed, nodes: int = 0,
+               max_skew: int = 64):
+    """Per-node HLC wall-clock offsets (default: a quarter of the
+    cluster, seeded offsets up to ``max_skew`` rounds fast or slow) —
+    the NTP-drift study: LWW tie-breaks and EmptySet-ts gating must
+    stay convergent when some nodes mint timestamps from the future.
+    No outage: the heal marker sits at the write-phase end so recovery
+    measures the skewed tail."""
+    count = int(nodes) or max(1, n // 4)
+    victims = _pick_nodes(n, count, seed, 0xC10C)
+    rng = np.random.default_rng(int(seed) ^ 0x5CE3)
+    offs = rng.integers(1, max(int(max_skew), 2), size=len(victims))
+    signs = rng.choice((-1, 1), size=len(victims))
+    skew = tuple(
+        (v, int(o * s)) for v, o, s in zip(victims, offs, signs)
+    )
+    events = [
+        (0, "skew", {"nodes": victims}),
+        (max(write_rounds - 1, 0), "heal", {"phase": "heal"}),
+    ]
+    return Scenario(
+        name="clock_skew",
+        params={"nodes": count, "max_skew": int(max_skew)},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        events=events, node_faults={"skew": skew},
+    )
+
+
+def stragglers(n, rounds, write_rounds, seed, frac: float = 0.1,
+               period: int = 8, active: int = 2):
+    """A fraction of nodes run slow: they emit broadcasts and initiate
+    sync sweeps only ``active`` of every ``period`` duty rounds
+    (faults/nodes.py — they still receive, answer SWIM probes, serve
+    inbound sync and commit local writes). The convergence tail
+    stretches to the stragglers' cadence; the heal marker sits at the
+    write-phase end so recovery measures that stretch."""
+    k = max(1, int(round(n * float(frac))))
+    victims = _pick_nodes(n, k, seed, 0x57AA)
+    events = [
+        (0, "straggle", {"nodes": victims, "period": int(period),
+                         "active": int(active)}),
+        (max(write_rounds - 1, 0), "heal", {"phase": "heal"}),
+    ]
+    return Scenario(
+        name="stragglers",
+        params={"frac": frac, "period": int(period),
+                "active": int(active)},
+        rounds=rounds, write_rounds=write_rounds, faults={},
+        events=events,
+        node_faults={
+            "straggle": tuple(
+                (v, int(period), int(active)) for v in victims
+            ),
+        },
+    )
+
+
 # ----------------------------------------------------- topology constraints
 def _allow_only(n: int, allowed: np.ndarray) -> tuple:
     """Blackhole pairs blocking every directed edge NOT in ``allowed``
@@ -329,6 +523,10 @@ SCENARIOS = {
     "churn": churn,
     "ring": ring,
     "star": star,
+    "crash_amnesia": crash_amnesia,
+    "stale_rejoin": stale_rejoin,
+    "clock_skew": clock_skew,
+    "stragglers": stragglers,
 }
 
 # The soak sweep's default set: scenarios whose faults clear (or are
@@ -339,6 +537,7 @@ SCENARIOS = {
 SOAK_DEFAULT = (
     "lossy", "duplicating", "burst", "rolling_restart", "flapper",
     "split_brain_heal", "churn",
+    "crash_amnesia", "stale_rejoin", "clock_skew", "stragglers",
 )
 
 

@@ -349,6 +349,11 @@ def _cluster_from_meta(meta, tripwire=None):
             tuple(int(x) for x in p) for p in faults.get("blackhole", ())
         )
         cfg["faults"] = FaultConfig(**faults)
+    node_faults = cfg.pop("node_faults", None)
+    if node_faults:  # same flattening, same rebuild (schedule tuples)
+        from corro_sim.config import node_faults_from_dict
+
+        cfg["node_faults"] = node_faults_from_dict(node_faults)
     layout = _rebuild_layout(meta)
     universe = LiveUniverse.restore(
         [_dec_value(v) for v in meta["universe"]["values"]],
@@ -539,7 +544,11 @@ SIM_CKPT_FORMAT = 1
 
 def _simconfig_from_dict(d: dict):
     """Rebuild a SimConfig from its JSON-round-tripped asdict form."""
-    from corro_sim.config import FaultConfig, SimConfig
+    from corro_sim.config import (
+        FaultConfig,
+        SimConfig,
+        node_faults_from_dict,
+    )
 
     d = dict(d)
     faults = d.pop("faults", None)
@@ -549,6 +558,9 @@ def _simconfig_from_dict(d: dict):
             tuple(int(x) for x in p) for p in faults.get("blackhole", ())
         )
         d["faults"] = FaultConfig(**faults)
+    node_faults = d.pop("node_faults", None)
+    if node_faults:
+        d["node_faults"] = node_faults_from_dict(node_faults)
     return SimConfig(**d)
 
 

@@ -39,10 +39,11 @@ except ModuleNotFoundError:  # pragma: no cover - version-dependent
     except ModuleNotFoundError:
         tomllib = None
 
-from corro_sim.config import FaultConfig, SimConfig
+from corro_sim.config import FaultConfig, NodeFaultConfig, SimConfig
 
 ENV_PREFIX = "CORRO_SIM__"
 FAULTS_ENV_PREFIX = ENV_PREFIX + "FAULTS__"
+NODE_FAULTS_ENV_PREFIX = ENV_PREFIX + "NODE_FAULTS__"
 
 
 def _parse_bool(name: str, raw: str) -> bool:
@@ -108,12 +109,67 @@ def _build_faults(table: dict, env) -> FaultConfig | None:
     return FaultConfig(**values) if values else None
 
 
+def _parse_tuples(raw, width: int, what: str) -> tuple:
+    """Node-fault schedule rows from TOML (``[[1, 12], [4, 12]]``) or an
+    env string (``"1:12,4:12"`` — colon-separated fields, comma-separated
+    rows) into the tuple-of-tuples NodeFaultConfig carries."""
+    if isinstance(raw, str):
+        rows = []
+        for item in raw.split(","):
+            if not item.strip():
+                continue
+            parts = item.split(":")
+            if len(parts) != width:
+                raise ValueError(
+                    f"node_faults.{what} entry {item!r} needs "
+                    f"{width} colon-separated fields"
+                )
+            rows.append(tuple(int(p) for p in parts))
+        return tuple(rows)
+    out = tuple(tuple(int(x) for x in row) for row in raw)
+    for row in out:
+        if len(row) != width:
+            raise ValueError(
+                f"node_faults.{what} entry {row!r} needs {width} fields"
+            )
+    return out
+
+
+_NODE_FAULT_TUPLES = {"crash": 2, "stale": 3, "skew": 2, "straggle": 3}
+
+
+def _build_node_faults(table: dict, env) -> NodeFaultConfig | None:
+    """The ``[sim.node_faults]`` block + ``CORRO_SIM__NODE_FAULTS__*``
+    overrides (schedule tuples via the colon/comma grammar above; the
+    vendored flat-TOML fallback parser carries only scalar values, so
+    schedule lists need real tomllib or the env spelling)."""
+    nfields = {f.name: f for f in dataclasses.fields(NodeFaultConfig)}
+    values: dict = {}
+    for k, v in table.items():
+        if k not in nfields:
+            raise KeyError(f"unknown node_faults config key: {k!r}")
+        values[k] = (
+            _parse_tuples(v, _NODE_FAULT_TUPLES[k], k)
+            if k in _NODE_FAULT_TUPLES else v
+        )
+    for k, field in nfields.items():
+        env_key = NODE_FAULTS_ENV_PREFIX + k.upper()
+        if env_key in env:
+            raw = env[env_key]
+            if k in _NODE_FAULT_TUPLES:
+                values[k] = _parse_tuples(raw, _NODE_FAULT_TUPLES[k], k)
+            else:
+                values[k] = _coerce(field, raw)
+    return NodeFaultConfig(**values) if values else None
+
+
 def load_config(path: str | None = None, env=None) -> SimConfig:
     """Build a SimConfig from an optional TOML file + env overrides."""
     env = os.environ if env is None else env
     fields = {f.name: f for f in dataclasses.fields(SimConfig)}
     values: dict = {}
     faults_table: dict = {}
+    node_faults_table: dict = {}
 
     if path is not None:
         if tomllib is not None:
@@ -127,8 +183,12 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
         faults_table = dict(
             table.pop("faults", None) or doc.get("sim.faults") or {}
         )
+        node_faults_table = dict(
+            table.pop("node_faults", None)
+            or doc.get("sim.node_faults") or {}
+        )
         for k, v in table.items():
-            if k == "sim.faults" or isinstance(v, dict):
+            if k in ("sim.faults", "sim.node_faults") or isinstance(v, dict):
                 continue
             if k not in fields:
                 raise KeyError(f"unknown config key in {path}: {k!r}")
@@ -143,7 +203,7 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
             values[k] = v
 
     for k, field in fields.items():
-        if k == "faults":
+        if k in ("faults", "node_faults"):
             continue
         env_key = ENV_PREFIX + k.upper()
         if env_key in env:
@@ -152,6 +212,9 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
     faults = _build_faults(faults_table, env)
     if faults is not None:
         values["faults"] = faults
+    node_faults = _build_node_faults(node_faults_table, env)
+    if node_faults is not None:
+        values["node_faults"] = node_faults
     return SimConfig(**values).validate()
 
 

@@ -198,6 +198,59 @@ def down_belief_matrix(sw, n: int):
     return status >= 2
 
 
+def renew_membership(swim_state, wipe: jnp.ndarray):
+    """Crash-restart a masked set of nodes' membership state, on-device
+    (corro_sim/faults/nodes.py; the traced analog of the admin ``cluster
+    rejoin`` path in harness/cluster.py): each wiped node's belief row is
+    reset to the empty-DB state and its SELF entry comes back ALIVE at a
+    bumped (saturating) incarnation — the foca identity ``renew()`` that
+    lets peers holding a DOWN verdict re-admit it (``actor.rs:199-210``).
+    The pre-wipe self-incarnation is read before the reset: a node's own
+    inc is the max of every belief about it (refutation always bumps
+    past the suspicion it answers), so old_inc + 1 outranks any DOWN
+    entry a peer still gossips. Handles BOTH layouts — the full (N, N)
+    plane and the windowed member/belief state — like
+    :func:`down_belief_matrix`, so the step cannot drift from the admin
+    surface. ``wipe`` is an (N,) bool mask; untouched rows pass through
+    bit-identically (an all-False mask is a traced no-op)."""
+    if hasattr(swim_state, "member"):  # windowed O(N·K) belief state
+        lo = swim_layout(swim_state.belief.dtype)
+        n = swim_state.member.shape[0]
+        old_inc = swim_state.belief[:, 0] >> lo.inc_shift
+        renewed = (
+            jnp.minimum(old_inc + 1, lo.inc_max) << lo.inc_shift
+        ).astype(lo.dtype)
+        member = jnp.where(
+            wipe[:, None],
+            jnp.full_like(swim_state.member, -1).at[:, 0].set(
+                jnp.arange(n, dtype=jnp.int32)
+            ),
+            swim_state.member,
+        )
+        belief = jnp.where(
+            wipe[:, None], jnp.zeros_like(swim_state.belief),
+            swim_state.belief,
+        )
+        belief = belief.at[:, 0].set(
+            jnp.where(wipe, renewed, belief[:, 0])
+        )
+        cursor = jnp.where(wipe, 1, swim_state.cursor)
+        return swim_state.replace(
+            member=member, belief=belief, cursor=cursor
+        )
+    lo = swim_layout(swim_state.p.dtype)
+    p = swim_state.p
+    n = p.shape[0]
+    rows = jnp.arange(n, dtype=jnp.int32)
+    old_inc = p[rows, rows] >> lo.inc_shift
+    renewed = (
+        jnp.minimum(old_inc + 1, lo.inc_max) << lo.inc_shift
+    ).astype(lo.dtype)
+    p = jnp.where(wipe[:, None], jnp.zeros_like(p), p)
+    p = p.at[rows, rows].set(jnp.where(wipe, renewed, p[rows, rows]))
+    return swim_state.replace(p=p)
+
+
 def view_alive(swim: SwimState) -> jnp.ndarray:
     """(N, N) bool: who each node would still gossip/sync with.
 

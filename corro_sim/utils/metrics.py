@@ -202,6 +202,34 @@ SUB_LATENCY_SECONDS_HELP = (
     "subscription delivery wall latency (write accepted -> event "
     "drained by the subscriber)"
 )
+# ---- corro_node_fault_* / corro_resilience_*: the node-lifecycle
+# fault domain + resilience scorecard (corro_sim/faults/nodes.py,
+# faults/scorecard.py; doc/fault_injection.md §node faults). Step
+# metrics (additive node-round counters, emitted only while
+# SimConfig.node_faults is enabled):
+#   corro_node_fault_wipes_total        crash-restart wipes executed
+#                                       (amnesia + stale restores)
+#   corro_node_fault_straggling_total   straggler node-rounds parked by
+#                                       the duty cycle
+#   corro_node_fault_recovering_total   node-rounds spent resyncing a
+#                                       wiped write cursor
+# Scorecard families (one finalized block per graded run, labeled by
+# scenario):
+#   corro_resilience_runs_total             graded runs
+#   corro_resilience_rows_lost_total        cells diverging from the
+#                                           partition reference at the
+#                                           convergence report
+#   corro_resilience_resync_rows_total      version-applications repaid
+#                                           to wiped nodes
+#   corro_resilience_swim_false_down_total  belief pairs marking a live
+#                                           node DOWN
+#   corro_resilience_swim_flaps_total       false-DOWN pairs relapsing
+#   corro_resilience_recovery_rounds        histogram: heal →
+#                                           re-convergence (ROUNDS_BUCKETS)
+NODE_FAULT_WIPES_TOTAL = "corro_node_fault_wipes_total"
+RESILIENCE_RUNS_TOTAL = "corro_resilience_runs_total"
+RESILIENCE_RECOVERY_ROUNDS = "corro_resilience_recovery_rounds"
+
 WORKLOAD_WRITES_TOTAL = "corro_workload_writes_total"
 WORKLOAD_ROUNDS_TOTAL = "corro_workload_rounds_total"
 WORKLOAD_COALESCED_TOTAL = "corro_workload_coalesced_total"
@@ -464,12 +492,23 @@ def render_prometheus(cluster) -> str:
     for key, v in sorted(totals.items()):
         if (
             key not in _SERIES
-            and not key.startswith(("probe_", "fault_"))
+            and not key.startswith(("probe_", "fault_", "node_fault_"))
         ):
             emit(
                 f"corro_sim_{key}_total", "counter",
                 f"sim step metric {key}", v,
             )
+
+    # ---- node-lifecycle faults (corro_sim/faults/nodes.py): additive
+    # node-round counters, named corro_node_fault_* so the driver-side
+    # counters and soak dashboards line up.
+    for key in sorted(k for k in totals if k.startswith("node_fault_")):
+        emit(
+            f"corro_{key}_total", "counter",
+            f"node-lifecycle fault flow {key[11:]} "
+            "(corro_sim/faults/nodes.py)",
+            int(totals[key]),
+        )
 
     # ---- chaos injection (corro_sim/faults/): injected-fault flow
     # counters + the burst-state gauge, named corro_fault_* so soak

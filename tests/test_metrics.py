@@ -428,6 +428,81 @@ def test_workload_and_sub_latency_families_render_and_validate():
     _validate_exposition(text)
 
 
+def test_node_fault_and_resilience_families_render_and_validate(cluster):
+    """ISSUE 11 satellite: the corro_node_fault_* step-metric family
+    (rendered from totals, never mis-summed into the generic
+    corro_sim_*_total path) and the corro_resilience_* scorecard
+    families (counters + the recovery-rounds histogram, emitted by
+    faults/scorecard.export_metrics) render through the exposition and
+    the whole thing still passes the scraper-contract validator."""
+    from corro_sim.faults.scorecard import export_metrics
+
+    # a finalized scorecard block drives the corro_resilience_* export
+    export_metrics({
+        "scenario": "crash_amnesia:nodes=3",
+        "converged_round": 20,
+        "recovery_rounds": 8,
+        "rows_lost": 0,
+        "resync_rows": 153,
+        "swim_false_down": 2,
+        "swim_flaps": 1,
+    })
+    # the step-metric family renders from a cluster whose totals carry
+    # node_fault_* series — inject them the way a ticked node-fault
+    # cluster would accumulate them. The driver-side counters share
+    # these names (the corro_fault_* precedent: headless runs count in
+    # the process registry, live clusters render from totals — one
+    # process hosts one or the other); earlier driver tests in the same
+    # process may have bumped them, so drop those copies to keep this
+    # render single-sourced regardless of test order.
+    from corro_sim.utils.metrics import counters as _counters
+
+    with _counters._lock:
+        for k in list(_counters._c):
+            if k[0].startswith("corro_node_fault_"):
+                _counters._c.pop(k)
+                _counters._help.pop(k[0], None)
+    cluster._totals["node_fault_wipes"] = 3
+    cluster._totals["node_fault_straggling"] = 12
+    cluster._totals["node_fault_recovering"] = 7
+    try:
+        text = render_prometheus(cluster)
+    finally:
+        for k in ("node_fault_wipes", "node_fault_straggling",
+                  "node_fault_recovering"):
+            cluster._totals.pop(k, None)
+    assert "corro_node_fault_wipes_total 3" in text
+    assert "corro_node_fault_straggling_total 12" in text
+    assert "corro_node_fault_recovering_total 7" in text
+    # never double-rendered through the generic family
+    assert "corro_sim_node_fault_wipes_total" not in text
+    assert (
+        'corro_resilience_runs_total{scenario="crash_amnesia:nodes=3"}'
+        in text
+    )
+    assert (
+        'corro_resilience_rows_lost_total'
+        '{scenario="crash_amnesia:nodes=3"} 0' in text
+    )
+    assert (
+        'corro_resilience_resync_rows_total'
+        '{scenario="crash_amnesia:nodes=3"} 153' in text
+    )
+    assert (
+        'corro_resilience_swim_false_down_total'
+        '{scenario="crash_amnesia:nodes=3"} 2' in text
+    )
+    assert (
+        'corro_resilience_swim_flaps_total'
+        '{scenario="crash_amnesia:nodes=3"} 1' in text
+    )
+    assert (
+        'corro_resilience_recovery_rounds_bucket'
+        '{scenario="crash_amnesia:nodes=3",le="+Inf"}' in text
+    )
+    _validate_exposition(text)
+
+
 def test_compile_cache_and_batched_subs_families_render_and_validate(
     cluster,
 ):

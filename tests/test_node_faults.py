@@ -1,0 +1,468 @@
+"""Node-lifecycle fault domain (corro_sim/faults/nodes.py) + resilience
+scorecard (faults/scorecard.py) — the ISSUE 11 tentpole guards.
+
+Evidence layers, mirroring the link-fault chaos engine's (ISSUE 3):
+
+- **non-perturbation** — node faults disabled contribute zero SimState
+  leaves and trace the byte-identical step program (registry-feature
+  contract, tests/test_cache_stability.py pattern); the vacuous trace
+  (machinery traced, zero scheduled effect) is bit-identical state and
+  metrics;
+- **self-healing semantics** — a 3-node crash-amnesia wipe under active
+  Zipf load re-converges to the reference replica bit-exactly
+  (rows_lost == 0) with recovery_rounds reported; the stale-rejoin
+  variant restores from its snapshot leaf and reports resync_rows > 0;
+  clock skew and stragglers stay convergent with every invariant green;
+- **program discipline** — the repair-specialized driver path produces
+  bit-identical results to the full-program path under node faults
+  (wipe masks derive from the round counter, no new key draws);
+- **combined load+faults** — the bookkeeping-conservation and
+  convergence-honesty invariants hold on a run where link loss, node
+  wipes AND a workload schedule overlap (the ISSUE 11 satellite: they
+  were previously only exercised with faults alone).
+
+Config literals are kept in lockstep with tools/prime_cache.py's
+node-fault matrix so the chunk programs come out of the warm cache in
+CI.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from corro_sim.config import FaultConfig, NodeFaultConfig, SimConfig
+from corro_sim.engine.driver import Schedule, run_sim
+from corro_sim.engine.state import init_state
+from corro_sim.faults import (
+    InvariantChecker,
+    ResilienceScorecard,
+    check_thresholds,
+    load_thresholds,
+    make_scenario,
+)
+
+N = 12
+BASE = SimConfig(
+    num_nodes=N, num_rows=16, num_cols=2, log_capacity=64,
+    write_rate=0.6, sync_interval=4,
+)
+# lockstep with tools/prime_cache.py `_prime_node_fault_matrix`
+CRASH = dataclasses.replace(
+    BASE, node_faults=NodeFaultConfig(crash=((1, 12), (4, 12), (7, 12)))
+).validate()
+STALE = dataclasses.replace(
+    BASE, node_faults=NodeFaultConfig(stale=((2, 4, 12),))
+).validate()
+SKEW = dataclasses.replace(
+    BASE, node_faults=NodeFaultConfig(skew=((0, 50), (9, -20)))
+).validate()
+STRAGGLE = dataclasses.replace(
+    BASE, node_faults=NodeFaultConfig(straggle=((3, 8, 2), (5, 8, 2)))
+).validate()
+
+
+def _down_schedule(nodes, lo, hi, rounds=64):
+    alive = np.ones((rounds, N), bool)
+    alive[lo:hi, list(nodes)] = False
+    return Schedule(write_rounds=8, alive=alive)
+
+
+# ---------------------------------------------------------------- vacuity
+
+def test_node_faults_off_traces_nothing():
+    """Disabled node faults: no node_fault_* metric series, no feature
+    leaves, and gate-neutral knob values (epoch_jump without any wipe
+    schedule) must not leak into the traced program — the falsifiable
+    form of 'off traces zero extra ops'."""
+    from corro_sim.analysis.jaxpr_audit import (
+        assert_same_program,
+        step_metric_names,
+    )
+    from corro_sim.engine.features import enabled_feature_names
+
+    assert SimConfig().node_faults.enabled is False
+    knobs = NodeFaultConfig(epoch_jump=7)
+    assert knobs.enabled is False
+    assert not any(
+        k.startswith("node_fault_") for k in step_metric_names(BASE)
+    )
+    assert "node_epoch" not in enabled_feature_names(BASE)
+    assert "node_snapshot" not in enabled_feature_names(BASE)
+    assert_same_program(
+        BASE, dataclasses.replace(BASE, node_faults=knobs),
+        label="node_faults_off_knobs",
+    )
+
+
+def test_node_fault_leaves_are_registry_features():
+    """The acceptance criterion's registry claim: enabling configs get
+    exactly their leaves; the scrub rule rides the registry."""
+    from corro_sim.engine.features import (
+        enabled_feature_names,
+        volatile_scrub_prefixes,
+    )
+
+    assert "node_epoch" in enabled_feature_names(CRASH)
+    assert "node_snapshot" not in enabled_feature_names(CRASH)
+    assert {"node_epoch", "node_snapshot"} <= set(
+        enabled_feature_names(STALE)
+    )
+    assert set(init_state(CRASH, seed=0).features) == {"node_epoch"}
+    assert set(init_state(STALE, seed=0).features) == {
+        "node_epoch", "node_snapshot",
+    }
+    # skew/straggle are pure config constants — no state at all
+    assert init_state(SKEW, seed=0).features == {}
+    assert init_state(STRAGGLE, seed=0).features == {}
+    pref = volatile_scrub_prefixes()
+    assert "features/node_epoch" in pref
+    assert "features/node_snapshot" in pref
+
+
+def test_vacuous_node_faults_do_not_perturb_simulation():
+    """THE vacuity oracle: the node-fault program traced with zero
+    scheduled effect (sentinel schedules, zero skew, always-active duty)
+    is bit-identical — state and metrics — to the fault-free run, and
+    the three node_fault_* series are additive-only and identically
+    zero."""
+    from corro_sim.analysis.jaxpr_audit import assert_feature_vacuous
+
+    cfgv = dataclasses.replace(
+        BASE, node_faults=NodeFaultConfig(trace_vacuous=True)
+    ).validate()
+    assert_feature_vacuous(
+        BASE, cfgv,
+        exclude_leaves=("features",),
+        extra_metrics={
+            "node_fault_wipes", "node_fault_straggling",
+            "node_fault_recovering",
+        },
+        zero_metrics=(
+            "node_fault_wipes", "node_fault_straggling",
+            "node_fault_recovering",
+        ),
+        rounds=16, write_rounds=4, seed=3,
+    )
+
+
+# ------------------------------------------------------------ semantics
+
+def _zipf_workload():
+    from corro_sim.workload import make_workload
+
+    return make_workload(
+        "zipf:alpha=1.1,rate=0.5,keys=16", N, rounds=8, seed=0
+    )
+
+
+def test_crash_amnesia_self_heals_under_load():
+    """The acceptance criterion verbatim: a 3-node amnesia wipe under
+    active Zipf load re-converges to the reference replica bit-exactly,
+    with recovery_rounds reported and rows_lost == 0 in the scorecard;
+    every invariant stays green."""
+    sched = _down_schedule((1, 4, 7), 6, 12)
+    inv = InvariantChecker(CRASH)
+    sc = make_scenario("crash_amnesia", N, rounds=64, write_rounds=8)
+    card = ResilienceScorecard(
+        CRASH, scenario=None, workload=_zipf_workload()
+    )
+    card.heal_round = 12  # schedule-local heal (wipe at the rejoin)
+    card._fault_window = (6, 12)
+    res = run_sim(
+        CRASH, init_state(CRASH, seed=0), sched, max_rounds=96, chunk=8,
+        seed=0, min_rounds=12, invariants=inv, scorecard=card,
+        workload=_zipf_workload(),
+    )
+    assert res.converged_round is not None and not res.poisoned
+    assert inv.ok, inv.report()
+    r = res.resilience
+    assert r["rows_lost"] == 0
+    assert r["recovery_rounds"] == res.converged_round - 12
+    assert r["recovery_rounds"] >= 0
+    assert r["wipes"] == 3
+    assert r["resync_rows"] > 0  # amnesia repaid the full history
+    # bit-exact agreement across every node on every table plane
+    for plane in ("cv", "vr", "site", "cl"):
+        arr = np.asarray(getattr(res.state.table, plane))
+        for i in range(1, N):
+            assert np.array_equal(arr[0], arr[i]), (plane, i)
+    # the epoch leaf recorded exactly one restart per victim
+    assert np.asarray(res.state.features["node_epoch"]).tolist() == [
+        0, 1, 0, 0, 1, 0, 0, 1, 0, 0, 0, 0,
+    ]
+    # the wiped nodes' writes resumed after recovery is allowed but the
+    # write gate must have held while their cursor was behind
+    assert int(res.metrics["node_fault_recovering"].sum()) > 0
+    assert sc.heal_round is not None  # the catalog entry carries a heal
+
+
+def test_stale_rejoin_restores_snapshot_and_reports_resync():
+    """Stale rejoin: the victim restarts FROM the captured snapshot
+    (not zero), sync repays only the delta, and the scorecard reports
+    resync_rows > 0 — the second half of the acceptance criterion."""
+    sched = _down_schedule((2,), 8, 12)
+    inv = InvariantChecker(STALE)
+    card = ResilienceScorecard(STALE)
+    card.heal_round = 12
+    res = run_sim(
+        STALE, init_state(STALE, seed=0), sched, max_rounds=96, chunk=8,
+        seed=0, min_rounds=12, invariants=inv, scorecard=card,
+    )
+    assert res.converged_round is not None and not res.poisoned
+    assert inv.ok, inv.report()
+    snap_head = np.asarray(res.state.features["node_snapshot"]["head"])
+    # the snapshot captured round-4 bookkeeping for the victim only
+    assert snap_head[2].sum() > 0
+    assert (np.delete(snap_head, 2, axis=0) == 0).all()
+    r = res.resilience
+    assert r["resync_rows"] > 0
+    assert r["rows_lost"] == 0
+    # delta accounting: repaid = final - snapshot baseline
+    final = int(np.asarray(res.state.book.head)[2].sum())
+    assert r["resync_rows"] == final - int(snap_head[2].sum())
+
+
+def test_clock_skew_converges_and_moves_clocks():
+    """Per-node HLC offsets perturb timestamp generation (clock_skew
+    metric reflects the spread) without breaking convergence or
+    invariants — LWW stays a total order."""
+    inv = InvariantChecker(SKEW)
+    res = run_sim(
+        SKEW, init_state(SKEW, seed=0), Schedule(write_rounds=8),
+        max_rounds=96, chunk=8, seed=0, invariants=inv,
+    )
+    assert res.converged_round is not None
+    assert inv.ok, inv.report()
+    assert float(np.asarray(res.metrics["clock_skew"]).max()) >= 50.0
+
+
+def test_stragglers_delay_but_converge():
+    """Duty-cycled stragglers stretch the tail, never wedge it: the
+    parked node-rounds are counted, the cluster still converges, and the
+    stragglers' own writes survive (they serve sync passively)."""
+    inv = InvariantChecker(STRAGGLE)
+    res = run_sim(
+        STRAGGLE, init_state(STRAGGLE, seed=0), Schedule(write_rounds=8),
+        max_rounds=256, chunk=8, seed=0, invariants=inv,
+    )
+    assert res.converged_round is not None
+    assert inv.ok, inv.report()
+    assert int(res.metrics["node_fault_straggling"].sum()) > 0
+    # stragglers' histories fully disseminated
+    head = np.asarray(res.state.book.head)
+    log = np.asarray(res.state.log.head)
+    assert (head == log[None, :]).all()
+
+
+def test_repair_program_equivalence_under_node_faults():
+    """The driver's post-quiesce program switch must stay bit-for-bit
+    under node faults — wipe masks and duty cycles derive from the same
+    round/sweep counters in both programs."""
+    sched = _down_schedule((1, 4, 7), 6, 12)
+    kw = dict(max_rounds=96, chunk=8, seed=0, min_rounds=12,
+              stop_on_convergence=False)
+    a = run_sim(CRASH, init_state(CRASH, seed=0), sched,
+                phase_specialize=True, **kw)
+    b = run_sim(CRASH, init_state(CRASH, seed=0), sched,
+                phase_specialize=False, **kw)
+    assert a.repair_chunks > 0  # the switch actually exercised
+    for la, lb in zip(jax.tree.leaves(a.state), jax.tree.leaves(b.state)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+    for k in a.metrics:
+        assert np.array_equal(a.metrics[k], b.metrics[k]), k
+
+
+# ------------------------------------------- combined workload + faults
+
+def test_invariants_hold_under_combined_workload_and_faults():
+    """ISSUE 11 satellite: bookkeeping conservation and convergence
+    honesty exercised on a run where link loss, node wipes AND a
+    workload schedule all overlap — previously only tested with faults
+    alone."""
+    cfg = dataclasses.replace(
+        CRASH, faults=FaultConfig(loss=0.2)
+    ).validate()
+    sched = _down_schedule((1, 4, 7), 6, 12)
+    inv = InvariantChecker(cfg)
+    res = run_sim(
+        cfg, init_state(cfg, seed=0), sched, max_rounds=192, chunk=8,
+        seed=0, min_rounds=12, invariants=inv,
+        workload=_zipf_workload(),
+    )
+    assert res.converged_round is not None and not res.poisoned
+    # conservation was actually CHECKED (fault metrics present), and
+    # every checker — including convergence honesty at the report —
+    # came back green
+    assert "fault_delivered" in res.metrics
+    assert inv.chunks_checked > 0
+    assert inv.ok, inv.report()
+    # and the identity holds on the recorded series too
+    m = res.metrics
+    lhs = m["msgs_sent"].astype(np.int64) + m["fault_matured"]
+    rhs = (
+        m["fault_parked"].astype(np.int64) + m["fault_emit_lost"]
+        + m["fault_delivered"] + m["fault_unreachable"]
+        + m["fault_blackholed"] + m["fault_lost"]
+    )
+    assert (lhs == rhs).all()
+
+
+def test_head_monotonicity_exemption_is_wipe_scoped():
+    """Only the scheduled (node, round) wipes are exempt from the
+    head-monotonicity invariant — an unscheduled decrease still
+    violates."""
+    inv = InvariantChecker(CRASH)
+    state = init_state(CRASH, seed=0)
+    alive = np.ones((8, N), bool)
+    part = np.zeros((8, N), np.int32)
+    head = np.zeros((N, N), np.int32)
+
+    class S:  # minimal state stub for the checker
+        class book:
+            pass
+        swim = None
+    S.book.head = head
+    inv.on_chunk(S, {}, alive, part, 0)
+    # wiped node decreasing inside its wipe chunk: exempt
+    S2 = type("S2", (), {"book": type("B", (), {"head": head.copy()})})
+    S2.book.head = head.copy()
+    S2.book.head[1, :] -= 1
+    assert not inv.on_chunk(S2, {}, alive, part, 8)  # wipe round 12 ∈ [8, 16)
+    # a different node decreasing: still a violation
+    S3 = type("S3", (), {"book": type("B", (), {"head": head.copy()})})
+    S3.book.head = S2.book.head.copy()
+    S3.book.head[0, :] -= 1
+    v = inv.on_chunk(S3, {}, alive, part, 16)
+    assert v and v[0].invariant == "head_monotonicity"
+
+
+# ------------------------------------------------- scorecard + coupling
+
+def test_scorecard_thresholds_gate():
+    thresholds = load_thresholds()
+    assert thresholds is not None
+    good = {
+        "scenario": "crash_amnesia:nodes=3",
+        "converged_round": 20, "recovery_rounds": 8,
+        "rows_lost": 0, "resync_rows": 40,
+        "swim_false_down": 0,
+    }
+    assert check_thresholds(good, thresholds) == []
+    bad = dict(good, rows_lost=3, recovery_rounds=500)
+    breaches = check_thresholds(bad, thresholds)
+    assert len(breaches) == 2
+    assert any("rows_lost" in b for b in breaches)
+    assert any("recovery_rounds" in b for b in breaches)
+    unconverged = dict(good, converged_round=None, recovery_rounds=None)
+    assert any(
+        "converge" in b for b in check_thresholds(unconverged, thresholds)
+    )
+    stale_block = {
+        "scenario": "stale_rejoin", "converged_round": 20,
+        "recovery_rounds": 4, "rows_lost": 0, "resync_rows": 0,
+    }
+    assert any(
+        "resync_rows" in b
+        for b in check_thresholds(stale_block, thresholds)
+    )
+
+
+def test_coupled_spec_overlap_validation():
+    """The unified-spec satellite: ONE clear error when the scenario's
+    fault window and the workload's write range never overlap."""
+    from corro_sim.workload import make_workload
+
+    sc = make_scenario("crash_amnesia:at=20,down=6", N, rounds=64,
+                       write_rounds=32)
+    early = make_workload("zipf:rate=0.5,keys=16", N, rounds=8, seed=0)
+    with pytest.raises(ValueError, match="never.*overlap"):
+        sc.check_workload(early)
+    late = make_workload("zipf:rate=0.5,keys=16", N, rounds=32, seed=0)
+    sc.check_workload(late)  # overlapping: no raise
+
+
+def test_node_fault_scenarios_compile_and_carry_overrides():
+    """The catalog entries compile deterministically and carry their
+    node-fault overrides through Scenario.apply."""
+    for spec, field in (
+        ("crash_amnesia:nodes=2,at=4,down=3", "crash"),
+        ("stale_rejoin:nodes=1,snap=2,at=5,down=3", "stale"),
+        ("clock_skew:nodes=3,max_skew=32", "skew"),
+        ("stragglers:frac=0.2,period=6,active=2", "straggle"),
+    ):
+        sc = make_scenario(spec, N, rounds=32, write_rounds=8, seed=1)
+        sc2 = make_scenario(spec, N, rounds=32, write_rounds=8, seed=1)
+        assert sc.node_faults == sc2.node_faults  # seeded-deterministic
+        cfg = sc.apply(BASE)
+        assert getattr(cfg.node_faults, field)
+        assert cfg.node_faults.enabled
+        assert sc.heal_round is not None
+        assert sc.fault_window() is not None
+
+
+def test_config_validation_bounds():
+    with pytest.raises(AssertionError):
+        SimConfig(
+            num_nodes=4,
+            node_faults=NodeFaultConfig(crash=((9, 4),)),
+        ).validate()
+    with pytest.raises(AssertionError):
+        SimConfig(
+            num_nodes=4,
+            node_faults=NodeFaultConfig(stale=((1, 8, 4),)),  # snap>=restore
+        ).validate()
+    with pytest.raises(AssertionError):
+        SimConfig(
+            num_nodes=4,
+            node_faults=NodeFaultConfig(straggle=((1, 4, 0),)),  # no duty
+        ).validate()
+
+
+def test_checkpoint_roundtrip_with_node_faults(tmp_path):
+    """A node-fault-enabled cluster checkpoints and resumes: the
+    NodeFaultConfig schedule tuples rebuild from the JSON meta (the
+    FaultConfig.blackhole precedent) and the feature leaves scrub as
+    volatile (registry-declared)."""
+    from corro_sim.harness.cluster import LiveCluster
+    from corro_sim.io.checkpoint import load_checkpoint, save_checkpoint
+
+    c = LiveCluster(
+        "CREATE TABLE kv (k TEXT PRIMARY KEY, v TEXT);", num_nodes=4,
+        cfg_overrides={
+            "node_faults": NodeFaultConfig(
+                crash=((1, 64),), epoch_jump=2,
+            ),
+        },
+    )
+    c.execute(["INSERT INTO kv (k, v) VALUES ('a', '1')"], node=0)
+    c.tick(4)
+    p = str(tmp_path / "nf.ckpt")
+    save_checkpoint(c, p)
+    c2 = load_checkpoint(p)
+    assert c2.cfg.node_faults.crash == ((1, 64),)
+    assert c2.cfg.node_faults.epoch_jump == 2
+    assert c2.cfg.node_faults.enabled
+    c2.tick(2)  # node-fault-enabled step reloads and runs
+
+
+def test_node_faults_config_file_roundtrip(tmp_path):
+    """[sim.node_faults] TOML + CORRO_SIM__NODE_FAULTS__* env overrides
+    build the schedule tuples."""
+    from corro_sim.io.config_file import load_config
+
+    p = tmp_path / "c.toml"
+    p.write_text(
+        "[sim]\nnum_nodes = 8\n\n[sim.node_faults]\n"
+        "crash = [[1, 12], [2, 12]]\nepoch_jump = 3\n"
+    )
+    cfg = load_config(str(p))
+    assert cfg.node_faults.crash == ((1, 12), (2, 12))
+    assert cfg.node_faults.epoch_jump == 3
+    cfg = load_config(str(p), env={
+        "CORRO_SIM__NODE_FAULTS__STRAGGLE": "3:8:2",
+        "CORRO_SIM__NODE_FAULTS__SKEW": "0:50,4:-9",
+    })
+    assert cfg.node_faults.straggle == ((3, 8, 2),)
+    assert cfg.node_faults.skew == ((0, 50), (4, -9))

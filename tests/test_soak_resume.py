@@ -154,6 +154,63 @@ def test_checkpoint_is_atomic(tmp_path):
     assert ck.metrics["gap"].shape[0] == ck.rounds
 
 
+def test_resume_mid_node_fault_window_bit_identical(tmp_path):
+    """ISSUE 11 acceptance: a soak killed MID-FAULT-WINDOW — after the
+    crash-amnesia victims went down but before their wipe-and-rejoin
+    executed — resumes bit-identically: the wipe masks derive from the
+    absolute round counter and the node_epoch/node_snapshot feature
+    leaves ride the checkpoint like every other carry leaf."""
+    import dataclasses
+
+    from corro_sim.config import NodeFaultConfig
+
+    # lockstep with tools/prime_cache.py "resume-nf": the soak-resume
+    # config + a 3-node amnesia wipe at round 12 (rejoin of a 6..12 down
+    # window) + a stale victim snapshotted at 4
+    cfg = dataclasses.replace(
+        CFG, node_faults=NodeFaultConfig(
+            crash=((1, 12), (4, 12)), stale=((7, 4, 12),),
+        ),
+    ).validate()
+    alive = np.ones((64, CFG.num_nodes), bool)
+    alive[6:12, [1, 4, 7]] = False
+    sched = Schedule(write_rounds=8, alive=alive)
+
+    def run(resume=None, ckpt=None, every=0, kill_after=None):
+        def bomb(info):
+            if kill_after is not None and info["chunk"] >= kill_after:
+                raise _Kill
+
+        return run_sim(
+            cfg, init_state(cfg, seed=0), sched, max_rounds=64, chunk=8,
+            seed=0, min_rounds=12, resume=resume, checkpoint_path=ckpt,
+            checkpoint_every=every,
+            on_chunk=bomb if kill_after is not None else None,
+        )
+
+    ref = run()
+    ckpt = str(tmp_path / "nf.ckpt.npz")
+    with pytest.raises(_Kill):
+        # killed with chunk 0's token on disk (rounds 0..8): victims are
+        # DOWN, the round-12 wipe has NOT executed yet — resume replays it
+        run(ckpt=ckpt, every=1, kill_after=1)
+    ck = load_sim_checkpoint(ckpt)
+    assert ck.rounds == 8  # mid-window: before the wipe round
+    # the feature leaves are in the token (epoch still zero, snapshot
+    # already captured at round 4)
+    assert "features/node_epoch" in ck.state_flat
+    assert any(
+        k.startswith("features/node_snapshot/") for k in ck.state_flat
+    )
+    assert int(ck.state_flat["features/node_epoch"].sum()) == 0
+    res = run(resume=ck)
+    _assert_bit_identical(ref, res)
+    # the replayed tail executed the wipes: one restart per victim
+    assert np.asarray(
+        res.state.features["node_epoch"]
+    ).sum() == 3
+
+
 @pytest.mark.slow  # three subprocess jax launches; the t1.yml chaos
 # step runs the same resume flow as a CI smoke
 def test_soak_cli_sigkill_resume(tmp_path):

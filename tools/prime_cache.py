@@ -24,8 +24,8 @@ The matrix covers the programs that dominate suite compile wall: the
 canonical audit config and the 32-node CI smoke config, each as
 full + repair chunk programs, wide and narrow state, packed the way
 ``run_sim`` dispatches them (``_chunk_runner(packed=True)`` over an
-8-round scan), plus the workload, sharded-mesh and soak-resume test
-programs. Compilation is aval-only (``jit(...).lower().compile()`` —
+8-round scan), plus the workload, sharded-mesh, soak-resume and
+node-fault (ISSUE 11) test programs. Compilation is aval-only (``jit(...).lower().compile()`` —
 nothing executes, no state is materialized beyond eval_shape).
 
 Usage::
@@ -193,6 +193,13 @@ def prime_matrix(chunk: int = 8) -> ProgramRecorder:
             runner, state, *avals,
         )
 
+    # ISSUE 11: the node-lifecycle fault chunk programs
+    # tests/test_node_faults.py + tests/test_soak_resume.py dispatch
+    # inside pytest — keep the config literals in lockstep with those
+    # files. Every schedule tuple is baked into the program as a
+    # constant, so each distinct schedule is its own compile.
+    _prime_node_fault_matrix(jax, jnp, chunk, rec)
+
     # ISSUE 8: the SHARDED chunk programs, AOT-compiled against the
     # 8-device host mesh (aval-only — ShapeDtypeStructs carry the
     # NamedShardings, nothing allocates). Covers the CI multichip smoke
@@ -201,6 +208,74 @@ def prime_matrix(chunk: int = 8) -> ProgramRecorder:
     # config literals below in lockstep with that file.
     _prime_sharded_matrix(jax, jnp, smoke, chunk, rec)
     return rec
+
+
+def _prime_node_fault_matrix(jax, jnp, chunk: int, rec: ProgramRecorder):
+    import dataclasses
+
+    from corro_sim.config import FaultConfig, NodeFaultConfig, SimConfig
+    from corro_sim.engine.driver import _chunk_runner
+    from corro_sim.engine.state import init_state
+
+    base = SimConfig(
+        num_nodes=12, num_rows=16, num_cols=2, log_capacity=64,
+        write_rate=0.6, sync_interval=4,
+    )
+    variants = {
+        "nf-crash": NodeFaultConfig(crash=((1, 12), (4, 12), (7, 12))),
+        "nf-stale": NodeFaultConfig(stale=((2, 4, 12),)),
+        "nf-skew": NodeFaultConfig(skew=((0, 50), (9, -20))),
+        "nf-straggle": NodeFaultConfig(
+            straggle=((3, 8, 2), (5, 8, 2))
+        ),
+    }
+
+    def prime(name, cfg, repair=False, workload=False):
+        cfg = cfg.validate()
+        n = cfg.num_nodes
+        state = jax.eval_shape(lambda cfg=cfg: init_state(cfg, seed=0))
+        avals = (
+            jax.ShapeDtypeStruct((chunk, 2), jnp.uint32),
+            jax.ShapeDtypeStruct((chunk, n), jnp.bool_),
+            jax.ShapeDtypeStruct((chunk, n), jnp.int32),
+            jax.ShapeDtypeStruct((chunk,), jnp.bool_),
+        )
+        wl = (
+            _workload_avals(jax, jnp, chunk, n, cfg.seqs_per_version)
+            if workload else ()
+        )
+        runner = _chunk_runner(cfg, repair=repair, packed=True,
+                               workload=workload)
+        rec.compile(name, runner, state, *avals, *wl)
+
+    for name, nf in variants.items():
+        cfg = dataclasses.replace(base, node_faults=nf)
+        prime(f"{name}/wide/full", cfg)
+        prime(f"{name}/wide/repair", cfg, repair=True)
+    # the crash-under-Zipf-load acceptance run + the combined
+    # loss+wipes+workload invariants run (test_node_faults.py)
+    crash = dataclasses.replace(
+        base, node_faults=variants["nf-crash"]
+    )
+    prime("nf-crash/wide/workload", crash, workload=True)
+    prime("nf-crash/wide/workload-repair", crash, repair=True,
+          workload=True)
+    crash_lossy = dataclasses.replace(
+        crash, faults=FaultConfig(loss=0.2)
+    )
+    prime("nf-crash-lossy/wide/workload", crash_lossy, workload=True)
+    prime("nf-crash-lossy/wide/workload-repair", crash_lossy,
+          repair=True, workload=True)
+    # tests/test_soak_resume.py mid-fault-window token (the soak-resume
+    # lossy shape + crash/stale wipes at round 12)
+    resume_nf = dataclasses.replace(
+        base, faults=FaultConfig(loss=0.2),
+        node_faults=NodeFaultConfig(
+            crash=((1, 12), (4, 12)), stale=((7, 4, 12),),
+        ),
+    )
+    prime("resume-nf/wide/full", resume_nf)
+    prime("resume-nf/wide/repair", resume_nf, repair=True)
 
 
 def _prime_sharded_matrix(jax, jnp, smoke, chunk: int, rec: ProgramRecorder):
