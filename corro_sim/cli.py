@@ -104,11 +104,43 @@ def _cmd_run(args: argparse.Namespace) -> int:
         from corro_sim.faults import make_scenario
 
         scenario = make_scenario(
-            args.scenario, cfg.num_nodes, rounds=args.max_rounds,
+            args.scenario, cfg.num_nodes,
+            # --scenario-rounds pins the fault-timeline horizon a sweep
+            # lane was compiled with (corro_sim/sweep/ repro commands):
+            # generators truncate/derive waves against `rounds`, so a
+            # different horizon is a different timeline
+            rounds=getattr(args, "scenario_rounds", None)
+            or args.max_rounds,
             write_rounds=args.write_rounds, seed=args.seed,
         )
         cfg = scenario.apply(cfg)
         schedule = scenario.schedule()
+    if getattr(args, "knob", None):
+        # `--knob loss=0.2` link-fault threshold overrides — the sweep
+        # frontier's worst-seed repro surface (corro_sim/sweep/plan.py
+        # repro_cmd): a knob-axis grid cell reproduces as one serial
+        # run with the same override applied on top of the scenario
+        from corro_sim.sweep import SWEEP_KNOB_FIELDS
+
+        overrides = {}
+        for kv in args.knob:
+            field, _, value = kv.partition("=")
+            try:
+                num = float(value)
+            except ValueError:
+                num = None
+            if field not in SWEEP_KNOB_FIELDS or num is None:
+                print(
+                    f"error: --knob {kv!r} (expected field=value with "
+                    f"field one of {', '.join(SWEEP_KNOB_FIELDS)} and "
+                    "a numeric value)",
+                    file=sys.stderr,
+                )
+                return 2
+            overrides[field] = num
+        cfg = dataclasses.replace(
+            cfg, faults=dataclasses.replace(cfg.faults, **overrides)
+        ).validate()
     workload = None
     if getattr(args, "workload", None):
         # the unified spec surface: --scenario X --workload Y in ONE run
@@ -283,11 +315,20 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     invariant verdicts. Exit codes: 0 all green; 5 an invariant broke;
     3 a scenario failed to re-converge within the round budget.
 
-    Multi-hour soaks survive device loss (ISSUE 10): with an artifact
-    prefix (``--out``) or an explicit ``--checkpoint``, a resumable
+    Since ISSUE 12 the scenarios race as lanes of ONE vmapped dispatch
+    (corro_sim/sweep/ — bit-identical per-scenario numbers, one compile
+    instead of one per scenario; doc/sweeping.md). ``--serial`` keeps
+    the sequential loop below; ``--resume`` and an explicit
+    ``--checkpoint`` imply it (resume tokens are a sequential-loop
+    concept).
+
+    Multi-hour soaks survive device loss (ISSUE 10) in SERIAL mode:
+    with an artifact prefix (``--out``) or an explicit ``--checkpoint``
+    / ``--checkpoint-every`` (either implies ``--serial``), a resumable
     checkpoint is written every ``--checkpoint-every`` chunks and a run
     that dies leaves ``<prefix>.partial.json`` (last completed chunk +
-    the resume token) instead of rc=1 with no state. ``soak --resume
+    the resume token) instead of rc=1 with no state. The default swept
+    path finishes in one dispatch and writes no token. ``soak --resume
     <ckpt>`` reconstructs the sweep from the token — same config, seed
     and chunking — and continues the killed scenario BIT-IDENTICALLY
     (state, metrics and flight timeline match the uninterrupted run;
@@ -386,7 +427,39 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     ckpt_path = sweep.get("checkpoint") or (
         f"{out}.ckpt.npz" if out else None
     )
-    ckpt_every = int(sweep.get("checkpoint_every") or 0)
+    # None = the flag was not given (argparse default): serial mode
+    # still checkpoints every 4 chunks when a path resolves
+    _ck = sweep.get("checkpoint_every")
+    ckpt_every = 4 if _ck is None else int(_ck)
+
+    # ------------------------------------------------- sweep-engine path
+    # The sequential scenario loop below is the ESCAPE HATCH (ISSUE 12):
+    # by default the whole sweep races as lanes of ONE vmapped dispatch
+    # (corro_sim/sweep/), with identical per-scenario report fields and
+    # exit codes. Serial mode remains for --resume (checkpoint tokens
+    # are a serial-loop concept), --serial, or an EXPLICIT checkpoint
+    # request (--checkpoint or a hand-set --checkpoint-every) — a user
+    # who asked for resumability must get the loop that provides it,
+    # not a silent fast path that drops it.
+    if not (
+        args.resume or getattr(args, "serial", False)
+        or sweep.get("checkpoint")
+        # an explicit NONZERO cadence asks for checkpoints; an explicit
+        # 0 asks for none — which is what the swept path provides
+        or sweep.get("checkpoint_every")
+    ):
+        if out:
+            print(
+                "# swept soak: scenarios race as one vmapped dispatch; "
+                "no resume checkpoint and no per-scenario flight "
+                "journals are written (pass --serial for the "
+                "checkpointed, journaling loop)",
+                file=sys.stderr,
+            )
+        return _soak_swept(
+            base, specs, sweep, getattr(args, "workload", None),
+            getattr(args, "scorecard", None),
+        )
 
     workload = None
     if getattr(args, "workload", None):
@@ -626,6 +699,297 @@ def _cmd_soak(args: argparse.Namespace) -> int:
     if any_violation:
         return 5
     if any_unconverged:
+        return 3
+    return 6 if breaches else 0
+
+
+def _soak_swept(base, specs, sweep, workload_spec, scorecard_path) -> int:
+    """The soak sweep as lanes of ONE vmapped dispatch (ISSUE 12): the
+    per-scenario report fields, threshold gating and exit codes of the
+    sequential loop, produced from the fleet-of-clusters engine. Every
+    lane is bit-identical to the serial run it replaces
+    (tests/test_sweep.py), so the report numbers are THE soak numbers."""
+    import numpy as np
+
+    from corro_sim.faults import check_thresholds, load_thresholds
+    from corro_sim.sweep import build_plan, run_sweep
+
+    try:
+        plan = build_plan(
+            base, specs, [sweep["seed"]], rounds=sweep["rounds"],
+            write_rounds=sweep["write_rounds"],
+            workload_spec=workload_spec,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    thresholds = load_thresholds()  # raises on a corrupt golden
+    if thresholds is None and scorecard_path:
+        print(
+            "warning: no resilience threshold golden committed — the "
+            "scorecard artifact is written but nothing gates it "
+            "(analysis/golden/resilience_thresholds.json)",
+            file=sys.stderr,
+        )
+    res = run_sweep(
+        plan, max_rounds=sweep["max_rounds"], chunk=sweep["chunk"],
+        on_chunk=lambda p: print(
+            f"# sweep chunk {p['chunk']}: rounds {p['rounds_done']}, "
+            f"{p['lanes_active']}/{plan.num_lanes} lanes racing",
+            file=sys.stderr, flush=True,
+        ),
+    )
+    runs: list = []
+    breaches: list = []
+    any_violation = False
+    any_unconverged = False
+    for lr, lane in zip(res.lanes, plan.lanes):
+        # fault totals restricted to the families the lane's SERIAL
+        # config emits — the union program accounts link flow for every
+        # lane, but the report must match the serial soak's
+        fault_totals = (
+            {
+                k: int(np.asarray(lr.metrics[k]).sum())
+                for k in sorted(lr.metrics)
+                if k.startswith("fault_") and k != "fault_burst_nodes"
+            }
+            if lane.cfg.faults.enabled else {}
+        )
+        inv = lr.invariants or {"ok": True, "violations": []}
+        run = {
+            "scenario": lr.spec,
+            "converged_round": lr.converged_round,
+            "rounds_run": lr.rounds,
+            "heal_round": lr.heal_round,
+            "recovery_rounds": lr.recovery_rounds,
+            "poisoned": lr.poisoned,
+            "fault_totals": fault_totals,
+            "invariants": inv,
+            "repro_cmd": lr.repro_cmd,
+            # the serial loop's per-run fields, kept present so report
+            # consumers never key-error on the (default) swept path:
+            # compile cost lives on the ONE shared program (the
+            # report-level "sweep" block) and flight journals are a
+            # serial-mode feature
+            "compile_cache": None,
+            "flight": None,
+        }
+        if workload_spec is not None and lane.workload is not None:
+            run["workload"] = lane.workload.spec
+        if scorecard_path or lane.cfg.node_faults.enabled:
+            run["resilience"] = lr.resilience
+            if thresholds is not None and lr.resilience is not None:
+                run_breaches = check_thresholds(lr.resilience, thresholds)
+                run["threshold_breaches"] = run_breaches
+                breaches.extend(run_breaches)
+        runs.append(run)
+        any_violation |= not inv.get("ok", True)
+        any_unconverged |= lr.converged_round is None
+        print(
+            f"# {lr.spec}: converged={lr.converged_round} "
+            f"recovery={lr.recovery_rounds} invariants="
+            f"{'ok' if inv.get('ok', True) else 'VIOLATED'}",
+            file=sys.stderr, flush=True,
+        )
+    report = {
+        "nodes": base.num_nodes,
+        "rounds": sweep["rounds"],
+        "seed": sweep["seed"],
+        "scenarios": runs,
+        "ok": not (any_violation or any_unconverged or breaches),
+        "sweep": {
+            "lanes": plan.num_lanes,
+            "dispatches": res.dispatches,
+            "wall_seconds": round(res.wall_seconds, 3),
+            "compile_seconds": round(res.compile_seconds, 3),
+            "clusters_per_second_per_device": (
+                round(res.clusters_per_second_per_device, 3)
+                if res.clusters_per_second_per_device is not None
+                else None
+            ),
+            "compile_cache": res.compile_cache,
+        },
+    }
+    if workload_spec is not None:
+        report["workload"] = workload_spec
+    if breaches:
+        report["threshold_breaches"] = breaches
+    if scorecard_path:
+        # keep this artifact's shape in lockstep with the serial loop's
+        # scorecard_doc in _cmd_soak — CI asserts on either path
+        scorecard_doc = {
+            "nodes": base.num_nodes,
+            "seed": sweep["seed"],
+            "workload": workload_spec,
+            "scenarios": [
+                {
+                    "scenario": r["scenario"],
+                    "resilience": r.get("resilience"),
+                    "threshold_breaches": r.get("threshold_breaches", []),
+                }
+                for r in runs
+            ],
+            "thresholds_ok": not breaches,
+            "breaches": breaches,
+        }
+        with open(scorecard_path, "w", encoding="utf-8") as f:
+            json.dump(scorecard_doc, f, indent=2)
+            f.write("\n")
+        report["scorecard"] = scorecard_path
+    out = sweep.get("out")
+    if out:
+        with open(f"{out}.report.json", "w") as f:
+            json.dump(report, f, indent=2)
+        report["report"] = f"{out}.report.json"
+    print(json.dumps(report, indent=2))
+    if any_violation:
+        return 5
+    if any_unconverged:
+        return 3
+    return 6 if breaches else 0
+
+
+def _cmd_sweep(args: argparse.Namespace) -> int:
+    """`corro-sim sweep` — race a (scenario × seed × knob) chaos matrix
+    as lanes of ONE vmapped dispatch (corro_sim/sweep/, ISSUE 12).
+
+    Grid axes are positional ``KEY=VALUES`` tokens::
+
+        corro-sim sweep scenario=crash_amnesia,lossy seed=0..31 \\
+            knob.loss=0.05,0.2 --nodes 64
+
+    The report carries every lane's convergence/recovery numbers plus
+    the **resilience frontier**: per-cell worst/p95 recovery across
+    seeds with the arg-max worst seed named and the one serial
+    ``corro-sim run`` command that reproduces it. Threshold gating is
+    quantile-over-seeds against the committed golden — breaches exit 6
+    (the soak tripwire, unchanged through the sweep path); exit 5 on
+    an invariant violation, 3 when a lane fails to settle.
+    """
+    import dataclasses
+
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    from corro_sim.faults import load_thresholds
+    from corro_sim.io.config_file import load_config
+    from corro_sim.sweep import (
+        build_frontier,
+        build_plan,
+        check_frontier,
+        parse_grid,
+        run_sweep,
+    )
+
+    base = load_config(args.config)
+    overrides = {
+        field: getattr(args, flag)
+        for flag, field in _FLAG_TO_FIELD.items()
+        if getattr(args, flag, None) is not None
+    }
+    base = dataclasses.replace(base, **overrides).validate()
+    try:
+        grid = parse_grid(args.grid)
+        if not grid["scenario"]:
+            raise ValueError("the grid needs a scenario=... axis")
+        plan = build_plan(
+            base, grid["scenario"], grid["seed"], grid["knobs"],
+            rounds=args.rounds, write_rounds=args.write_rounds,
+            workload_spec=args.workload,
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    mesh = None
+    if args.mesh:
+        from corro_sim.engine.sharding import make_sweep_mesh
+
+        mesh = make_sweep_mesh(plan.num_lanes)
+        print(
+            f"# mesh: {plan.num_lanes} lanes over "
+            f"{dict(mesh.shape)}", file=sys.stderr,
+        )
+    print(
+        f"# sweeping {plan.num_lanes} lanes "
+        f"({len(grid['scenario'])} scenarios x {len(grid['seed'])} seeds"
+        + (f" x {len(grid['knobs'])} knob combos" if grid["knobs"] != [{}]
+           else "")
+        + ") in one dispatch",
+        file=sys.stderr, flush=True,
+    )
+    res = run_sweep(
+        plan, max_rounds=args.max_rounds, chunk=args.chunk, mesh=mesh,
+        on_chunk=lambda p: print(
+            f"# chunk {p['chunk']}: rounds {p['rounds_done']}, "
+            f"{p['lanes_active']} lanes racing, "
+            f"{p['lanes_settled']} settled "
+            f"({p['chunk_wall_s']}s)",
+            file=sys.stderr, flush=True,
+        ),
+    )
+    frontier = build_frontier(res.lanes)
+    thresholds = load_thresholds()
+    breaches = (
+        check_frontier(frontier, thresholds)
+        if thresholds is not None else []
+    )
+    frontier["thresholds_ok"] = not breaches
+    frontier["breaches"] = breaches
+    from corro_sim.faults.invariants import merge_reports
+
+    inv_summary = merge_reports([lr.invariants for lr in res.lanes])
+    any_violation = not inv_summary["ok"]
+    any_unsettled = any(
+        lr.converged_round is None or lr.poisoned for lr in res.lanes
+    )
+    report = {
+        "nodes": base.num_nodes,
+        "lanes": plan.num_lanes,
+        "rounds": args.rounds,
+        "dispatches": res.dispatches,
+        "wall_seconds": round(res.wall_seconds, 3),
+        "compile_seconds": round(res.compile_seconds, 3),
+        "clusters_per_second_per_device": (
+            round(res.clusters_per_second_per_device, 3)
+            if res.clusters_per_second_per_device is not None else None
+        ),
+        "devices": res.devices,
+        "compile_cache": res.compile_cache,
+        "lanes_detail": [
+            {
+                "scenario": lr.spec,
+                "seed": lr.seed,
+                "cell": lr.cell,
+                "converged_round": lr.converged_round,
+                "rounds_run": lr.rounds,
+                "recovery_rounds": lr.recovery_rounds,
+                "poisoned": lr.poisoned,
+                "rows_lost": (lr.resilience or {}).get("rows_lost"),
+                "invariants_ok": (lr.invariants or {}).get("ok", True),
+                "repro_cmd": lr.repro_cmd,
+            }
+            for lr in res.lanes
+        ],
+        "frontier": frontier,
+        "invariants": inv_summary,
+        "ok": not (any_violation or any_unsettled or breaches),
+    }
+    if args.workload:
+        report["workload"] = args.workload
+    if args.frontier:
+        with open(args.frontier, "w", encoding="utf-8") as f:
+            json.dump(frontier, f, indent=2)
+            f.write("\n")
+        report["frontier_artifact"] = args.frontier
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps(report, indent=2))
+    if any_violation:
+        return 5
+    if any_unsettled:
         return 3
     return 6 if breaches else 0
 
@@ -1075,6 +1439,19 @@ def build_parser() -> argparse.ArgumentParser:
              "front",
     )
     pr.add_argument(
+        "--knob", action="append", metavar="FIELD=VALUE",
+        help="link-fault threshold override on top of the scenario "
+             "(loss/dup/burst_*/sync_loss); repeatable — the sweep "
+             "frontier's worst-seed repro surface (doc/sweeping.md)",
+    )
+    pr.add_argument(
+        "--scenario-rounds", type=int,
+        help="fault-timeline horizon the scenario compiles against "
+             "(default: --max-rounds). Sweep worst-seed repro commands "
+             "pin this to the lane's horizon — wave-shaped generators "
+             "derive different timelines from different horizons",
+    )
+    pr.add_argument(
         "--scorecard", action="store_true",
         help="arm the resilience scorecard (faults/scorecard.py): the "
              "report gains a `resilience` block (recovery_rounds, "
@@ -1223,18 +1600,81 @@ def build_parser() -> argparse.ArgumentParser:
              "--out is set; io/checkpoint.py sim checkpoints)",
     )
     ps.add_argument(
-        "--checkpoint-every", type=int, default=4,
-        help="chunks between resumable checkpoints (0 disables; only "
-             "active when a checkpoint path resolves)",
+        "--checkpoint-every", type=int, default=None,
+        help="chunks between resumable checkpoints (default 4 in the "
+             "serial loop; only active when a checkpoint path "
+             "resolves). An explicit nonzero cadence implies --serial "
+             "— the vmapped sweep path writes no resume tokens; 0 "
+             "keeps the swept path (no checkpoints either way)",
     )
     ps.add_argument(
         "--resume",
         help="continue a killed soak from its checkpoint file — the "
              "token reconstructs the sweep (config, seed, chunking, "
              "remaining scenarios) and the killed scenario continues "
-             "bit-identically; other flags are ignored",
+             "bit-identically; other flags are ignored (implies "
+             "--serial: resume tokens are a sequential-loop concept)",
+    )
+    ps.add_argument(
+        "--serial", action="store_true",
+        help="run the sequential one-run_sim-per-scenario loop instead "
+             "of the default vmapped sweep dispatch (corro_sim/sweep/) "
+             "— the escape hatch for checkpointed multi-hour soaks and "
+             "schedules the lane encoding cannot carry; also implied "
+             "by --resume and an explicit --checkpoint",
     )
     ps.set_defaults(fn=_cmd_soak)
+
+    psw = sub.add_parser(
+        "sweep",
+        help="race a scenario x seed x knob chaos matrix as lanes of "
+             "ONE vmapped dispatch; resilience frontier + worst-seed "
+             "repro (doc/sweeping.md)",
+    )
+    psw.add_argument(
+        "grid", nargs="+", metavar="AXIS=VALUES",
+        help="grid axes: scenario=name[:k=v,..][,name2...] (';' hard-"
+             "separates), seed=0..31 or comma list, knob.loss=0.05,0.2 "
+             "(link-fault threshold axes cross-product)",
+    )
+    psw.add_argument("--config", help="TOML config file ([sim] table)")
+    psw.add_argument("--nodes", type=int)
+    psw.add_argument("--rows", type=int)
+    psw.add_argument("--cols", type=int)
+    psw.add_argument("--log-capacity", type=int)
+    psw.add_argument("--write-rate", type=float)
+    psw.add_argument("--zipf", type=float)
+    psw.add_argument("--swim", action="store_const", const=True)
+    psw.add_argument("--swim-view", type=int)
+    psw.add_argument("--sync-interval", type=int)
+    psw.add_argument("--probes", type=int)
+    psw.add_argument(
+        "--rounds", type=int, default=128,
+        help="scenario length in rounds (fault timeline horizon)",
+    )
+    psw.add_argument("--write-rounds", type=int, default=16)
+    psw.add_argument("--max-rounds", type=int, default=4096)
+    psw.add_argument("--chunk", type=int, default=16)
+    psw.add_argument(
+        "--workload",
+        help="couple a traffic workload spec into EVERY lane "
+             "(lane-seeded; fault-window overlap validated per lane "
+             "up front, all errors in one report)",
+    )
+    psw.add_argument(
+        "--mesh", action="store_true",
+        help="shard the LANE axis over all visible devices (sweep on "
+             "one mesh axis — lanes are independent, so this is pure "
+             "batch data parallelism; doc/sweeping.md)",
+    )
+    psw.add_argument(
+        "--frontier", nargs="?", const="FRONTIER.json", metavar="PATH",
+        help="write the resilience-frontier artifact (per-cell "
+             "worst/p95 over seeds + worst-seed repro commands) to "
+             "PATH (default FRONTIER.json)",
+    )
+    psw.add_argument("--out", help="also write the full report JSON here")
+    psw.set_defaults(fn=_cmd_sweep, pipeline=None)
 
     pli = sub.add_parser(
         "lint",
@@ -1291,12 +1731,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     pb.add_argument(
         "--config", dest="bench_config", type=int,
-        choices=[0, 1, 2, 3, 4, 5, 6, 7],
+        choices=[0, 1, 2, 3, 4, 5, 6, 7, 8],
         help="0=north-star (10k sim convergence wall vs 64-agent "
              "devcluster wall) 1=devcluster 2=64-node slice 3=1k zipf "
              "4=10k headline 5=50k outage catch-up 6=workload engine "
              "7=weak-scaling multichip (100k @ 8 devices, actor-sharded "
-             "log, windowed SWIM; doc/multichip.md)",
+             "log, windowed SWIM; doc/multichip.md) 8=chaos-matrix "
+             "sweep (scenario x seed grid in one vmapped dispatch, "
+             "clusters/sec/device; doc/sweeping.md)",
     )
     pb.add_argument("--nodes", dest="bench_nodes", type=int,
                     help="override the config's cluster size")

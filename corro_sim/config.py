@@ -77,6 +77,15 @@ class FaultConfig:
         )
 
     @property
+    def burst_on(self) -> bool:
+        """Static burst-machinery gate. The inject kernels branch on
+        THIS (never on ``burst_enter`` numerically), so a sweep can
+        substitute per-lane traced thresholds behind the same gate
+        (corro_sim/sweep/: ``burst_on`` is a static bool on the lane
+        knob object too)."""
+        return self.burst_enter > 0.0
+
+    @property
     def resolved_sync_loss(self) -> float:
         return self.loss if self.sync_loss is None else self.sync_loss
 
@@ -229,6 +238,70 @@ def node_faults_from_dict(d: dict) -> NodeFaultConfig:
             tuple(int(x) for x in row) for row in d.get(key, ())
         )
     return NodeFaultConfig(**d)
+
+
+@dataclasses.dataclass(frozen=True)
+class SweepConfig:
+    """Static descriptor of a fleet-of-clusters sweep program
+    (corro_sim/sweep/): ``lanes`` simulated clusters race in ONE jitted
+    dispatch — the scan carry gains a leading lane axis and
+    ``make_step``/``make_workload_step`` run under ``jax.vmap``.
+
+    Everything that VARIES across lanes (link-fault scalars, node-fault
+    schedules, the sampler-vs-schedule write source) moves from baked
+    config constants into per-lane DATA riding the ``sweep_knobs``
+    registry feature leaf (engine/features.py — the PR 10 contract:
+    disabled configs contribute zero leaves, so every non-sweeping
+    config's pytree/jaxpr/cache keys stay byte-identical). The fields
+    here are the static GATES: which fault machinery the union program
+    must trace at all. A gate is on when ANY lane needs it; lanes that
+    don't carry value-neutral knobs (loss 0, wipe round -1, duty 1/1),
+    which the vacuity guards (tests/test_faults.py,
+    tests/test_node_faults.py) already prove bit-identical to the
+    untraced path — that equivalence is exactly what makes a mixed
+    scenario matrix collapse into one program whose every lane equals
+    its serial ``run_sim`` twin (tests/test_sweep.py).
+    """
+
+    lanes: int = 0  # sweep width; 0 = sweeping off (every existing
+    # config — the enabled-gate for the sweep_knobs feature leaf)
+    link_faults: bool = False  # trace the link-fault machinery with
+    # per-lane traced thresholds (loss/dup/sync_loss ride the knob leaf)
+    burst: bool = False  # trace the Gilbert burst machinery (per-lane
+    # enter/exit/loss thresholds; arms the (N,) fault_burst plane)
+    wipes: bool = False  # per-lane crash-restart wipe planes
+    # (wipe_round/wipe_stale/epoch_jump)
+    stale: bool = False  # per-lane stale-rejoin snapshot planes
+    # (snap_round; arms the node_snapshot leaf)
+    skew: bool = False  # per-lane HLC skew plane
+    straggle: bool = False  # per-lane duty-cycle planes
+    workload: bool = False  # the program takes the write-schedule scan
+    # inputs AND traces the sampler, selecting per lane by the
+    # use_workload knob — so schedule-driven and sampler-driven lanes
+    # mix in one dispatch
+
+    @property
+    def enabled(self) -> bool:
+        return self.lanes > 0
+
+    @property
+    def node_faults(self) -> bool:
+        """Whether any node-lifecycle plane is armed."""
+        return self.wipes or self.stale or self.skew or self.straggle
+
+    @property
+    def wipe_planes(self) -> bool:
+        """Whether the wipe planes (and the node_epoch leaf) exist."""
+        return self.wipes or self.stale
+
+    def validate(self) -> "SweepConfig":
+        assert self.lanes >= 0, "sweep.lanes must be >= 0"
+        if not self.enabled:
+            assert not (
+                self.link_faults or self.burst or self.wipes or self.stale
+                or self.skew or self.straggle or self.workload
+            ), "sweep gates need lanes > 0"
+        return self
 
 
 @dataclasses.dataclass(frozen=True)
@@ -404,6 +477,14 @@ class SimConfig:
     # zero extra SimState leaves (registry features), bit-identical
     # step program (tests/test_node_faults.py non-perturbation guard).
 
+    # --- fleet-of-clusters sweep (corro_sim/sweep/) ---
+    sweep: SweepConfig = SweepConfig()  # static gates of the vmapped
+    # chaos-matrix program: lanes > 0 stacks the scan carry over a
+    # leading lane axis and the per-lane fault knobs ride the
+    # sweep_knobs registry feature leaf. Default disabled: zero extra
+    # traced ops, zero extra SimState leaves, byte-identical step
+    # program (the engine/features.py contract).
+
     # --- host-side driver (engine/driver.py) ---
     pipeline: bool = True  # pipelined chunk dispatch: overlap device
     # compute with host-side control/transfers/bookkeeping (speculative
@@ -490,4 +571,10 @@ class SimConfig:
         )
         self.faults.validate(self.num_nodes)
         self.node_faults.validate(self.num_nodes)
+        self.sweep.validate()
+        if self.sweep.enabled:
+            assert not self.node_faults.enabled, (
+                "a sweep union config carries node faults as per-lane "
+                "planes (sweep_knobs leaf), never as static schedules"
+            )
         return self

@@ -175,6 +175,24 @@ class Schedule:
         ]
 
 
+def converged_at(gaps, base: int, chunk: int, min_rounds: int) -> int | None:
+    """THE convergence rule, applied to one executed chunk's per-round
+    ``gap`` series: the first round strictly past ``min_rounds`` with a
+    zero cluster-wide gap, and only when the chunk ENDS converged (a
+    transient zero during the write phase is not convergence). Shared
+    by ``run_sim`` and the fleet-of-clusters sweep
+    (:mod:`corro_sim.sweep.engine`) so a lane's convergence report is
+    the serial rule verbatim — per-lane bit-identity depends on it."""
+    rounds = base + chunk
+    # Strictly greater: at rounds == min_rounds the round numbered
+    # min_rounds (e.g. a scheduled rejoin) has not executed yet.
+    if not (rounds > min_rounds and gaps[-1] == 0.0):
+        return None
+    idx = np.arange(1, chunk + 1) + base
+    eligible = (gaps == 0.0) & (idx > min_rounds)
+    return int(idx[np.argmax(eligible)])
+
+
 @dataclasses.dataclass
 class RunResult:
     state: SimState
@@ -908,17 +926,10 @@ def run_sim(
             )
             flight.annotate(wrapped_at, "log_wrapped")
             return False
-        # Strictly greater: at rounds == min_rounds the round numbered
-        # min_rounds (e.g. a scheduled rejoin) has not executed yet.
-        if stop_on_convergence and rounds > min_rounds:
-            gaps = m["gap"]
-            if gaps[-1] == 0.0:
-                # Only rounds strictly past min_rounds are convergence
-                # candidates — a transient zero during the write phase (all
-                # deliveries momentarily caught up) is not convergence.
-                idx = np.arange(1, chunk + 1) + base
-                eligible = (gaps == 0.0) & (idx > min_rounds)
-                converged_round = int(idx[np.argmax(eligible)])
+        if stop_on_convergence:
+            conv = converged_at(m["gap"], base, chunk, min_rounds)
+            if conv is not None:
+                converged_round = conv
                 flight.annotate(converged_round, "converged")
                 if scorecard is not None:
                     # rows_lost is measured AT the convergence report —

@@ -23,6 +23,51 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(devices, axis_names=("nodes",))
 
 
+def make_sweep_mesh(lanes: int, devices=None, node_shards: int = 1) -> Mesh:
+    """A mesh for the fleet-of-clusters sweep (corro_sim/sweep/): the
+    LANE axis rides ``"sweep"``, and — when ``node_shards`` > 1 — the
+    node axis rides ``"nodes"`` inside each lane group (sweep on one
+    mesh axis, nodes on the other, the PR 8 composition). Uses the most
+    devices that divide the lane count evenly; lanes are independent,
+    so this is pure batch data-parallelism."""
+    import numpy as np
+
+    devices = list(devices if devices is not None else jax.devices())
+    per_lane = max(1, int(node_shards))
+    usable = len(devices) // per_lane
+    sweep_devs = 1
+    for d in range(min(usable, lanes), 0, -1):
+        if lanes % d == 0:
+            sweep_devs = d
+            break
+    grid = np.asarray(
+        devices[: sweep_devs * per_lane]
+    ).reshape(sweep_devs, per_lane)
+    return Mesh(grid, axis_names=("sweep", "nodes"))
+
+
+def sweep_state_shardings(cfg, stacked, mesh: Mesh):
+    """Shardings for the ``(L, ...)``-stacked sweep carry: every leaf's
+    leading lane axis over the mesh's ``sweep`` axis; when the mesh
+    carries a >1 ``nodes`` axis, node-sized axis-1 leaves additionally
+    shard over it (the PR 8 node-leading rule, shifted one axis right
+    by the stack). Placement only — lanes never exchange data, so any
+    layout is value-identical to the unsharded sweep."""
+    n = cfg.num_nodes
+    node_shards = dict(mesh.shape).get("nodes", 1)
+
+    def one(leaf):
+        parts: list = ["sweep"]
+        if (
+            node_shards > 1 and leaf.ndim >= 2 and leaf.shape[1] == n
+            and leaf.shape[1] % node_shards == 0
+        ):
+            parts.append("nodes")
+        return NamedSharding(mesh, P(*parts))
+
+    return jax.tree.map(one, stacked)
+
+
 # Replicating the change log is the right call while it is small (every
 # delivery/sync gather is device-local); past this many actors the log's
 # HBM share forces the actor-sharded layout + delivery collectives.

@@ -20,6 +20,8 @@ import corro_sim.faults.inject  # noqa: F401  (registers the fault_burst
 # feature leaf at import time — engine/features.py)
 import corro_sim.faults.nodes  # noqa: F401  (registers the node_epoch /
 # node_snapshot dict-style feature leaves — node-lifecycle fault domain)
+import corro_sim.sweep.knobs  # noqa: F401  (registers the sweep_knobs
+# leaf — per-lane fault parameters of the fleet-of-clusters sweep)
 from corro_sim.config import SimConfig
 from corro_sim.core.bookkeeping import Bookkeeping, make_bookkeeping
 from corro_sim.core.changelog import ChangeLog, make_changelog

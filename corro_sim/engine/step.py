@@ -29,6 +29,7 @@ from corro_sim.core.compaction import update_ownership
 from corro_sim.core.crdt import NEG, local_write
 from corro_sim.core.delivery import delivery_pass
 from corro_sim.faults.inject import (
+    LaneFaultKnobs,
     blackhole_mask,
     burst_update,
     fault_keys,
@@ -169,6 +170,14 @@ def sim_step(
     )
     reach = _reachable_fn(alive, part)
 
+    # ------------------------------------------------ sweep knob planes
+    # (corro_sim/sweep/): inside a vmapped fleet program the per-lane
+    # fault knobs ride the sweep_knobs registry feature leaf — every
+    # gate below stays STATIC (SweepConfig), only thresholds/schedules
+    # become traced per-lane data. cfg.sweep off (every existing
+    # config) touches nothing: the program is byte-identical.
+    sw = state.features["sweep_knobs"] if cfg.sweep.enabled else None
+
     # ---------------------------------------------- node-lifecycle faults
     # (faults/nodes.py): scheduled crash-restart wipes / stale-rejoin
     # restores rebind the carry BEFORE anything reads it, plus the
@@ -176,12 +185,18 @@ def sim_step(
     # traces ZERO extra ops (the cfg.probes discipline) — and every
     # mask is a pure function of the round counter and baked constants
     # (no new key draws), so the repair step derives the identical
-    # fault timeline.
-    nf_on = cfg.node_faults.enabled
+    # fault timeline. Under a sweep the masks derive from per-lane
+    # planes instead of constants — same expressions, traced operands.
+    nf_sweep = sw if (sw is not None and cfg.sweep.node_faults) else None
+    nf_on = cfg.node_faults.enabled or nf_sweep is not None
     if nf_on:
-        state, nf_wiped = apply_node_faults(cfg, state, state.round)
-        nf_active = straggler_active(cfg.node_faults, n, state.round)
-        nf_skew = skew_plane(cfg.node_faults, n)
+        state, nf_wiped = apply_node_faults(
+            cfg, state, state.round, sweep=nf_sweep
+        )
+        nf_active = straggler_active(
+            cfg.node_faults, n, state.round, sweep=nf_sweep
+        )
+        nf_skew = skew_plane(cfg.node_faults, n, sweep=nf_sweep)
     else:
         nf_active = None
         nf_skew = None
@@ -192,13 +207,19 @@ def sim_step(
     # fault key lane is fold_in-derived, NOT a wider split, so the 9
     # subkeys above are byte-identical either way and the repair step
     # derives the same fault stream (faults/inject.py).
-    fault_on = cfg.faults.enabled
+    lane_link = sw is not None and cfg.sweep.link_faults
+    fault_on = cfg.faults.enabled or lane_link
     if fault_on:
+        fconf = (
+            LaneFaultKnobs(sw, cfg.sweep.burst) if lane_link
+            else cfg.faults
+        )
         k_fburst, k_flink, k_fsync = fault_keys(key)
-        burst = burst_update(cfg.faults, state.fault_burst, k_fburst)
+        burst = burst_update(fconf, state.fault_burst, k_fburst)
         bh = blackhole_mask(cfg.faults, n)
         bh = None if bh is None else jnp.asarray(bh)
     else:
+        fconf = None
         burst = state.fault_burst
         k_fsync = None
         bh = None
@@ -209,7 +230,8 @@ def sim_step(
     # ---------------------------------------------------------- local writes
     # One changeset per node per round max — the reference serializes local
     # writes through one write conn + Semaphore(1) (agent.rs:500-731).
-    if writes is not None:
+    lane_wl = sw is not None and cfg.sweep.workload
+    if writes is not None and not lane_wl:
         writers, w_row_s, w_col, w_val, w_del, w_ncells = writes
         writers = writers & alive
         w_del = w_del & writers
@@ -252,6 +274,24 @@ def sim_step(
             k_val, (n, s), 0, cfg.value_universe, dtype=jnp.int32
         )
         w_row_s = jnp.broadcast_to(w_row[:, None], (n, s))
+
+        if writes is not None:
+            # mixed sweep (corro_sim/sweep/): a lane whose knob says
+            # use_workload takes its staged schedule rows; sampler
+            # lanes keep the draws above — both sources are traced,
+            # the per-lane scalar selects. Each lane is thereby
+            # bit-identical to its serial twin (the twin runs exactly
+            # one of the two sources through the same expressions).
+            s_writers, s_rows, s_cols, s_vals, s_dels, s_ncells = writes
+            s_writers = s_writers & alive
+            s_dels = s_dels & s_writers
+            uw = sw["use_workload"]
+            writers = jnp.where(uw, s_writers, writers)
+            w_row_s = jnp.where(uw, s_rows, w_row_s)
+            w_col = jnp.where(uw, s_cols, w_col)
+            w_val = jnp.where(uw, s_vals, w_val)
+            w_del = jnp.where(uw, s_dels, w_del)
+            w_ncells = jnp.where(uw, s_ncells, w_ncells)
 
     if nf_on:
         # post-wipe write gate (faults/nodes.py module docstring): a
@@ -430,7 +470,7 @@ def sim_step(
             f_blackholed = holed.sum(dtype=jnp.int32)
         else:
             f_blackholed = jnp.int32(0)
-        keep, dup_m = link_fault_masks(cfg.faults, k_flink, dst, burst)
+        keep, dup_m = link_fault_masks(fconf, k_flink, dst, burst)
         f_lost = (delivered & ~keep).sum(dtype=jnp.int32)
         delivered = delivered & keep
         f_dup = (delivered & dup_m).sum(dtype=jnp.int32)
@@ -544,13 +584,16 @@ def sim_step(
     # slow agent.
     nf_sync_ok = (
         None if nf_active is None
-        else straggler_active(cfg.node_faults, n, state.sync_rounds)
+        else straggler_active(
+            cfg.node_faults, n, state.sync_rounds, sweep=nf_sweep
+        )
     )
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, table, state.hlc, last_cleared, cleared_hlc,
         k_sync, alive, view, part,
         rtt=rtt if cfg.rtt_rings else None, round_idx=state.sync_rounds,
         fault_key=k_fsync, mesh=mesh, client_ok=nf_sync_ok,
+        fault_cfg=fconf if lane_link else None,
     )
     if cfg.probes:
         # the anti-entropy merge point: heads that now cover a probe's
@@ -605,7 +648,7 @@ def sim_step(
             "fault_matured": f_matured,
             "fault_burst_nodes": (
                 burst.sum(dtype=jnp.int32)
-                if cfg.faults.burst_enter > 0 else jnp.int32(0)
+                if fconf.burst_on else jnp.int32(0)
             ),
         } if fault_on else {}),
         # node-lifecycle fault accounting (faults/nodes.py; additive):
@@ -702,7 +745,7 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
 def _sync_block(
     cfg, is_sync, book, log, table, hlc, last_cleared, cleared_hlc,
     k_sync, alive, view, part, rtt, round_idx=0, fault_key=None,
-    mesh=None, client_ok=None,
+    mesh=None, client_ok=None, fault_cfg=None,
 ):
     """The sync cond: one anti-entropy sweep when ``is_sync``.
 
@@ -716,7 +759,10 @@ def _sync_block(
     stretched) but still SERVES inbound requests: the reference's sync
     server is a passive semaphore-guarded responder, so only the client
     side slows down. Gating the pair-mask rows gates exactly that.
-    None (node faults off) traces the pre-fault program exactly."""
+    None (node faults off) traces the pre-fault program exactly.
+
+    ``fault_cfg``: per-lane knob substitute for ``cfg.faults``
+    (corro_sim/sweep/ LaneFaultKnobs) — None everywhere off-sweep."""
 
     def do_sync(args):
         book, table, hlc, lc = args
@@ -729,6 +775,7 @@ def _sync_block(
             cfg, book, log, table, hlc, lc, cleared_hlc, k_sync, alive,
             view, pairs,
             rtt=rtt, round_idx=round_idx, fault_key=fault_key, mesh=mesh,
+            fault_cfg=fault_cfg,
         )
 
     def no_sync(args):
@@ -742,7 +789,7 @@ def _sync_block(
             "sync_empties": zero,
             "sync_cells": zero,
         }
-        if cfg.faults.enabled:
+        if cfg.faults.enabled or fault_cfg is not None:
             m["fault_sync_lost"] = zero
         return book, table, hlc, lc, m
 
@@ -816,15 +863,26 @@ def _repair_step(
      k_sync) = jax.random.split(key, 9)
     reach = _reachable_fn(alive, part)
 
+    # sweep knob planes: the identical handle the full step holds (the
+    # sweep engine itself never dispatches this program — it always
+    # runs the full step so every lane can write/wipe at any chunk —
+    # but the two programs must stay trace-equivalent under ANY config)
+    sw = state.features["sweep_knobs"] if cfg.sweep.enabled else None
+
     # node-lifecycle faults: the identical prologue the full step runs
     # (masks are pure functions of the round counter — no keys), so a
     # wipe landing in the convergence tail executes bit-for-bit on this
     # program too and the driver's specialization stays equivalence-safe
-    nf_on = cfg.node_faults.enabled
+    nf_sweep = sw if (sw is not None and cfg.sweep.node_faults) else None
+    nf_on = cfg.node_faults.enabled or nf_sweep is not None
     if nf_on:
-        state, nf_wiped = apply_node_faults(cfg, state, state.round)
-        nf_active = straggler_active(cfg.node_faults, n, state.round)
-        nf_skew = skew_plane(cfg.node_faults, n)
+        state, nf_wiped = apply_node_faults(
+            cfg, state, state.round, sweep=nf_sweep
+        )
+        nf_active = straggler_active(
+            cfg.node_faults, n, state.round, sweep=nf_sweep
+        )
+        nf_skew = skew_plane(cfg.node_faults, n, sweep=nf_sweep)
     else:
         nf_active = None
         nf_skew = None
@@ -834,11 +892,17 @@ def _repair_step(
     # convergence tail — recovery under loss must not get a fault-free
     # repair program. The unused link-loss subkey costs nothing (the full
     # step's draws on zero valid lanes are masked no-ops there too).
-    fault_on = cfg.faults.enabled
+    lane_link = sw is not None and cfg.sweep.link_faults
+    fault_on = cfg.faults.enabled or lane_link
     if fault_on:
+        fconf = (
+            LaneFaultKnobs(sw, cfg.sweep.burst) if lane_link
+            else cfg.faults
+        )
         k_fburst, _k_flink, k_fsync = fault_keys(key)
-        burst = burst_update(cfg.faults, state.fault_burst, k_fburst)
+        burst = burst_update(fconf, state.fault_burst, k_fburst)
     else:
+        fconf = None
         burst = state.fault_burst
         k_fsync = None
 
@@ -871,13 +935,15 @@ def _repair_step(
 
     nf_sync_ok = (
         None if nf_active is None
-        else straggler_active(cfg.node_faults, n, state.sync_rounds)
+        else straggler_active(
+            cfg.node_faults, n, state.sync_rounds, sweep=nf_sweep
+        )
     )
     book, table, hlc_s, last_cleared, sync_metrics = _sync_block(
         cfg, is_sync, book, log, state.table, state.hlc, state.last_cleared,
         state.cleared_hlc, k_sync, alive, view, part, rtt=None,
         round_idx=state.sync_rounds, fault_key=k_fsync, mesh=mesh,
-        client_ok=nf_sync_ok,
+        client_ok=nf_sync_ok, fault_cfg=fconf if lane_link else None,
     )
     probe = state.probe
     if cfg.probes:
@@ -927,7 +993,7 @@ def _repair_step(
             "fault_matured": zero,
             "fault_burst_nodes": (
                 burst.sum(dtype=jnp.int32)
-                if cfg.faults.burst_enter > 0 else zero
+                if fconf.burst_on else zero
             ),
         } if fault_on else {}),
         # node-fault series stay LIVE through the tail (wipes can land

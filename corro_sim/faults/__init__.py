@@ -33,7 +33,11 @@ package makes faults first-class:
   committed threshold golden.
 """
 
-from corro_sim.faults.invariants import InvariantChecker, InvariantViolation
+from corro_sim.faults.invariants import (
+    InvariantChecker,
+    InvariantViolation,
+    merge_reports,
+)
 from corro_sim.faults.scenarios import (
     SCENARIOS,
     Scenario,
@@ -55,5 +59,6 @@ __all__ = [
     "check_thresholds",
     "load_thresholds",
     "make_scenario",
+    "merge_reports",
     "parse_scenario_spec",
 ]

@@ -28,7 +28,13 @@ from corro_sim.faults.masks import pairs_to_mask
 # live here so the faults module owns its plane end to end.
 register_feature(FeatureLeaf(
     name="fault_burst",
-    enabled=lambda cfg: cfg.faults.burst_enter > 0,
+    # a sweep with any bursting lane arms the plane for EVERY lane —
+    # burst-free lanes carry enter=0 knobs, which keep it all-False
+    # (value-identical to the untraced path; corro_sim/sweep/)
+    enabled=lambda cfg: (
+        cfg.faults.burst_enter > 0
+        or (cfg.sweep.enabled and cfg.sweep.burst)
+    ),
     build=lambda cfg, seed: jnp.zeros((cfg.num_nodes,), bool),
     placeholder=lambda cfg: jnp.zeros((1,), bool),
     field="fault_burst",
@@ -74,8 +80,11 @@ def burst_update(faults, burst: jnp.ndarray, k_burst: jax.Array):
 
     Two independent uniforms per node: in-burst nodes exit with
     ``burst_exit``, healthy nodes enter with ``burst_enter``. Static
-    no-op (returns the placeholder untouched) when the knob is off."""
-    if faults.burst_enter <= 0.0:
+    no-op (returns the placeholder untouched) when the gate is off.
+    ``faults`` is a :class:`FaultConfig` or a :class:`LaneFaultKnobs`
+    — the gate (``burst_on``) is static either way; the thresholds may
+    be per-lane traced scalars under a sweep."""
+    if not faults.burst_on:
         return burst
     u = jax.random.uniform(k_burst, (2,) + burst.shape)
     enter = u[0] < faults.burst_enter
@@ -97,13 +106,37 @@ def link_fault_masks(
     idempotent per (dst, actor, ver, chunk))."""
     u = jax.random.uniform(k_link, (2,) + dst.shape)
     p = jnp.float32(faults.loss)
-    if faults.burst_enter > 0.0:
+    if faults.burst_on:
         p = jnp.where(
             burst[dst], jnp.maximum(p, jnp.float32(faults.burst_loss)), p
         )
     keep = u[0] >= p
     dup = u[1] < jnp.float32(faults.dup)
     return keep, dup
+
+
+class LaneFaultKnobs:
+    """Duck-types :class:`FaultConfig` for the inject kernels with
+    per-lane TRACED thresholds (the corro_sim/sweep knob leaf): inside
+    the vmapped fleet program each lane reads its own loss/dup/burst/
+    sync-loss scalars from the carry instead of baked constants, so one
+    compiled dispatch races lanes with different fault knobs. The
+    static gates (``burst_on``) come from the union SweepConfig —
+    gates must never be traced values."""
+
+    __slots__ = (
+        "loss", "dup", "burst_enter", "burst_exit", "burst_loss",
+        "resolved_sync_loss", "burst_on",
+    )
+
+    def __init__(self, knobs: dict, burst_on: bool):
+        self.loss = knobs["loss"]
+        self.dup = knobs["dup"]
+        self.burst_enter = knobs["burst_enter"]
+        self.burst_exit = knobs["burst_exit"]
+        self.burst_loss = knobs["burst_loss"]
+        self.resolved_sync_loss = knobs["sync_loss"]
+        self.burst_on = bool(burst_on)
 
 
 def sync_grant_keep(

@@ -30,7 +30,29 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["InvariantChecker", "InvariantViolation"]
+__all__ = ["InvariantChecker", "InvariantViolation", "merge_reports"]
+
+
+def merge_reports(reports: list) -> dict:
+    """Fold many per-run checker reports (``InvariantChecker.report()``
+    dicts) into one summary — the sweep engine grades every lane with
+    its own checker, and the matrix report needs the one-line verdict:
+    overall ok, total chunks checked, and the violations with their
+    originating lane index attached."""
+    violations = []
+    chunks = 0
+    for i, rep in enumerate(reports):
+        if rep is None:
+            continue
+        chunks += int(rep.get("chunks_checked", 0))
+        for v in rep.get("violations", []):
+            violations.append({"lane": i, **v})
+    return {
+        "ok": not violations,
+        "lanes_checked": sum(1 for r in reports if r is not None),
+        "chunks_checked": chunks,
+        "violations": violations,
+    }
 
 
 @dataclasses.dataclass

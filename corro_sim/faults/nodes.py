@@ -90,16 +90,22 @@ def _snapshot_leaf(cfg, seed):
 register_feature(FeatureLeaf(
     name="node_epoch",
     # the vacuous trace threads the plane too — the guard must exercise
-    # the real carry, not a special-cased one
+    # the real carry, not a special-cased one. A sweep with any wiping
+    # lane arms the plane for every lane (corro_sim/sweep/: wipe-free
+    # lanes carry never-firing wipe_round=-1 planes).
     enabled=lambda cfg: bool(
         cfg.node_faults.wipe_enabled or cfg.node_faults.trace_vacuous
+        or (cfg.sweep.enabled and cfg.sweep.wipe_planes)
     ),
     build=lambda cfg, seed: jnp.zeros((cfg.num_nodes,), jnp.int32),
     volatile=True,
 ))
 register_feature(FeatureLeaf(
     name="node_snapshot",
-    enabled=lambda cfg: bool(cfg.node_faults.stale),
+    enabled=lambda cfg: bool(
+        cfg.node_faults.stale
+        or (cfg.sweep.enabled and cfg.sweep.stale)
+    ),
     build=_snapshot_leaf,
     volatile=True,
 ))
@@ -128,10 +134,14 @@ def _sched(pairs, vacuous: bool, width: int = 2):
     return tuple(np.asarray(col, np.int32) for col in zip(*rows))
 
 
-def skew_plane(nf, n: int):
+def skew_plane(nf, n: int, sweep=None):
     """(N,) int32 per-node wall-clock offset constant for ``_hlc_tick``'s
     physical floor, or None when skew is statically off (the None path
-    traces the pre-skew expression exactly)."""
+    traces the pre-skew expression exactly). ``sweep``: the per-lane
+    knob leaf (corro_sim/sweep/) — when it carries a ``skew`` plane,
+    the lane's traced offsets replace the baked constant."""
+    if sweep is not None:
+        return sweep["skew"] if "skew" in sweep else None
     if not (nf.skew or nf.trace_vacuous):
         return None
     plane = np.zeros((n,), np.int32)
@@ -140,13 +150,24 @@ def skew_plane(nf, n: int):
     return jnp.asarray(plane)
 
 
-def straggler_active(nf, n: int, round_):
+def straggler_active(nf, n: int, round_, sweep=None):
     """(N,) bool participation mask: False while a straggler's duty
     cycle parks it — ``(round + node) % period < active`` (the node-id
     phase decorrelates stragglers so they do not all stall the same
     rounds). None when statically off. Consumers gate broadcast
     emission and sync participation; delivery, SWIM probes and local
-    commits stay ungated (a straggler is alive, just slow)."""
+    commits stay ungated (a straggler is alive, just slow).
+
+    ``sweep``: the per-lane knob leaf — when it carries duty planes
+    the whole mask is the dense per-node form of the same expression
+    (non-stragglers ride period=1/active=1, identically True)."""
+    if sweep is not None:
+        if "straggle_period" not in sweep:
+            return None
+        ids = jnp.arange(n, dtype=jnp.int32)
+        return (
+            (round_ + ids) % sweep["straggle_period"]
+        ) < sweep["straggle_active"]
     if not (nf.straggle or nf.trace_vacuous):
         return None
     nodes, period, active = _sched(nf.straggle, nf.trace_vacuous, width=3)
@@ -172,13 +193,21 @@ def recovering_mask(book, log) -> jnp.ndarray:
     return book.head[rows, rows] < log.head
 
 
-def apply_node_faults(cfg, state, round_):
+def apply_node_faults(cfg, state, round_, sweep=None):
     """The node-fault prologue, applied at the START of a round by BOTH
     step programs: capture stale-rejoin snapshots, then execute every
     wipe scheduled for this round. Returns ``(state, wiped)`` where
     ``wiped`` is the (N,) bool mask of nodes restarted this round (a
     zeros constant when no wipe plane is armed, so the metric surface
     stays static).
+
+    ``sweep``: the per-lane knob leaf (corro_sim/sweep/) — when it
+    carries wipe planes, the fire masks derive from per-lane TRACED
+    round planes (``wipe_round``/``wipe_stale``/``snap_round``, one
+    wipe per node, -1 = never) instead of baked schedule constants, so
+    one vmapped program executes a different wipe timeline per lane.
+    The restore tail is shared verbatim with the static path — the two
+    cannot drift.
 
     Wipe semantics (the empty-SQLite restart): table cell planes and the
     bookkeeping row reset to init values (or the snapshot's, for stale
@@ -192,22 +221,18 @@ def apply_node_faults(cfg, state, round_):
     state, RTT observations (link properties), and the probe tracer
     (an observer, not node state)."""
     nf = cfg.node_faults
-    if not (nf.wipe_enabled or nf.trace_vacuous):
+    if sweep is not None and "wipe_round" not in sweep:
+        sweep = None  # sweeping, but no lane arms the wipe planes
+    if sweep is None and not (nf.wipe_enabled or nf.trace_vacuous):
         return state, jnp.zeros((cfg.num_nodes,), bool)
     n = cfg.num_nodes
     feats = dict(state.features)
     table, book = state.table, state.book
 
-    # ---- stale-rejoin snapshot capture (before any wipe this round:
-    # a same-round capture+restore degenerates to an identity wipe)
-    stale_on = bool(nf.stale)
-    if stale_on:
-        s_nodes = [int(x[0]) for x in nf.stale]
-        s_caps = [int(x[1]) for x in nf.stale]
-        s_restores = [int(x[2]) for x in nf.stale]
-        cap = _mask_at(s_nodes, s_caps, n, round_)
-        snap = feats["node_snapshot"]
-        snap = {
+    def _captured(cap, snap):
+        """Stale-rejoin snapshot capture (before any wipe this round: a
+        same-round capture+restore degenerates to an identity wipe)."""
+        return {
             "cv": jnp.where(cap[:, None, None], table.cv, snap["cv"]),
             "vr": jnp.where(cap[:, None, None], table.vr, snap["vr"]),
             "site": jnp.where(
@@ -217,17 +242,43 @@ def apply_node_faults(cfg, state, round_):
             "head": jnp.where(cap[:, None], book.head, snap["head"]),
             "win": jnp.where(cap[:, None], book.win, snap["win"]),
         }
-        feats["node_snapshot"] = snap
-        sv = _mask_at(s_nodes, s_restores, n, round_)
-    else:
-        sv = None
 
-    # ---- wipe masks: amnesia + stale restores
-    if nf.crash or (nf.trace_vacuous and not stale_on):
-        c_nodes, c_rounds = _sched(nf.crash, nf.trace_vacuous)
-        am = _mask_at(c_nodes, c_rounds, n, round_)
+    if sweep is not None:
+        # per-lane traced wipe planes: one wipe per node, -1 = never
+        stale_on = "snap_round" in sweep
+        if stale_on:
+            feats["node_snapshot"] = _captured(
+                sweep["snap_round"] == round_, feats["node_snapshot"]
+            )
+        fire = sweep["wipe_round"] == round_
+        if stale_on:
+            sv = fire & sweep["wipe_stale"]
+            am = fire & ~sweep["wipe_stale"]
+        else:
+            sv = None
+            am = fire
+        epoch_jump = sweep["epoch_jump"]
     else:
-        am = jnp.zeros((n,), bool)
+        # ---- static schedules baked as host constants
+        stale_on = bool(nf.stale)
+        if stale_on:
+            s_nodes = [int(x[0]) for x in nf.stale]
+            s_caps = [int(x[1]) for x in nf.stale]
+            s_restores = [int(x[2]) for x in nf.stale]
+            feats["node_snapshot"] = _captured(
+                _mask_at(s_nodes, s_caps, n, round_),
+                feats["node_snapshot"],
+            )
+            sv = _mask_at(s_nodes, s_restores, n, round_)
+        else:
+            sv = None
+        # ---- wipe masks: amnesia + stale restores
+        if nf.crash or (nf.trace_vacuous and not stale_on):
+            c_nodes, c_rounds = _sched(nf.crash, nf.trace_vacuous)
+            am = _mask_at(c_nodes, c_rounds, n, round_)
+        else:
+            am = jnp.zeros((n,), bool)
+        epoch_jump = jnp.int32(nf.epoch_jump)
     wiped = am | sv if sv is not None else am
 
     # ---- restore sources: empty-DB init values, snapshot where stale
@@ -271,7 +322,7 @@ def apply_node_faults(cfg, state, round_):
     feats["node_epoch"] = epoch
     hlc = jnp.where(
         wiped,
-        (round_ + jnp.int32(nf.epoch_jump) * epoch).astype(jnp.int32),
+        (round_ + epoch_jump * epoch).astype(jnp.int32),
         state.hlc,
     )
     last_cleared = jnp.where(wiped, -1, state.last_cleared)

@@ -354,6 +354,11 @@ def _cluster_from_meta(meta, tripwire=None):
         from corro_sim.config import node_faults_from_dict
 
         cfg["node_faults"] = node_faults_from_dict(node_faults)
+    sweep = cfg.pop("sweep", None)
+    if sweep:  # asdict flattened the SweepConfig block too
+        from corro_sim.config import SweepConfig
+
+        cfg["sweep"] = SweepConfig(**sweep)
     layout = _rebuild_layout(meta)
     universe = LiveUniverse.restore(
         [_dec_value(v) for v in meta["universe"]["values"]],
@@ -561,6 +566,11 @@ def _simconfig_from_dict(d: dict):
     node_faults = d.pop("node_faults", None)
     if node_faults:
         d["node_faults"] = node_faults_from_dict(node_faults)
+    sweep = d.pop("sweep", None)
+    if sweep:
+        from corro_sim.config import SweepConfig
+
+        d["sweep"] = SweepConfig(**sweep)
     return SimConfig(**d)
 
 

@@ -1,0 +1,75 @@
+"""Fleet-of-clusters sweep engine: the whole chaos matrix in ONE dispatch.
+
+The ROADMAP giga-sweep: every axis the simulator explores — chaos
+scenarios, seeds, link-fault knobs, coupled workloads — used to run one
+``run_sim`` at a time through the serial soak loop. This package stacks
+the scan carry over a leading lane axis, ``vmap``s the exact serial step
+body, and races dozens of simulated clusters per device in one jitted
+program, the way SWARM (PAPERS.md) characterizes replication latency
+across whole load envelopes instead of single points:
+
+- :mod:`knobs` — per-lane fault parameters as carry data (the
+  ``sweep_knobs`` registry feature leaf; non-sweeping configs stay
+  byte-identical, the engine/features.py contract);
+- :mod:`plan` — the grid grammar (``scenario=... seed=0..31
+  knob.loss=...``), all-errors-at-once validation, and the union
+  program's static gates;
+- :mod:`engine` — the lane-batched dispatch loop: per-lane convergence
+  via the serial rule, bit-freeze for settled lanes, per-lane
+  scorecards/invariants batched over the lane axis;
+- :mod:`frontier` — worst/p95-over-seeds resilience frontier with
+  arg-max worst-seed repro commands and quantile threshold gating.
+
+Surfaces: ``corro-sim sweep`` (grid spec → frontier artifact, exit 6 on
+threshold breach), ``corro-sim soak`` (now a thin wrapper over the
+sweep engine; ``--serial`` keeps the sequential loop and ``--resume``),
+bench config 8 (clusters/sec/device), and the t1.yml chaos-matrix leg.
+See doc/sweeping.md.
+"""
+
+# Lazy exports: engine/state.py imports corro_sim.sweep.knobs at import
+# time (leaf registration), which initializes THIS package — an eager
+# `from .engine import ...` here would re-enter engine/state mid-import.
+from corro_sim.sweep.knobs import (  # noqa: F401  (registration + re-export)
+    SWEEP_KNOB_FIELDS,
+    lane_knobs,
+    neutral_knobs,
+)
+
+__all__ = [
+    "SWEEP_KNOB_FIELDS",
+    "LaneResult",
+    "SweepLane",
+    "SweepPlan",
+    "SweepResult",
+    "build_frontier",
+    "build_plan",
+    "check_frontier",
+    "lane_knobs",
+    "neutral_knobs",
+    "parse_grid",
+    "run_sweep",
+    "sweep_runner",
+]
+
+_LAZY = {
+    "LaneResult": "corro_sim.sweep.engine",
+    "SweepResult": "corro_sim.sweep.engine",
+    "run_sweep": "corro_sim.sweep.engine",
+    "sweep_runner": "corro_sim.sweep.engine",
+    "build_frontier": "corro_sim.sweep.frontier",
+    "check_frontier": "corro_sim.sweep.frontier",
+    "SweepLane": "corro_sim.sweep.plan",
+    "SweepPlan": "corro_sim.sweep.plan",
+    "build_plan": "corro_sim.sweep.plan",
+    "parse_grid": "corro_sim.sweep.plan",
+}
+
+
+def __getattr__(name: str):
+    mod = _LAZY.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)

@@ -1,0 +1,443 @@
+"""The fleet-of-clusters dispatch loop: L simulated clusters per program.
+
+``run_sweep`` stacks every lane's :class:`SimState` along a leading lane
+axis, ``jax.vmap``s the exact scan body the serial driver iterates
+(:func:`corro_sim.engine.step.make_step` /
+:func:`~corro_sim.engine.step.make_workload_step` — never a parallel
+implementation), and drives chunks of rounds through ONE jitted program.
+Per-lane scenario schedules, workload schedules and PRNG roots ride the
+scan inputs stacked to ``(L, chunk, ...)``; per-lane fault knobs ride
+the ``sweep_knobs`` feature leaf in the carry
+(:mod:`corro_sim.sweep.knobs`).
+
+Bit-identity contract (tests/test_sweep.py): every lane's final state,
+metric series and resilience scorecard equal its serial ``run_sim``
+twin's, because
+
+- the per-lane key streams are the serial streams verbatim
+  (``fold_in(PRNGKey(lane_seed), chunk_index)``, split per round);
+- traced-knob expressions are the constant expressions with traced
+  operands — same values, different program;
+- a lane whose twin never traces some fault machinery carries
+  value-neutral knobs, which the vacuity guards prove bit-identical;
+- ``lax.cond`` under a batched predicate lowers to select — both
+  branches run, the untaken one is discarded, values unchanged;
+- the sweep always runs the FULL step program: the twin's post-quiesce
+  repair specialization is bit-for-bit equivalent under its
+  precondition (tests/test_pipeline.py pins it), so program choice
+  cannot diverge results.
+
+Convergence is judged host-side between chunks with the serial rule
+(:func:`corro_sim.engine.driver.converged_at`) applied per lane; a
+converged or poisoned lane FREEZES — the next dispatch carries its
+state through ``jnp.where(active, new, old)`` untouched, bit-frozen at
+its convergence chunk's boundary, exactly where its twin stopped. The
+dispatch loop exits when every lane has settled or the round budget is
+spent.
+
+Mesh composition (PR 8): lanes are embarrassingly parallel, so a device
+mesh shards the LANE axis (``sweep_state_shardings`` — sweep on one
+mesh axis, nodes optionally on the other); GSPMD partitions the batch
+dimension without a single collective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from corro_sim.engine.driver import converged_at
+from corro_sim.engine.state import init_state
+from corro_sim.engine.step import make_step, make_workload_step
+from corro_sim.utils.compile_cache import CompileCacheProbe
+from corro_sim.utils.metrics import counters, histograms
+from corro_sim.utils.tracing import tracer
+from corro_sim.workload.generators import empty_slice
+
+__all__ = ["LaneResult", "SweepResult", "run_sweep", "sweep_chunk_args"]
+
+
+@dataclasses.dataclass
+class LaneResult:
+    """One lane's serial-equivalent outcome."""
+
+    index: int
+    spec: str
+    seed: int
+    cell: str  # frontier cell key (spec + knob suffix)
+    converged_round: int | None
+    rounds: int  # rounds this lane executed before freezing
+    poisoned: bool
+    heal_round: int | None
+    recovery_rounds: int | None
+    metrics: dict  # name -> (rounds,) np arrays, the twin's series
+    resilience: dict | None
+    invariants: dict | None
+    repro_cmd: str
+    state: object = None  # final per-lane SimState slice (device arrays)
+
+
+@dataclasses.dataclass
+class SweepResult:
+    lanes: list
+    rounds: int  # rounds the longest-running lane executed
+    dispatches: int
+    wall_seconds: float
+    compile_seconds: float
+    devices: int
+    compile_cache: dict | None = None
+
+    @property
+    def clusters_per_second_per_device(self) -> float | None:
+        if self.wall_seconds <= 0:
+            return None
+        return len(self.lanes) / self.wall_seconds / max(self.devices, 1)
+
+    @property
+    def ok(self) -> bool:
+        return all(
+            lane.converged_round is not None and not lane.poisoned
+            and (lane.invariants or {}).get("ok", True)
+            for lane in self.lanes
+        )
+
+
+def _stack(trees):
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def _lane_slice(state, lane: int):
+    """One lane's SimState view off the stacked carry (device-side
+    slices — consumers np.asarray only the leaves they touch)."""
+    return jax.tree.map(lambda x: x[lane], state)
+
+
+def build_lane_states(plan):
+    """The stacked ``(L, ...)`` carry: each lane's ``init_state`` under
+    the UNION config (identical pytree structure across lanes) with its
+    own seed and its own knob values swapped into the sweep leaf."""
+    states = []
+    for lane in plan.lanes:
+        st = init_state(plan.union_cfg, seed=lane.seed)
+        feats = dict(st.features)
+        feats["sweep_knobs"] = {
+            k: jnp.asarray(v) for k, v in lane.knobs.items()
+        }
+        states.append(st.replace(features=feats))
+    return _stack(states)
+
+
+def sweep_runner(cfg, workload: bool = False):
+    """The jitted lane-batched chunk program: vmapped scan over the
+    exact serial body + the freeze select + packed metric stacks (the
+    driver's two-read-per-chunk discipline, lane axis added)."""
+    body = make_workload_step(cfg) if workload else make_step(cfg)
+    meta: dict = {}
+
+    def lane(state, xs):
+        return jax.lax.scan(body, state, xs)
+
+    @jax.jit
+    def run_chunk(state, active, keys, alive, part, we, *wl):
+        out, m = jax.vmap(lane)(state, (keys, alive, part, we, *wl))
+
+        def freeze(new, old):
+            mask = active.reshape(active.shape + (1,) * (new.ndim - 1))
+            return jnp.where(mask, new, old)
+
+        # a settled (converged/poisoned) lane is BIT-FROZEN: its carry
+        # rides through unchanged, exactly the state its serial twin
+        # returned when it stopped
+        out = jax.tree.map(freeze, out, state)
+        fkeys = sorted(k for k in m if m[k].dtype == jnp.float32)
+        ikeys = sorted(k for k in m if k not in fkeys)
+        # deliberate trace-time side channel: the packed-stack key order
+        # is a pure function of cfg, identical on every (re)trace — the
+        # driver's packed-metric idiom with a lane axis
+        meta["fkeys"], meta["ikeys"] = fkeys, ikeys  # corro-lint: ignore[CL105]
+        i_stack = jnp.stack([m[k].astype(jnp.int32) for k in ikeys])
+        f_stack = jnp.stack([m[k].astype(jnp.float32) for k in fkeys])
+        return out, i_stack, f_stack
+
+    def unpack(i_np, f_np):
+        m = {k: i_np[j] for j, k in enumerate(meta["ikeys"])}
+        m.update({k: f_np[j] for j, k in enumerate(meta["fkeys"])})
+        return m
+
+    run_chunk.unpack = unpack
+    return run_chunk
+
+
+def sweep_chunk_args(plan, ci: int, base: int, chunk: int, roots) -> tuple:
+    """Stage chunk ``ci``'s stacked scan inputs: per-lane keys, schedule
+    rows and (when coupled) workload write rows, all ``(L, chunk, ...)``.
+    Every lane's rows are the rows its serial twin would stage at the
+    same absolute rounds — lockstep in ``base``, per-lane in content;
+    the keys are the serial driver's ``fold_in(root, ci)`` verbatim.
+    Returns ``(device_args, alive_rows, part_rows)`` — the host-side
+    per-lane rows ride along for the post-dispatch bookkeeping."""
+    cfg = plan.union_cfg
+    n = cfg.num_nodes
+    s = cfg.seqs_per_version
+    keys, alive, part, we = [], [], [], []
+    wl_cols: list = [[] for _ in range(6)]
+    for lane, root in zip(plan.lanes, roots):
+        keys.append(np.asarray(
+            jax.random.split(jax.random.fold_in(root, ci), chunk)
+        ))
+        a, p, w = lane.schedule.slice(base, chunk, n)
+        alive.append(a)
+        part.append(p)
+        we.append(w)
+        if cfg.sweep.workload:
+            rows = (
+                lane.workload.slice(base, chunk, s)
+                if lane.workload is not None
+                else empty_slice(n, chunk, s)
+            )
+            for i, r in enumerate(rows):
+                wl_cols[i].append(r)
+    out = (
+        jnp.asarray(np.stack(keys)),
+        jnp.asarray(np.stack(alive)),
+        jnp.asarray(np.stack(part)),
+        jnp.asarray(np.stack(we)),
+    )
+    if cfg.sweep.workload:
+        out += tuple(jnp.asarray(np.stack(col)) for col in wl_cols)
+    # the host-side per-lane rows ride along so the post-dispatch
+    # bookkeeping (scorecards/invariants) reuses them instead of
+    # re-slicing every schedule a second time per chunk
+    return out, alive, part
+
+
+def sweep_chunk_avals(plan, chunk: int) -> tuple:
+    """Aval-only ``(state, active, keys, alive, part, we, *wl)`` for
+    AOT-compiling the sweep chunk program without materializing a
+    single lane (tools/prime_cache.py — the persistent warm layer)."""
+    cfg = plan.union_cfg
+    L = plan.num_lanes
+    n = cfg.num_nodes
+    s = cfg.seqs_per_version
+    state = jax.eval_shape(lambda: build_lane_states(plan))
+    avals = (
+        state,
+        jax.ShapeDtypeStruct((L,), jnp.bool_),
+        jax.ShapeDtypeStruct((L, chunk, 2), jnp.uint32),
+        jax.ShapeDtypeStruct((L, chunk, n), jnp.bool_),
+        jax.ShapeDtypeStruct((L, chunk, n), jnp.int32),
+        jax.ShapeDtypeStruct((L, chunk), jnp.bool_),
+    )
+    if cfg.sweep.workload:
+        avals += (
+            jax.ShapeDtypeStruct((L, chunk, n), jnp.bool_),
+            jax.ShapeDtypeStruct((L, chunk, n, s), jnp.int32),
+            jax.ShapeDtypeStruct((L, chunk, n, s), jnp.int32),
+            jax.ShapeDtypeStruct((L, chunk, n, s), jnp.int32),
+            jax.ShapeDtypeStruct((L, chunk, n), jnp.bool_),
+            jax.ShapeDtypeStruct((L, chunk, n), jnp.int32),
+        )
+    return avals
+
+
+def run_sweep(
+    plan,
+    max_rounds: int = 4096,
+    chunk: int = 16,
+    mesh=None,
+    scorecards: bool = True,
+    invariants: bool = True,
+    on_chunk=None,
+) -> SweepResult:
+    """Race the whole plan in lane-batched dispatches.
+
+    ``mesh``: shard the lane axis over the devices
+    (:func:`corro_sim.engine.sharding.sweep_state_shardings`) — lanes
+    are independent, so this is pure batch data-parallelism.
+
+    ``scorecards``/``invariants``: arm a per-lane
+    :class:`~corro_sim.faults.ResilienceScorecard` /
+    :class:`~corro_sim.faults.InvariantChecker`, fed each lane's own
+    metric rows and schedule slices on the serial cadence (batched over
+    the lane axis by slicing the stacked carry).
+    """
+    from corro_sim.faults import InvariantChecker, ResilienceScorecard
+
+    cfg = plan.union_cfg
+    lanes = plan.lanes
+    L = len(lanes)
+    roots = [jax.random.PRNGKey(lane.seed) for lane in lanes]
+    cards = [
+        ResilienceScorecard(
+            lane.cfg, scenario=lane.scenario, workload=lane.workload
+        ) if scorecards else None
+        for lane in lanes
+    ]
+    checks = [
+        InvariantChecker(lane.cfg) if invariants else None
+        for lane in lanes
+    ]
+
+    state = build_lane_states(plan)
+    if mesh is not None:
+        from corro_sim.engine.sharding import sweep_state_shardings
+
+        state = jax.device_put(
+            state, sweep_state_shardings(cfg, state, mesh)
+        )
+    runner = sweep_runner(cfg, workload=cfg.sweep.workload)
+
+    active = np.ones(L, bool)
+    converged = [None] * L
+    poisoned = [False] * L
+    lane_rounds = [0] * L
+    lane_metrics: list[list] = [[] for _ in range(L)]
+
+    compiled = None
+    cache_probe = CompileCacheProbe()
+    compile_seconds = 0.0
+    wall = 0.0
+    dispatches = 0
+    rounds = 0
+    ci = 0
+    while active.any() and rounds < max_rounds:
+        args, sched_alive, sched_part = sweep_chunk_args(
+            plan, ci, rounds, chunk, roots
+        )
+        act = jnp.asarray(active)
+        if ci == 0 and mesh is None:
+            # AOT compile up front (compile wall separated from sim
+            # wall, the driver discipline). Mesh runs stay on plain jit
+            # — it auto-reshards the carry across dispatches, which the
+            # unconstrained AOT executable would reject.
+            t0 = time.perf_counter()
+            try:
+                with tracer.span("sweep aot compile", lanes=L,
+                                 slow_warn=False):
+                    lowered = runner.lower(state, act, *args)
+                    cache_probe.begin()
+                    t_c = time.perf_counter()
+                    compiled = lowered.compile()
+                    cache_probe.end(
+                        "sweep", time.perf_counter() - t_c
+                    )
+            except Exception:  # AOT unsupported on some backend
+                counters.inc(
+                    "corro_compile_aot_fallback_total",
+                    labels='{program="sweep"}',
+                    help_="AOT lower/compile failures falling back to jit",
+                )
+            compile_seconds += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        with tracer.span("sweep chunk", ci=ci, lanes=int(active.sum())):
+            out = (compiled or runner)(state, act, *args)
+            m = runner.unpack(np.asarray(out[1]), np.asarray(out[2]))
+        elapsed = time.perf_counter() - t0
+        if ci == 0 and compiled is None:
+            # jit fallback: the first dispatch is compile+exec mixed
+            compile_seconds += elapsed
+        else:
+            wall += elapsed
+        dispatches += 1
+        state = out[0]
+        counters.inc(
+            "corro_sweep_dispatch_total",
+            help_="lane-batched sweep chunk dispatches "
+                  "(corro_sim/sweep/engine.py)",
+        )
+        base = rounds
+        rounds += chunk
+        for li, lane in enumerate(lanes):
+            if not active[li]:
+                continue
+            lm = {k: np.asarray(v[li]) for k, v in m.items()}
+            lane_metrics[li].append(lm)
+            lane_rounds[li] = rounds
+            a, p = sched_alive[li], sched_part[li]
+            lane_state = _lane_slice(state, li)
+            if cards[li] is not None:
+                cards[li].on_chunk(lane_state, lm, a, p, base)
+            if checks[li] is not None:
+                checks[li].on_chunk(lane_state, lm, a, p, base)
+            if lm["log_wrapped"].any():
+                poisoned[li] = True
+                active[li] = False
+                continue
+            conv = converged_at(lm["gap"], base, chunk, lane.min_rounds)
+            if conv is not None:
+                converged[li] = conv
+                active[li] = False
+                if cards[li] is not None:
+                    cards[li].on_converged(lane_state, a[-1], p[-1])
+                if checks[li] is not None:
+                    checks[li].on_converged(lane_state, a[-1], p[-1])
+        if on_chunk is not None:
+            on_chunk({
+                "chunk": ci,
+                "rounds_done": rounds,
+                "lanes_active": int(active.sum()),
+                "lanes_settled": L - int(active.sum()),
+                "chunk_wall_s": round(elapsed, 3),
+            })
+        ci += 1
+    jax.block_until_ready(jax.tree.leaves(state)[0])
+    histograms.observe(
+        "corro_sweep_wall_seconds", wall,
+        help_="whole-sweep execution wall (compile separate)",
+    )
+
+    results = []
+    for li, lane in enumerate(lanes):
+        metrics = (
+            {
+                k: np.concatenate([c[k] for c in lane_metrics[li]])
+                for k in lane_metrics[li][0]
+            }
+            if lane_metrics[li] else {}
+        )
+        lane_state = _lane_slice(state, li)
+        resilience = None
+        if cards[li] is not None:
+            resilience = cards[li].finalize(
+                converged_round=(
+                    None if poisoned[li] else converged[li]
+                ),
+                rounds=lane_rounds[li], final_state=lane_state,
+            )
+        heal = lane.scenario.heal_round
+        conv = None if poisoned[li] else converged[li]
+        results.append(LaneResult(
+            index=lane.index, spec=lane.spec, seed=lane.seed,
+            cell=lane.cell,
+            converged_round=conv,
+            rounds=lane_rounds[li],
+            poisoned=poisoned[li],
+            heal_round=heal,
+            recovery_rounds=(
+                conv - heal
+                if conv is not None and heal is not None else None
+            ),
+            metrics=metrics,
+            resilience=resilience,
+            invariants=(
+                checks[li].report() if checks[li] is not None else None
+            ),
+            repro_cmd=lane.repro_cmd(
+                plan.base_cfg, plan.rounds, plan.write_rounds,
+                max_rounds, chunk,
+            ),
+            state=lane_state,
+        ))
+    return SweepResult(
+        lanes=results,
+        rounds=rounds,
+        dispatches=dispatches,
+        wall_seconds=wall,
+        compile_seconds=compile_seconds,
+        devices=(mesh.size if mesh is not None else 1),
+        compile_cache=cache_probe.summary(),
+    )

@@ -1,0 +1,162 @@
+"""The sweep knob leaf: per-lane fault parameters as carry DATA.
+
+A serial ``run_sim`` bakes every fault parameter into the compiled
+program as a constant — ``loss=0.05`` is a literal in the jaxpr, a crash
+schedule is a baked int array. That is exactly right for one cluster and
+exactly wrong for a fleet: two lanes with different knobs would need two
+programs, and the chaos matrix degenerates back into the serial soak
+loop. This module moves the *varying* parameters into a registry feature
+leaf (``sweep_knobs``, the PR 10 contract in
+:mod:`corro_sim.engine.features`): under a sweep every lane's carry
+holds its own traced knob scalars/planes, the step reads them in place
+of the constants (same expressions, traced operands — value-identical),
+and one vmapped program races the whole grid. Non-sweeping configs get
+NOTHING — no leaf, no aval — so every existing program's pytree, jaxpr
+and cache key stays byte-identical.
+
+Leaf contents are keyed by the union :class:`corro_sim.config.
+SweepConfig` gates, so the program's scope covers exactly the armed
+sweep dimensions:
+
+========================  =========================================
+gate                      knobs
+========================  =========================================
+``link_faults``           ``loss``/``dup``/``burst_enter``/
+                          ``burst_exit``/``burst_loss``/``sync_loss``
+                          — () float32 thresholds
+``wipes`` or ``stale``    ``wipe_round`` (N,) int32 (-1 = never),
+                          ``wipe_stale`` (N,) bool, ``epoch_jump`` ()
+``stale``                 ``snap_round`` (N,) int32 (-1 = never)
+``skew``                  ``skew`` (N,) int32 HLC offsets
+``straggle``              ``straggle_period``/``straggle_active``
+                          (N,) int32 duty cycles (1/1 = full duty)
+``workload``              ``use_workload`` () bool — schedule-driven
+                          vs sampler-driven writes, per lane
+========================  =========================================
+
+The *neutral* values (what the builder emits, and what a lane that does
+not use a dimension carries) are value-identical to the untraced path —
+the vacuity guards in tests/test_faults.py and tests/test_node_faults.py
+are the proof obligation this design leans on.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from corro_sim.engine.features import FeatureLeaf, register_feature
+
+__all__ = ["SWEEP_KNOB_FIELDS", "lane_knobs", "neutral_knobs"]
+
+# the link-fault scalar thresholds a `knob.<field>=...` grid axis may
+# sweep (everything else on FaultConfig changes program structure)
+SWEEP_KNOB_FIELDS = (
+    "loss", "dup", "burst_enter", "burst_exit", "burst_loss", "sync_loss",
+)
+
+
+def neutral_knobs(cfg, seed: int = 0) -> dict:
+    """The value-neutral leaf for ``cfg``'s armed sweep dimensions —
+    the feature builder (every lane starts here; the sweep engine
+    overwrites the values with each lane's own before stacking)."""
+    import jax.numpy as jnp
+
+    sw = cfg.sweep
+    n = cfg.num_nodes
+    out: dict = {}
+    if sw.link_faults:
+        out.update(
+            loss=jnp.float32(0.0), dup=jnp.float32(0.0),
+            burst_enter=jnp.float32(0.0), burst_exit=jnp.float32(1.0),
+            burst_loss=jnp.float32(0.0), sync_loss=jnp.float32(0.0),
+        )
+    if sw.wipe_planes:
+        out["wipe_round"] = jnp.full((n,), -1, jnp.int32)
+        out["wipe_stale"] = jnp.zeros((n,), bool)
+        out["epoch_jump"] = jnp.int32(0)
+    if sw.stale:
+        out["snap_round"] = jnp.full((n,), -1, jnp.int32)
+    if sw.skew:
+        out["skew"] = jnp.zeros((n,), jnp.int32)
+    if sw.straggle:
+        out["straggle_period"] = jnp.ones((n,), jnp.int32)
+        out["straggle_active"] = jnp.ones((n,), jnp.int32)
+    if sw.workload:
+        out["use_workload"] = jnp.asarray(False)
+    return out
+
+
+register_feature(FeatureLeaf(
+    name="sweep_knobs",
+    enabled=lambda cfg: cfg.sweep.enabled,
+    build=neutral_knobs,
+    volatile=True,
+))
+
+
+def lane_knobs(union_cfg, lane_cfg, use_workload: bool = False) -> dict:
+    """One lane's knob values (host numpy, the union leaf's exact key
+    set) extracted from the lane's serial-twin config — the config a
+    plain ``run_sim`` of this lane would bake as constants.
+
+    Raises ValueError for schedules the plane form cannot carry (more
+    than one wipe per node, a node both crashing and stale-rejoining)
+    — those lanes must run serially (``soak --serial``)."""
+    sw = union_cfg.sweep
+    nf = lane_cfg.node_faults
+    n = union_cfg.num_nodes
+    out: dict = {}
+    if sw.link_faults:
+        f = lane_cfg.faults
+        out.update(
+            loss=np.float32(f.loss), dup=np.float32(f.dup),
+            burst_enter=np.float32(f.burst_enter),
+            burst_exit=np.float32(f.burst_exit),
+            burst_loss=np.float32(f.burst_loss),
+            sync_loss=np.float32(f.resolved_sync_loss),
+        )
+    if sw.wipe_planes:
+        wipe_round = np.full((n,), -1, np.int32)
+        wipe_stale = np.zeros((n,), bool)
+        snap_round = np.full((n,), -1, np.int32)
+        for node, r in nf.crash:
+            node = int(node)
+            if wipe_round[node] >= 0:
+                raise ValueError(
+                    f"node {node} carries more than one scheduled wipe — "
+                    "the sweep's one-wipe-per-node planes cannot encode "
+                    "it; run this lane serially (soak --serial)"
+                )
+            wipe_round[node] = int(r)
+        for node, s, r in nf.stale:
+            node = int(node)
+            if wipe_round[node] >= 0:
+                raise ValueError(
+                    f"node {node} carries more than one scheduled wipe — "
+                    "the sweep's one-wipe-per-node planes cannot encode "
+                    "it; run this lane serially (soak --serial)"
+                )
+            wipe_round[node] = int(r)
+            wipe_stale[node] = True
+            snap_round[node] = int(s)
+        out["wipe_round"] = wipe_round
+        out["wipe_stale"] = wipe_stale
+        out["epoch_jump"] = np.int32(nf.epoch_jump)
+        if sw.stale:
+            out["snap_round"] = snap_round
+    if sw.skew:
+        skew = np.zeros((n,), np.int32)
+        for node, off in nf.skew:
+            skew[int(node)] = int(off)
+        out["skew"] = skew
+    if sw.straggle:
+        period = np.ones((n,), np.int32)
+        active = np.ones((n,), np.int32)
+        for node, p, a in nf.straggle:
+            period[int(node)] = int(p)
+            active[int(node)] = int(a)
+        out["straggle_period"] = period
+        out["straggle_active"] = active
+    if sw.workload:
+        out["use_workload"] = np.asarray(bool(use_workload))
+    return out

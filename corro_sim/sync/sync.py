@@ -349,10 +349,16 @@ def sync_round(
     round_idx: jnp.ndarray | int = 0,
     fault_key: jax.Array | None = None,
     mesh=None,
+    fault_cfg=None,
 ):
     """One anti-entropy sweep (multi-peer).
 
     Returns (book, table, hlc, last_cleared, metrics).
+
+    ``fault_cfg``: per-lane traced substitute for ``cfg.faults``
+    (corro_sim/sweep/ ``LaneFaultKnobs``) — the sweep program's sync
+    grants fail with each LANE's own sync-loss knob. None (every
+    off-sweep caller) keeps the static-config path byte-identical.
 
     Each admitted peer slot carries a FULL per-connection budget
     (``sync_actor_topk`` actors × ``sync_cap_per_actor`` versions), so a
@@ -377,12 +383,13 @@ def sync_round(
     # in the concurrency-rejection metric.
     rejected = requested & ~granted
     fault_metrics = {}
-    if cfg.faults.enabled:
+    if cfg.faults.enabled or fault_cfg is not None:
         from corro_sim.faults.inject import blackhole_mask, sync_grant_keep
 
         bh = blackhole_mask(cfg.faults, n)
         keep = sync_grant_keep(
-            cfg.faults, fault_key, jnp.arange(n, dtype=jnp.int32), peer,
+            fault_cfg if fault_cfg is not None else cfg.faults,
+            fault_key, jnp.arange(n, dtype=jnp.int32), peer,
             None if bh is None else jnp.asarray(bh),
         )
         fault_metrics["fault_sync_lost"] = (granted & ~keep).sum(

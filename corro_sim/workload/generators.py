@@ -52,6 +52,7 @@ from corro_sim.utils.spec import format_spec, parse_spec
 __all__ = [
     "WORKLOADS",
     "Workload",
+    "empty_slice",
     "make_workload",
     "parse_workload_spec",
 ]
@@ -155,15 +156,7 @@ class Workload:
     def slice(self, start: int, length: int, s: int):
         """Round-major ``(length, ...)`` write arrays for one scan chunk —
         the workload analog of :meth:`engine.driver.Schedule.slice`."""
-        n = self.n
-        out = (
-            np.zeros((length, n), bool),
-            np.zeros((length, n, s), np.int32),
-            np.zeros((length, n, s), np.int32),
-            np.zeros((length, n, s), np.int32),
-            np.zeros((length, n), bool),
-            np.zeros((length, n), np.int32),
-        )
+        out = empty_slice(self.n, length, s)
         lo, hi = start, min(start + length, self.rounds)
         if lo < hi:
             k = hi - lo
@@ -186,6 +179,20 @@ class Workload:
         return [
             ev for ev in self.events if start <= ev[0] < start + length
         ]
+
+
+def empty_slice(n: int, length: int, s: int) -> tuple:
+    """All-idle round-major write arrays in the exact ``slice`` shape —
+    what a sweep lane with no coupled workload stages (the write source
+    its per-lane ``use_workload`` knob then ignores; corro_sim/sweep/)."""
+    return (
+        np.zeros((length, n), bool),
+        np.zeros((length, n, s), np.int32),
+        np.zeros((length, n, s), np.int32),
+        np.zeros((length, n, s), np.int32),
+        np.zeros((length, n), bool),
+        np.zeros((length, n), np.int32),
+    )
 
 
 def _alloc(rounds: int, n: int, s: int):

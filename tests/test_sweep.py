@@ -1,0 +1,376 @@
+"""Fleet-of-clusters sweep acceptance (ISSUE 12, corro_sim/sweep/).
+
+The load-bearing claim: every lane of a vmapped sweep — mixed scenarios,
+node-fault lanes, workload-coupled lanes, per-lane seeds — is
+BIT-IDENTICAL to its serial ``run_sim`` twin: final state, metric
+series, and resilience scorecard. Everything else (the frontier, the
+soak migration, threshold gating) stands on that.
+
+Config literals here are in lockstep with tools/prime_cache.py
+(``sweep/test-mixed`` / ``sweep/test-workload`` + the twin programs) so
+the chunk programs come out of the primed cache inside tier-1.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from corro_sim.config import SimConfig
+from corro_sim.engine import init_state, run_sim
+from corro_sim.faults import (
+    InvariantChecker,
+    ResilienceScorecard,
+    merge_reports,
+)
+from corro_sim.sweep import build_plan, parse_grid
+from corro_sim.sweep.engine import run_sweep
+from corro_sim.sweep.frontier import build_frontier, check_frontier
+
+CHUNK = 8
+MAX_ROUNDS = 256
+
+# the prime_cache `t_base` literal
+BASE = SimConfig(
+    num_nodes=12, num_rows=16, num_cols=2, log_capacity=64,
+    write_rate=0.6, sync_interval=4, swim_enabled=True,
+).validate()
+
+# prime_cache `sweep/test-mixed`: link-fault, node-wipe and skew lanes
+# racing in one program, two seeds each
+MIXED_SCENARIOS = [
+    "lossy:p=0.2", "crash_amnesia:nodes=2,at=6,down=4",
+    "clock_skew:nodes=3",
+]
+# prime_cache `sweep/test-workload`: wipes + stale + stragglers, every
+# lane coupled to a lane-seeded zipf workload
+WL_SCENARIOS = [
+    "crash_amnesia:nodes=2,at=6,down=4",
+    "stale_rejoin:nodes=2,snap=2,at=6,down=4",
+    "stragglers:frac=0.3,period=8,active=2",
+]
+WL_SPEC = "zipf:alpha=1.1,rate=0.5,keys=12"
+
+_CORE_FIELDS = (
+    "table", "book", "log", "own", "gossip", "swim", "hlc",
+    "last_cleared", "cleared_hlc", "round", "sync_rounds", "ring0",
+)
+
+
+def _mixed_plan():
+    return build_plan(
+        BASE, MIXED_SCENARIOS, [0, 1], rounds=48, write_rounds=8,
+    )
+
+
+def _wl_plan():
+    return build_plan(
+        BASE, WL_SCENARIOS, [0], rounds=64, write_rounds=8,
+        workload_spec=WL_SPEC,
+    )
+
+
+def _run_twin(lane):
+    """The lane's serial run_sim twin — the exact dispatch the
+    sequential soak loop would make for this grid cell."""
+    card = ResilienceScorecard(
+        lane.cfg, scenario=lane.scenario, workload=lane.workload
+    )
+    inv = InvariantChecker(lane.cfg)
+    return run_sim(
+        lane.cfg, init_state(lane.cfg, seed=lane.seed),
+        lane.scenario.schedule(), max_rounds=MAX_ROUNDS, chunk=CHUNK,
+        seed=lane.seed, min_rounds=lane.min_rounds,
+        invariants=inv, scorecard=card, workload=lane.workload,
+    ), inv
+
+
+def _assert_twin(lane_result, serial, inv):
+    """State + metrics + scorecard bit-identity against the twin."""
+    tag = (lane_result.spec, lane_result.seed)
+    assert serial.converged_round == lane_result.converged_round, tag
+    assert serial.rounds == lane_result.rounds, tag
+    assert serial.poisoned == lane_result.poisoned, tag
+    # every metric family the twin computes, bit for bit (the sweep's
+    # union program may add zero-valued families the twin lacks)
+    for k in serial.metrics:
+        assert np.array_equal(
+            np.asarray(serial.metrics[k]),
+            np.asarray(lane_result.metrics[k]),
+        ), (*tag, k)
+    # core state leaves, bit for bit
+    for field in _CORE_FIELDS:
+        a = jax.tree.leaves(getattr(serial.state, field))
+        b = jax.tree.leaves(getattr(lane_result.state, field))
+        for la, lb in zip(a, b):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+                *tag, field,
+            )
+    # feature leaves the twin carries (node_epoch/node_snapshot) match
+    for name, leaf in serial.state.features.items():
+        for la, lb in zip(
+            jax.tree.leaves(leaf),
+            jax.tree.leaves(lane_result.state.features[name]),
+        ):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+                *tag, "features", name,
+            )
+    # the scorecard block IS the graded evidence — field-for-field
+    sa, sb = serial.resilience, lane_result.resilience
+    for key in ("recovery_rounds", "rows_lost", "resync_rows",
+                "swim_false_down", "swim_flaps", "wipes",
+                "sub_delivery"):
+        assert sa[key] == sb[key], (*tag, key, sa[key], sb[key])
+    assert inv.ok == lane_result.invariants["ok"], tag
+
+
+def test_mixed_scenario_lanes_bit_identical_to_serial_twins():
+    """The acceptance criterion: one compiled dispatch races mixed
+    link-fault / node-wipe / clock-skew lanes across seeds, every lane
+    bit-identical to its serial run_sim twin."""
+    plan = _mixed_plan()
+    assert plan.num_lanes == 6
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    assert res.dispatches >= 1
+    for lane_result, lane in zip(res.lanes, plan.lanes):
+        serial, inv = _run_twin(lane)
+        _assert_twin(lane_result, serial, inv)
+
+
+def test_workload_coupled_lanes_and_lane_freeze():
+    """Workload-coupled sweep: wipes + stale rejoins + stragglers under
+    a lane-seeded zipf load. The straggler lane converges LATE — the
+    early lanes must freeze bit-exactly at their convergence chunk
+    while it keeps running (the lane-freeze contract)."""
+    plan = _wl_plan()
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    rounds = [lr.rounds for lr in res.lanes]
+    # the freeze is only proven if lanes actually settle at different
+    # chunks — the straggler lane outlives the wipe lanes by design
+    assert len(set(rounds)) > 1, rounds
+    assert max(rounds) > min(rounds)
+    for lane_result, lane in zip(res.lanes, plan.lanes):
+        serial, inv = _run_twin(lane)
+        # an early-frozen lane's state equals the twin that STOPPED at
+        # that chunk, even though the sweep kept dispatching rounds
+        _assert_twin(lane_result, serial, inv)
+    # stale rejoin repaid a snapshot delta; amnesia repaid everything
+    by_spec = {lr.spec.split(":")[0]: lr for lr in res.lanes}
+    assert by_spec["stale_rejoin"].resilience["resync_rows"] > 0
+    assert by_spec["crash_amnesia"].resilience["rows_lost"] == 0
+
+
+def test_sweep_leaf_absent_off_sweep():
+    """The PR 10 contract: a non-sweeping config contributes no sweep
+    leaf — pytree structure (and therefore jaxpr + cache keys) of every
+    existing config stays byte-identical."""
+    state = jax.eval_shape(lambda: init_state(BASE, seed=0))
+    assert "sweep_knobs" not in state.features
+    union = _mixed_plan().union_cfg
+    swept = jax.eval_shape(lambda: init_state(union, seed=0))
+    assert "sweep_knobs" in swept.features
+
+
+def test_grid_validation_reports_all_errors_at_once():
+    """`corro-sim sweep` must refuse up front with EVERY invalid grid
+    entry in one ValueError — never die on lane 37 mid-dispatch."""
+    with pytest.raises(ValueError) as ei:
+        build_plan(
+            BASE,
+            ["nosuch_scenario", "lossy:p=0.1",
+             "crash_amnesia:nodes=2,at=40,down=4"],
+            [0, 1], rounds=64, write_rounds=8,
+            # writes end at round 8; the at=40 fault window never
+            # overlaps — a per-lane check_workload failure
+            workload_spec=WL_SPEC,
+        )
+    msg = str(ei.value)
+    assert "nosuch_scenario" in msg
+    assert "never overlap" in msg
+    assert "bad entries" in msg
+    # both seeds of the bad coupling are listed, plus the unknown name
+    assert msg.count("never overlap") >= 2
+
+
+def test_grid_grammar():
+    grid = parse_grid([
+        "scenario=lossy:p=0.1,dup=0.2,crash_amnesia:nodes=2,at=6,churn",
+        "seed=0..3,8",
+        "knob.loss=0.05,0.2",
+    ])
+    assert grid["scenario"] == [
+        "lossy:p=0.1,dup=0.2", "crash_amnesia:nodes=2,at=6", "churn",
+    ]
+    assert grid["seed"] == [0, 1, 2, 3, 8]
+    assert grid["knobs"] == [{"loss": 0.05}, {"loss": 0.2}]
+    # ';' is the unambiguous hard separator
+    assert parse_grid(["scenario=lossy:p=0.1;churn"])["scenario"] == [
+        "lossy:p=0.1", "churn",
+    ]
+    with pytest.raises(ValueError) as ei:
+        parse_grid(["scenario=lossy", "knob.nosuch=1", "weird=2"])
+    assert "nosuch" in str(ei.value) and "weird" in str(ei.value)
+
+
+def test_knob_axis_lands_in_lane_config_and_repro():
+    plan = build_plan(
+        BASE, ["lossy:p=0.1"], [0, 1],
+        knob_combos=[{"loss": 0.3}], rounds=48, write_rounds=8,
+    )
+    for lane in plan.lanes:
+        assert lane.cfg.faults.loss == pytest.approx(0.3)
+        assert float(lane.knobs["loss"]) == pytest.approx(0.3)
+        cmd = lane.repro_cmd(BASE, 48, 8, MAX_ROUNDS, CHUNK)
+        assert "--knob loss=0.3" in cmd
+        assert "--nodes 12" in cmd and "--rows 16" in cmd
+        assert "--scenario-rounds 48" in cmd
+
+
+def _fake_lane(spec, seed, cell, recovery, rows_lost=0, resync=1,
+               converged=10, poisoned=False):
+    from corro_sim.sweep.engine import LaneResult
+
+    return LaneResult(
+        index=0, spec=spec, seed=seed, cell=cell,
+        converged_round=converged, rounds=32, poisoned=poisoned,
+        heal_round=8,
+        recovery_rounds=recovery,
+        metrics={},
+        resilience={
+            "rows_lost": rows_lost, "resync_rows": resync,
+            "swim_false_down": 0,
+            "sub_delivery": {"degradation_p99": 1.5},
+        },
+        invariants={"ok": True, "violations": []},
+        repro_cmd=f"corro-sim run --scenario '{spec}' --seed {seed}",
+    )
+
+
+def test_frontier_quantiles_and_worst_seed():
+    lanes = [
+        _fake_lane("lossy:p=0.1", s, "lossy:p=0.1", recovery=r)
+        for s, r in enumerate([4, 6, 5, 40])
+    ]
+    fr = build_frontier(lanes)
+    (cell,) = fr["cells"]
+    assert cell["lanes"] == 4
+    assert cell["recovery_rounds"]["worst"] == 40
+    assert 5 < cell["recovery_rounds"]["p95"] <= 40
+    # the arg-max worst seed is NAMED with its one-command repro
+    assert cell["worst_seed"] == 3
+    assert "--seed 3" in cell["worst_repro"]
+
+    thresholds = {
+        "default": {"require_converged": True, "rows_lost_max": 0},
+        "scenarios": {"lossy": {
+            "recovery_rounds_worst_max": 30,
+            "recovery_rounds_p95_max": 20,
+        }},
+    }
+    breaches = check_frontier(fr, thresholds)
+    assert len(breaches) == 2  # worst AND p95 both blew their bounds
+    assert all("repro: corro-sim run" in b for b in breaches)
+    assert all("worst seed 3" in b for b in breaches)
+    # worst-of-K falls back to the serial recovery_rounds_max bound
+    legacy = {"default": {}, "scenarios": {"lossy": {
+        "recovery_rounds_max": 30,
+    }}}
+    assert len(check_frontier(fr, legacy)) == 1
+
+
+def test_frontier_unconverged_seed_beats_any_recovery():
+    lanes = [
+        _fake_lane("churn", 0, "churn", recovery=50),
+        _fake_lane("churn", 1, "churn", recovery=None, converged=None),
+    ]
+    fr = build_frontier(lanes)
+    (cell,) = fr["cells"]
+    assert cell["unconverged_seeds"] == [1]
+    assert cell["worst_seed"] == 1
+    breaches = check_frontier(
+        fr, {"default": {"require_converged": True}, "scenarios": {}}
+    )
+    assert breaches and "did not re-converge" in breaches[0]
+
+
+def test_merge_reports_attaches_lane_index():
+    reports = [
+        {"ok": True, "chunks_checked": 2, "violations": []},
+        None,
+        {"ok": False, "chunks_checked": 3, "violations": [
+            {"round": 7, "invariant": "conservation", "detail": "x"},
+        ]},
+    ]
+    merged = merge_reports(reports)
+    assert not merged["ok"]
+    assert merged["lanes_checked"] == 2
+    assert merged["chunks_checked"] == 5
+    assert merged["violations"][0]["lane"] == 2
+
+
+@pytest.mark.slow
+def test_mesh_sweep_bit_identical_to_unsharded():
+    """PR 8 composition: the lane axis sharded over the host mesh must
+    change placement only — every lane's state and metrics equal the
+    unsharded sweep's (which equal the serial twins')."""
+    from corro_sim.engine.sharding import make_sweep_mesh
+
+    plan = _mixed_plan()
+    ref = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    plan2 = _mixed_plan()
+    mesh = make_sweep_mesh(plan2.num_lanes)
+    assert mesh.shape["sweep"] > 1, dict(mesh.shape)
+    sharded = run_sweep(plan2, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                        mesh=mesh)
+    for a, b in zip(ref.lanes, sharded.lanes):
+        assert a.converged_round == b.converged_round
+        assert a.rounds == b.rounds
+        for k in a.metrics:
+            assert np.array_equal(a.metrics[k], b.metrics[k]), k
+        for field in _CORE_FIELDS:
+            for la, lb in zip(
+                jax.tree.leaves(getattr(a.state, field)),
+                jax.tree.leaves(getattr(b.state, field)),
+            ):
+                assert np.array_equal(
+                    np.asarray(la), np.asarray(lb)
+                ), field
+
+
+@pytest.mark.slow
+def test_soak_swept_report_matches_serial(tmp_path, capsys):
+    """The soak migration satellite: the default (swept) soak path and
+    `--serial` produce field-identical per-scenario reports."""
+    import json
+
+    from corro_sim.cli import main as cli_main
+
+    flags = [
+        "--nodes", "12", "--rows", "16", "--cols", "2",
+        "--log-capacity", "64", "--write-rate", "0.6",
+        "--sync-interval", "4",
+        "--scenario", "lossy:p=0.2",
+        "--scenario", "crash_amnesia:nodes=2,at=6,down=4",
+        "--rounds", "48", "--write-rounds", "8", "--chunk", "8",
+    ]
+    rc_swept = cli_main(["soak", *flags])
+    swept = json.loads(capsys.readouterr().out)
+    rc_serial = cli_main(["soak", "--serial", *flags])
+    serial = json.loads(capsys.readouterr().out)
+    assert rc_swept == rc_serial == 0
+    assert swept["sweep"]["lanes"] == 2  # the swept path ran as lanes
+    for ra, rb in zip(swept["scenarios"], serial["scenarios"]):
+        # every per-scenario field the serial loop emits must exist on
+        # the swept path too (consumers never key-error on the default
+        # path); the swept path may add fields (repro_cmd)
+        assert set(rb) <= set(ra), set(rb) - set(ra)
+        for k in ("scenario", "converged_round", "rounds_run",
+                  "heal_round", "recovery_rounds", "poisoned",
+                  "fault_totals"):
+            assert ra[k] == rb[k], (k, ra[k], rb[k])
+        assert ra["invariants"]["ok"] == rb["invariants"]["ok"]
+        if "resilience" in rb:
+            for k in ("recovery_rounds", "rows_lost", "resync_rows",
+                      "wipes"):
+                assert ra["resilience"][k] == rb["resilience"][k], k

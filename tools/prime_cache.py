@@ -207,7 +207,104 @@ def prime_matrix(chunk: int = 8) -> ProgramRecorder:
     # matrix tests/test_multichip.py dispatches inside pytest — keep the
     # config literals below in lockstep with that file.
     _prime_sharded_matrix(jax, jnp, smoke, chunk, rec)
+
+    # ISSUE 12: the vmapped fleet-of-clusters sweep programs — the t1
+    # chaos-matrix leg's grid and the exact plans tests/test_sweep.py
+    # dispatches inside pytest (config literals in lockstep with both).
+    _prime_sweep_matrix(jax, chunk, rec)
     return rec
+
+
+def _prime_sweep_matrix(jax, chunk: int, rec: ProgramRecorder):
+    from corro_sim.config import SimConfig
+    from corro_sim.sweep.engine import sweep_chunk_avals, sweep_runner
+    from corro_sim.sweep.plan import build_plan
+
+    def prime(name, plan):
+        runner = sweep_runner(
+            plan.union_cfg, workload=plan.union_cfg.sweep.workload
+        )
+        rec.compile(name, runner, *sweep_chunk_avals(plan, chunk))
+
+    # the t1.yml chaos-matrix leg: 4 scenarios x 8 seeds, zipf+churn
+    # workload coupled into every lane (32 lanes, one dispatch; the
+    # zipf background keeps every seed's write range across the fault
+    # windows — churn_storm alone leaves sub-window gaps at some seeds)
+    ci_base = SimConfig(num_nodes=16, num_rows=32).validate()
+    prime("sweep/ci-matrix", build_plan(
+        ci_base,
+        ["lossy:p=0.1", "crash_amnesia:nodes=3,at=6,down=6",
+         "stale_rejoin:nodes=2,snap=2,at=6,down=4", "clock_skew"],
+        list(range(8)), rounds=64, write_rounds=8,
+        workload_spec="zipf:alpha=1.1,rate=0.5,keys=24"
+                      "+churn_storm:waves=2,keys=12",
+    ))
+
+    # tests/test_sweep.py: the mixed-scenario plan and the
+    # workload-coupled plan (the wltest 12-node shape)
+    t_base = SimConfig(
+        num_nodes=12, num_rows=16, num_cols=2, log_capacity=64,
+        write_rate=0.6, sync_interval=4, swim_enabled=True,
+    ).validate()
+    mixed_plan = build_plan(
+        t_base,
+        ["lossy:p=0.2", "crash_amnesia:nodes=2,at=6,down=4",
+         "clock_skew:nodes=3"],
+        [0, 1], rounds=48, write_rounds=8,
+    )
+    prime("sweep/test-mixed", mixed_plan)
+    wl_plan = build_plan(
+        t_base,
+        ["crash_amnesia:nodes=2,at=6,down=4",
+         "stale_rejoin:nodes=2,snap=2,at=6,down=4",
+         "stragglers:frac=0.3,period=8,active=2"],
+        [0], rounds=64, write_rounds=8,
+        workload_spec="zipf:alpha=1.1,rate=0.5,keys=12",
+    )
+    prime("sweep/test-workload", wl_plan)
+
+    # the tests' serial TWIN programs: every lane's bit-identity oracle
+    # dispatches a plain run_sim of the lane's own config — full AND
+    # the repair program its convergence tail switches to
+    import jax.numpy as jnp
+
+    from corro_sim.engine.driver import _chunk_runner
+    from corro_sim.engine.state import init_state
+
+    seen: set = set()
+    for plan_, wl in ((mixed_plan, False), (wl_plan, True)):
+        for lane in plan_.lanes:
+            if lane.spec in seen:
+                continue
+            seen.add(lane.spec)
+            cfg = lane.cfg
+            n = cfg.num_nodes
+            state = jax.eval_shape(
+                lambda cfg=cfg: init_state(cfg, seed=0)
+            )
+            avals = (
+                jax.ShapeDtypeStruct((chunk, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((chunk, n), jnp.bool_),
+                jax.ShapeDtypeStruct((chunk, n), jnp.int32),
+                jax.ShapeDtypeStruct((chunk,), jnp.bool_),
+            )
+            wl_avals = (
+                _workload_avals(jax, jnp, chunk, n, cfg.seqs_per_version)
+                if wl else ()
+            )
+            safe = "".join(
+                ch if ch.isalnum() or ch in "._-" else "-"
+                for ch in lane.spec
+            )
+            for repair in (False, True):
+                runner = _chunk_runner(
+                    cfg, repair=repair, packed=True, workload=wl,
+                )
+                rec.compile(
+                    f"sweep-twin/{safe}/"
+                    f"{'repair' if repair else 'full'}",
+                    runner, state, *avals, *wl_avals,
+                )
 
 
 def _prime_node_fault_matrix(jax, jnp, chunk: int, rec: ProgramRecorder):
