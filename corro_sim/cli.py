@@ -4,6 +4,9 @@ Command surface vs the reference's Command enum
 (``crates/corrosion/src/main.rs:626-801``):
 
   run          — run a simulation config to convergence, print a report
+                 (--fork replays a what-if off a twin fork token)
+  twin         — shadow a changeset feed + forecast what-if chaos
+                 (streaming ingest, cursor resume; doc/twin.md)
   bench        — BASELINE benchmark configs 0-7 (default: 0, north star)
   agent        — live cluster: HTTP API + admin socket (+ --pg-addr
                  pgwire, + --tls-* for TLS/mTLS)      [Command::Agent]
@@ -71,7 +74,6 @@ def _cmd_run(args: argparse.Namespace) -> int:
     # --config (+ CORRO_SIM__* env) provides the base; explicit CLI flags
     # win — the reference's TOML < env < CLI precedence
     # (corro-types/src/config.rs:284-291, corrosion/src/main.rs:558-624).
-    cfg = load_config(args.config)
     overrides = {
         field: getattr(args, flag)
         for flag, field in _FLAG_TO_FIELD.items()
@@ -82,7 +84,46 @@ def _cmd_run(args: argparse.Namespace) -> int:
         overrides["shard_log"] = {
             "on": True, "off": False, "auto": None
         }[args.shard_log]
-    cfg = dataclasses.replace(cfg, **overrides).validate()
+    fork_tok = None
+    if getattr(args, "fork", None):
+        # what-if fork repro (corro_sim/engine/twin.py; doc/twin.md):
+        # the run warm-starts from a twin's fork token — the token OWNS
+        # the base shape, so shape flags are refused rather than
+        # silently diverging the repro from the forecast lane it names
+        from corro_sim.io.checkpoint import load_sim_checkpoint
+
+        try:
+            fork_tok = load_sim_checkpoint(args.fork)
+        except (OSError, ValueError) as e:
+            print(f"error: --fork {args.fork!r}: {e}", file=sys.stderr)
+            return 2
+        if not fork_tok.is_fork:
+            print(
+                f"error: {args.fork!r} is a mid-run soak cursor, not a "
+                "fork token (corro-sim twin --fork-out writes one)",
+                file=sys.stderr,
+            )
+            return 2
+        if overrides:
+            print(
+                "error: --fork carries the base config in the token — "
+                f"drop {sorted(overrides)} (only --scenario/--knob/"
+                "--seed/--chunk/--max-rounds compose with a fork)",
+                file=sys.stderr,
+            )
+            return 2
+        if getattr(args, "workload", None):
+            print(
+                "error: --fork does not compose with --workload (the "
+                "forked state IS the load; run_sim resume refuses "
+                "workload schedules)",
+                file=sys.stderr,
+            )
+            return 2
+        cfg = fork_tok.cfg
+    else:
+        cfg = load_config(args.config)
+        cfg = dataclasses.replace(cfg, **overrides).validate()
     mesh = None
     if getattr(args, "mesh", False):
         import jax
@@ -141,6 +182,18 @@ def _cmd_run(args: argparse.Namespace) -> int:
         cfg = dataclasses.replace(
             cfg, faults=dataclasses.replace(cfg.faults, **overrides)
         ).validate()
+    if fork_tok is not None and cfg.node_faults.enabled:
+        # the what-if frame shift (config.shift_node_faults): the forked
+        # state's round counter continues the twin's timeline, so
+        # scenario-relative wipe rounds become absolute (fork + k) —
+        # exactly what the forecast lane this command reproduces baked
+        from corro_sim.config import shift_node_faults
+
+        cfg = dataclasses.replace(
+            cfg, node_faults=shift_node_faults(
+                cfg.node_faults, fork_tok.fork_round
+            )
+        ).validate()
     workload = None
     if getattr(args, "workload", None):
         # the unified spec surface: --scenario X --workload Y in ONE run
@@ -154,11 +207,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
         )
         if scenario is not None:
             scenario.check_workload(workload)
+    round_offset = fork_tok.fork_round if fork_tok is not None else 0
     invariants = None
     if args.check_invariants or args.scenario:
         from corro_sim.faults import InvariantChecker
 
-        invariants = InvariantChecker(cfg)
+        invariants = InvariantChecker(cfg, round_offset=round_offset)
     scorecard = None
     if getattr(args, "scorecard", False) or (
         scenario is not None and cfg.node_faults.enabled
@@ -168,7 +222,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
         from corro_sim.faults import ResilienceScorecard
 
         scorecard = ResilienceScorecard(
-            cfg, scenario=scenario, workload=workload
+            cfg, scenario=scenario, workload=workload,
+            round_offset=round_offset,
         )
     flight = None
     if args.flight_out:
@@ -195,6 +250,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         invariants=invariants,
         scorecard=scorecard,
         workload=workload,
+        resume=(
+            fork_tok.refit(cfg, args.seed, args.chunk)
+            if fork_tok is not None else None
+        ),
         # None defers to the CORRO_SIM_TRANSFER_GUARD env var
         transfer_guard=True if args.transfer_guard else None,
         min_rounds=(
@@ -269,6 +328,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
         report["probe_coverage"] = [s["coverage"] for s in summaries]
     if args.profile_dir:
         report["profile_dir"] = args.profile_dir
+    if fork_tok is not None:
+        report["fork"] = args.fork
+        report["fork_round"] = fork_tok.fork_round
     if scenario is not None:
         report["scenario"] = scenario.spec
         report["heal_round"] = scenario.heal_round
@@ -994,6 +1056,182 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
     return 6 if breaches else 0
 
 
+def _cmd_twin(args: argparse.Namespace) -> int:
+    """`corro-sim twin` — shadow a changeset feed and forecast what-if
+    chaos (corro_sim/engine/twin.py, doc/twin.md).
+
+    Streams the ND-JSON feed chunk by chunk against a frozen scan-window
+    universe, publishes per-chunk convergence + FIFO delivery headlines
+    scored against the feed's own `ts` stamps, writes a resumable cursor
+    checkpoint at chunk boundaries (`--resume` continues a SIGKILL'd
+    twin bit-identically), and — with `--forecast` — forks the live twin
+    state and races the scenario × seed grid as warm-start lanes of ONE
+    vmapped dispatch, graded against the `twin_forecast` threshold
+    section (breach = exit 6, the soak tripwire semantics).
+
+    Exit codes: 0 ok; 2 hostile feed refused (strict mode) / bad args;
+    3 the shadow failed to drain to convergence; 4 poisoned (log ring
+    wrapped); 6 forecast threshold breach.
+    """
+    import dataclasses
+
+    from corro_sim.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    from corro_sim.config import TwinConfig
+    from corro_sim.engine.twin import (
+        fork_twin,
+        load_feed_lines,
+        probe_feed_heads,
+        run_forecast,
+        run_twin,
+        twin_universe,
+    )
+    from corro_sim.faults import load_thresholds
+    from corro_sim.io.checkpoint import load_sim_checkpoint
+    from corro_sim.sweep import parse_grid
+
+    forecast_grid = None
+    if args.forecast:
+        try:
+            forecast_grid = parse_grid(args.forecast)
+            if not forecast_grid["scenario"]:
+                raise ValueError(
+                    "--forecast needs a scenario=... axis"
+                )
+            if forecast_grid["knobs"] != [{}]:
+                raise ValueError(
+                    "--forecast takes scenario/seed axes (knob axes "
+                    "ride the scenario specs or `run --fork --knob`)"
+                )
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+
+    try:
+        lines = load_feed_lines(args.feed)
+    except OSError as e:
+        print(f"error: cannot read feed {args.feed!r}: {e}",
+              file=sys.stderr)
+        return 2
+    resume = None
+    universe = None
+    if args.resume:
+        try:
+            resume = load_sim_checkpoint(args.resume)
+        except (OSError, ValueError) as e:
+            print(f"error: --resume {args.resume!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        # the token is self-contained (the soak --resume posture): the
+        # killed twin's own config continues, shape flags are ignored
+        cfg = resume.cfg
+    else:
+        twin_knobs = TwinConfig(
+            enabled=True,
+            scan_lines=args.scan_lines,
+            chunk_lines=args.chunk_lines,
+            skip_bad=args.skip_bad,
+            drain_rounds=args.drain_rounds,
+            checkpoint_every=args.checkpoint_every,
+        )
+        universe = twin_universe(lines, twin_knobs.scan_lines)
+        heads = probe_feed_heads(lines, universe)
+        overrides = {}
+        if args.log_capacity is not None:
+            overrides["log_capacity"] = args.log_capacity
+        if args.nodes is not None:
+            overrides["num_nodes"] = args.nodes
+        try:
+            cfg = dataclasses.replace(
+                universe.suggest_config(
+                    rounds=int(heads.max(initial=0)) + 1, **overrides
+                ),
+                twin=twin_knobs,
+            ).validate()
+        except AssertionError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+    flight = None
+    if args.flight_out:
+        from corro_sim.obs.flight import FlightRecorder
+
+        flight = FlightRecorder(sink_path=args.flight_out)
+    checkpoint_path = args.checkpoint or (
+        f"{args.out}.ckpt.npz" if args.out else None
+    )
+    try:
+        res = run_twin(
+            feed=args.feed, cfg=cfg, lines=lines, seed=args.seed,
+            checkpoint_path=checkpoint_path, resume=resume,
+            flight=flight, universe=universe,
+            on_chunk=lambda h: print(
+                f"# twin chunk {h['chunk']}: {h['lines']} lines "
+                f"({h['bad']} bad), {h['rounds']} rounds, "
+                f"gap {h['gap']:.0f}",
+                file=sys.stderr, flush=True,
+            ),
+        )
+    except ValueError as e:
+        # the strict hostile-feed refusal: ONE error naming every bad
+        # line, before any sim work (io/traces.py validate_feed)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    report = dict(res.report)
+    if checkpoint_path:
+        report["checkpoint"] = checkpoint_path
+    if args.resume:
+        report["resumed_from"] = args.resume
+    if args.flight_out:
+        wrote = res.flight.sink_active
+        res.flight.close()
+        report["flight"] = args.flight_out if wrote else None
+
+    rc = 0
+    if res.poisoned:
+        rc = 4
+    elif res.converged_round is None:
+        rc = 3
+    if forecast_grid is not None and not res.poisoned:
+        fork_path = args.fork_out or (
+            f"{args.out}.fork.npz" if args.out
+            else (args.feed + ".fork.npz")
+        )
+        tok = fork_twin(res, fork_path, chunk=args.chunk)
+        thresholds = load_thresholds()  # raises on a corrupt golden
+        fc = run_forecast(
+            tok, forecast_grid["scenario"], forecast_grid["seed"],
+            rounds=args.forecast_rounds, max_rounds=args.max_rounds,
+            chunk=args.chunk, thresholds=thresholds,
+            on_chunk=lambda p: print(
+                f"# forecast chunk {p['chunk']}: rounds "
+                f"{p['rounds_done']}, {p['lanes_active']} lanes racing",
+                file=sys.stderr, flush=True,
+            ),
+        )
+        report["fork"] = fork_path
+        report["forecast"] = fc
+        if args.frontier:
+            with open(args.frontier, "w", encoding="utf-8") as f:
+                json.dump(fc["frontier"], f, indent=2)
+                f.write("\n")
+            report["frontier_artifact"] = args.frontier
+        if rc == 0 and fc["frontier"]["breaches"]:
+            rc = 6
+        if rc == 0 and not fc["ok"]:
+            rc = 3
+    elif args.fork_out and not res.poisoned:
+        fork_twin(res, args.fork_out, chunk=args.chunk)
+        report["fork"] = args.fork_out
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    print(json.dumps(report, indent=2))
+    return rc
+
+
 def _cmd_load(args: argparse.Namespace) -> int:
     """`corro-sim load` — drive a production-shaped traffic workload
     (corro_sim/workload/, doc/workloads.md) through the simulator.
@@ -1452,6 +1690,15 @@ def build_parser() -> argparse.ArgumentParser:
              "derive different timelines from different horizons",
     )
     pr.add_argument(
+        "--fork", metavar="TOKEN",
+        help="warm-start from a twin fork token (corro-sim twin "
+             "--fork-out; doc/twin.md): the run resumes the forked "
+             "state under the --scenario applied on top — the what-if "
+             "forecast's one-command serial repro. The token owns the "
+             "base shape (shape flags refused); node-fault rounds "
+             "shift into the fork's absolute frame automatically",
+    )
+    pr.add_argument(
         "--scorecard", action="store_true",
         help="arm the resilience scorecard (faults/scorecard.py): the "
              "report gains a `resilience` block (recovery_rounds, "
@@ -1675,6 +1922,101 @@ def build_parser() -> argparse.ArgumentParser:
     )
     psw.add_argument("--out", help="also write the full report JSON here")
     psw.set_defaults(fn=_cmd_sweep, pipeline=None)
+
+    pt2 = sub.add_parser(
+        "twin",
+        help="shadow a changeset feed (streaming ingest + per-chunk "
+             "headlines) and forecast what-if chaos off a forked twin "
+             "state (doc/twin.md)",
+    )
+    pt2.add_argument(
+        "feed",
+        help="ND-JSON changeset feed (corro-api-types wire shapes — "
+             "io/traces.py module docstring)",
+    )
+    pt2.add_argument(
+        "--scan-lines", type=int, default=0,
+        help="universe scan window in feed lines (0 = scan the whole "
+             "feed); lines naming actors/tables/values outside the "
+             "frozen window quarantine",
+    )
+    pt2.add_argument(
+        "--chunk-lines", type=int, default=64,
+        help="feed lines consumed per shadow chunk (the checkpoint "
+             "cursor granularity)",
+    )
+    pt2.add_argument(
+        "--skip-bad", action="store_true",
+        help="quarantine hostile feed lines with per-reason counters "
+             "(corro_twin_bad_lines_total) + flight annotations instead "
+             "of refusing the whole feed with one up-front error",
+    )
+    pt2.add_argument("--seed", type=int, default=0)
+    pt2.add_argument(
+        "--nodes", type=int,
+        help="shadow cluster size (default: the feed's actor count)",
+    )
+    pt2.add_argument(
+        "--log-capacity", type=int,
+        help="change-log ring size (default: the feed's deepest actor "
+             "history + 1)",
+    )
+    pt2.add_argument(
+        "--drain-rounds", type=int, default=256,
+        help="post-feed round budget chasing gap -> 0",
+    )
+    pt2.add_argument(
+        "--checkpoint",
+        help="cursor-checkpoint path (default: <out>.ckpt.npz when "
+             "--out is set)",
+    )
+    pt2.add_argument(
+        "--checkpoint-every", type=int, default=1,
+        help="feed chunks between cursor checkpoints (0 = none)",
+    )
+    pt2.add_argument(
+        "--resume", metavar="TOKEN",
+        help="continue a SIGKILL'd twin from its cursor token — the "
+             "remaining feed plays out bit-identically to the "
+             "uninterrupted run (shape flags are ignored; the token "
+             "carries the config)",
+    )
+    pt2.add_argument(
+        "--forecast", nargs="+", metavar="AXIS=VALUES",
+        help="what-if grid (the sweep grammar: scenario=crash_amnesia:"
+             "nodes=2,at=4,down=4,lossy:p=0.2 seed=0..3): fork the "
+             "final twin state and race every (scenario x seed) lane "
+             "in ONE vmapped dispatch, graded against the "
+             "twin_forecast threshold section (breach = exit 6)",
+    )
+    pt2.add_argument(
+        "--forecast-rounds", type=int, default=64,
+        help="fault-timeline horizon of each forecast lane (relative "
+             "to the fork)",
+    )
+    pt2.add_argument("--max-rounds", type=int, default=1024,
+                     help="forecast round budget")
+    pt2.add_argument("--chunk", type=int, default=8,
+                     help="forecast dispatch chunk")
+    pt2.add_argument(
+        "--fork-out", metavar="PATH",
+        help="write the fork token here (default <out>.fork.npz with "
+             "--forecast; also usable without --forecast to hand the "
+             "token to `corro-sim run --fork`)",
+    )
+    pt2.add_argument(
+        "--frontier", nargs="?", const="TWIN_frontier.json",
+        metavar="PATH",
+        help="write the projected resilience-frontier artifact "
+             "(per-cell worst/p95 + worst-seed `run --fork` repro "
+             "commands)",
+    )
+    pt2.add_argument("--flight-out",
+                     help="journal the shadow's flight timeline "
+                          "(ND-JSON) with twin_chunk/twin_bad_line "
+                          "annotations")
+    pt2.add_argument("--out", help="also write the report JSON here")
+    pt2.set_defaults(fn=_cmd_twin)
 
     pli = sub.add_parser(
         "lint",

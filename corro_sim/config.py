@@ -240,6 +240,65 @@ def node_faults_from_dict(d: dict) -> NodeFaultConfig:
     return NodeFaultConfig(**d)
 
 
+def shift_node_faults(nf: "NodeFaultConfig", offset: int) -> "NodeFaultConfig":
+    """``nf`` with every round-scheduled fault shifted ``offset`` rounds
+    later — the what-if fork's frame adapter (corro_sim/engine/twin.py).
+
+    Node-fault schedules compare against ``state.round``, which is
+    ABSOLUTE: a twin forked at round R carries ``round == R`` into every
+    forecast lane, so a scenario whose wipe is authored "at relative
+    round k" must schedule it at R + k. Only the wipe/snapshot rounds
+    shift; ``skew`` carries no round and a straggler's duty phase is a
+    function of the absolute round by design (``(round + node) %
+    period`` — the phase an overloaded agent is in does not reset
+    because an operator forked a forecast)."""
+    offset = int(offset)
+    if offset == 0 or not (nf.crash or nf.stale):
+        return nf
+    return dataclasses.replace(
+        nf,
+        crash=tuple((int(n), int(r) + offset) for n, r in nf.crash),
+        stale=tuple(
+            (int(n), int(s) + offset, int(r) + offset)
+            for n, s, r in nf.stale
+        ),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class TwinConfig:
+    """Digital-twin driver knobs (corro_sim/engine/twin.py): how the
+    shadow consumes a changeset feed. HOST-side orchestration only — a
+    twin run dispatches the exact same compiled step/inject programs a
+    plain replay of the same shape would, so this block contributes ZERO
+    SimState leaves and ZERO traced ops whether enabled or not
+    (tests/test_twin.py pins pytree + jaxpr identity across the gate;
+    the acceptance bar: golden 4253/2153 and every primed program stay
+    byte-identical for non-twin configs — and for twin ones too)."""
+
+    enabled: bool = False  # provenance gate: a twin run's config says so
+    # (reports, checkpoint headers); nothing on-device reads it
+    scan_lines: int = 0  # universe scan window in feed lines; 0 = the
+    # whole feed (file mode — a live tail must bound it)
+    chunk_lines: int = 64  # feed lines consumed per shadow chunk (the
+    # checkpoint-cursor granularity)
+    skip_bad: bool = False  # quarantine hostile feed lines (counted in
+    # corro_twin_bad_lines_total{reason}) instead of refusing the feed
+    # with one up-front ValueError
+    drain_rounds: int = 256  # post-feed round budget chasing gap -> 0
+    checkpoint_every: int = 1  # feed chunks between cursor checkpoints
+
+    def validate(self) -> "TwinConfig":
+        assert self.scan_lines >= 0, "twin.scan_lines must be >= 0"
+        assert self.chunk_lines >= 1, "twin.chunk_lines must be >= 1"
+        assert self.drain_rounds >= 0, "twin.drain_rounds must be >= 0"
+        assert self.checkpoint_every >= 0, (
+            "twin.checkpoint_every must be >= 0 (0 = no cursor "
+            "checkpoints)"
+        )
+        return self
+
+
 @dataclasses.dataclass(frozen=True)
 class SweepConfig:
     """Static descriptor of a fleet-of-clusters sweep program
@@ -477,6 +536,13 @@ class SimConfig:
     # zero extra SimState leaves (registry features), bit-identical
     # step program (tests/test_node_faults.py non-perturbation guard).
 
+    # --- digital twin (corro_sim/engine/twin.py) ---
+    twin: TwinConfig = TwinConfig()  # feed-shadow driver knobs (scan
+    # window, chunk size, hostile-line posture, cursor cadence). Pure
+    # host orchestration: zero SimState leaves, zero traced ops, the
+    # step program byte-identical with the block enabled OR disabled
+    # (tests/test_twin.py pins it at the pytree and jaxpr layers).
+
     # --- fleet-of-clusters sweep (corro_sim/sweep/) ---
     sweep: SweepConfig = SweepConfig()  # static gates of the vmapped
     # chaos-matrix program: lanes > 0 stacks the scan carry over a
@@ -571,6 +637,7 @@ class SimConfig:
         )
         self.faults.validate(self.num_nodes)
         self.node_faults.validate(self.num_nodes)
+        self.twin.validate()
         self.sweep.validate()
         if self.sweep.enabled:
             assert not self.node_faults.enabled, (

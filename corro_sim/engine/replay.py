@@ -31,9 +31,44 @@ from corro_sim.config import SimConfig
 from corro_sim.engine.state import SimState, init_state
 from corro_sim.engine.step import sim_step
 from corro_sim.io.traces import EncodedTrace
-from corro_sim.workload.inject import inject_round
+from corro_sim.workload.inject import (
+    inject_round,
+    pad_trace_cells,
+    trace_round_args,
+)
 
-__all__ = ["ReplayResult", "inject_round", "read_table", "replay"]
+__all__ = [
+    "ReplayResult",
+    "inject_round",
+    "make_injector",
+    "make_shadow_step",
+    "read_table",
+    "replay",
+]
+
+
+def make_injector(cfg: SimConfig):
+    """The jitted between-rounds changeset injector — ONE compiled
+    program shared by one-shot :func:`replay` and the streaming digital
+    twin (:mod:`corro_sim.engine.twin`)."""
+    return jax.jit(functools.partial(inject_round, cfg))
+
+
+def make_shadow_step(cfg: SimConfig):
+    """The jitted everyone-up single-round step a replay/twin shadow
+    drives between injections (no fault schedule: the shadow mirrors the
+    feed's reality; what-if faults live in the FORKED forecast lanes,
+    never the shadow itself)."""
+    n = cfg.num_nodes
+    alive = jnp.ones((n,), bool)
+    part = jnp.zeros((n,), jnp.int32)
+    off = jnp.asarray(False)
+
+    @jax.jit
+    def step(state, key):
+        return sim_step(cfg, state, key, alive, part, off)
+
+    return step
 
 
 @dataclasses.dataclass
@@ -71,23 +106,10 @@ def replay(
     )
     # Pad cell planes up to the config's seq capacity (extra lanes are dead:
     # ncells masks them out everywhere).
-    pad = cfg.seqs_per_version - trace.seqs_per_version
-    cells = {
-        name: np.pad(getattr(trace, name), ((0, 0), (0, 0), (0, pad)))
-        for name in ("row", "col", "vr", "cv", "cl")
-    }
+    cells = pad_trace_cells(trace, cfg.seqs_per_version)
     state = init_state(cfg, seed=seed)
-    n = cfg.num_nodes
-    alive = jnp.ones((n,), bool)
-    part = jnp.zeros((n,), jnp.int32)
-    off = jnp.asarray(False)
-
-    inject = jax.jit(functools.partial(inject_round, cfg))
-
-    @jax.jit
-    def step(state, key):
-        return sim_step(cfg, state, key, alive, part, off)
-
+    inject = make_injector(cfg)
+    step = make_shadow_step(cfg)
     root = jax.random.PRNGKey(seed)
     t0 = time.perf_counter()
     metrics_rounds = []
@@ -96,18 +118,7 @@ def replay(
     r = 0
     while r < max_rounds:
         if r < trace.rounds:
-            state = inject(
-                state,
-                jnp.asarray(trace.valid[r]),
-                jnp.asarray(trace.empty[r]),
-                jnp.asarray(trace.ts[r]),
-                jnp.asarray(trace.ncells[r]),
-                jnp.asarray(cells["row"][r]),
-                jnp.asarray(cells["col"][r]),
-                jnp.asarray(cells["vr"][r]),
-                jnp.asarray(cells["cv"][r]),
-                jnp.asarray(cells["cl"][r]),
-            )
+            state = inject(state, *trace_round_args(trace, cells, r))
         state, m = step(state, jax.random.fold_in(root, r))
         r += 1
         if int(m["log_wrapped"]) > 0:

@@ -1,0 +1,651 @@
+"""Digital twin: shadow a live changeset feed and forecast what-if chaos.
+
+The PAPER.md north star is explicit — "the simulator must consume
+`corro-api-types` changesets so real-cluster traces replay on TPU" — and
+this module is the bridge's top layer, composing three subsystems:
+
+- **streaming ingestion** (:class:`corro_sim.io.traces.TraceStream`):
+  an initial scan window freezes the interner/actor universe, then the
+  ND-JSON feed is consumed chunk by chunk against it. The feed is
+  HOSTILE input: malformed lines, unknown actors, out-of-order versions
+  and duplicates quarantine with ``corro_twin_bad_lines_total{reason}``
+  counters (``--skip-bad``) or collect into ONE up-front ValueError
+  (the strict default — the PR 12 all-errors-at-once posture);
+- **the shadow** (:func:`run_twin`): each feed chunk's completed
+  injection slices commit through the replay path
+  (:func:`corro_sim.workload.inject.inject_round` — the identity-tested
+  single injection home) and the everyone-up step runs between them;
+  per-chunk headlines score convergence and FIFO delivery p50/p99
+  against the feed's own ``ts`` stamps (the SWARM
+  replication-latency-under-load comparison). A cursor checkpoint
+  (the PR 10 resume token, ``meta["twin"]``) is written at feed-chunk
+  boundaries, so a SIGKILL'd twin resumes bit-identically mid-feed;
+- **predictive what-if chaos** (:func:`fork_twin` / :func:`run_forecast`):
+  the live twin state is written as a FORK token
+  (:func:`corro_sim.io.checkpoint.save_fork_checkpoint`) and the whole
+  scenario × seed grid races as warm-start lanes of ONE vmapped
+  dispatch (``corro_sim/sweep/`` with ``plan.fork``), each lane
+  bit-identical to a serial ``run_sim`` resumed from the same token
+  (tests/test_twin.py). The frontier grades projected
+  ``recovery_rounds``/``rows_lost`` against the ``twin_forecast``
+  section of ``analysis/golden/resilience_thresholds.json`` — the
+  operator sees the projected blast radius BEFORE the real cluster
+  ever takes the fault.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+
+import jax
+import numpy as np
+
+from corro_sim.config import SimConfig
+from corro_sim.engine.replay import make_injector, make_shadow_step
+from corro_sim.engine.state import init_state
+from corro_sim.io.traces import (
+    TraceStream,
+    TraceUniverse,
+    scan_universe,
+    validate_feed,
+)
+from corro_sim.obs.flight import FlightRecorder
+from corro_sim.utils.metrics import (
+    ROUNDS_BUCKETS,
+    TWIN_BAD_LINES_HELP,
+    TWIN_BAD_LINES_TOTAL,
+    TWIN_DELIVERY_ROUNDS,
+    TWIN_FEED_LINES_TOTAL,
+    TWIN_FORECAST_LANES_TOTAL,
+    counters,
+    histograms,
+)
+from corro_sim.workload.inject import pad_trace_cells, trace_round_args
+
+__all__ = [
+    "TwinResult",
+    "fork_twin",
+    "load_feed_lines",
+    "probe_feed_heads",
+    "run_forecast",
+    "run_twin",
+    "twin_universe",
+]
+
+
+@dataclasses.dataclass
+class TwinResult:
+    """One shadow run's outcome (:func:`run_twin`)."""
+
+    state: object
+    cfg: SimConfig
+    universe: TraceUniverse
+    stream: TraceStream
+    rounds: int  # sim rounds executed (feed + drain), twin-absolute
+    feed_rounds: int  # rounds that carried injected feed versions
+    converged_round: int | None
+    poisoned: bool
+    metrics: dict  # name -> (rounds,) np arrays
+    headlines: list  # per-feed-chunk headline dicts
+    report: dict
+    flight: FlightRecorder
+    seed: int
+    wall_seconds: float
+    checkpoint_path: str | None = None
+
+
+def load_feed_lines(path: str) -> list:
+    """The feed file's lines, UNFILTERED (file mode reads it once; a
+    live tail would hand :func:`run_twin` its own ``lines``). Blank
+    lines ride along so every quarantine diagnostic reports the real
+    file line number — the stream consumes them without effect."""
+    with open(path, encoding="utf-8") as f:
+        return list(f)
+
+
+def twin_universe(lines: list, cfg_scan_lines: int = 0) -> TraceUniverse:
+    """Freeze the closed world from the scan window (``scan_lines == 0``
+    scans the whole feed — the file posture). Lenient: hostile lines in
+    the window are skipped here and classified at feed time."""
+    window = lines if cfg_scan_lines <= 0 else lines[:cfg_scan_lines]
+    return scan_universe(window, lenient=True)
+
+
+def probe_feed_heads(lines: list, universe: TraceUniverse) -> np.ndarray:
+    """Final per-actor version horizons a full feed would reach — sizes
+    the shadow's change-log ring without encoding anything
+    (``encode=False``: classification only, no plane allocation)."""
+    probe = TraceStream(universe)
+    for i in range(0, len(lines), 4096):
+        probe.feed(lines[i:i + 4096], skip_bad=True, encode=False)
+    return probe.heads
+
+
+def run_twin(
+    feed: str | None = None,
+    cfg: SimConfig | None = None,
+    lines: list | None = None,
+    seed: int = 0,
+    checkpoint_path: str | None = None,
+    resume=None,
+    flight: FlightRecorder | None = None,
+    on_chunk=None,
+    universe: TraceUniverse | None = None,
+) -> TwinResult:
+    """Shadow a changeset feed chunk by chunk.
+
+    ``cfg`` defaults to the universe's suggested shape with the feed's
+    final horizons sizing the log ring; pass one to pin the shadow
+    shape (its ``cfg.twin`` block carries the driver knobs — scan
+    window, chunk size, hostile-line posture, checkpoint cadence).
+
+    ``resume``: a twin cursor checkpoint
+    (:func:`corro_sim.io.checkpoint.load_sim_checkpoint`, ``meta
+    ["twin"]``) — the stream cursor, sim state, metrics and headlines
+    all restore, the per-round key stream continues at its absolute
+    round, and the remaining feed plays out BIT-IDENTICALLY to the
+    uninterrupted run (tests/test_twin.py pins report field identity
+    after a mid-feed kill)."""
+    from corro_sim.io.checkpoint import save_sim_checkpoint
+
+    if lines is None:
+        if feed is None:
+            raise ValueError("run_twin needs a feed path or lines")
+        lines = load_feed_lines(feed)
+    if resume is not None and cfg is None:
+        cfg = resume.cfg
+    twin_knobs = (cfg.twin if cfg is not None else None)
+    scan_lines = twin_knobs.scan_lines if twin_knobs else 0
+    if universe is None:  # the CLI hands in the one it already scanned
+        universe = twin_universe(lines, scan_lines)
+    if cfg is None:
+        heads = probe_feed_heads(lines, universe)
+        cfg = universe.suggest_config(
+            rounds=int(heads.max(initial=0)) + 1,
+        )
+        from corro_sim.config import TwinConfig
+
+        cfg = dataclasses.replace(
+            cfg, twin=TwinConfig(enabled=True)
+        ).validate()
+        twin_knobs = cfg.twin
+    assert universe.num_actors <= cfg.num_nodes, (
+        f"feed has {universe.num_actors} actors > {cfg.num_nodes} nodes"
+    )
+    assert universe.seqs_per_version <= cfg.seqs_per_version, (
+        f"feed changesets carry up to {universe.seqs_per_version} "
+        f"cells; cfg.seqs_per_version={cfg.seqs_per_version} is too "
+        "small"
+    )
+
+    # strict posture: classify EVERY line up front and refuse the whole
+    # feed with one error naming each bad line (the PR 12 pattern);
+    # --skip-bad defers to per-chunk quarantine instead. The validation
+    # pass MUST chunk exactly like the run below — classification is
+    # chunk-boundary-dependent (io/traces.py validate_feed docstring)
+    if not twin_knobs.skip_bad:
+        bad = validate_feed(
+            lines, universe, chunk_lines=twin_knobs.chunk_lines
+        )
+        if bad:
+            raise ValueError(
+                f"hostile trace feed ({len(bad)} bad lines — rerun "
+                "with --skip-bad to quarantine them):\n  "
+                + "\n  ".join(
+                    f"line {no}: {reason}: {detail}"
+                    for no, reason, detail in bad
+                )
+            )
+
+    if flight is None:
+        flight = FlightRecorder()
+    flight.set_meta(
+        driver="run_twin", nodes=cfg.num_nodes, seed=seed,
+        feed=feed, chunk_lines=twin_knobs.chunk_lines,
+        skip_bad=twin_knobs.skip_bad,
+    )
+
+    inject = make_injector(cfg)
+    step = make_shadow_step(cfg)
+    root = jax.random.PRNGKey(seed)
+
+    metrics_parts: list = []  # dict-of-arrays blocks to concatenate
+    headlines: list = []
+    rounds = 0
+    feed_rounds = 0
+    chunk_index = 0
+
+    def _consumed_sha(upto: int) -> str:
+        # the consumed prefix's content hash: the resume guard that a
+        # rotated/edited/truncated feed cannot silently pass (the token
+        # only knows cfg/seed/chunking — the FEED is part of the run's
+        # identity too)
+        h = hashlib.sha256()
+        for ln in lines[:upto]:
+            h.update((ln if isinstance(ln, str) else repr(ln)).encode())
+        return h.hexdigest()
+
+    if resume is not None:
+        twin_meta = (resume.meta or {}).get("twin")
+        if not twin_meta:
+            raise ValueError(
+                f"{resume.path!r} is a sim checkpoint but carries no "
+                "twin cursor — resume it via run_sim(resume=...)"
+            )
+        resume.check_compatible(cfg, seed=seed, chunk=1)
+        consumed = int(twin_meta["cursor"].get("lines_seen", 0))
+        if consumed > len(lines):
+            raise ValueError(
+                f"resume cursor has consumed {consumed} feed lines but "
+                f"the feed only has {len(lines)} — this is not the "
+                "feed the token was written against"
+            )
+        want_sha = twin_meta.get("feed_sha")
+        if want_sha is not None and _consumed_sha(consumed) != want_sha:
+            raise ValueError(
+                "resume feed mismatch: the first "
+                f"{consumed} lines differ from the ones the token's "
+                "shadow consumed — resuming against a rotated or "
+                "edited feed would silently diverge"
+            )
+        state = resume.install_state(init_state(cfg, seed=seed))
+        stream = TraceStream.from_cursor(
+            universe, twin_meta["cursor"]
+        )
+        rounds = resume.rounds
+        feed_rounds = int(twin_meta.get("feed_rounds", rounds))
+        chunk_index = int(twin_meta.get("chunk_index", 0))
+        headlines = list(twin_meta.get("headlines", []))
+        if resume.metrics:
+            metrics_parts.append(resume.metrics)
+        flight.ingest_ndjson(resume.flight_lines)
+        flight.set_meta(
+            resumed_from=resume.path, resumed_at_round=rounds,
+        )
+        flight.annotate(rounds, "twin_resume", chunk=chunk_index)
+        counters.inc(
+            "corro_twin_resumes_total",
+            help_="twin shadows continued from a feed-cursor "
+                  "checkpoint (engine/twin.py)",
+        )
+    else:
+        state = init_state(cfg, seed=seed)
+        stream = TraceStream(universe)
+
+    def _save_checkpoint() -> None:
+        metrics_now = _concat_metrics(metrics_parts)
+        save_sim_checkpoint(
+            checkpoint_path, cfg=cfg, state=state, seed=seed,
+            chunk=1, rounds=rounds, next_chunk=rounds, cursor={},
+            metrics=metrics_now, flight=flight,
+            meta={"twin": {
+                "feed": feed,
+                "feed_sha": _consumed_sha(stream.lines_seen),
+                "cursor": stream.cursor(),
+                "chunk_index": chunk_index,
+                "feed_rounds": feed_rounds,
+                "headlines": headlines,
+            }},
+        )
+        flight.annotate(rounds, "twin_checkpoint", chunk=chunk_index,
+                        path=checkpoint_path)
+        counters.inc(
+            "corro_twin_checkpoints_total",
+            help_="feed-cursor checkpoints written (engine/twin.py)",
+        )
+
+    t0 = time.perf_counter()
+    poisoned = False
+    converged = None
+
+    def _exec_round(state):
+        """One shadow step + the ring-wrap poison tripwire — the ONE
+        per-round stanza both the feed loop and the drain loop run."""
+        nonlocal rounds, poisoned
+        state, m = step(state, jax.random.fold_in(root, rounds))
+        rounds += 1
+        m = jax.tree.map(np.asarray, m)
+        if int(m["log_wrapped"]) > 0:
+            # ring-wrap tripwire (engine/step.py): state may be
+            # silently wrong — stop, never report convergence
+            poisoned = True
+            flight.annotate(rounds, "log_wrapped")
+        return state, m
+
+    def _flush_rounds(base: int, ms: list) -> None:
+        if not ms:
+            return
+        stacked = {
+            k: np.stack([mr[k] for mr in ms]) for k in ms[0]
+        }
+        metrics_parts.append(stacked)
+        flight.record_rounds(base + 1, stacked)
+
+    start_line = stream.lines_seen
+    step_width = twin_knobs.chunk_lines
+    while start_line < len(lines) and not poisoned:
+        chunk_lines = lines[start_line:start_line + step_width]
+        start_line += len(chunk_lines)
+        out = stream.feed(chunk_lines, skip_bad=twin_knobs.skip_bad)
+        for line_no, reason, detail in out.bad:
+            counters.inc(
+                TWIN_BAD_LINES_TOTAL,
+                labels=f'{{reason="{reason}"}}',
+                help_=TWIN_BAD_LINES_HELP,
+            )
+            flight.annotate(
+                rounds, "twin_bad_line", line=line_no, reason=reason,
+                detail=detail,
+            )
+        for line_no, _reason, detail in out.late:
+            counters.inc(
+                "corro_twin_late_clears_total",
+                help_="benign late EmptySets dropped (clearing already-"
+                      "injected versions; io/traces.py LATE_CLEAR)",
+            )
+            flight.annotate(
+                rounds, "twin_late_clear", line=line_no, detail=detail,
+            )
+        counters.inc(
+            TWIN_FEED_LINES_TOTAL, n=out.lines,
+            help_="feed lines consumed by the twin shadow "
+                  "(good + quarantined; engine/twin.py)",
+        )
+        chunk_metrics: list = []
+        if out.rounds:
+            cells = pad_trace_cells(out, cfg.seqs_per_version)
+            base = rounds
+            for j in range(out.rounds):
+                state = inject(
+                    state, *trace_round_args(out, cells, j)
+                )
+                state, m = _exec_round(state)
+                feed_rounds = rounds
+                chunk_metrics.append(m)
+                if poisoned:
+                    break
+            _flush_rounds(base, chunk_metrics)
+        headline = {
+            "chunk": chunk_index,
+            "lines": out.lines,
+            "bad": len(out.bad),
+            "rounds": out.rounds,
+            "round": rounds,
+            "gap": (
+                float(chunk_metrics[-1]["gap"]) if chunk_metrics
+                else (
+                    float(headlines[-1]["gap"]) if headlines else 0.0
+                )
+            ),
+            "applied": int(sum(
+                int(mr["fresh"]) + int(mr["sync_versions"])
+                for mr in chunk_metrics
+            )),
+            "feed_ts": (
+                {"lo": out.ts_lo, "hi": out.ts_hi}
+                if out.ts_hi is not None else None
+            ),
+            "sim_ms": round(out.rounds * cfg.round_ms, 3),
+        }
+        headlines.append(headline)
+        flight.annotate(
+            rounds, "twin_chunk",
+            **{k: v for k, v in headline.items()
+               if isinstance(v, (int, float, str, bool)) or v is None},
+        )
+        counters.inc(
+            "corro_twin_chunks_total",
+            help_="feed chunks shadowed (engine/twin.py)",
+        )
+        if on_chunk is not None:
+            on_chunk(dict(headline))
+        chunk_index += 1
+        if (
+            checkpoint_path and twin_knobs.checkpoint_every
+            and chunk_index % twin_knobs.checkpoint_every == 0
+            and not poisoned
+        ):
+            _save_checkpoint()
+
+    # ---- drain: chase gap -> 0 now that the feed is exhausted
+    drained = 0
+    last_gap = float(headlines[-1]["gap"]) if headlines else 0.0
+    if not poisoned and last_gap == 0.0 and rounds > 0:
+        converged = rounds
+    while (
+        not poisoned and converged is None
+        and drained < twin_knobs.drain_rounds
+    ):
+        base = rounds
+        drain_metrics: list = []
+        for _ in range(min(8, twin_knobs.drain_rounds - drained)):
+            state, m = _exec_round(state)
+            drained += 1
+            drain_metrics.append(m)
+            if poisoned:
+                break
+            if float(m["gap"]) == 0.0:
+                converged = rounds
+                break
+        _flush_rounds(base, drain_metrics)
+    if converged is not None:
+        flight.annotate(converged, "converged")
+    wall = time.perf_counter() - t0
+
+    metrics = _concat_metrics(metrics_parts)
+    counters.inc(
+        "corro_twin_rounds_total",
+        # rounds executed IN THIS PROCESS: a resumed run restored
+        # `resume.rounds` of history whose execution the killed process
+        # already counted
+        n=rounds - (resume.rounds if resume is not None else 0),
+        help_="shadow sim rounds executed (feed + drain; "
+              "engine/twin.py)",
+    )
+    if checkpoint_path and twin_knobs.checkpoint_every:
+        # the final cursor: a twin killed AFTER the feed still resumes
+        # into the drain tail instead of replaying the whole feed
+        if not poisoned:
+            _save_checkpoint()
+
+    report = _shadow_report(
+        cfg, stream, metrics, headlines, rounds, feed_rounds,
+        converged, poisoned, feed,
+    )
+    flight.annotate(
+        rounds, "twin_report",
+        **{k: v for k, v in report.items()
+           if isinstance(v, (int, float, str, bool)) or v is None},
+    )
+    return TwinResult(
+        state=state, cfg=cfg, universe=universe, stream=stream,
+        rounds=rounds, feed_rounds=feed_rounds,
+        converged_round=None if poisoned else converged,
+        poisoned=poisoned, metrics=metrics, headlines=headlines,
+        report=report, flight=flight, seed=seed, wall_seconds=wall,
+        checkpoint_path=checkpoint_path,
+    )
+
+
+def _concat_metrics(parts: list) -> dict:
+    if not parts:
+        return {}
+    return {
+        k: np.concatenate([np.asarray(p[k]) for p in parts])
+        for k in parts[0]
+    }
+
+
+def _shadow_report(
+    cfg, stream, metrics, headlines, rounds, feed_rounds, converged,
+    poisoned, feed,
+) -> dict:
+    """The shadow headline block: feed hygiene + convergence + the FIFO
+    delivery read scored against the feed's own clock."""
+    from corro_sim.faults.scorecard import fifo_delivery_quantiles
+
+    delivery = None
+    if metrics:
+        applied = (
+            np.asarray(metrics["fresh"], np.int64)
+            + np.asarray(metrics["sync_versions"], np.int64)
+        )
+        q = fifo_delivery_quantiles(
+            applied, metrics["gap"], 0, rounds
+        )
+        if q is not None:
+            delivery = {
+                "method": "fifo_horizontal_distance",
+                "p50_rounds": q["p50"],
+                "p99_rounds": q["p99"],
+                "p50_ms": round(q["p50"] * cfg.round_ms, 3),
+                "p99_ms": round(q["p99"] * cfg.round_ms, 3),
+                "units": q["units"],
+            }
+            histograms.observe(
+                TWIN_DELIVERY_ROUNDS, q["p99"],
+                help_="shadowed feed delivery p99 in rounds "
+                      "(FIFO horizontal distance; engine/twin.py)",
+                buckets=ROUNDS_BUCKETS,
+            )
+    ts_stamps = [
+        h["feed_ts"] for h in headlines if h.get("feed_ts")
+    ]
+    feed_ts = None
+    if ts_stamps:
+        feed_ts = {
+            "lo": min(t["lo"] for t in ts_stamps),
+            "hi": max(t["hi"] for t in ts_stamps),
+        }
+        feed_ts["span"] = feed_ts["hi"] - feed_ts["lo"]
+    return {
+        "feed": feed,
+        "nodes": cfg.num_nodes,
+        "actors": stream.universe.num_actors,
+        "lines": stream.lines_seen,
+        "bad_lines": stream.bad_lines,
+        "bad_by_reason": dict(stream.counters),
+        "late_clears": stream.late_clears,
+        "chunks": len(headlines),
+        "rounds": rounds,
+        "feed_rounds": feed_rounds,
+        "converged_round": None if poisoned else converged,
+        "poisoned": poisoned,
+        "final_gap": (
+            float(np.asarray(metrics["gap"])[-1]) if metrics else 0.0
+        ),
+        "changes_applied": (
+            int(np.asarray(metrics["fresh"]).sum())
+            + int(np.asarray(metrics["sync_versions"]).sum())
+            if metrics else 0
+        ),
+        # the SWARM comparison: the shadow's wall on the SIM clock next
+        # to the feed's own span on ITS clock (ts units are the feed
+        # producer's — reported verbatim, never converted)
+        "sim_ms": round(rounds * cfg.round_ms, 3),
+        "feed_ts": feed_ts,
+        "shadow_delivery": delivery,
+    }
+
+
+# --------------------------------------------------------------- forecast
+
+def fork_twin(result: TwinResult, path: str,
+              chunk: int = 8) -> "object":
+    """Write the live twin state as a what-if FORK token and return the
+    loaded :class:`~corro_sim.io.checkpoint.SimCheckpoint` — the state
+    every forecast lane (and every serial repro) warm-starts from."""
+    from corro_sim.io.checkpoint import (
+        load_sim_checkpoint,
+        save_fork_checkpoint,
+    )
+
+    save_fork_checkpoint(
+        path, cfg=result.cfg, state=result.state, seed=result.seed,
+        chunk=chunk, fork_round=result.rounds,
+        meta={
+            "feed": result.report.get("feed"),
+            "lines_seen": result.stream.lines_seen,
+        },
+    )
+    return load_sim_checkpoint(path)
+
+
+def run_forecast(
+    fork,
+    scenarios: list,
+    seeds: list,
+    rounds: int = 64,
+    max_rounds: int = 512,
+    chunk: int = 8,
+    thresholds: dict | None = None,
+    on_chunk=None,
+) -> dict:
+    """Race the what-if grid from a fork token: ONE vmapped dispatch of
+    (scenario × seed) warm-start lanes, frontier-graded against the
+    ``twin_forecast`` threshold section. Returns the forecast block the
+    twin CLI publishes; ``breaches`` non-empty is the exit-6 condition
+    (semantics unchanged from the soak/sweep gate)."""
+    from corro_sim.config import FaultConfig, NodeFaultConfig
+    from corro_sim.sweep.engine import run_sweep
+    from corro_sim.sweep.frontier import build_frontier, check_frontier
+    from corro_sim.sweep.plan import build_plan
+
+    base = dataclasses.replace(
+        fork.cfg, faults=FaultConfig(), node_faults=NodeFaultConfig(),
+        write_rate=0.0,
+    ).validate()
+    plan = build_plan(
+        base, scenarios, seeds, rounds=rounds, write_rounds=0,
+        fork=fork,
+    )
+    res = run_sweep(
+        plan, max_rounds=max_rounds, chunk=chunk, on_chunk=on_chunk,
+    )
+    frontier = build_frontier(res.lanes, projected=True)
+    breaches = (
+        check_frontier(frontier, thresholds, section="twin_forecast")
+        if thresholds else []
+    )
+    frontier["thresholds_ok"] = not breaches
+    frontier["breaches"] = breaches
+    for lane in res.lanes:
+        counters.inc(
+            TWIN_FORECAST_LANES_TOTAL,
+            labels=f'{{scenario="{lane.spec.split(":", 1)[0]}"}}',
+            help_="what-if forecast lanes raced from a twin fork, by "
+                  "scenario (engine/twin.py)",
+        )
+    return {
+        "fork": fork.path,
+        "fork_round": fork.fork_round,
+        "lanes": plan.num_lanes,
+        "rounds": rounds,
+        "dispatches": res.dispatches,
+        "wall_seconds": round(res.wall_seconds, 3),
+        "compile_seconds": round(res.compile_seconds, 3),
+        "compile_cache": res.compile_cache,
+        "lanes_detail": [
+            {
+                "scenario": lr.spec,
+                "seed": lr.seed,
+                "cell": lr.cell,
+                "converged_round": lr.converged_round,
+                "rounds_run": lr.rounds,
+                "recovery_rounds": lr.recovery_rounds,
+                "poisoned": lr.poisoned,
+                "rows_lost": (lr.resilience or {}).get("rows_lost"),
+                "resync_rows": (lr.resilience or {}).get("resync_rows"),
+                "invariants_ok": (lr.invariants or {}).get("ok", True),
+                "repro_cmd": lr.repro_cmd,
+            }
+            for lr in res.lanes
+        ],
+        "frontier": frontier,
+        "ok": not breaches and all(
+            lr.converged_round is not None and not lr.poisoned
+            for lr in res.lanes
+        ),
+    }

@@ -47,6 +47,7 @@ from corro_sim.faults.scenarios import (
 from corro_sim.faults.scorecard import (
     ResilienceScorecard,
     check_thresholds,
+    fifo_delivery_quantiles,
     load_thresholds,
 )
 
@@ -57,6 +58,7 @@ __all__ = [
     "InvariantViolation",
     "ResilienceScorecard",
     "check_thresholds",
+    "fifo_delivery_quantiles",
     "load_thresholds",
     "make_scenario",
     "merge_reports",

@@ -73,7 +73,7 @@ class InvariantChecker:
     from the previous chunk's snapshots, so one instance covers one run.
     """
 
-    def __init__(self, cfg):
+    def __init__(self, cfg, round_offset: int = 0):
         self.cfg = cfg
         self.violations: list[InvariantViolation] = []
         self.chunks_checked = 0
@@ -86,7 +86,13 @@ class InvariantChecker:
         # the fault being injected, not a bookkeeping bug. Only the
         # scheduled (node, round) entries are exempt, and only for the
         # chunk the wipe lands in; any other decrease still violates.
-        self._wipe_schedule = tuple(cfg.node_faults.wipe_schedule())
+        # ``round_offset``: what-if forks (corro_sim/engine/twin.py)
+        # schedule faults at ABSOLUTE state rounds (fork round + k)
+        # while the driver frame starts at 0 — map the exemptions back.
+        self._wipe_schedule = tuple(
+            (n, r - int(round_offset))
+            for n, r in cfg.node_faults.wipe_schedule()
+        )
 
     # ------------------------------------------------------------- checks
     def on_chunk(self, state, metrics, alive, part, start_round):

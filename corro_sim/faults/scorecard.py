@@ -48,8 +48,60 @@ __all__ = [
     "THRESHOLDS_PATH",
     "ResilienceScorecard",
     "check_thresholds",
+    "fifo_delivery_quantiles",
     "load_thresholds",
 ]
+
+
+def fifo_delivery_quantiles(
+    applied: np.ndarray, gap: np.ndarray, lo: int, hi: int,
+    first_round: int = 0,
+) -> dict | None:
+    """FIFO horizontal-distance latency quantiles for work entering
+    ABSOLUTE rounds ``[lo, hi]``: unit k's entry round is where the
+    cumulative offered-work curve reaches k, its completion round where
+    the cumulative completed-work curve does. ``applied``/``gap`` are
+    per-round series whose index 0 sits at absolute round
+    ``first_round`` (nonzero on a resumed run).
+
+    Offered work derives from the gap identity ``gap[r] = gap[r-1] +
+    offered[r] - applied[r]`` rather than from the write count: that way
+    a wipe's re-created backlog enters the offered curve at the wipe
+    round (the re-applications that repay it are in the completed curve,
+    so deriving offered from writes alone would understate fault-window
+    latency — the one window the metric exists to grade). Negative
+    deltas (a kill shrinking the live set's gap) clip to zero.
+
+    Shared by the resilience scorecard (fault-window vs steady grading)
+    and the digital twin's shadow delivery headline
+    (corro_sim/engine/twin.py — the SWARM replication-latency read over
+    a replayed feed). An aggregate-flow approximation, exact for FIFO
+    service — stated wherever the number is published."""
+    applied = np.asarray(applied, np.int64)
+    gap = np.asarray(gap, np.float64)
+    if applied.size == 0:
+        return None
+    gap_delta = np.diff(np.concatenate([[0.0], gap]))
+    offered = np.maximum(
+        gap_delta + applied.astype(np.float64), 0.0
+    ).astype(np.int64)
+    ca = np.cumsum(offered)
+    cs = np.cumsum(applied)
+    done = int(min(ca[-1], cs[-1]))
+    if done <= 0:
+        return None
+    units = np.arange(1, done + 1)
+    entry = np.searchsorted(ca, units) + first_round
+    completion = np.searchsorted(cs, units) + first_round
+    in_window = (entry >= lo) & (entry <= hi)
+    if not in_window.any():
+        return None
+    lat = np.maximum(completion - entry, 0)[in_window]
+    return {
+        "p50": float(np.percentile(lat, 50)),
+        "p99": float(np.percentile(lat, 99)),
+        "units": int(in_window.sum()),
+    }
 
 THRESHOLDS_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
@@ -60,10 +112,17 @@ THRESHOLDS_PATH = os.path.join(
 class ResilienceScorecard:
     """Accumulating per-chunk resilience accountant for one run."""
 
-    def __init__(self, cfg, scenario=None, workload=None):
+    def __init__(self, cfg, scenario=None, workload=None,
+                 round_offset: int = 0):
         self.cfg = cfg
         self.scenario = scenario
         self.workload = workload
+        # what-if forks (corro_sim/engine/twin.py): node-fault schedules
+        # on cfg are shifted to ABSOLUTE state rounds (fork round R +
+        # relative round), while the driver's round frame — metrics,
+        # converged_round, `rounds` — starts at 0. This offset maps the
+        # schedule back into the driver frame wherever the two meet.
+        self.round_offset = int(round_offset)
         self.heal_round = (
             scenario.heal_round if scenario is not None else None
         )
@@ -166,7 +225,7 @@ class ResilienceScorecard:
             + [(int(n), int(r), False) for n, _s, r in nf.stale]
         )
         for node, r, amnesia in executed:
-            if r >= rounds:
+            if r - self.round_offset >= rounds:
                 continue
             prev = last.get(node)
             if prev is None or (r, amnesia) > prev:
@@ -188,46 +247,16 @@ class ResilienceScorecard:
         return total
 
     def _delivery_quantiles(self, lo: int, hi: int) -> dict | None:
-        """FIFO horizontal-distance latency quantiles for work entering
-        ABSOLUTE rounds [lo, hi]: unit k's entry round is where the
-        cumulative offered-work curve reaches k, its completion round
-        where the cumulative completed-work curve does. Series index 0
-        is anchored to ``_first_round`` (nonzero on a resumed run).
-
-        Offered work derives from the gap identity
-        ``gap[r] = gap[r-1] + offered[r] - applied[r]`` rather than from
-        the write count: that way a wipe's re-created backlog enters the
-        offered curve at the wipe round (the re-applications that repay
-        it are in the completed curve, so deriving offered from writes
-        alone would understate fault-window latency — the one window the
-        metric exists to grade). Negative deltas (a kill shrinking the
-        live set's gap) clip to zero."""
+        """The shared FIFO horizontal-distance read
+        (:func:`fifo_delivery_quantiles`) over this run's accumulated
+        series — index 0 anchored to ``_first_round`` (nonzero on a
+        resumed run)."""
         if not self._applied:
             return None
-        applied = np.concatenate(self._applied)
-        gap = np.concatenate(self._gap)
-        gap_delta = np.diff(np.concatenate([[0.0], gap]))
-        offered = np.maximum(
-            gap_delta + applied.astype(np.float64), 0.0
-        ).astype(np.int64)
-        ca = np.cumsum(offered)
-        cs = np.cumsum(applied)
-        done = int(min(ca[-1], cs[-1]))
-        if done <= 0:
-            return None
-        units = np.arange(1, done + 1)
-        base = self._first_round or 0
-        entry = np.searchsorted(ca, units) + base
-        completion = np.searchsorted(cs, units) + base
-        in_window = (entry >= lo) & (entry <= hi)
-        if not in_window.any():
-            return None
-        lat = np.maximum(completion - entry, 0)[in_window]
-        return {
-            "p50": float(np.percentile(lat, 50)),
-            "p99": float(np.percentile(lat, 99)),
-            "units": int(in_window.sum()),
-        }
+        return fifo_delivery_quantiles(
+            np.concatenate(self._applied), np.concatenate(self._gap),
+            lo, hi, first_round=self._first_round or 0,
+        )
 
     def _sub_delivery(self, rounds: int) -> dict | None:
         if self.workload is None or self._fault_window is None:
@@ -274,7 +303,7 @@ class ResilienceScorecard:
         # but a wipe whose round already passed still happened
         wipes = sum(
             1 for _n, r in self.cfg.node_faults.wipe_schedule()
-            if r < rounds
+            if r - self.round_offset < rounds
         )
         block = {
             "scenario": (

@@ -359,6 +359,11 @@ def _cluster_from_meta(meta, tripwire=None):
         from corro_sim.config import SweepConfig
 
         cfg["sweep"] = SweepConfig(**sweep)
+    twin = cfg.pop("twin", None)
+    if twin:  # same flattening, same rebuild
+        from corro_sim.config import TwinConfig
+
+        cfg["twin"] = TwinConfig(**twin)
     layout = _rebuild_layout(meta)
     universe = LiveUniverse.restore(
         [_dec_value(v) for v in meta["universe"]["values"]],
@@ -571,6 +576,11 @@ def _simconfig_from_dict(d: dict):
         from corro_sim.config import SweepConfig
 
         d["sweep"] = SweepConfig(**sweep)
+    twin = d.pop("twin", None)
+    if twin:
+        from corro_sim.config import TwinConfig
+
+        d["twin"] = TwinConfig(**twin)
     return SimConfig(**d)
 
 
@@ -601,6 +611,39 @@ class SimCheckpoint:
     def cfg(self):
         return _simconfig_from_dict(self.cfg_dict)
 
+    @property
+    def is_fork(self) -> bool:
+        """Whether this token is a what-if FORK (a twin state presented
+        as a round-0 resume point, :func:`save_fork_checkpoint`) rather
+        than a mid-run soak cursor."""
+        return "fork" in (self.meta or {})
+
+    @property
+    def fork_round(self) -> int:
+        """The twin's absolute ``state.round`` at the fork — the frame
+        offset every round-scheduled what-if fault must shift by
+        (``corro_sim.config.shift_node_faults``). 0 for non-fork
+        tokens."""
+        return int((self.meta or {}).get("fork", {}).get("round", 0))
+
+    def refit(self, cfg, seed: int, chunk: int) -> "SimCheckpoint":
+        """A what-if lane's view of a fork token: the SAME state tensors
+        presented as a round-0 resume point under the lane's
+        scenario-applied config, seed and chunking — what makes
+        ``run_sim(resume=token.refit(...))`` the serial twin of a forked
+        sweep lane (corro_sim/sweep/; state shapes still gate through
+        :meth:`install_state`'s shape/dtype refusal)."""
+        if not self.is_fork:
+            raise ValueError(
+                "refit() is for fork tokens only — a mid-run soak "
+                "cursor's config/seed/chunk are part of its identity "
+                "(check_compatible)"
+            )
+        return dataclasses.replace(
+            self, cfg_dict=_cfg_json(cfg), seed=int(seed),
+            chunk=int(chunk),
+        )
+
     def check_compatible(self, cfg, seed: int, chunk: int) -> None:
         """Refuse to resume under a different config/seed/chunking —
         any of those changes the key stream or the schedule alignment,
@@ -626,6 +669,38 @@ class SimCheckpoint:
         return flax.serialization.from_state_dict(template, base)
 
 
+def _write_sim_token(
+    path: str, *, cfg, flat: dict, seed: int, chunk: int, rounds: int,
+    next_chunk: int, cursor: dict, meta: dict, flight_text: str,
+) -> None:
+    """The ONE sim-token serializer (header shape + npz layout + atomic
+    write-then-rename) — shared by mid-run cursors and fork tokens so a
+    format bump cannot drift between them. A kill mid-save leaves the
+    PREVIOUS file intact, never a torn one."""
+    header = {
+        "format": SIM_CKPT_FORMAT,
+        "kind": "sim",
+        "cfg": _cfg_json(cfg),
+        "seed": int(seed),
+        "chunk": int(chunk),
+        "rounds": int(rounds),
+        "next_chunk": int(next_chunk),
+        "cursor": cursor,
+        "meta": meta,
+    }
+    buf = _io.BytesIO()
+    np.savez_compressed(
+        buf,
+        __meta__=np.frombuffer(json.dumps(header).encode(), np.uint8),
+        __flight__=np.frombuffer(flight_text.encode(), np.uint8),
+        **flat,
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+
+
 def save_sim_checkpoint(
     path: str, *, cfg, state, seed: int, chunk: int, rounds: int,
     next_chunk: int, cursor: dict, metrics: dict, flight=None,
@@ -642,33 +717,45 @@ def save_sim_checkpoint(
     flat = {f"state/{k}": np.asarray(v) for k, v in _flatten(sd).items()}
     for k, v in metrics.items():
         flat[f"metrics/{k}"] = np.asarray(v)
-    header = {
-        "format": SIM_CKPT_FORMAT,
-        "kind": "sim",
-        "cfg": _cfg_json(cfg),
-        "seed": int(seed),
-        "chunk": int(chunk),
-        "rounds": int(rounds),
-        "next_chunk": int(next_chunk),
-        "cursor": cursor,
-        "meta": meta or {},
-    }
-    fl = flight.to_ndjson() if flight is not None else ""
-    buf = _io.BytesIO()
-    np.savez_compressed(
-        buf,
-        __meta__=np.frombuffer(json.dumps(header).encode(), np.uint8),
-        __flight__=np.frombuffer(fl.encode(), np.uint8),
-        **flat,
+    _write_sim_token(
+        path, cfg=cfg, flat=flat, seed=seed, chunk=chunk,
+        rounds=rounds, next_chunk=next_chunk, cursor=cursor,
+        meta=meta or {},
+        flight_text=flight.to_ndjson() if flight is not None else "",
     )
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(buf.getvalue())
-    os.replace(tmp, path)
     _histograms.observe(
         "corro_soak_checkpoint_seconds", _time.perf_counter() - _t0,
         help_="chunk-boundary soak checkpoint wall (state snapshot + "
               "serialize + atomic rename)",
+    )
+
+
+def save_fork_checkpoint(
+    path: str, *, cfg, state, seed: int, chunk: int,
+    fork_round: int, meta: dict | None = None,
+) -> None:
+    """Write a what-if FORK token: the twin's live state as a round-0
+    resume point (``rounds == next_chunk == 0``, empty cursor/metrics),
+    so ``run_sim(resume=token.refit(lane_cfg, lane_seed, chunk))`` and a
+    forked sweep lane start from byte-identical carries with fresh
+    per-lane key streams (corro_sim/engine/twin.py what-if forecasts).
+
+    Volatile registry feature leaves (probe / fault_burst placeholders,
+    ``features/*``) are scrubbed: their SHAPES are keyed by the fault and
+    probe gates the what-if scenario is about to change, and they are
+    instrumentation / fault-machinery state a forecast starts neutral —
+    each lane rebuilds them from its own ``init_state`` template. Core
+    volatile state (gossip rings, SWIM beliefs, in-flight lanes) RIDES:
+    it is part of "the cluster as it stands right now", which is the
+    entire point of a predictive fork."""
+    sd = flax.serialization.to_state_dict(state)
+    flat = _drop_volatile(_flatten(sd), ())  # feature leaves only
+    flat = {f"state/{k}": np.asarray(v) for k, v in flat.items()}
+    _write_sim_token(
+        path, cfg=cfg, flat=flat, seed=seed, chunk=chunk, rounds=0,
+        next_chunk=0, cursor={},
+        meta={"fork": {"round": int(fork_round), **(meta or {})}},
+        flight_text="",
     )
 
 

@@ -203,7 +203,11 @@ def load_config(path: str | None = None, env=None) -> SimConfig:
             values[k] = v
 
     for k, field in fields.items():
-        if k in ("faults", "node_faults"):
+        if k in ("faults", "node_faults", "sweep", "twin"):
+            # nested config blocks: faults/node_faults have their own
+            # env grammar above; sweep/twin are driver-internal (built
+            # by the sweep planner / twin CLI, never from flat env
+            # strings — a raw CORRO_SIM__TWIN value cannot coerce)
             continue
         env_key = ENV_PREFIX + k.upper()
         if env_key in env:

@@ -210,21 +210,62 @@ class EncodedTrace:
         return SimConfig(**fields)
 
 
-def ingest(lines, layout=None) -> EncodedTrace:
-    """Two-phase ingest of an iterable of trace lines (str or parsed).
+@dataclasses.dataclass
+class TraceUniverse:
+    """The frozen closed world a trace is encoded against: actor ordinals,
+    row slots, column planes and the interned value space. Batch ingest
+    discovers one per call; the streaming twin (:class:`TraceStream`)
+    freezes one from an initial scan window and then encodes every later
+    feed chunk against it — lines naming anything OUTSIDE the frozen
+    universe quarantine instead of growing it (a live feed can contain
+    anything; the compiled tensor shapes cannot move)."""
 
-    With a :class:`~corro_sim.schema.TableLayout`, row slots and column
-    planes come from the schema (unknown tables/columns are rejected);
-    without one, the universe is discovered from the trace itself.
-    """
-    lines = list(lines)
-    raw = [ln for ln in lines if isinstance(ln, str)]
-    parsed = iter(parse_trace_lines(raw))  # one bulk pk-decode batch
-    events = [
-        next(parsed) if isinstance(ln, str) else ln for ln in lines
-    ]
+    actors: dict  # actor_id -> ordinal
+    row_of: dict  # (table, pk tuple) -> row slot
+    row_keys: list  # slot -> (table, pk tuple); None = unallocated
+    col_keys: dict  # (table, cid) -> plane index
+    interner: ValueInterner
+    values: list  # rank -> value
+    seqs_per_version: int  # widest changeset the scan window carried
 
-    # --- phase 1: discover the closed world -----------------------------
+    @property
+    def num_actors(self) -> int:
+        return len(self.actors)
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.row_keys)
+
+    @property
+    def num_cols(self) -> int:
+        return max([p + 1 for p in self.col_keys.values()], default=1)
+
+    def col_triples(self) -> list:
+        """The (table, cid, plane) triples in EncodedTrace order."""
+        return sorted((t, c, p) for (t, c), p in self.col_keys.items())
+
+    def suggest_config(self, rounds: int = 0, **overrides):
+        """A :class:`~corro_sim.config.SimConfig` sized for this
+        universe (the twin's shadow shape; ``rounds`` bounds the
+        change-log ring — size it for the whole feed, not the window)."""
+        from corro_sim.config import SimConfig
+
+        fields = dict(
+            num_nodes=max(2, self.num_actors),
+            num_rows=max(1, self.num_rows),
+            num_cols=self.num_cols,
+            seqs_per_version=self.seqs_per_version,
+            log_capacity=max(2, rounds),
+            write_rate=0.0,
+        )
+        fields.update(overrides)
+        return SimConfig(**fields)
+
+
+def _discover(events, layout=None) -> tuple:
+    """Phase 1 (the closed world) over parsed events → ``(TraceUniverse,
+    per-actor version books)`` — shared by batch :func:`ingest` and the
+    streaming scan window (:func:`scan_universe`)."""
     actors: dict[str, int] = {}
     col_keys: dict[tuple, int] = {}
     if layout is not None:
@@ -234,6 +275,7 @@ def ingest(lines, layout=None) -> EncodedTrace:
                 col_keys[(t.name, c.name)] = layout.col_index(t.name, c.name)
     pk_raw: set = set()
     interner = ValueInterner()
+    seen_vals: list = []
     per_actor: dict[str, dict[int, object]] = {}
 
     for ev in events:
@@ -266,6 +308,7 @@ def ingest(lines, layout=None) -> EncodedTrace:
                         (c.table, c.cid), layout.col_index(c.table, c.cid)
                     )
                 interner.add(c.val)
+                seen_vals.append(c.val)
 
     if layout is None:
         # Row slots ordered by (table, pk) with SQLite value comparison on
@@ -286,11 +329,14 @@ def ingest(lines, layout=None) -> EncodedTrace:
             row_keys[slot] = k
     interner.freeze()
     values = [None] * len(interner)
-
-    # --- phase 2: encode -------------------------------------------------
-    a = len(actors)
-    heads = {aid: (max(book) if book else 0) for aid, book in per_actor.items()}
-    rounds = max(heads.values(), default=0)
+    for v in seen_vals:
+        rk = interner.rank(v)
+        if values[rk] is None:
+            # first-encountered representative per conflict key — bool
+            # and int share a key (crsql_conflict_key(True) == (..., 1))
+            # and read_table decodes through this list, so last-wins
+            # would flip 1 -> True in replay output
+            values[rk] = v
     s = max(
         (
             len(ev.changes)
@@ -300,7 +346,73 @@ def ingest(lines, layout=None) -> EncodedTrace:
         ),
         default=1,
     )
-    s = max(1, s)
+    universe = TraceUniverse(
+        actors=actors, row_of=row_of, row_keys=row_keys,
+        col_keys=col_keys, interner=interner, values=values,
+        seqs_per_version=max(1, s),
+    )
+    return universe, per_actor
+
+
+def scan_universe(lines, layout=None, lenient: bool = False) -> TraceUniverse:
+    """Freeze a :class:`TraceUniverse` from a scan window of trace lines
+    (the streaming twin's phase 1 — nothing is encoded).
+
+    ``lenient``: a twin's scan window is the same hostile feed the
+    stream later consumes — unparseable lines are skipped here (they
+    quarantine with a proper reason at feed/validate time) and a
+    duplicated Full changeset keeps its first copy (discovery only
+    collects names and values; the duplicate itself is classified
+    later). Strict mode (the batch-ingest posture) raises on both."""
+    lines = list(lines)
+    if not lenient:
+        events = parse_trace_lines(lines)
+    else:
+        events = []
+        seen: set = set()
+        for ln in lines:
+            try:
+                ev = parse_trace_line(ln) if isinstance(ln, str) else ln
+                if not isinstance(ev, (TraceChangeset, TraceEmpty)):
+                    raise TypeError(f"not a trace event: {type(ev)!r}")
+            except Exception:
+                continue  # classified as `malformed` at feed time
+            if isinstance(ev, TraceChangeset):
+                key = (ev.actor_id, ev.version)
+                if key in seen:
+                    continue  # classified as `duplicate` at feed time
+                seen.add(key)
+            events.append(ev)
+    universe, _ = _discover(events, layout=layout)
+    return universe
+
+
+def ingest(lines, layout=None) -> EncodedTrace:
+    """Two-phase ingest of an iterable of trace lines (str or parsed).
+
+    With a :class:`~corro_sim.schema.TableLayout`, row slots and column
+    planes come from the schema (unknown tables/columns are rejected);
+    without one, the universe is discovered from the trace itself.
+    """
+    lines = list(lines)
+    raw = [ln for ln in lines if isinstance(ln, str)]
+    parsed = iter(parse_trace_lines(raw))  # one bulk pk-decode batch
+    events = [
+        next(parsed) if isinstance(ln, str) else ln for ln in lines
+    ]
+
+    # --- phase 1: discover the closed world -----------------------------
+    uni, per_actor = _discover(events, layout=layout)
+    actors = uni.actors
+    col_keys = uni.col_keys
+    row_of, row_keys = uni.row_of, uni.row_keys
+    interner, values = uni.interner, uni.values
+
+    # --- phase 2: encode -------------------------------------------------
+    a = len(actors)
+    heads = {aid: (max(book) if book else 0) for aid, book in per_actor.items()}
+    rounds = max(heads.values(), default=0)
+    s = uni.seqs_per_version
 
     valid = np.zeros((rounds, a), bool)
     empty = np.zeros((rounds, a), bool)
@@ -340,10 +452,8 @@ def ingest(lines, layout=None) -> EncodedTrace:
                     vr[r, ai, j] = np.iinfo(np.int32).min  # NEG: cl-only
                 else:
                     col[r, ai, j] = col_keys[(c.table, c.cid)]
-                    rk = interner.rank(c.val)
-                    vr[r, ai, j] = rk
-                    if values[rk] is None:
-                        values[rk] = c.val
+                    vr[r, ai, j] = interner.rank(c.val)  # values[] is
+                    # pre-filled by _discover
 
     return EncodedTrace(
         actors=list(actors),
@@ -369,6 +479,350 @@ def ingest(lines, layout=None) -> EncodedTrace:
 def ingest_file(path, layout=None) -> EncodedTrace:
     with open(path) as f:
         return ingest((ln for ln in f if ln.strip()), layout=layout)
+
+
+# --------------------------------------------------------- streaming tail
+#
+# The digital twin (corro_sim/engine/twin.py) does not get the whole
+# trace up front: it tails an ND-JSON feed chunk by chunk against the
+# universe a scan window froze. A feed is HOSTILE INPUT — a live
+# corrosion agent's broadcast stream can carry actors, tables, values or
+# version orderings the scan window never promised — so every line is
+# classified and the bad ones QUARANTINE with a reason instead of
+# crashing the shadow (counted in corro_twin_bad_lines_total{reason}).
+
+# quarantine reasons, the corro_twin_bad_lines_total label set
+BAD_MALFORMED = "malformed"  # unparseable JSON / wrong field shapes
+BAD_UNKNOWN_ACTOR = "unknown_actor"  # actor outside the frozen universe
+BAD_UNKNOWN_ROW = "unknown_row"  # (table, pk) outside the frozen slots
+BAD_UNKNOWN_COLUMN = "unknown_column"  # cid outside the frozen planes
+BAD_UNKNOWN_VALUE = "unknown_value"  # value outside the frozen interner
+BAD_STALE_VERSION = "stale_version"  # at/below the injected horizon
+# (out-of-order arrival across an already-encoded chunk boundary)
+BAD_DUPLICATE = "duplicate"  # second Full changeset for one version
+BAD_OVERSIZED = "oversized"  # more cells than the frozen seq capacity
+
+BAD_REASONS = (
+    BAD_MALFORMED, BAD_UNKNOWN_ACTOR, BAD_UNKNOWN_ROW,
+    BAD_UNKNOWN_COLUMN, BAD_UNKNOWN_VALUE, BAD_STALE_VERSION,
+    BAD_DUPLICATE, BAD_OVERSIZED,
+)
+
+# NOT a quarantine reason: an EmptySet entirely at/below the horizon is
+# how a NORMAL feed looks — overwritten-version clearings broadcast
+# AFTER the superseding version (store_empty_changeset), so the clear
+# routinely lands a chunk behind the content it compacts. The
+# superseding version is already injected, so the clear is dropped as
+# value-neutral for convergence (the uncompacted cells sync identically
+# — LWW supersedes them on arrival) and COUNTED, never refused.
+LATE_CLEAR = "late_clear"
+
+
+@dataclasses.dataclass
+class StreamChunk:
+    """One feed chunk's encoded injection slices, ``(rounds, A, [S])``
+    shaped exactly like the matching :class:`EncodedTrace` planes —
+    slice ``j`` commits each actor's next pending version (replay's
+    per-round injection form, :func:`corro_sim.workload.inject.
+    inject_round`)."""
+
+    rounds: int
+    valid: np.ndarray
+    empty: np.ndarray
+    ts: np.ndarray
+    delete: np.ndarray
+    ncells: np.ndarray
+    row: np.ndarray
+    col: np.ndarray
+    vr: np.ndarray
+    cv: np.ndarray
+    cl: np.ndarray
+    bad: list  # (line_no, reason, detail) quarantined this chunk
+    lines: int  # feed lines consumed this chunk (good + bad)
+    late: list = dataclasses.field(default_factory=list)  # benign
+    # late clears dropped this chunk (module comment at LATE_CLEAR)
+    ts_lo: int | None = None  # earliest `ts` stamp absorbed this chunk
+    ts_hi: int | None = None  # latest — (ts_lo, ts_hi) is the chunk's
+    # span on the FEED's own clock, what the shadow's sim wall is
+    # scored against (the SWARM replication-latency comparison)
+
+
+class TraceStream:
+    """Incremental phase-2 encoder over a frozen :class:`TraceUniverse`.
+
+    The stream keeps one cursor per actor — the *injected horizon*
+    ``heads[a]`` (highest version already encoded) — and drains fully at
+    every :meth:`feed` boundary: a chunk's events raise each actor's
+    horizon to the highest version the chunk carried, with never-seen
+    versions below the new horizon encoded as cleared gaps (the batch
+    :func:`ingest` closed-world rule, applied per chunk). A version
+    arriving BELOW its actor's horizon is therefore out-of-order across
+    a boundary the shadow already committed — it quarantines
+    (``stale_version``) rather than rewriting injected history.
+
+    Restart cursor: ``heads``/``counters``/``lines_seen`` are the whole
+    resumable state (the pending book is empty between feeds), so a
+    SIGKILL'd twin stores them in its checkpoint token and resumes the
+    feed bit-identically (:mod:`corro_sim.engine.twin`).
+    """
+
+    def __init__(self, universe: TraceUniverse, heads=None,
+                 counters: dict | None = None, lines_seen: int = 0,
+                 late_clears: int = 0):
+        self.universe = universe
+        self.heads = (
+            np.zeros(universe.num_actors, np.int64) if heads is None
+            else np.asarray(heads, np.int64).copy()
+        )
+        self.counters: dict[str, int] = dict(counters or {})
+        self.lines_seen = int(lines_seen)
+        self.late_clears = int(late_clears)
+
+    # ------------------------------------------------------------ cursor
+    def cursor(self) -> dict:
+        """The JSON-serializable resume cursor."""
+        return {
+            "heads": [int(h) for h in self.heads],
+            "counters": dict(self.counters),
+            "lines_seen": self.lines_seen,
+            "late_clears": self.late_clears,
+        }
+
+    @classmethod
+    def from_cursor(cls, universe: TraceUniverse, cur: dict):
+        return cls(
+            universe, heads=cur.get("heads"),
+            counters=cur.get("counters"),
+            lines_seen=cur.get("lines_seen", 0),
+            late_clears=cur.get("late_clears", 0),
+        )
+
+    @property
+    def bad_lines(self) -> int:
+        return sum(self.counters.values())
+
+    # ---------------------------------------------------- classification
+    def _classify(self, ev, book: dict) -> tuple[str, str] | None:
+        """One parsed event against the frozen universe + horizon —
+        ``(reason, detail)`` when the line must quarantine, else None."""
+        uni = self.universe
+        if ev.actor_id not in uni.actors:
+            return BAD_UNKNOWN_ACTOR, f"actor {ev.actor_id}"
+        ai = uni.actors[ev.actor_id]
+        head = int(self.heads[ai])
+        if isinstance(ev, TraceEmpty):
+            if ev.versions[1] <= head:
+                # benign (module comment at LATE_CLEAR) — never a
+                # strict-mode refusal, counted apart from quarantines
+                return LATE_CLEAR, (
+                    f"empty versions {ev.versions} <= injected horizon "
+                    f"{head} of actor {ev.actor_id}"
+                )
+            return None
+        if ev.version <= head:
+            return BAD_STALE_VERSION, (
+                f"version {ev.version} <= injected horizon {head} of "
+                f"actor {ev.actor_id}"
+            )
+        pending = book.get(ai, {}).get(ev.version)
+        if isinstance(pending, TraceChangeset):
+            return BAD_DUPLICATE, (
+                f"version {ev.version} of actor {ev.actor_id} already "
+                "in this chunk"
+            )
+        if len(ev.changes) > uni.seqs_per_version:
+            return BAD_OVERSIZED, (
+                f"{len(ev.changes)} cells > frozen seq capacity "
+                f"{uni.seqs_per_version}"
+            )
+        for c in ev.changes:
+            if (c.table, c.pk) not in uni.row_of:
+                return BAD_UNKNOWN_ROW, f"row ({c.table}, {c.pk!r})"
+            if c.cid != DELETE_CID:
+                if (c.table, c.cid) not in uni.col_keys:
+                    return BAD_UNKNOWN_COLUMN, (
+                        f"column ({c.table}, {c.cid})"
+                    )
+                try:
+                    uni.interner.rank(c.val)
+                except KeyError:
+                    return BAD_UNKNOWN_VALUE, f"value {c.val!r}"
+        return None
+
+    # ------------------------------------------------------------- feed
+    def feed(self, lines, skip_bad: bool = False,
+             encode: bool = True) -> StreamChunk:
+        """Consume one chunk of feed lines (str or pre-parsed events) and
+        encode the injection slices they complete.
+
+        ``skip_bad=False`` (the strict posture): ALL bad lines in the
+        chunk are collected into ONE ValueError — nothing is encoded and
+        the stream cursor does not move, so a validation failure is
+        up-front and side-effect-free. ``skip_bad=True`` (``corro-sim
+        twin --skip-bad``): bad lines quarantine with per-reason
+        counters and the good lines encode normally.
+
+        Blank/whitespace lines are consumed without effect — the cursor
+        counts them, so quarantine diagnostics report FILE line numbers
+        when the caller passes the file's lines unfiltered
+        (:func:`corro_sim.engine.twin.load_feed_lines` does).
+
+        ``encode=False``: classify and advance the horizon without
+        allocating or filling the injection planes (the validation /
+        head-probe passes — same verdicts, no throwaway tensors)."""
+        uni = self.universe
+        a = uni.num_actors
+        s = uni.seqs_per_version
+        book: dict[int, dict[int, object]] = {}
+        bad: list = []
+        late: list = []
+        n_lines = 0
+        ts_lo: int | None = None
+        ts_hi: int | None = None
+        for ln in lines:
+            line_no = self.lines_seen + n_lines + 1
+            n_lines += 1
+            if isinstance(ln, str) and not ln.strip():
+                continue  # blank feed line: counted, never classified
+            try:
+                ev = parse_trace_line(ln) if isinstance(ln, str) else ln
+                if not isinstance(ev, (TraceChangeset, TraceEmpty)):
+                    raise TypeError(f"not a trace event: {type(ev)!r}")
+            except Exception as e:  # hostile bytes: anything can be here
+                bad.append((line_no, BAD_MALFORMED,
+                            f"{type(e).__name__}: {e}"))
+                continue
+            verdict = self._classify(ev, book)
+            if verdict is not None:
+                if verdict[0] == LATE_CLEAR:
+                    late.append((line_no, *verdict))
+                else:
+                    bad.append((line_no, *verdict))
+                continue
+            ai = uni.actors[ev.actor_id]
+            abook = book.setdefault(ai, {})
+            if ev.ts is not None:
+                ts_lo = int(ev.ts) if ts_lo is None else min(
+                    ts_lo, int(ev.ts)
+                )
+                ts_hi = int(ev.ts) if ts_hi is None else max(
+                    ts_hi, int(ev.ts)
+                )
+            if isinstance(ev, TraceEmpty):
+                lo = max(ev.versions[0], int(self.heads[ai]) + 1)
+                for v in range(lo, ev.versions[1] + 1):
+                    # last-wins, the batch-ingest book rule: a clearing
+                    # that follows a Full changeset compacts it (the
+                    # overwritten-version clearing a real feed emits);
+                    # the [lo, hi] clip only skips already-injected
+                    # versions (the stale part of a straddling range)
+                    abook[v] = -1 if ev.ts is None else int(ev.ts)
+            else:
+                abook[ev.version] = ev
+        if bad and not skip_bad:
+            raise ValueError(
+                f"hostile trace feed ({len(bad)} bad lines):\n  "
+                + "\n  ".join(
+                    f"line {no}: {reason}: {detail}"
+                    for no, reason, detail in bad
+                )
+            )
+        self.lines_seen += n_lines
+        for _no, reason, _detail in bad:
+            self.counters[reason] = self.counters.get(reason, 0) + 1
+        self.late_clears += len(late)
+
+        # ---- encode: raise each actor's horizon to its chunk max;
+        # unseen versions below the new horizon are lost-gap cleared
+        new_heads = self.heads.copy()
+        for ai, abook in book.items():
+            new_heads[ai] = max(int(new_heads[ai]), max(abook))
+        if not encode:
+            self.heads = new_heads
+            return StreamChunk(
+                rounds=0, valid=None, empty=None, ts=None, delete=None,
+                ncells=None, row=None, col=None, vr=None, cv=None,
+                cl=None, bad=bad, lines=n_lines, late=late,
+                ts_lo=ts_lo, ts_hi=ts_hi,
+            )
+        slices = int((new_heads - self.heads).max(initial=0))
+        valid = np.zeros((slices, a), bool)
+        empty = np.zeros((slices, a), bool)
+        ts = np.full((slices, a), -1, np.int32)
+        delete = np.zeros((slices, a), bool)
+        ncells = np.zeros((slices, a), np.int32)
+        row = np.zeros((slices, a, s), np.int32)
+        col = np.zeros((slices, a, s), np.int32)
+        vr = np.zeros((slices, a, s), np.int32)
+        cv = np.zeros((slices, a, s), np.int32)
+        cl = np.ones((slices, a, s), np.int32)
+        for ai in range(a):
+            abook = book.get(ai, {})
+            for j in range(int(new_heads[ai] - self.heads[ai])):
+                v = int(self.heads[ai]) + 1 + j
+                ev = abook.get(v)
+                valid[j, ai] = True
+                if not isinstance(ev, TraceChangeset):
+                    # cleared (EmptySet) or a gap this chunk lost — the
+                    # batch-ingest closed-world rule, per chunk
+                    empty[j, ai] = True
+                    if ev is not None:
+                        ts[j, ai] = ev
+                    continue
+                chs = sorted(ev.changes, key=lambda c: c.seq)[:s]
+                ncells[j, ai] = len(chs)
+                delete[j, ai] = (
+                    all(c.cid == DELETE_CID for c in chs) and bool(chs)
+                )
+                for k, c in enumerate(chs):
+                    row[j, ai, k] = uni.row_of[(c.table, c.pk)]
+                    cv[j, ai, k] = c.col_version
+                    cl[j, ai, k] = c.cl
+                    if c.cid == DELETE_CID:
+                        col[j, ai, k] = 0
+                        vr[j, ai, k] = np.iinfo(np.int32).min
+                    else:
+                        col[j, ai, k] = uni.col_keys[(c.table, c.cid)]
+                        vr[j, ai, k] = uni.interner.rank(c.val)
+        self.heads = new_heads
+        return StreamChunk(
+            rounds=slices, valid=valid, empty=empty, ts=ts,
+            delete=delete, ncells=ncells, row=row, col=col, vr=vr,
+            cv=cv, cl=cl, bad=bad, lines=n_lines, late=late,
+            ts_lo=ts_lo, ts_hi=ts_hi,
+        )
+
+
+def validate_feed(lines, universe: TraceUniverse,
+                  chunk_lines: int = 4096) -> list:
+    """Classify EVERY line of a feed against the frozen universe without
+    encoding anything — the twin's strict up-front validation pass: all
+    malformed / unknown-actor / out-of-order / duplicate lines across
+    the whole feed come back as one list, raised as ONE ValueError by
+    the caller (the PR 12 all-errors-at-once posture).
+
+    ``chunk_lines`` must be the chunking the REAL run will use:
+    classification is chunk-boundary-dependent (an out-of-order version
+    inside one chunk reorders through the pending book; across a
+    boundary it is stale), so validating under a different chunking
+    would pass feeds the run then refuses mid-stream, or vice versa."""
+    probe = TraceStream(universe)
+    bad: list = []
+    for chunk in _chunked(lines, max(1, chunk_lines)):
+        out = probe.feed(chunk, skip_bad=True, encode=False)
+        bad.extend(out.bad)
+    return bad
+
+
+def _chunked(it, n: int):
+    buf: list = []
+    for x in it:
+        buf.append(x)
+        if len(buf) >= n:
+            yield buf
+            buf = []
+    if buf:
+        yield buf
 
 
 def dump_changeset(

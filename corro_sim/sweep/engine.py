@@ -119,10 +119,20 @@ def _lane_slice(state, lane: int):
 def build_lane_states(plan):
     """The stacked ``(L, ...)`` carry: each lane's ``init_state`` under
     the UNION config (identical pytree structure across lanes) with its
-    own seed and its own knob values swapped into the sweep leaf."""
+    own seed and its own knob values swapped into the sweep leaf.
+
+    A FORK plan (what-if forecasts, corro_sim/engine/twin.py) installs
+    the fork token's state over every lane's template first — the same
+    ``SimCheckpoint.install_state`` merge the lane's serial twin
+    (``run_sim(resume=token.refit(...))``) performs, so the warm-start
+    carries are byte-identical by construction; feature leaves the token
+    scrubbed (probe/burst placeholders, registry features) stay at their
+    per-lane init values on both sides."""
     states = []
     for lane in plan.lanes:
         st = init_state(plan.union_cfg, seed=lane.seed)
+        if plan.fork is not None:
+            st = plan.fork.install_state(st)
         feats = dict(st.features)
         feats["sweep_knobs"] = {
             k: jnp.asarray(v) for k, v in lane.knobs.items()
@@ -273,12 +283,14 @@ def run_sweep(
     roots = [jax.random.PRNGKey(lane.seed) for lane in lanes]
     cards = [
         ResilienceScorecard(
-            lane.cfg, scenario=lane.scenario, workload=lane.workload
+            lane.cfg, scenario=lane.scenario, workload=lane.workload,
+            round_offset=plan.fork_round,
         ) if scorecards else None
         for lane in lanes
     ]
     checks = [
-        InvariantChecker(lane.cfg) if invariants else None
+        InvariantChecker(lane.cfg, round_offset=plan.fork_round)
+        if invariants else None
         for lane in lanes
     ]
 
@@ -428,7 +440,7 @@ def run_sweep(
             ),
             repro_cmd=lane.repro_cmd(
                 plan.base_cfg, plan.rounds, plan.write_rounds,
-                max_rounds, chunk,
+                max_rounds, chunk, fork_path=plan.fork_path,
             ),
             state=lane_state,
         ))

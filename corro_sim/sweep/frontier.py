@@ -31,10 +31,16 @@ def _p95(values: list) -> float | None:
         if values else None
 
 
-def build_frontier(lane_results: list) -> dict:
+def build_frontier(lane_results: list, projected: bool = False) -> dict:
     """Aggregate :class:`~corro_sim.sweep.engine.LaneResult`s into the
     frontier artifact: one cell per (scenario spec × knob overrides),
-    statistics across that cell's seeds."""
+    statistics across that cell's seeds.
+
+    ``projected=True`` marks a what-if FORECAST frontier (lanes
+    warm-started from a twin fork, corro_sim/engine/twin.py): the
+    numbers are projections of faults the real cluster has NOT taken,
+    and the artifact says so — a dashboard must never present a
+    forecast as a measurement."""
     cells: dict[str, list] = {}
     for lane in lane_results:
         cells.setdefault(lane.cell, []).append(lane)
@@ -107,17 +113,28 @@ def build_frontier(lane_results: list) -> dict:
                 (lr.invariants or {}).get("ok", True) for lr in members
             ),
         })
-    return {"cells": sorted(out, key=lambda c: c["cell"])}
+    doc = {"cells": sorted(out, key=lambda c: c["cell"])}
+    if projected:
+        doc["projected"] = True
+    return doc
 
 
-def check_frontier(frontier: dict, thresholds: dict) -> list[str]:
+def check_frontier(frontier: dict, thresholds: dict,
+                   section: str | None = None) -> list[str]:
     """Grade the frontier against the committed threshold golden —
     quantile-over-seeds semantics. Per cell, the ``default`` table
     merges under the scenario's base-name entry (the
     ``check_thresholds`` rule); ``recovery_rounds_worst_max`` falls
     back to the serial ``recovery_rounds_max`` bound so a scenario
     graded before the sweep era keeps its tripwire. Every breach names
-    the worst seed's one-command repro."""
+    the worst seed's one-command repro.
+
+    ``section``: grade against a sub-table of the golden instead of its
+    top level — the twin's what-if forecasts use ``"twin_forecast"``
+    (projected bounds live apart from measured ones; an absent section
+    gates nothing, exit-6 semantics unchanged where it exists)."""
+    if section is not None:
+        thresholds = thresholds.get(section) or {}
     breaches: list[str] = []
     for cell in frontier.get("cells", []):
         base = (cell["scenario"] or "").split(":", 1)[0]

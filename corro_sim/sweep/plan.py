@@ -30,7 +30,13 @@ from __future__ import annotations
 
 import dataclasses
 
-from corro_sim.config import FaultConfig, NodeFaultConfig, SimConfig, SweepConfig
+from corro_sim.config import (
+    FaultConfig,
+    NodeFaultConfig,
+    SimConfig,
+    SweepConfig,
+    shift_node_faults,
+)
 from corro_sim.faults.scenarios import make_scenario
 from corro_sim.sweep.knobs import SWEEP_KNOB_FIELDS, lane_knobs
 
@@ -81,25 +87,34 @@ class SweepLane:
     )
 
     def repro_cmd(self, base_cfg, rounds: int, write_rounds: int,
-                  max_rounds: int, chunk: int) -> str:
+                  max_rounds: int, chunk: int,
+                  fork_path: str | None = None) -> str:
         """The ONE serial command that reproduces this lane — what a
         failing frontier cell prints next to its worst seed. ``rounds``
         pins the lane's fault-timeline horizon (``--scenario-rounds``):
         wave-shaped generators truncate against it, so the horizon is
         part of the timeline's identity even though the canonical spec
-        pins every resolved parameter."""
+        pins every resolved parameter.
+
+        ``fork_path``: a what-if forecast lane reproduces as ``run
+        --fork <token>`` — the base config, seed-independent state and
+        fork-round frame all ride the token, so base-shape flags are
+        omitted (``run --fork`` refuses them)."""
         defaults = SimConfig()
         cmd = f"corro-sim run --scenario '{self.spec}' --seed {self.seed}"
-        for flag, field in self._REPRO_FLAGS:
-            v = getattr(base_cfg, field)
-            if v == getattr(defaults, field):
-                continue
-            if isinstance(v, bool):
-                if v:
-                    cmd += f" {flag}"
-            else:
-                cmd += f" {flag} {v:g}" if isinstance(v, float) \
-                    else f" {flag} {v}"
+        if fork_path is not None:
+            cmd += f" --fork {fork_path}"
+        else:
+            for flag, field in self._REPRO_FLAGS:
+                v = getattr(base_cfg, field)
+                if v == getattr(defaults, field):
+                    continue
+                if isinstance(v, bool):
+                    if v:
+                        cmd += f" {flag}"
+                else:
+                    cmd += f" {flag} {v:g}" if isinstance(v, float) \
+                        else f" {flag} {v}"
         cmd += (
             f" --scenario-rounds {rounds} --write-rounds {write_rounds} "
             f"--max-rounds {max_rounds} --chunk {chunk} --scorecard"
@@ -122,10 +137,20 @@ class SweepPlan:
     rounds: int
     write_rounds: int
     workload_spec: str | None = None
+    fork: object | None = None  # SimCheckpoint fork token — every lane
+    # warm-starts from its state (corro_sim/engine/twin.py what-if
+    # forecasts) instead of a fresh init_state
+    fork_round: int = 0  # the twin's absolute state.round at the fork
+    # (node-fault schedules are shifted into this frame; scorecards and
+    # invariant checkers map them back via round_offset)
 
     @property
     def num_lanes(self) -> int:
         return len(self.lanes)
+
+    @property
+    def fork_path(self) -> str | None:
+        return getattr(self.fork, "path", None)
 
 
 # ------------------------------------------------------------- grid spec
@@ -229,14 +254,38 @@ def build_plan(
     rounds: int = 128,
     write_rounds: int = 16,
     workload_spec: str | None = None,
+    fork=None,
 ) -> SweepPlan:
     """Compile the grid into a validated :class:`SweepPlan`.
 
     Every error across the WHOLE grid lands in one ValueError — the
     satellite contract: a sweep must refuse up front, never die on lane
-    37 mid-dispatch."""
+    37 mid-dispatch.
+
+    ``fork``: a :class:`corro_sim.io.checkpoint.SimCheckpoint` fork
+    token (``save_fork_checkpoint``) — the what-if forecast grid: every
+    lane warm-starts from the token's state, and each lane's node-fault
+    schedule shifts into the fork's absolute round frame
+    (:func:`corro_sim.config.shift_node_faults`), so "wipe at relative
+    round k" fires k rounds after the fork on a ``state.round`` that
+    keeps counting from the twin's timeline."""
     knob_combos = knob_combos or [{}]
     errors: list[str] = []
+    fork_round = 0
+    if fork is not None:
+        if not fork.is_fork:
+            raise ValueError(
+                "build_plan(fork=...) needs a fork token "
+                "(io/checkpoint.py save_fork_checkpoint), not a mid-run "
+                "soak cursor"
+            )
+        if workload_spec is not None:
+            raise ValueError(
+                "a what-if forecast does not couple a workload — the "
+                "forked state IS the load (run_sim resume does not "
+                "compose with workload schedules)"
+            )
+        fork_round = fork.fork_round
     lanes: list[SweepLane] = []
     blackholes: set = set()
     index = 0
@@ -255,6 +304,14 @@ def build_plan(
                     errors.append(f"{cell}: {e}")
                     continue
                 cfg = sc.apply(base_cfg)
+                if fork_round and cfg.node_faults.enabled:
+                    # the what-if frame shift: scenario-relative wipe
+                    # rounds become absolute state rounds (fork + k)
+                    cfg = dataclasses.replace(
+                        cfg, node_faults=shift_node_faults(
+                            cfg.node_faults, fork_round
+                        )
+                    ).validate()
                 if knobs_over:
                     try:
                         cfg = dataclasses.replace(
@@ -350,5 +407,5 @@ def build_plan(
     return SweepPlan(
         base_cfg=base_cfg, union_cfg=union_cfg, lanes=lanes,
         rounds=rounds, write_rounds=write_rounds,
-        workload_spec=workload_spec,
+        workload_spec=workload_spec, fork=fork, fork_round=fork_round,
     )

@@ -234,6 +234,27 @@ WORKLOAD_WRITES_TOTAL = "corro_workload_writes_total"
 WORKLOAD_ROUNDS_TOTAL = "corro_workload_rounds_total"
 WORKLOAD_COALESCED_TOTAL = "corro_workload_coalesced_total"
 WORKLOAD_QUERIES_TOTAL = "corro_workload_queries_total"
+
+# Digital-twin shadow (corro_sim/engine/twin.py; doc/twin.md):
+#   corro_twin_feed_lines_total        feed lines consumed (good + bad)
+#   corro_twin_bad_lines_total{reason} quarantined hostile feed lines by
+#                                      reason (io/traces.py BAD_REASONS)
+#   corro_twin_chunks_total            feed chunks shadowed
+#   corro_twin_rounds_total            shadow sim rounds (feed + drain)
+#   corro_twin_checkpoints_total       feed-cursor checkpoints written
+#   corro_twin_resumes_total           shadows resumed from a cursor
+#   corro_twin_forecast_lanes_total{scenario}
+#                                      what-if lanes raced from a fork
+#   corro_twin_delivery_rounds         histogram: shadowed delivery p99
+#                                      in rounds (ROUNDS_BUCKETS)
+TWIN_BAD_LINES_TOTAL = "corro_twin_bad_lines_total"
+TWIN_BAD_LINES_HELP = (
+    "hostile feed lines quarantined by the twin shadow, by reason "
+    "(corro_sim/io/traces.py)"
+)
+TWIN_FEED_LINES_TOTAL = "corro_twin_feed_lines_total"
+TWIN_DELIVERY_ROUNDS = "corro_twin_delivery_rounds"
+TWIN_FORECAST_LANES_TOTAL = "corro_twin_forecast_lanes_total"
 ROUNDS_BUCKETS = (
     0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0,
     64.0, 96.0, 128.0,

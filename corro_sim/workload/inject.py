@@ -32,7 +32,46 @@ from corro_sim.core.crdt import NEG, apply_cell_changes
 from corro_sim.engine.state import SimState
 from corro_sim.gossip.broadcast import enqueue_broadcasts
 
-__all__ = ["inject_round", "workload_as_injection"]
+__all__ = [
+    "inject_round",
+    "pad_trace_cells",
+    "trace_round_args",
+    "workload_as_injection",
+]
+
+
+def pad_trace_cells(block, seqs_per_version: int) -> dict:
+    """Pad an encoded trace block's cell planes (``row/col/vr/cv/cl``,
+    shape ``(rounds, A, S)``) up to the config's seq capacity — extra
+    lanes are dead, ``ncells`` masks them out everywhere. ``block`` is
+    an :class:`~corro_sim.io.traces.EncodedTrace` or a streaming
+    :class:`~corro_sim.io.traces.StreamChunk` (same plane names); shared
+    by one-shot replay and the digital twin's chunk loop."""
+    pad = seqs_per_version - block.row.shape[2]
+    assert pad >= 0, (
+        f"trace changesets carry up to {block.row.shape[2]} cells; "
+        f"cfg.seqs_per_version={seqs_per_version} is too small"
+    )
+    return {
+        name: np.pad(getattr(block, name), ((0, 0), (0, 0), (0, pad)))
+        for name in ("row", "col", "vr", "cv", "cl")
+    }
+
+
+def trace_round_args(block, cells: dict, r: int) -> tuple:
+    """Round ``r``'s staged :func:`inject_round` argument tuple off an
+    encoded block + its :func:`pad_trace_cells` planes."""
+    return (
+        jnp.asarray(block.valid[r]),
+        jnp.asarray(block.empty[r]),
+        jnp.asarray(block.ts[r]),
+        jnp.asarray(block.ncells[r]),
+        jnp.asarray(cells["row"][r]),
+        jnp.asarray(cells["col"][r]),
+        jnp.asarray(cells["vr"][r]),
+        jnp.asarray(cells["cv"][r]),
+        jnp.asarray(cells["cl"][r]),
+    )
 
 
 def inject_round(

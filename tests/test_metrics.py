@@ -557,3 +557,70 @@ def test_compile_cache_and_batched_subs_families_render_and_validate(
     assert 'corro_subs_matcher_evals_total{mode="batched"}' in text
     assert "corro_subs_batch_groups_total" in text
     _validate_exposition(text)
+
+
+def test_twin_families_render_and_validate(cluster):
+    """ISSUE 13 satellite: the digital-twin families — the per-reason
+    hostile-line quarantine counter (corro_twin_bad_lines_total{reason},
+    the label set pinned to io/traces.py BAD_REASONS), feed/chunk/round
+    flow counters, forecast-lane counters and the shadowed-delivery
+    histogram — render through the exposition and the whole thing still
+    passes the scraper-contract validator. The names/labels here come
+    from the same utils.metrics constants engine/twin.py emits with, so
+    this coverage cannot drift from the runtime emission."""
+    from corro_sim.io.traces import BAD_REASONS
+    from corro_sim.utils.metrics import (
+        ROUNDS_BUCKETS,
+        TWIN_BAD_LINES_HELP,
+        TWIN_BAD_LINES_TOTAL,
+        TWIN_DELIVERY_ROUNDS,
+        TWIN_FEED_LINES_TOTAL,
+        TWIN_FORECAST_LANES_TOTAL,
+        counters,
+        histograms,
+    )
+
+    for reason in BAD_REASONS:
+        counters.inc(
+            TWIN_BAD_LINES_TOTAL, labels=f'{{reason="{reason}"}}',
+            help_=TWIN_BAD_LINES_HELP,
+        )
+    counters.inc(TWIN_FEED_LINES_TOTAL, n=40,
+                 help_="feed lines consumed by the twin shadow")
+    counters.inc("corro_twin_chunks_total", n=5,
+                 help_="feed chunks shadowed")
+    counters.inc("corro_twin_rounds_total", n=12,
+                 help_="shadow sim rounds executed")
+    counters.inc("corro_twin_late_clears_total",
+                 help_="benign late EmptySets dropped")
+    counters.inc("corro_twin_checkpoints_total",
+                 help_="feed-cursor checkpoints written")
+    counters.inc("corro_twin_resumes_total",
+                 help_="shadows resumed from a cursor")
+    counters.inc(
+        TWIN_FORECAST_LANES_TOTAL,
+        labels='{scenario="crash_amnesia"}',
+        help_="what-if forecast lanes raced from a twin fork",
+    )
+    histograms.observe(
+        TWIN_DELIVERY_ROUNDS, 3.0,
+        help_="shadowed feed delivery p99 in rounds",
+        buckets=ROUNDS_BUCKETS,
+    )
+    text = render_prometheus(cluster)
+    for reason in BAD_REASONS:
+        assert (
+            f'corro_twin_bad_lines_total{{reason="{reason}"}}' in text
+        ), reason
+    assert "corro_twin_feed_lines_total" in text
+    assert "corro_twin_chunks_total" in text
+    assert "corro_twin_rounds_total" in text
+    assert "corro_twin_late_clears_total" in text
+    assert "corro_twin_checkpoints_total" in text
+    assert "corro_twin_resumes_total" in text
+    assert (
+        'corro_twin_forecast_lanes_total{scenario="crash_amnesia"}'
+        in text
+    )
+    assert 'corro_twin_delivery_rounds_bucket{le="+Inf"}' in text
+    _validate_exposition(text)

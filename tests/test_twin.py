@@ -1,0 +1,611 @@
+"""Digital-twin acceptance (ISSUE 13, corro_sim/engine/twin.py).
+
+The load-bearing claims:
+
+- **streaming == batch**: a feed consumed through the scan-window +
+  tail-mode path encodes the batch ``ingest`` planes (exactly for a
+  single feed, per-actor-identically for chunked feeds), and hostile
+  lines quarantine with reasons instead of crashing the shadow —
+  strict mode collects EVERY bad line into ONE up-front ValueError;
+- **fixture replay identity**: the committed fly.io-shaped trace
+  (Full + Empty changesets, a ``__crsql_del`` causal-length delete, a
+  blob value) shadows to the hand-derived final state, and its
+  first-write prefix produces the identical table/log/book through the
+  replay-injection path and the step's ``writes=`` port;
+- **SIGKILL resume**: a twin killed mid-feed resumes from its cursor
+  token and produces a report FIELD-IDENTICAL to the uninterrupted run
+  (state, metrics, headlines);
+- **fork-and-race bit-identity**: every what-if lane warm-started from
+  a fork token equals the serial ``run_sim`` resumed from the same
+  token (state + metrics + scorecard) — the ISSUE 13 acceptance
+  criterion;
+- **zero footprint**: the ``TwinConfig`` block contributes no SimState
+  leaves and no traced ops, enabled or not.
+
+Config literals here are in lockstep with tools/prime_cache.py
+(``twin/*`` programs) so the compiled programs come out of the primed
+cache inside tier-1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from corro_sim.config import TwinConfig, shift_node_faults
+from corro_sim.engine import init_state, run_sim
+from corro_sim.engine.replay import make_shadow_step, read_table
+from corro_sim.engine.twin import (
+    fork_twin,
+    probe_feed_heads,
+    run_forecast,
+    run_twin,
+    twin_universe,
+)
+from corro_sim.faults import InvariantChecker, ResilienceScorecard
+from corro_sim.io.traces import (
+    TraceStream,
+    dump_changeset,
+    ingest,
+    scan_universe,
+    validate_feed,
+)
+
+FIXTURE = (
+    pathlib.Path(__file__).parent / "fixtures" / "traces"
+    / "flyio_small.ndjson"
+)
+
+TA1 = "7c2e1a00-0001-4000-8000-000000000001"
+TA2 = "7c2e1a00-0002-4000-8000-000000000002"
+TA3 = "7c2e1a00-0003-4000-8000-000000000003"
+
+# hand-derived from the reference apply semantics (doc/crdts.md):
+# cv2 beats cv1 on services(api-1).port; web-1's port rides ta1 v4
+# after the EmptySet compacts v3; checks(web-1-http) is cl-deleted
+EXPECTED = {
+    ("services", ("web-1",)): {"name": "web", "port": 8082},
+    ("services", ("api-1",)): {"name": "api", "port": 9191},
+    ("services", ("blob-1",)): {"meta": b"\x00\x01\xfe\xff"},
+    ("checks", ("api-1-http",)): {"status": "passing"},
+}
+
+# the forecast grid (prime_cache `twin/forecast` — keep in lockstep)
+FORECAST_SCENARIOS = ["lossy:p=0.3", "crash_amnesia:nodes=2,at=4,down=4"]
+FORECAST_SEEDS = [0, 1]
+FORECAST_ROUNDS = 32
+CHUNK = 8
+MAX_ROUNDS = 256
+
+
+def _fixture_lines() -> list:
+    with open(FIXTURE, encoding="utf-8") as f:
+        return [ln for ln in f if ln.strip()]
+
+
+def _twin_cfg(lines):
+    """The fixture's shadow config (prime_cache `twin/*` base shape)."""
+    uni = twin_universe(lines, 0)
+    heads = probe_feed_heads(lines, uni)
+    return dataclasses.replace(
+        uni.suggest_config(rounds=int(heads.max()) + 1),
+        twin=TwinConfig(enabled=True, chunk_lines=4),
+    ).validate()
+
+
+@pytest.fixture(scope="module")
+def lines():
+    return _fixture_lines()
+
+
+@pytest.fixture(scope="module")
+def shadow(lines, tmp_path_factory):
+    """One shadow of the committed fixture, cursor-checkpointed every
+    chunk, with the mid-feed token captured for the resume test."""
+    tmp = tmp_path_factory.mktemp("twin")
+    ckpt = str(tmp / "twin.ckpt.npz")
+    kill = str(tmp / "twin.kill.npz")
+
+    def grab(headline):
+        # the token on disk when chunk 1's headline lands was written at
+        # the PREVIOUS chunk boundary — a genuine mid-feed cursor
+        if headline["chunk"] == 1 and pathlib.Path(ckpt).exists():
+            shutil.copy(ckpt, kill)
+
+    cfg = _twin_cfg(lines)
+    res = run_twin(
+        feed=str(FIXTURE), cfg=cfg, lines=lines, seed=0,
+        checkpoint_path=ckpt, on_chunk=grab,
+    )
+    return res, kill
+
+
+# ------------------------------------------------------------- streaming
+
+def test_stream_single_feed_matches_batch_ingest(lines):
+    tr = ingest(lines)
+    st = TraceStream(scan_universe(lines))
+    chunk = st.feed(lines)
+    for name in ("valid", "empty", "ts", "delete", "ncells", "row",
+                 "col", "vr", "cv", "cl"):
+        assert np.array_equal(
+            getattr(chunk, name), getattr(tr, name)
+        ), name
+    assert chunk.ts_lo == 1000 and chunk.ts_hi == 1090
+
+
+def test_stream_chunked_preserves_per_actor_content(lines):
+    """Chunked feeds advance per-actor horizons independently — global
+    round alignment may differ from batch, but every actor's version
+    sequence (content, clears, stamps) is the batch sequence."""
+    tr = ingest(lines)
+    st = TraceStream(scan_universe(lines))
+    chunks = [st.feed(lines[i:i + 4]) for i in range(0, len(lines), 4)]
+    assert np.array_equal(
+        st.heads, tr.valid.sum(axis=0)
+    )  # every version accounted for
+    val = np.concatenate([c.valid for c in chunks if c.rounds])
+    for name in ("empty", "ncells", "ts", "delete"):
+        got_all = np.concatenate(
+            [getattr(c, name) for c in chunks if c.rounds]
+        )
+        for ai in range(tr.num_actors):
+            got = got_all[val[:, ai], ai]
+            want = getattr(tr, name)[tr.valid[:, ai], ai]
+            if ai == 0:
+                # ta1 is the late-clear actor: its EmptySet trails the
+                # superseding v4 across a chunk boundary, so the stream
+                # drops the clear as benign (LATE_CLEAR) and v3 stays
+                # the Full changeset batch ingest (whole-file closed
+                # world) compacted — the ONE sanctioned divergence
+                if name == "ncells":
+                    assert got[2] == 1 and want[2] == 0
+                continue
+            assert np.array_equal(got, want), (name, ai)
+    assert st.late_clears == 1
+    assert st.bad_lines == 0
+
+
+def test_hostile_feed_collects_every_error_into_one(lines):
+    """The satellite contract: ALL malformed/unknown-actor/stale/
+    duplicate lines across a feed collect into ONE ValueError naming
+    each; --skip-bad quarantines them with per-reason counters."""
+    uni = scan_universe(lines)
+    hostile = [
+        "{definitely not json",
+        dump_changeset(
+            "eeeeeeee-0000-4000-8000-00000000000e", 1, 0,
+            [("services", ("web-1",), "name", "web", 1, 1)],
+        ),  # unknown actor
+        dump_changeset(TA1, 1, 0, [
+            ("services", ("web-1",), "name", "web", 1, 1),
+        ]),  # in-order here, duplicated below
+        dump_changeset(TA1, 1, 0, [
+            ("services", ("web-1",), "name", "again", 1, 1),
+        ]),  # duplicate version
+        dump_changeset(TA2, 1, 0, [
+            ("rockets", ("x",), "thrust", 9000, 1, 1),
+        ]),  # unknown row/table
+        dump_changeset(TA3, 1, 0, [
+            ("services", ("web-1",), "name", "NEVER-INTERNED", 1, 1),
+        ]),  # unknown value
+    ]
+    st = TraceStream(uni)
+    with pytest.raises(ValueError) as ei:
+        st.feed(hostile)
+    msg = str(ei.value)
+    for reason in ("malformed", "unknown_actor", "duplicate",
+                   "unknown_row", "unknown_value"):
+        assert reason in msg, (reason, msg)
+    # strict refusal is side-effect-free: nothing consumed, no counters
+    assert st.lines_seen == 0 and st.counters == {}
+
+    # validate_feed is the twin's up-front pass over the WHOLE feed
+    bad = validate_feed(lines + hostile[:1], uni)
+    assert len(bad) == 1 and bad[0][1] == "malformed"
+
+    # quarantine mode: same lines, counted by reason, good ones encode
+    st = TraceStream(uni)
+    out = st.feed(hostile, skip_bad=True)
+    assert out.rounds == 1  # TA1 v1 made it through
+    assert st.counters == {
+        "malformed": 1, "unknown_actor": 1, "duplicate": 1,
+        "unknown_row": 1, "unknown_value": 1,
+    }
+    # stale_version: a version below the injected horizon is
+    # out-of-order across a committed boundary
+    out = st.feed(
+        [dump_changeset(TA1, 1, 0, [
+            ("services", ("web-1",), "name", "late", 1, 1),
+        ])],
+        skip_bad=True,
+    )
+    assert out.rounds == 0 and st.counters["stale_version"] == 1
+
+
+# ----------------------------------------------------------- the shadow
+
+def test_shadow_converges_to_reference_state(shadow, lines):
+    res, _ = shadow
+    assert not res.poisoned
+    assert res.converged_round is not None
+    assert res.report["bad_lines"] == 0
+    assert res.report["late_clears"] == 1  # the trailing EmptySet
+    assert res.report["chunks"] == 3  # 10 lines / 4 per chunk
+    assert res.report["feed_ts"] == {"lo": 1000, "hi": 1090, "span": 90}
+    assert res.report["shadow_delivery"] is not None
+    assert res.report["shadow_delivery"]["p99_rounds"] >= 0
+    # every node's decoded table equals the hand-derived reference
+    tr = ingest(lines)  # same deterministic universe mapping
+    for node in range(res.cfg.num_nodes):
+        assert read_table(res.state, tr, node) == EXPECTED, node
+
+
+def test_shadow_headlines_and_flight_annotations(shadow):
+    res, _ = shadow
+    assert len(res.headlines) == 3
+    assert sum(h["rounds"] for h in res.headlines) == res.feed_rounds
+    assert [h["chunk"] for h in res.headlines] == [0, 1, 2]
+    assert res.headlines[-1]["gap"] == 0.0
+    kinds = {e["name"] for e in res.flight.events()}
+    assert "twin_chunk" in kinds
+    assert "twin_checkpoint" in kinds
+    assert "twin_late_clear" in kinds
+
+
+def test_twin_sigkill_resume_field_identical(shadow, lines):
+    """A twin killed mid-feed resumes from its cursor token and produces
+    a report field-identical to the uninterrupted run — plus identical
+    metric series and final state (the bit-identity underneath)."""
+    full, kill_token = shadow
+    from corro_sim.io.checkpoint import load_sim_checkpoint
+
+    tok = load_sim_checkpoint(kill_token)
+    assert tok.rounds < full.rounds  # genuinely mid-feed
+    resumed = run_twin(
+        feed=str(FIXTURE), cfg=full.cfg, lines=lines, seed=0,
+        resume=tok,
+    )
+    assert resumed.report == full.report
+    assert set(resumed.metrics) == set(full.metrics)
+    for k in full.metrics:
+        assert np.array_equal(full.metrics[k], resumed.metrics[k]), k
+    for la, lb in zip(jax.tree.leaves(full.state),
+                      jax.tree.leaves(resumed.state)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_resume_refuses_a_different_feed(shadow, lines):
+    """The cursor token is bound to the FEED it consumed: resuming
+    against a truncated or edited file refuses instead of silently
+    diverging (the consumed-prefix hash rides the token)."""
+    full, kill_token = shadow
+    from corro_sim.io.checkpoint import load_sim_checkpoint
+
+    tok = load_sim_checkpoint(kill_token)
+    with pytest.raises(ValueError, match="only has"):
+        run_twin(lines=lines[:2], cfg=full.cfg, seed=0, resume=tok)
+    edited = [lines[1]] + [lines[0]] + lines[2:]  # reordered prefix
+    with pytest.raises(ValueError, match="feed mismatch"):
+        run_twin(lines=edited, cfg=full.cfg, seed=0, resume=tok)
+
+
+def test_strict_mode_refuses_hostile_feed_upfront(lines):
+    cfg = _twin_cfg(lines)
+    hostile = lines + ["{nope", lines[0]]  # malformed + duplicate
+    with pytest.raises(ValueError) as ei:
+        run_twin(lines=hostile, cfg=cfg, seed=0)
+    msg = str(ei.value)
+    assert "malformed" in msg and "2 bad lines" in msg
+    skip = dataclasses.replace(
+        cfg, twin=dataclasses.replace(cfg.twin, skip_bad=True)
+    ).validate()
+    res = run_twin(lines=hostile, cfg=skip, seed=0)
+    assert res.report["bad_lines"] == 2
+    assert res.report["bad_by_reason"] == {
+        "malformed": 1, "stale_version": 1,
+    }
+    tr = ingest(lines)
+    for node in range(res.cfg.num_nodes):
+        assert read_table(res.state, tr, node) == EXPECTED, node
+
+
+# ------------------------------------------- write-port identity (PR 7)
+
+def test_fixture_prefix_replay_equals_write_port(lines):
+    """The fixture's first-write-only prefix through BOTH injection
+    homes: replay-form injection (inject_round) vs the step's writes=
+    port — identical table/log/book once both drain (the PR 7 path
+    identity, driven by the committed trace)."""
+    from corro_sim.engine.replay import make_injector
+    from corro_sim.engine.step import make_workload_step
+    from corro_sim.workload.inject import pad_trace_cells, trace_round_args
+
+    cfg = _twin_cfg(lines)
+    uni = scan_universe(lines)
+    prefix = lines[:3]  # cv=1/cl=1 inserts, every cell written once
+    chunk = TraceStream(uni).feed(prefix)
+    n, s = cfg.num_nodes, cfg.seqs_per_version
+    cells = pad_trace_cells(chunk, s)
+    root = jax.random.PRNGKey(0)
+    idle = make_shadow_step(cfg)
+
+    # path A: replay-form injection (the twin's shadow path — same
+    # compiled injector/step programs, same full-universe row mapping)
+    inject = make_injector(cfg)
+    state_a = init_state(cfg, seed=0)
+    r = 0
+    for j in range(chunk.rounds):
+        state_a = inject(state_a, *trace_round_args(chunk, cells, j))
+        state_a, m = idle(state_a, jax.random.fold_in(root, r))
+        r += 1
+    while float(m["gap"]) > 0:
+        state_a, m = idle(state_a, jax.random.fold_in(root, r))
+        r += 1
+        assert r < 64, "injection path failed to drain"
+
+    # path B: the same cells through sim_step's writes= port
+    body = make_workload_step(cfg)
+    step_wl = jax.jit(body)
+    import jax.numpy as jnp
+
+    state = init_state(cfg, seed=0)
+    alive = jnp.ones((n,), bool)
+    part = jnp.zeros((n,), jnp.int32)
+    r = 0
+    for j in range(chunk.rounds):
+        writers = chunk.valid[j] & ~chunk.empty[j]
+        inp = (
+            jax.random.fold_in(root, r), alive, part,
+            jnp.asarray(True),
+            jnp.asarray(writers),
+            jnp.asarray(cells["row"][j]),
+            jnp.asarray(cells["col"][j]),
+            jnp.asarray(cells["vr"][j]),
+            jnp.asarray(np.zeros(n, bool)),  # no deletes in the prefix
+            jnp.asarray(chunk.ncells[j]),
+        )
+        state, m = step_wl(state, inp)
+        r += 1
+    while float(m["gap"]) > 0:
+        state, m = idle(state, jax.random.fold_in(root, r))
+        r += 1
+        assert r < 64, "write-port path failed to drain"
+
+    for field in ("table", "book"):
+        for la, lb in zip(
+            jax.tree.leaves(getattr(state_a, field)),
+            jax.tree.leaves(getattr(state, field)),
+        ):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), field
+    # the change log matches on every LIVE lane; lanes past ncells are
+    # dead (masked by every consumer) and hold path-specific pad values
+    # (the trace form zero-pads cv, local_write stamps cv=1)
+    log_a, log_b = state_a.log, state.log
+    for name in ("ncells", "live", "cleared", "head"):
+        assert np.array_equal(
+            np.asarray(getattr(log_a, name)),
+            np.asarray(getattr(log_b, name)),
+        ), name
+    lane_live = (
+        np.arange(log_a.seqs)[None, None, :]
+        < np.asarray(log_a.ncells)[:, :, None]
+    )[..., None]
+    assert np.array_equal(
+        np.where(lane_live, np.asarray(log_a.cells), 0),
+        np.where(lane_live, np.asarray(log_b.cells), 0),
+    )
+
+
+# --------------------------------------------------- fork-and-race
+
+@pytest.fixture(scope="module")
+def forecast(shadow, tmp_path_factory):
+    res, _ = shadow
+    tmp = tmp_path_factory.mktemp("fork")
+    tok = fork_twin(res, str(tmp / "twin.fork.npz"), chunk=CHUNK)
+    fc = run_forecast(
+        tok, FORECAST_SCENARIOS, FORECAST_SEEDS,
+        rounds=FORECAST_ROUNDS, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+        thresholds={"twin_forecast": {
+            "default": {"require_converged": True, "rows_lost_max": 0},
+            "scenarios": {
+                "crash_amnesia": {"recovery_rounds_worst_max": 48},
+            },
+        }},
+    )
+    return res, tok, fc
+
+
+def test_forecast_grid_and_frontier(forecast):
+    res, tok, fc = forecast
+    assert tok.is_fork and tok.fork_round == res.rounds
+    assert fc["lanes"] == 4 and fc["ok"], fc["frontier"]["breaches"]
+    assert fc["frontier"]["projected"] is True
+    cells = {c["scenario"].split(":")[0]: c
+             for c in fc["frontier"]["cells"]}
+    crash = cells["crash_amnesia"]
+    # the wipe FIRED in the forked frame: recovery measured, nothing
+    # durably lost, and the repro command rides the fork token
+    assert crash["rows_lost_worst"] == 0
+    assert crash["recovery_rounds"]["worst"] is not None
+    assert "--fork" in crash["worst_repro"]
+    assert "--scenario 'crash_amnesia" in crash["worst_repro"]
+    for lane in fc["lanes_detail"]:
+        assert lane["invariants_ok"], lane
+        assert lane["converged_round"] is not None, lane
+
+
+def test_fork_lanes_bit_identical_to_serial_fork_resume(forecast):
+    """THE acceptance criterion: every asserted what-if lane started
+    from the forked twin state equals the serial ``run_sim`` resumed
+    from the same checkpoint token — state + metrics + scorecard."""
+    from corro_sim.config import FaultConfig, NodeFaultConfig
+    from corro_sim.sweep.engine import run_sweep
+    from corro_sim.sweep.plan import build_plan
+
+    res, tok, fc = forecast
+    base = dataclasses.replace(
+        tok.cfg, faults=FaultConfig(), node_faults=NodeFaultConfig(),
+        write_rate=0.0,
+    ).validate()
+    plan = build_plan(
+        base, FORECAST_SCENARIOS, FORECAST_SEEDS,
+        rounds=FORECAST_ROUNDS, write_rounds=0, fork=tok,
+    )
+    assert plan.fork_round == res.rounds
+    sweep = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    # serial twins: both lossy seeds (one program) + crash seed 0 (its
+    # victim schedule is seed-derived, so each crash seed is its own
+    # compiled program — one serial twin covers the wipe machinery)
+    asserted = 0
+    for lane, lr in zip(plan.lanes, sweep.lanes):
+        if lane.spec.startswith("crash") and lane.seed != 0:
+            continue
+        card = ResilienceScorecard(
+            lane.cfg, scenario=lane.scenario,
+            round_offset=plan.fork_round,
+        )
+        inv = InvariantChecker(lane.cfg, round_offset=plan.fork_round)
+        serial = run_sim(
+            lane.cfg, init_state(lane.cfg, seed=lane.seed),
+            lane.scenario.schedule(), max_rounds=MAX_ROUNDS,
+            chunk=CHUNK, seed=lane.seed, min_rounds=lane.min_rounds,
+            invariants=inv, scorecard=card,
+            resume=tok.refit(lane.cfg, lane.seed, CHUNK),
+        )
+        tag = (lane.spec, lane.seed)
+        assert serial.converged_round == lr.converged_round, tag
+        assert serial.rounds == lr.rounds, tag
+        for k in serial.metrics:
+            assert np.array_equal(
+                np.asarray(serial.metrics[k]),
+                np.asarray(lr.metrics[k]),
+            ), (*tag, k)
+        for field in ("table", "book", "log", "own", "gossip", "swim",
+                      "hlc", "last_cleared", "cleared_hlc", "round"):
+            for la, lb in zip(
+                jax.tree.leaves(getattr(serial.state, field)),
+                jax.tree.leaves(getattr(lr.state, field)),
+            ):
+                assert np.array_equal(
+                    np.asarray(la), np.asarray(lb)
+                ), (*tag, field)
+        assert serial.resilience is not None
+        for k, v in serial.resilience.items():
+            assert lr.resilience[k] == v, (*tag, k)
+        assert inv.ok and (lr.invariants or {}).get("ok"), tag
+        asserted += 1
+    assert asserted == 3
+    # the crash lane really wiped in the shifted frame
+    crash = next(
+        lr for lane, lr in zip(plan.lanes, sweep.lanes)
+        if lane.spec.startswith("crash") and lane.seed == 0
+    )
+    assert crash.resilience["wipes"] == 2
+    assert int(crash.metrics["node_fault_wipes"].sum()) == 2
+    assert crash.recovery_rounds is not None
+
+
+def test_fork_shift_keeps_schedule_relative(forecast):
+    """shift_node_faults moves crash/stale rounds by the fork offset and
+    leaves skew/straggle untouched (no rounds to move)."""
+    from corro_sim.config import NodeFaultConfig
+
+    nf = NodeFaultConfig(
+        crash=((1, 4),), stale=((2, 1, 6),), skew=((0, 9),),
+        straggle=((1, 8, 2),),
+    )
+    out = shift_node_faults(nf, 5)
+    assert out.crash == ((1, 9),)
+    assert out.stale == ((2, 6, 11),)
+    assert out.skew == nf.skew and out.straggle == nf.straggle
+    assert shift_node_faults(nf, 0) is nf
+
+
+def test_fork_token_guards(forecast, tmp_path):
+    """Non-fork tokens refuse refit/forecast; forks refuse workloads."""
+    from corro_sim.io.checkpoint import (
+        load_sim_checkpoint,
+        save_sim_checkpoint,
+    )
+    from corro_sim.sweep.plan import build_plan
+
+    res, tok, _ = forecast
+    path = str(tmp_path / "cursor.npz")
+    save_sim_checkpoint(
+        path, cfg=res.cfg, state=res.state, seed=0, chunk=CHUNK,
+        rounds=4, next_chunk=1, cursor={}, metrics={},
+    )
+    cursor = load_sim_checkpoint(path)
+    assert not cursor.is_fork
+    with pytest.raises(ValueError, match="fork tokens only"):
+        cursor.refit(res.cfg, 0, CHUNK)
+    with pytest.raises(ValueError, match="fork token"):
+        build_plan(res.cfg, ["lossy:p=0.1"], [0], fork=cursor)
+    with pytest.raises(ValueError, match="workload"):
+        build_plan(
+            res.cfg, ["lossy:p=0.1"], [0], fork=tok,
+            workload_spec="zipf:rate=0.5,keys=4",
+        )
+
+
+# -------------------------------------------------------- zero footprint
+
+def test_twin_config_zero_leaves_and_identical_program():
+    """The acceptance bar: the TwinConfig block contributes ZERO
+    SimState leaves and ZERO traced ops — pytree structure and step
+    jaxpr are byte-identical with the block enabled or disabled, so the
+    golden fingerprint and every primed cache key stay untouched."""
+    from corro_sim.config import SimConfig
+    from corro_sim.engine.step import make_step
+
+    base = SimConfig(num_nodes=8, num_rows=8, num_cols=2,
+                     log_capacity=16).validate()
+    twin_on = dataclasses.replace(
+        base, twin=TwinConfig(enabled=True, chunk_lines=4,
+                              skip_bad=True),
+    ).validate()
+    sa = jax.eval_shape(lambda: init_state(base, seed=0))
+    sb = jax.eval_shape(lambda: init_state(twin_on, seed=0))
+    assert jax.tree.structure(sa) == jax.tree.structure(sb)
+    assert jax.tree.leaves(sa) == jax.tree.leaves(sb)
+
+    def trace(cfg, aval):
+        import jax.numpy as jnp
+
+        n = cfg.num_nodes
+        xs = (
+            jax.ShapeDtypeStruct((2,), jnp.uint32),
+            jax.ShapeDtypeStruct((n,), jnp.bool_),
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.bool_),
+        )
+        return str(jax.make_jaxpr(make_step(cfg))(aval, xs))
+
+    assert trace(base, sa) == trace(twin_on, sb)
+
+
+def test_fork_token_scrubs_volatile_feature_leaves(shadow, tmp_path):
+    """A fork token carries the durable twin state (tables, logs,
+    bookkeeping, gossip/SWIM — the cluster as it stands) but scrubs
+    registry feature leaves, whose shapes are keyed by the gates the
+    what-if scenario changes."""
+    import numpy as _np
+
+    res, _ = shadow
+    path = str(tmp_path / "f.npz")
+    fork_twin(res, path, chunk=CHUNK)
+    with _np.load(path) as z:
+        keys = [k for k in z.files if k.startswith("state/")]
+    names = {k[len("state/"):].split("/")[0] for k in keys}
+    assert "probe" not in names and "fault_burst" not in names
+    assert "features" not in names
+    for durable in ("table", "log", "book", "gossip", "swim", "hlc",
+                    "round"):
+        assert durable in names, names

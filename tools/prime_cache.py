@@ -212,7 +212,142 @@ def prime_matrix(chunk: int = 8) -> ProgramRecorder:
     # chaos-matrix leg's grid and the exact plans tests/test_sweep.py
     # dispatches inside pytest (config literals in lockstep with both).
     _prime_sweep_matrix(jax, chunk, rec)
+
+    # ISSUE 13: the digital-twin programs — the fixture shadow's
+    # per-round inject/step pair, the write-port identity body, the
+    # what-if forecast sweep programs (the tests' 2x2 grid and the t1
+    # twin leg's 2x4 grid) and every forecast lane's serial run_sim
+    # twin (tests/test_twin.py + the t1 twin smoke, in lockstep).
+    _prime_twin_matrix(jax, jnp, chunk, rec)
     return rec
+
+
+def _prime_twin_matrix(jax, jnp, chunk: int, rec: ProgramRecorder):
+    import dataclasses as _dc
+
+    from corro_sim.engine.driver import _chunk_runner
+    from corro_sim.engine.replay import make_injector, make_shadow_step
+    from corro_sim.engine.state import init_state
+    from corro_sim.engine.step import make_workload_step
+    from corro_sim.engine.twin import fork_twin, run_twin
+    from corro_sim.sweep.engine import sweep_chunk_avals, sweep_runner
+    from corro_sim.sweep.plan import build_plan
+
+    fixture = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "fixtures", "traces", "flyio_small.ndjson",
+    )
+    with open(fixture, encoding="utf-8") as f:
+        lines = [ln for ln in f if ln.strip()]
+    from corro_sim.config import TwinConfig
+    from corro_sim.engine.twin import probe_feed_heads, twin_universe
+
+    uni = twin_universe(lines, 0)
+    heads = probe_feed_heads(lines, uni)
+    cfg = _dc.replace(
+        uni.suggest_config(rounds=int(heads.max()) + 1),
+        twin=TwinConfig(enabled=True, chunk_lines=4),
+    ).validate()
+    n, s = cfg.num_nodes, cfg.seqs_per_version
+    a = uni.num_actors
+    state = jax.eval_shape(lambda: init_state(cfg, seed=0))
+
+    # the shadow's per-round programs (jitted, so .lower works directly)
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    rec.compile("twin/shadow/step", make_shadow_step(cfg), state,
+                key_aval)
+    inject_avals = (
+        jax.ShapeDtypeStruct((a,), jnp.bool_),  # valid
+        jax.ShapeDtypeStruct((a,), jnp.bool_),  # empty
+        jax.ShapeDtypeStruct((a,), jnp.int32),  # ts
+        jax.ShapeDtypeStruct((a,), jnp.int32),  # ncells
+        *(jax.ShapeDtypeStruct((a, s), jnp.int32) for _ in range(5)),
+    )
+    rec.compile("twin/shadow/inject", make_injector(cfg), state,
+                *inject_avals)
+    # the write-port identity body (tests/test_twin.py path B: a jitted
+    # single-round make_workload_step call, not the chunk runner)
+    wl_inp = (
+        key_aval,
+        jax.ShapeDtypeStruct((n,), jnp.bool_),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.bool_),
+        jax.ShapeDtypeStruct((n,), jnp.bool_),
+        jax.ShapeDtypeStruct((n, s), jnp.int32),
+        jax.ShapeDtypeStruct((n, s), jnp.int32),
+        jax.ShapeDtypeStruct((n, s), jnp.int32),
+        jax.ShapeDtypeStruct((n,), jnp.bool_),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
+    rec.compile(
+        "twin/shadow/write-port", jax.jit(make_workload_step(cfg)),
+        state, wl_inp,
+    )
+
+    # the fork round is the shadow's convergence round — run the tiny
+    # committed fixture (5 rounds, 3 nodes; the ONE executed entry in
+    # an otherwise aval-only matrix) so the forecast lane configs below
+    # bake the exact shifted schedules the tests and the t1 twin leg
+    # dispatch, whatever round the shadow settles at
+    res = run_twin(lines=lines, cfg=cfg, seed=0)
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        tok = fork_twin(res, os.path.join(td, "fork.npz"), chunk=chunk)
+    from corro_sim.config import FaultConfig, NodeFaultConfig
+
+    base = _dc.replace(
+        cfg, faults=FaultConfig(), node_faults=NodeFaultConfig(),
+        write_rate=0.0,
+    ).validate()
+    scenarios = ["lossy:p=0.3", "crash_amnesia:nodes=2,at=4,down=4"]
+    # tests/test_twin.py forecast grid (2x2) + the t1 twin leg's (2x4)
+    plans = {
+        "twin/forecast-test": build_plan(
+            base, scenarios, [0, 1], rounds=32, write_rounds=0,
+            fork=tok,
+        ),
+        "twin/forecast-ci": build_plan(
+            base, scenarios, [0, 1, 2, 3], rounds=48, write_rounds=0,
+            fork=tok,
+        ),
+    }
+    for name, plan in plans.items():
+        runner = sweep_runner(plan.union_cfg, workload=False)
+        rec.compile(name, runner, *sweep_chunk_avals(plan, chunk))
+    # every distinct forecast lane config's serial run_sim twin
+    # (crash_amnesia's victim schedule is seed-derived, so each crash
+    # seed is its own program; lossy is one shared pair)
+    seen: set = set()
+    for plan in plans.values():
+        for lane in plan.lanes:
+            cfg_key = (lane.spec, lane.seed if
+                       lane.cfg.node_faults.enabled else -1)
+            if cfg_key in seen:
+                continue
+            seen.add(cfg_key)
+            lstate = jax.eval_shape(
+                lambda c=lane.cfg: init_state(c, seed=0)
+            )
+            avals = (
+                jax.ShapeDtypeStruct((chunk, 2), jnp.uint32),
+                jax.ShapeDtypeStruct((chunk, n), jnp.bool_),
+                jax.ShapeDtypeStruct((chunk, n), jnp.int32),
+                jax.ShapeDtypeStruct((chunk,), jnp.bool_),
+            )
+            safe = "".join(
+                ch if ch.isalnum() or ch in "._-" else "-"
+                for ch in lane.spec
+            )
+            tag = f"{safe}-s{lane.seed}" if cfg_key[1] >= 0 else safe
+            for repair in (False, True):
+                runner = _chunk_runner(lane.cfg, repair=repair,
+                                       packed=True)
+                rec.compile(
+                    f"twin-serial/{tag}/"
+                    f"{'repair' if repair else 'full'}",
+                    runner, lstate, *avals,
+                )
 
 
 def _prime_sweep_matrix(jax, chunk: int, rec: ProgramRecorder):
