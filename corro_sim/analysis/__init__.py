@@ -1,15 +1,22 @@
 """corro-lint: static trace-safety analysis + jaxpr audit harness.
 
-Three enforcement layers (ISSUE 5, doc/static_analysis.md):
+Four enforcement layers (ISSUE 5 + ISSUE 14, doc/static_analysis.md):
 
 - :mod:`corro_sim.analysis.rules` / :mod:`corro_sim.analysis.lint` —
   the AST rule engine (`corro-sim lint`, tools/corro_lint.py): JAX
   trace hazards (implicit host sync, PRNG reuse, weak scalars, traced
-  branches, trace-time host mutation, use-after-donate) with per-rule
+  branches, trace-time host mutation, use-after-donate, module-scope
+  jit, unpinned rank sorts) with per-rule
   ``# corro-lint: ignore[RULE]`` suppressions;
 - :mod:`corro_sim.analysis.jaxpr_audit` — compiles ``sim_step`` under a
   matrix of feature-off configs and asserts the vacuity invariants +
   the committed primitive-count golden fingerprint (`corro-sim audit`);
+- :mod:`corro_sim.analysis.dataflow` /
+  :mod:`corro_sim.analysis.contracts` — the program-contract auditor
+  (`corro-sim audit --contracts`): jaxpr dataflow vacuity proofs for
+  every registered feature x program, collective budgets of the
+  sharded/sweep programs, determinism lints, and a static peak-HBM
+  liveness golden (``analysis/golden/program_contracts.json``);
 - :mod:`corro_sim.analysis.transfer_guard` — ``jax.transfer_guard``
   wiring around the driver's chunk loop (CORRO_SIM_TRANSFER_GUARD),
   enforcing PR 4's async-copy discipline at runtime.

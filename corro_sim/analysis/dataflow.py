@@ -1,0 +1,449 @@
+"""jaxpr dataflow engine: forward influence propagation + liveness.
+
+The jaxpr audit (:mod:`corro_sim.analysis.jaxpr_audit`) proves program
+IDENTITY — "feature off traces the byte-identical program". The contract
+auditor (:mod:`corro_sim.analysis.contracts`) needs the stronger,
+per-edge claim: *which inputs can influence which outputs at all*, for
+every input at once, without executing anything. Corrosion gets this
+class of invariant from the borrow checker; here the jaxpr IS the
+program, so a forward dataflow over its equations is a real proof over
+all input values, not a sample.
+
+Three analyses, all conservative (may over-approximate influence /
+liveness, never under-approximate — a "cannot influence" verdict is
+sound):
+
+- **influence** (:func:`influence_masks`) — per-variable bitmasks of
+  the program inputs that can flow into it, propagated through every
+  equation with per-primitive rules: ``scan``/``while`` iterate their
+  carry to a fixpoint (loop-carried flow), ``cond`` unions its branches
+  plus the predicate (control dependence), ``pjit``/``closed_call``/
+  ``custom_jvp_call``/``remat``/``shard_map`` recurse into their
+  sub-jaxpr, and any UNKNOWN primitive (including opaque
+  ``custom_call``s) falls back to all-inputs-to-all-outputs — unknown
+  ops can only make the analysis more conservative, never unsound;
+- **liveness** (:func:`peak_bytes`) — a last-use buffer walk yielding a
+  static peak-resident estimate per program (the HBM contract's number)
+  plus the per-equation transient high-water mark;
+- **censuses** — :func:`sort_eqns` / :func:`while_eqns` /
+  :func:`collective_census` collect the determinism- and
+  collective-budget-relevant equations recursively.
+
+Nothing in this module imports jax at module scope; callers hand in a
+``ClosedJaxpr`` (``jax.make_jaxpr``'s output) and get Python ints back.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+
+# jaxpr primitives that ARE cross-device collectives (the manual /
+# shard_map spellings — GSPMD-inserted collectives only exist post-
+# partitioning, see stablehlo_collective_census for that layer)
+COLLECTIVE_PRIMITIVES = frozenset({
+    "all_to_all", "psum", "psum2", "pmax", "pmin", "all_gather",
+    "ppermute", "psum_scatter", "reduce_scatter", "pbroadcast",
+    "axis_index",
+})
+# axis_index is device-local (no communication) and pbroadcast is the
+# check_rep replication annotation psum rewrites through under
+# shard_map — both only meaningful under a mapped axis; keep them out
+# of the *budget* count while still reporting them in the census
+NON_COMMUNICATING = frozenset({"axis_index", "pbroadcast"})
+
+# primitives with no fixed influence semantics we would ever want to
+# allowlist as deterministic; anything here appearing in a step body is
+# a determinism violation outright
+NONDETERMINISTIC_PRIMITIVES = frozenset({
+    "infeed", "outfeed",
+})
+
+# StableHLO / post-partitioning HLO collective op spellings
+_STABLEHLO_COLLECTIVES = (
+    "all_to_all", "all_reduce", "all_gather", "collective_permute",
+    "reduce_scatter", "collective_broadcast",
+)
+
+
+# ------------------------------------------------------------ influence
+
+class _Env:
+    """Var -> influence bitmask (int). Literals carry no influence."""
+
+    def __init__(self):
+        self._m: dict[int, int] = {}
+
+    def read(self, atom) -> int:
+        # Literal has .val, Var does not
+        if hasattr(atom, "val"):
+            return 0
+        return self._m.get(id(atom), 0)
+
+    def write(self, var, mask: int) -> None:
+        self._m[id(var)] = mask
+
+
+def _subjaxpr(obj):
+    """Unwrap a ClosedJaxpr-or-Jaxpr param value to a plain Jaxpr."""
+    inner = getattr(obj, "jaxpr", None)
+    return inner if inner is not None else obj
+
+
+def _eval_jaxpr(jaxpr, in_masks: list[int], on_eqn=None) -> list[int]:
+    """Propagate input masks through one (open) jaxpr; returns the
+    outvar masks. ``in_masks`` aligns with ``jaxpr.invars``; constvars
+    are influence-free (baked trace-time constants). ``on_eqn(eqn,
+    in_masks)`` observes every equation (at every nesting depth) with
+    its operands' resolved masks — the contextual censuses
+    (:func:`while_eqns`) ride this hook."""
+    env = _Env()
+    for v in jaxpr.constvars:
+        env.write(v, 0)
+    assert len(in_masks) == len(jaxpr.invars), (
+        len(in_masks), len(jaxpr.invars)
+    )
+    for v, m in zip(jaxpr.invars, in_masks):
+        env.write(v, m)
+    for eqn in jaxpr.eqns:
+        ins = [env.read(a) for a in eqn.invars]
+        if on_eqn is not None:
+            on_eqn(eqn, ins)
+        outs = _eqn_rule(eqn, ins, on_eqn=on_eqn)
+        for v, m in zip(eqn.outvars, outs):
+            env.write(v, m)
+    return [env.read(a) for a in jaxpr.outvars]
+
+
+def _eqn_rule(eqn, ins: list[int], on_eqn=None) -> list[int]:
+    """Per-primitive influence rule; default = union-to-all (sound)."""
+    name = eqn.primitive.name
+    n_out = len(eqn.outvars)
+
+    if name == "scan":
+        body = _subjaxpr(eqn.params["jaxpr"])
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        consts = ins[:nc]
+        carry = list(ins[nc:nc + ncar])
+        xs = ins[nc + ncar:]
+        # fixpoint over the loop-carried masks (monotone on a finite
+        # lattice: terminates)
+        while True:
+            outs = _eval_jaxpr(body, consts + carry + xs, on_eqn=on_eqn)
+            new_carry = [c | o for c, o in zip(carry, outs[:ncar])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        ys = outs[ncar:]
+        return carry + ys
+
+    if name == "while":
+        cond = _subjaxpr(eqn.params["cond_jaxpr"])
+        body = _subjaxpr(eqn.params["body_jaxpr"])
+        cn = eqn.params["cond_nconsts"]
+        bn = eqn.params["body_nconsts"]
+        cconsts = ins[:cn]
+        bconsts = ins[cn:cn + bn]
+        carry = list(ins[cn + bn:])
+        while True:
+            outs = _eval_jaxpr(body, bconsts + carry, on_eqn=on_eqn)
+            new_carry = [c | o for c, o in zip(carry, outs)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        # control dependence: the trip count gates every output
+        pred = _eval_jaxpr(cond, cconsts + carry, on_eqn=on_eqn)
+        pmask = 0
+        for m in pred:
+            pmask |= m
+        return [c | pmask for c in carry]
+
+    if name == "cond":
+        branches = eqn.params["branches"]
+        pred = ins[0]
+        ops = ins[1:]
+        outs = [0] * n_out
+        for br in branches:
+            b = _eval_jaxpr(_subjaxpr(br), ops, on_eqn=on_eqn)
+            outs = [o | m for o, m in zip(outs, b)]
+        return [o | pred for o in outs]
+
+    # transparent single-sub-jaxpr wrappers with 1:1 invar mapping
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is not None:
+            body = _subjaxpr(sub)
+            if len(body.invars) == len(ins):
+                return _eval_jaxpr(body, ins, on_eqn=on_eqn)
+            break  # arity mismatch (e.g. custom_vjp extras): fall back
+
+    # default: every output influenced by every input (sound)
+    u = 0
+    for m in ins:
+        u |= m
+    return [u] * n_out
+
+
+def influence_masks(closed_jaxpr) -> list[int]:
+    """Per-output influence bitmask: output *i*'s mask has bit *j* set
+    iff program input *j* can influence it. One pass computes the full
+    input x output influence relation (bit j of input j's seed)."""
+    jaxpr = closed_jaxpr.jaxpr
+    seeds = [1 << i for i in range(len(jaxpr.invars))]
+    return _eval_jaxpr(jaxpr, seeds)
+
+
+def influenced_outputs(closed_jaxpr, taint_in: set[int]) -> set[int]:
+    """Indices of outputs influenced by any of the ``taint_in`` input
+    indices (the vacuity question, asked of one taint seed set)."""
+    mask = 0
+    for i in taint_in:
+        mask |= 1 << i
+    return {
+        o for o, m in enumerate(influence_masks(closed_jaxpr))
+        if m & mask
+    }
+
+
+def inert_inputs(closed_jaxpr) -> set[int]:
+    """Input indices that influence NO output except (at most) an
+    identity pass-through of themselves — the dead/placeholder carried
+    leaves the liveness contract reports. An input is *inert* when every
+    output it influences is the unmodified input var itself."""
+    jaxpr = closed_jaxpr.jaxpr
+    masks = influence_masks(closed_jaxpr)
+    invar_ids = {id(v): i for i, v in enumerate(jaxpr.invars)}
+    out: set[int] = set()
+    for i, v in enumerate(jaxpr.invars):
+        bit = 1 << i
+        inert = True
+        for o, (ov, m) in enumerate(zip(jaxpr.outvars, masks)):
+            if not (m & bit):
+                continue
+            if invar_ids.get(id(ov)) == i:
+                continue  # identity thread-through of itself
+            inert = False
+            break
+        if inert:
+            out.add(i)
+    return out
+
+
+# -------------------------------------------------------------- censuses
+
+def _walk_eqns(jaxpr):
+    """Yield every eqn, recursing into sub-jaxpr params."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in v if isinstance(v, (list, tuple)) else (v,):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is None and hasattr(sub, "eqns"):
+                    inner = sub
+                if inner is not None:
+                    yield from _walk_eqns(inner)
+
+
+def sort_eqns(closed_jaxpr) -> list[dict]:
+    """Every ``sort`` equation with its stability flag — the
+    determinism contract's raw material."""
+    out = []
+    for eqn in _walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name == "sort":
+            out.append({
+                "is_stable": bool(eqn.params.get("is_stable", False)),
+                "num_keys": int(eqn.params.get("num_keys", 1)),
+                "dimension": int(eqn.params.get("dimension", 0)),
+            })
+    return out
+
+
+def while_eqns(closed_jaxpr) -> list[dict]:
+    """Every ``while`` equation (at any nesting depth), flagged
+    ``data_dependent`` when its trip count — the cond output, with the
+    carry masks iterated to their loop fixpoint — is influenced by the
+    PROGRAM'S OWN INPUTS rather than only by baked trace-time
+    constants. Contextual by construction (the census rides the
+    influence walk's per-eqn hook), so a counter loop whose bounds are
+    baked constants is NOT flagged, while any trip count derived from
+    program data is — the class the step-body determinism contract
+    forbids (wall time, and on some backends results, become a
+    function of values)."""
+    jaxpr = closed_jaxpr.jaxpr
+    seeds = [1 << i for i in range(len(jaxpr.invars))]
+    out = []
+
+    def on_eqn(eqn, ins):
+        if eqn.primitive.name != "while":
+            return
+        cond = _subjaxpr(eqn.params["cond_jaxpr"])
+        body = _subjaxpr(eqn.params["body_jaxpr"])
+        cn = eqn.params["cond_nconsts"]
+        bn = eqn.params["body_nconsts"]
+        bconsts = ins[cn:cn + bn]
+        carry = list(ins[cn + bn:])
+        while True:
+            outs = _eval_jaxpr(body, bconsts + carry)
+            new_carry = [c | o for c, o in zip(carry, outs)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        pred = _eval_jaxpr(cond, ins[:cn] + carry)
+        dep = any(m != 0 for m in pred)
+        out.append({
+            "data_dependent": bool(dep), "carry": len(carry),
+        })
+
+    _eval_jaxpr(jaxpr, seeds, on_eqn=on_eqn)
+    return out
+
+
+def nondeterministic_eqns(closed_jaxpr) -> list[str]:
+    return [
+        eqn.primitive.name
+        for eqn in _walk_eqns(closed_jaxpr.jaxpr)
+        if eqn.primitive.name in NONDETERMINISTIC_PRIMITIVES
+    ]
+
+
+def collective_census(closed_jaxpr) -> dict[str, int]:
+    """Count of explicit collective primitives (shard_map spellings),
+    recursively. GSPMD-inserted collectives do not exist at this layer
+    — see :func:`stablehlo_collective_census` /
+    :func:`hlo_collective_census` for the lowered/compiled views."""
+    counts: dict[str, int] = {}
+    for eqn in _walk_eqns(closed_jaxpr.jaxpr):
+        if eqn.primitive.name in COLLECTIVE_PRIMITIVES:
+            counts[eqn.primitive.name] = (
+                counts.get(eqn.primitive.name, 0) + 1
+            )
+    return counts
+
+
+def stablehlo_collective_census(text: str) -> dict[str, int]:
+    """Collective-op census of lowered StableHLO MLIR text (explicit /
+    shard_map collectives appear here; GSPMD ones do not until the
+    partitioner runs at compile)."""
+    counts: dict[str, int] = {}
+    for op in _STABLEHLO_COLLECTIVES:
+        n = len(re.findall(rf"stablehlo\.{op}\b", text))
+        if n:
+            counts[op] = n
+    return counts
+
+
+def hlo_collective_census(text: str) -> dict[str, int]:
+    """Collective-op census of COMPILED (post-SPMD-partitioning) HLO
+    text — the census that proves GSPMD inserted nothing: every
+    cross-device op the program will ever issue is spelled here."""
+    counts: dict[str, int] = {}
+    for op in _STABLEHLO_COLLECTIVES:
+        hlo_op = op.replace("_", "-")
+        # HLO instruction form: `name = type all-to-all(...)`
+        n = len(re.findall(rf"\s{hlo_op}(?:-start|-done)?\(", text))
+        if n:
+            counts[op] = n
+    return counts
+
+
+# -------------------------------------------------------------- liveness
+
+@dataclasses.dataclass
+class LivenessReport:
+    peak_bytes: int  # static peak-resident estimate
+    input_bytes: int  # flattened program inputs (the carry ABI)
+    output_bytes: int
+    const_bytes: int  # trace-baked constants riding the executable
+    transient_bytes: int  # peak minus the always-resident inputs
+
+
+def _aval_bytes(var) -> int:
+    aval = var.aval
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", None)
+    itemsize = getattr(dtype, "itemsize", 1) if dtype is not None else 1
+    return int(math.prod(shape)) * int(itemsize) if shape else int(itemsize)
+
+
+def _jaxpr_peak(jaxpr) -> tuple[int, int]:
+    """(peak_bytes, io_bytes) of one open jaxpr: a last-use linear walk.
+    Buffers live from their defining equation to their last consuming
+    equation (outvars to the end). Sub-jaxpr equations contribute their
+    own inner transient high-water mark on top of their operands."""
+    last_use: dict[int, int] = {}
+    n_eqns = len(jaxpr.eqns)
+    for i, eqn in enumerate(jaxpr.eqns):
+        for a in eqn.invars:
+            if not hasattr(a, "val"):
+                last_use[id(a)] = i
+    for a in jaxpr.outvars:
+        if not hasattr(a, "val"):
+            last_use[id(a)] = n_eqns
+
+    live: dict[int, int] = {}  # id(var) -> bytes
+    io_bytes = 0
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        b = _aval_bytes(v)
+        io_bytes += b
+        if id(v) in last_use:
+            live[id(v)] = b
+    peak = sum(live.values())
+    for i, eqn in enumerate(jaxpr.eqns):
+        out_b = sum(_aval_bytes(v) for v in eqn.outvars)
+        inner = _eqn_inner_transient(eqn)
+        cur = sum(live.values()) + out_b + inner
+        peak = max(peak, cur)
+        for v in eqn.outvars:
+            if last_use.get(id(v), -1) > i or id(v) in {
+                id(o) for o in jaxpr.outvars
+            }:
+                live[id(v)] = _aval_bytes(v)
+        # retire buffers whose last use was this equation
+        dead = [k for k, u in last_use.items() if u == i]
+        for k in dead:
+            live.pop(k, None)
+            last_use.pop(k, None)
+    return peak, io_bytes
+
+
+def _eqn_inner_transient(eqn) -> int:
+    """Transient bytes a sub-jaxpr equation needs BEYOND its operands
+    and results (both already counted by the outer walk)."""
+    inner_peaks = []
+    for v in eqn.params.values():
+        for sub in v if isinstance(v, (list, tuple)) else (v,):
+            body = getattr(sub, "jaxpr", None)
+            if body is None and hasattr(sub, "eqns"):
+                body = sub
+            if body is not None:
+                p, io = _jaxpr_peak(body)
+                inner_peaks.append(max(0, p - io))
+    return max(inner_peaks, default=0)
+
+
+def liveness(closed_jaxpr) -> LivenessReport:
+    """Static peak-HBM estimate of one traced program.
+
+    Methodology (doc/static_analysis.md §"Program contracts"): buffers
+    live from definition to last textual use, program inputs and consts
+    are resident throughout, sub-jaxprs (scan bodies, cond branches)
+    contribute their inner high-water mark on top of their operands.
+    No aliasing/donation/fusion modeling — XLA fuses elementwise chains
+    into no buffer at all and rematerializes others, so this is an
+    upper-bound-shaped ESTIMATE whose value is drift detection, not an
+    allocator:  a PR that doubles the static peak doubled something
+    real."""
+    jaxpr = closed_jaxpr.jaxpr
+    peak, _ = _jaxpr_peak(jaxpr)
+    in_b = sum(_aval_bytes(v) for v in jaxpr.invars)
+    out_b = sum(_aval_bytes(v) for v in jaxpr.outvars)
+    const_b = sum(_aval_bytes(v) for v in jaxpr.constvars)
+    return LivenessReport(
+        peak_bytes=int(peak),
+        input_bytes=int(in_b),
+        output_bytes=int(out_b),
+        const_bytes=int(const_b),
+        transient_bytes=int(max(0, peak - in_b - const_b)),
+    )

@@ -60,34 +60,25 @@ def step_jaxpr(cfg, repair: bool = False, workload: bool = False):
     schedule arrays as extra inputs — the ON side of the workload
     vacuity claim."""
     import jax
-    import jax.numpy as jnp
 
-    from corro_sim.engine.state import init_state
-    from corro_sim.engine.step import make_step, make_workload_step
+    from corro_sim.engine.step import (
+        make_step,
+        make_workload_step,
+        step_input_avals,
+    )
 
-    n = cfg.num_nodes
-    s = cfg.seqs_per_version
-    state = jax.eval_shape(lambda: init_state(cfg, seed=0))
-    key = jax.eval_shape(lambda: jax.random.PRNGKey(0))
-    alive = jax.ShapeDtypeStruct((n,), jnp.bool_)
-    part = jax.ShapeDtypeStruct((n,), jnp.int32)
-    we = jax.ShapeDtypeStruct((), jnp.bool_)
+    # the ONE input-ABI definition (engine/step.py): the same avals feed
+    # this tracer and the contract auditor's provenance mapping, so the
+    # flat invar order cannot drift between the two
+    avals = step_input_avals(cfg, workload=workload)
 
     if workload:
         body = make_workload_step(cfg, repair=repair)
-        wl = (
-            jax.ShapeDtypeStruct((n,), jnp.bool_),  # writers
-            jax.ShapeDtypeStruct((n, s), jnp.int32),  # rows
-            jax.ShapeDtypeStruct((n, s), jnp.int32),  # cols
-            jax.ShapeDtypeStruct((n, s), jnp.int32),  # vals
-            jax.ShapeDtypeStruct((n,), jnp.bool_),  # dels
-            jax.ShapeDtypeStruct((n,), jnp.int32),  # ncells
-        )
 
         def step_wl(st, k, a, p, w, *writes):
             return body(st, (k, a, p, w, *writes))
 
-        return jax.make_jaxpr(step_wl)(state, key, alive, part, we, *wl)
+        return jax.make_jaxpr(step_wl)(*avals)
 
     # the exact scan body the driver iterates (engine/step.py:make_step)
     body = make_step(cfg, repair=repair)
@@ -95,7 +86,7 @@ def step_jaxpr(cfg, repair: bool = False, workload: bool = False):
     def step(st, k, a, p, w):
         return body(st, (k, a, p, w))
 
-    return jax.make_jaxpr(step)(state, key, alive, part, we)
+    return jax.make_jaxpr(step)(*avals)
 
 
 def primitive_fingerprint(closed_jaxpr) -> dict:
@@ -413,12 +404,16 @@ def audit(cfg=None) -> dict:
 
 
 def run_audit(update_golden: bool = False, out: str | None = None,
-              as_json: bool = False, diff: bool = False) -> int:
+              as_json: bool = False, diff: bool = False,
+              contracts: bool = False) -> int:
     """The `corro-sim audit` entrypoint: trace, audit, check (or
     rewrite) the golden fingerprint; returns the exit code. Exit 1 on
     any vacuity/hazard problem or golden drift. ``diff`` additionally
     reports the per-primitive eqn delta vs the golden (informational —
-    printed pass or fail, and embedded in the JSON report)."""
+    printed pass or fail, and embedded in the JSON report).
+    ``contracts`` additionally runs the program-contract auditor
+    (:mod:`corro_sim.analysis.contracts`) against its own committed
+    manifest — with ``update_golden`` that manifest re-baselines too."""
     report = audit()
     if update_golden:
         write_golden(report)
@@ -445,6 +440,18 @@ def run_audit(update_golden: bool = False, out: str | None = None,
     report["ok"] = report["ok"] and not drift
     if diff:
         report["golden_diff"] = golden_diff(report)
+    if contracts:
+        from corro_sim.analysis import contracts as _contracts
+
+        if update_golden:
+            crep = _contracts.build_report()
+            _contracts.write_golden(crep)
+            crep["golden_updated"] = _contracts.GOLDEN_PATH
+            crep = _contracts.check(crep)
+        else:
+            crep = _contracts.check()
+        report["contracts"] = crep
+        report["ok"] = report["ok"] and crep["ok"]
     if as_json:
         print(json.dumps(report, indent=2))
     else:
@@ -476,12 +483,23 @@ def run_audit(update_golden: bool = False, out: str | None = None,
                         key=lambda kv: (-abs(kv[1]), kv[0]),
                     ):
                         print(f"diff       {prim:<24} {delta:+d}")
+        if contracts:
+            from corro_sim.analysis import contracts as _contracts
+
+            for line in _contracts.render_text(report["contracts"]):
+                print(line)
         for p in report["problems"] + drift:
             print(f"PROBLEM  {p}")
         if report.get("golden_skipped"):
             print(f"golden   skipped: {report['golden_skipped']}")
         if update_golden:
             print(f"golden   updated: {GOLDEN_PATH}")
+            if contracts:
+                from corro_sim.analysis.contracts import (
+                    GOLDEN_PATH as CONTRACTS_GOLDEN,
+                )
+
+                print(f"golden   updated: {CONTRACTS_GOLDEN}")
         print("audit:", "ok" if report["ok"] else "FAILED")
     if out:
         with open(out, "w", encoding="utf-8") as fh:

@@ -29,6 +29,18 @@ perturbs key derivation. These rules encode the hazards that have bitten
   CL106 use-after-donate a buffer passed at a donated argnum and read
                         again after the call — donated input buffers are
                         invalidated by XLA aliasing.
+  CL107 module-scope-jit a ``jax.jit`` call (bare or decorator) at
+                        module/class scope — it executes at import
+                        time, before entrypoints configure the
+                        persistent compile cache and backend (the
+                        PR 10 class of latent bug that silently ran
+                        every CLI process cache-dir-less).
+  CL108 unseeded-shuffle a ``sort``/``argsort`` whose stability is not
+                        pinned (``stable=True`` / ``kind="stable"`` /
+                        ``is_stable=True``) feeding scatter/gather
+                        ranks — the determinism contract's AST-level
+                        early warning (analysis/contracts.py pins the
+                        same claim at the jaxpr layer).
 
 Trace context is inferred statically: functions decorated with ``jit``
 (including ``functools.partial(jax.jit, ...)``), callbacks handed to
@@ -80,6 +92,12 @@ RULES: dict[str, Rule] = {
              "code (runs at trace time only)"),
         Rule("CL106", "use-after-donate", "error",
              "buffer read after being donated to a jit-compiled call"),
+        Rule("CL107", "module-scope-jit", "warning",
+             "jax.jit executed at module import time, before "
+             "entrypoints configure the compile cache/backend"),
+        Rule("CL108", "unseeded-shuffle", "warning",
+             "sort/argsort without pinned stability feeding "
+             "scatter/gather ranks"),
     )
 }
 
@@ -858,6 +876,191 @@ def _check_prng_reuse(idx: _ModuleIndex, fn: ast.FunctionDef,
             ))
 
 
+# --------------------------------------------- CL107 (module-scope jit)
+
+_JIT_NAMES = {"jax.jit", "jax.pjit", "jit", "pjit"}
+
+
+def _check_module_scope_jit(idx: _ModuleIndex,
+                            findings: list[Finding]) -> None:
+    """A ``jax.jit(...)`` call that runs at import — a bare call in a
+    module/class-scope statement, or a ``@jax.jit`` decorator on a
+    module-level def (the decorator call executes at import too). The
+    jitted runner is then constructed before any entrypoint has
+    configured the persistent compile cache or pinned the platform
+    (the PR 10 latent-bug class: every CLI process silently ran with
+    the cache dir unset). Code inside ``lambda``/generator bodies is
+    lazy and exempt; function bodies are checked as their own scope
+    (where a jit construction is a deliberate, post-config act)."""
+
+    def emit(node) -> None:
+        findings.append(Finding(
+            rule="CL107", severity=RULES["CL107"].severity,
+            path=idx.path, line=node.lineno, col=node.col_offset,
+            message=(
+                "module-scope jax.jit executes at import time — the "
+                "runner is built before entrypoints configure the "
+                "persistent compile cache / backend platform; "
+                "construct it lazily inside the code that dispatches "
+                "it (functools.cache'd builder)"
+            ),
+        ))
+
+    def scan_expr(node) -> None:
+        # walk an import-time-evaluated expression, skipping lazy
+        # bodies (lambda, generator expressions)
+        if isinstance(node, (ast.Lambda, ast.GeneratorExp)):
+            return
+        if isinstance(node, ast.Call):
+            d = idx.dotted(node.func)
+            if d in _JIT_NAMES:
+                emit(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                scan_expr(child)
+
+    def scan_stmts(stmts) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in st.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if idx.dotted(target) in _JIT_NAMES:
+                        emit(dec)
+                continue  # the body runs at call time, not import time
+            if isinstance(st, ast.ClassDef):
+                scan_stmts(st.body)  # class bodies execute at import
+                continue
+            # one traversal only: child statements (If/Try/With/For
+            # bodies) recurse directly, expressions scan, and non-
+            # stmt/expr carriers (ExceptHandler, match_case) recurse
+            # through their body lists — double-visiting a statement
+            # would emit duplicate findings at the same position
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    scan_expr(child)
+                elif isinstance(child, ast.stmt):
+                    scan_stmts([child])
+                elif isinstance(
+                    getattr(child, "body", None), list
+                ):
+                    scan_stmts(child.body)
+
+    scan_stmts(idx.tree.body)
+
+
+# --------------------------------------------- CL108 (unseeded shuffle)
+
+_JNP_SORTS = {"jax.numpy.sort", "jax.numpy.argsort"}
+_RANK_CONSUMERS = {"take", "take_along_axis"}
+# result-shaping wrappers a sort result rides through before use
+_SORT_WRAPPERS = {"astype", "reshape", "clip", "transpose", "squeeze"}
+
+
+def _unpinned_sort(idx: _ModuleIndex, node: ast.AST) -> ast.Call | None:
+    """The sort call behind ``node`` (descending through astype/
+    reshape/slicing wrappers) IF its stability is not pinned, else
+    None. jnp defaults to a stable sort, but an unpinned call is one
+    signature-default change (or one refactor onto ``lax.sort``, whose
+    default is UNSTABLE) away from nondeterministic ranks — the
+    determinism contract wants the pin in the source."""
+    while True:
+        if isinstance(node, ast.Subscript):
+            node = node.value
+            continue
+        if isinstance(node, ast.Attribute):
+            node = node.value
+            continue
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ) and node.func.attr in _SORT_WRAPPERS:
+            node = node.func.value
+            continue
+        break
+    if not isinstance(node, ast.Call):
+        return None
+    d = idx.dotted(node.func)
+    if d in _JNP_SORTS:
+        for kw in node.keywords:
+            if kw.arg == "stable" and isinstance(
+                kw.value, ast.Constant
+            ) and kw.value.value is True:
+                return None
+            if kw.arg == "kind" and isinstance(
+                kw.value, ast.Constant
+            ) and kw.value.value == "stable":
+                return None
+        return node
+    if d == "jax.lax.sort":
+        for kw in node.keywords:
+            if kw.arg == "is_stable" and isinstance(
+                kw.value, ast.Constant
+            ) and kw.value.value is True:
+                return None
+        return node
+    return None
+
+
+def _check_unseeded_shuffle(idx: _ModuleIndex, fn: ast.FunctionDef,
+                            findings: list[Finding]) -> None:
+    """Unpinned sorts whose result is used as scatter/gather ranks
+    within the function: ``x[order]`` / ``x.at[order]`` subscripts or
+    ``take``/``take_along_axis`` calls. Reported at the sort call —
+    that is where ``stable=True`` belongs."""
+    candidates: dict[str, ast.Call] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            call = _unpinned_sort(idx, node.value)
+            if call is not None:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        candidates[t.id] = call
+
+    flagged: set[int] = set()
+
+    def emit(call: ast.Call) -> None:
+        if id(call) in flagged:
+            return
+        flagged.add(id(call))
+        findings.append(Finding(
+            rule="CL108", severity=RULES["CL108"].severity,
+            path=idx.path, line=call.lineno, col=call.col_offset,
+            message=(
+                "unpinned sort feeds scatter/gather ranks — pass "
+                "stable=True (jnp's default is stable today, but the "
+                "pin is what the determinism contract can hold; "
+                "lax.sort defaults to UNSTABLE)"
+            ),
+        ))
+
+    def rank_use(expr: ast.AST) -> None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in candidates:
+                emit(candidates[n.id])
+            inline = _unpinned_sort(idx, n) if isinstance(
+                n, ast.Call
+            ) else None
+            if inline is not None:
+                emit(inline)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript):
+            rank_use(node.slice)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and (
+                func.attr in _RANK_CONSUMERS
+            ):
+                for a in node.args[1:] or node.args:
+                    rank_use(a)
+            else:
+                d = idx.dotted(func)
+                if d is not None and d.rsplit(".", 1)[-1] in (
+                    _RANK_CONSUMERS
+                ):
+                    for a in node.args:
+                        rank_use(a)
+
+
 # ------------------------------------------------- trace-context graph
 
 def _trace_seeds_and_edges(idx: _ModuleIndex):
@@ -975,11 +1178,13 @@ def analyze(trees: dict[str, ast.Module]) -> list[Finding]:
 
     findings: list[Finding] = []
     for idx in indexes:
+        _check_module_scope_jit(idx, findings)
         for qual, fn in idx.functions.items():
             is_traced = (idx.module, qual) in traced
             _FunctionChecker(idx, fn, is_traced, findings).run()
             _check_prng_reuse(idx, fn, findings)
             _check_donation_uses(idx, fn, findings)
+            _check_unseeded_shuffle(idx, fn, findings)
         # module-level statements: PRNG + donation discipline
         pseudo = ast.FunctionDef(
             name="<module>", args=ast.arguments(
@@ -994,5 +1199,6 @@ def analyze(trees: dict[str, ast.Module]) -> list[Finding]:
         )
         _check_prng_reuse(idx, pseudo, findings)
         _check_donation_uses(idx, pseudo, findings)
+        _check_unseeded_shuffle(idx, pseudo, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -598,6 +598,37 @@ def run_config_4(n: int | None = None) -> dict:
     return run_headline_bench(n=n)
 
 
+def config5_cfg(n: int):
+    """The config-5 cluster shape at ``n`` nodes — module-level so the
+    contract auditor's static HBM estimator
+    (:mod:`corro_sim.analysis.contracts`) can rebuild the EXACT config
+    behind a committed artifact's measured ``device_hbm`` and compare.
+
+    Catch-up at this scale is an EPIDEMIC, not a budget problem:
+    right after the outage ends, each written version's holders are
+    few (the writer + whatever gossip reached), and the 3-inbound
+    server semaphore means an actor's holder set can only grow ~4x
+    per sweep IN WHICH SOMEBODY REQUESTS THAT ACTOR. A narrow
+    shared hot window synchronizes the whole cluster onto one
+    actor cohort per sweep, so each actor is serviced once per
+    full rotation — measured on a ratio-matched 4k repro:
+    window 64 converged at round 381, window 1024 at round 125
+    (doc/round5.md). The window must keep the rotation SHORT
+    (hot/window ~4-8): 8192 at 50k. cap 16 drains an actor's whole
+    backlog in one visit; 4 peer slots suffice (the semaphore
+    grants ~3) and halve the dense capability planes.
+    """
+    from corro_sim.config import SimConfig
+
+    return SimConfig(
+        num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
+        write_rate=0.2, swim_enabled=False, sync_interval=4,
+        sync_adaptive=True, sync_floor_rounds=1, sync_peers=4,
+        sync_actor_topk=512, sync_cap_per_actor=16,
+        sync_req_actors=512, sync_hot_actors=8192,
+    )
+
+
 def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
                  write_rounds: int = 24,
                  progress_path: str | None = None) -> dict:
@@ -618,34 +649,12 @@ def run_config_5(nodes: int = 50000, outage_frac: float = 0.3,
     import jax
     import numpy as np_
 
-    from corro_sim.config import SimConfig
     from corro_sim.engine.driver import Schedule
     from corro_sim.engine.sharding import make_mesh, state_bytes
 
     devices = jax.devices()
     mesh = make_mesh(devices) if len(devices) > 1 else None
-
-    def mk_cfg(n):
-        # Catch-up at this scale is an EPIDEMIC, not a budget problem:
-        # right after the outage ends, each written version's holders are
-        # few (the writer + whatever gossip reached), and the 3-inbound
-        # server semaphore means an actor's holder set can only grow ~4x
-        # per sweep IN WHICH SOMEBODY REQUESTS THAT ACTOR. A narrow
-        # shared hot window synchronizes the whole cluster onto one
-        # actor cohort per sweep, so each actor is serviced once per
-        # full rotation — measured on a ratio-matched 4k repro:
-        # window 64 converged at round 381, window 1024 at round 125
-        # (doc/round5.md). The window must keep the rotation SHORT
-        # (hot/window ~4-8): 8192 at 50k. cap 16 drains an actor's whole
-        # backlog in one visit; 4 peer slots suffice (the semaphore
-        # grants ~3) and halve the dense capability planes.
-        return SimConfig(
-            num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
-            write_rate=0.2, swim_enabled=False, sync_interval=4,
-            sync_adaptive=True, sync_floor_rounds=1, sync_peers=4,
-            sync_actor_topk=512, sync_cap_per_actor=16,
-            sync_req_actors=512, sync_hot_actors=8192,
-        )
+    mk_cfg = config5_cfg
 
     sized_reason = None
     if mesh is None:
@@ -892,6 +901,27 @@ def _device_hbm_stats() -> list[dict]:
     return out
 
 
+def config7_cfg(n: int):
+    """The config-7 cluster shape at ``n`` nodes — module-level for the
+    same reason as :func:`config5_cfg` (the static-HBM cross-check
+    rebuilds the measured artifact's exact config)."""
+    from corro_sim.config import SimConfig
+
+    return SimConfig(
+        num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
+        write_rate=0.2,
+        # windowed SWIM: O(N*K) belief state — the full (N, N)
+        # plane would be 40 GB at 100k (test_sharding_memory.py)
+        swim_enabled=True, swim_view_size=128, swim_interval=4,
+        sync_interval=4, sync_adaptive=True, sync_floor_rounds=1,
+        sync_peers=4, sync_actor_topk=512, sync_cap_per_actor=16,
+        sync_req_actors=512, sync_hot_actors=8192,
+        # the tentpole: actor-sharded log is the EXPLICIT regime
+        # here, not the SHARD_LOG_ACTORS shape accident
+        shard_log=True,
+    )
+
+
 def run_config_7(nodes: int | None = None, write_rounds: int = 8) -> dict:
     """Config 7 — the weak-scaling multichip leg (ISSUE 8 tentpole):
     100k simulated nodes over 8 devices, actor-sharded change log ON
@@ -911,7 +941,6 @@ def run_config_7(nodes: int | None = None, write_rounds: int = 8) -> dict:
     """
     import jax
 
-    from corro_sim.config import SimConfig
     from corro_sim.engine.driver import Schedule, run_sim
     from corro_sim.engine.sharding import (
         make_mesh,
@@ -926,21 +955,7 @@ def run_config_7(nodes: int | None = None, write_rounds: int = 8) -> dict:
     devices = jax.devices()
     mesh = make_mesh(devices) if len(devices) > 1 else None
     n_dev = len(devices) if mesh is not None else 1
-
-    def mk_cfg(n):
-        return SimConfig(
-            num_nodes=n, num_rows=128, num_cols=2, log_capacity=256,
-            write_rate=0.2,
-            # windowed SWIM: O(N*K) belief state — the full (N, N)
-            # plane would be 40 GB at 100k (test_sharding_memory.py)
-            swim_enabled=True, swim_view_size=128, swim_interval=4,
-            sync_interval=4, sync_adaptive=True, sync_floor_rounds=1,
-            sync_peers=4, sync_actor_topk=512, sync_cap_per_actor=16,
-            sync_req_actors=512, sync_hot_actors=8192,
-            # the tentpole: actor-sharded log is the EXPLICIT regime
-            # here, not the SHARD_LOG_ACTORS shape accident
-            shard_log=True,
-        )
+    mk_cfg = config7_cfg
 
     # Weak scaling on ANY mesh size: each device runs its 1/8-of-100k
     # share — a 2-device host runs 2 shares, not the full leg unsized.

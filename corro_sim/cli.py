@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
 
@@ -1362,12 +1363,28 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     """`corro-sim audit` — trace sim_step under the feature-off matrix,
     assert the vacuity invariants + hazard absence, and verify (or
     rewrite with --update-golden) the committed primitive-count
-    fingerprint (analysis/golden/jaxpr_fingerprint.json)."""
+    fingerprint (analysis/golden/jaxpr_fingerprint.json). With
+    --contracts, also run the program-contract auditor (dataflow
+    vacuity proofs, collective budgets, determinism, static peak-HBM —
+    analysis/contracts.py) against its committed manifest."""
+    if args.contracts:
+        # the collective-budget contracts lower against the 8-device
+        # host mesh (the prime_cache/conftest posture) — force it
+        # BEFORE jax initializes; a no-op when the flag is already set
+        # or jax is already up (then the device gate records a skip)
+        import sys as _sys
+
+        if "jax" not in _sys.modules:
+            _flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in _flags:
+                os.environ["XLA_FLAGS"] = (
+                    _flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
     from corro_sim.analysis.jaxpr_audit import run_audit
 
     return run_audit(
         update_golden=args.update_golden, out=args.out,
-        as_json=args.json, diff=args.diff,
+        as_json=args.json, diff=args.diff, contracts=args.contracts,
     )
 
 
@@ -2061,6 +2078,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="print (and embed in the report) the per-primitive eqn "
              "delta vs the committed golden — the PR's op-budget cost "
              "at a glance, shown pass or fail",
+    )
+    pau.add_argument(
+        "--contracts", action="store_true",
+        help="also run the program-contract auditor: jaxpr dataflow "
+             "vacuity proofs for every registered feature x program, "
+             "collective budgets of the sharded/sweep programs, "
+             "determinism lints, and the static peak-HBM golden "
+             "(analysis/golden/program_contracts.json; "
+             "doc/static_analysis.md)",
     )
     pau.add_argument(
         "--out", help="also write the JSON report to this path"

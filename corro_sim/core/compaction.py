@@ -93,7 +93,7 @@ def _decrement_live(log: ChangeLog, actor, ver, valid):
 def _first_per_key(key: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
     """Mask of the first valid lane per key value (in caller order)."""
     k = jnp.where(valid, key, jnp.int32(2**30))
-    order = jnp.argsort(k)
+    order = jnp.argsort(k, stable=True)
     inv = jnp.zeros(order.shape, jnp.int32).at[order].set(
         jnp.arange(order.shape[0], dtype=jnp.int32)
     )

@@ -298,7 +298,14 @@ def _chunk_runner(
     return run_chunk
 
 
-@jax.jit
+@functools.cache
+def _dbuf_copy_runner():
+    # jit construction deferred to first dispatch (CL107): built at
+    # module import it would predate the entrypoints' compile-cache /
+    # platform configuration — the PR 10 latent-bug class
+    return jax.jit(lambda tree: jax.tree.map(jnp.copy, tree))
+
+
 def _dbuf_copy(tree):
     """Device-side deep copy of a pytree (the pipeline's donation
     double-buffer): inputs are NOT donated, so XLA cannot alias them —
@@ -306,7 +313,7 @@ def _dbuf_copy(tree):
     consumes the COPY, never the committed carry: copy-output feeding
     the donated call is a true producer→consumer dependency, so the
     in-place reuse is ordered by construction."""
-    return jax.tree.map(jnp.copy, tree)
+    return _dbuf_copy_runner()(tree)
 
 
 @dataclasses.dataclass

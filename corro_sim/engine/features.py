@@ -148,3 +148,30 @@ def enabled_feature_names(cfg) -> tuple[str, ...]:
     return tuple(
         name for name in sorted(_REGISTRY) if _REGISTRY[name].enabled(cfg)
     )
+
+
+def leaf_provenance(path: str) -> str | None:
+    """Map a flattened SimState leaf key-path (``jax.tree_util.keystr``
+    relative to the state root, e.g. ``".probe.first_seen"`` or
+    ``".features['sweep_knobs']['loss']"``) to the registry feature that
+    owns it, or ``None`` for core state.
+
+    This is the provenance marker the contract auditor's taint seeds
+    are built from (:mod:`corro_sim.analysis.contracts`): a feature's
+    vacuity proof taints exactly the input leaves this function
+    attributes to it, and allows influence only on the output leaves it
+    attributes to it. Field-style features (probe / fault_burst) own
+    their legacy SimState field subtree; dict-style features own their
+    ``features['<name>']`` subtree. The mapping is a pure function of
+    the registry, so registering a feature IS declaring its taint
+    scope — no per-feature auditor edits."""
+    for name in sorted(_REGISTRY):
+        leaf = _REGISTRY[name]
+        if leaf.field is not None:
+            if path == f".{leaf.field}" or path.startswith(
+                f".{leaf.field}."
+            ):
+                return name
+        elif path.startswith(f".features['{name}']"):
+            return name
+    return None

@@ -18,6 +18,17 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from corro_sim.engine.state import SimState
 
 
+# Collective-budget contract (analysis/contracts.py, checked by
+# `corro-sim audit --contracts`): the sharded step program's ONLY
+# explicit collective is the delivery exchange — route_merge_sharded's
+# single all_to_all (core/merge_kernel.py). A second explicit collective
+# appearing in the lowered StableHLO is schedule drift and fails the
+# audit with a per-collective diff. GSPMD-inserted collectives (the
+# partitioner's gathers for replicated operands) are a separate,
+# compile-time layer and are NOT bounded by this declaration.
+DELIVERY_EXCHANGE_COLLECTIVES: dict[str, int] = {"all_to_all": 1}
+
+
 def make_mesh(devices=None) -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(devices, axis_names=("nodes",))

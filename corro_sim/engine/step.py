@@ -109,6 +109,41 @@ def make_workload_step(cfg: SimConfig, repair: bool = False, mesh=None):
     return body
 
 
+def step_input_avals(cfg: SimConfig, workload: bool = False) -> tuple:
+    """The canonical traced-step argument avals ``(state, key, alive,
+    part, write_enable, *writes)`` — the ONE definition of the chunk
+    program's input ABI, shared by the jaxpr audit's tracer
+    (:func:`corro_sim.analysis.jaxpr_audit.step_jaxpr`) and the
+    contract auditor's provenance mapping
+    (:mod:`corro_sim.analysis.contracts`): flattening this tuple with
+    ``jax.tree_util.tree_flatten_with_path`` yields exactly the traced
+    program's invars, in order, so a flat invar index maps to a state
+    leaf path maps to a registry feature
+    (:func:`corro_sim.engine.features.leaf_provenance`) without any
+    parallel bookkeeping that could drift from the real trace."""
+    from corro_sim.engine.state import init_state
+
+    n = cfg.num_nodes
+    s = cfg.seqs_per_version
+    args = (
+        jax.eval_shape(lambda: init_state(cfg, seed=0)),
+        jax.eval_shape(lambda: jax.random.PRNGKey(0)),
+        jax.ShapeDtypeStruct((n,), jnp.bool_),  # alive
+        jax.ShapeDtypeStruct((n,), jnp.int32),  # part
+        jax.ShapeDtypeStruct((), jnp.bool_),  # write_enable
+    )
+    if workload:
+        args += (
+            jax.ShapeDtypeStruct((n,), jnp.bool_),  # writers
+            jax.ShapeDtypeStruct((n, s), jnp.int32),  # rows
+            jax.ShapeDtypeStruct((n, s), jnp.int32),  # cols
+            jax.ShapeDtypeStruct((n, s), jnp.int32),  # vals
+            jax.ShapeDtypeStruct((n,), jnp.bool_),  # dels
+            jax.ShapeDtypeStruct((n,), jnp.int32),  # ncells
+        )
+    return args
+
+
 def _reachable_fn(alive: jnp.ndarray, part: jnp.ndarray):
     """Ground-truth link predicate: both up and in the same partition."""
 
@@ -258,7 +293,8 @@ def sim_step(
                 k_ncell, (n,), 1, s_eff + 1, dtype=jnp.int32
             )
             w_col = jnp.argsort(
-                jax.random.uniform(k_col, (n, cfg.num_cols)), axis=1
+                jax.random.uniform(k_col, (n, cfg.num_cols)), axis=1,
+                stable=True,
             ).astype(jnp.int32)[:, :s_eff]
             if s_eff < s:
                 w_col = jnp.pad(w_col, ((0, 0), (0, s - s_eff)))

@@ -116,7 +116,7 @@ def enqueue_broadcasts(
         counts = jnp.minimum(counts_all, p)
     else:
         key = jnp.where(valid, dst, big)
-        order = jnp.argsort(key)
+        order = jnp.argsort(key, stable=True)
         s_dst = key[order]
         s_actor = actor[order]
         s_ver = ver[order]

@@ -421,7 +421,7 @@ def swim_step(
     def do_announce(p):
         ka = jax.random.fold_in(k_ex, 997)
         perm = jax.random.permutation(ka, n).astype(jnp.int32)
-        inv = jnp.argsort(perm).astype(jnp.int32)
+        inv = jnp.argsort(perm, stable=True).astype(jnp.int32)
         for partner in (perm, inv):
             can = (
                 alive & alive[partner] & reachable(rows, partner)

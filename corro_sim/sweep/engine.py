@@ -60,6 +60,15 @@ from corro_sim.workload.generators import empty_slice
 
 __all__ = ["LaneResult", "SweepResult", "run_sweep", "sweep_chunk_args"]
 
+# Collective-budget contract (analysis/contracts.py, checked by
+# `corro-sim audit --contracts`): lanes are independent clusters, so
+# the sweep-mesh program must contain ZERO collectives — explicit
+# (jaxpr/StableHLO) AND GSPMD-inserted (compiled HLO): the lane axis is
+# pure batch data-parallelism, and any collective appearing in the
+# partitioned program means a lane coupled to another lane, which
+# breaks the bit-identical-to-serial-twin contract above.
+SWEEP_MESH_COLLECTIVES: dict[str, int] = {}
+
 
 @dataclasses.dataclass
 class LaneResult:

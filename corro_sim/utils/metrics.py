@@ -172,6 +172,16 @@ LINT_FINDINGS_TOTAL = "corro_lint_findings_total"
 LINT_SUPPRESSIONS_TOTAL = "corro_lint_suppressions_total"
 LINT_SANCTIONED_TRANSFERS_TOTAL = "corro_lint_sanctioned_transfers_total"
 
+# ---- corro_audit_contract_*: the program-contract auditor
+# (analysis/contracts.py, `corro-sim audit --contracts`) counts every
+# statically-checked contract and every violation/drift row, labeled by
+# family (vacuity | determinism | memory | collectives):
+#   corro_audit_contract_checks_total{family}      contracts evaluated
+#   corro_audit_contract_violations_total{family}  budget violations +
+#                                                  manifest drift
+AUDIT_CONTRACT_CHECKS_TOTAL = "corro_audit_contract_checks_total"
+AUDIT_CONTRACT_VIOLATIONS_TOTAL = "corro_audit_contract_violations_total"
+
 # ---- corro_workload_* / corro_sub_latency_*: the production workload
 # engine (corro_sim/workload/, doc/workloads.md). The load harness
 # drives a compiled traffic schedule through a LiveCluster with

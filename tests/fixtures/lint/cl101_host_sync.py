@@ -1,10 +1,17 @@
-"""CL101 fixture: implicit host sync inside jitted code (fires once)."""
+"""CL101 fixture: implicit host sync inside jitted code (fires once).
+
+Trace context arms through a function-local ``jax.jit(step)`` call —
+the module-scope decorator form would itself be a CL107 finding.
+"""
 import jax
 import jax.numpy as jnp
 
 
-@jax.jit
 def step(x: jnp.ndarray):
     total = jnp.sum(x)
     scale = float(total)  # BAD: blocking device->host sync in traced code
     return x * scale
+
+
+def run(x):
+    return jax.jit(step)(x)

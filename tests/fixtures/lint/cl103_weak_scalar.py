@@ -1,9 +1,16 @@
-"""CL103 fixture: weak-typed scalar without dtype (fires once)."""
+"""CL103 fixture: weak-typed scalar without dtype (fires once).
+
+Trace context arms through a function-local ``jax.jit(scaled)`` call —
+the module-scope decorator form would itself be a CL107 finding.
+"""
 import jax
 import jax.numpy as jnp
 
 
-@jax.jit
 def scaled(x: jnp.ndarray):
     half = jnp.asarray(0.5)  # BAD: weak float scalar, promotion contextual
     return x * half
+
+
+def run(x):
+    return jax.jit(scaled)(x)

@@ -1,10 +1,17 @@
-"""CL104 fixture: Python `if` on a traced value (fires once)."""
+"""CL104 fixture: Python `if` on a traced value (fires once).
+
+Trace context arms through a function-local ``jax.jit(clamp)`` call —
+the module-scope decorator form would itself be a CL107 finding.
+"""
 import jax
 import jax.numpy as jnp
 
 
-@jax.jit
 def clamp(x: jnp.ndarray):
     if x.sum() > 0:  # BAD: traced value in Python control flow
         return x
     return -x
+
+
+def run(x):
+    return jax.jit(clamp)(x)

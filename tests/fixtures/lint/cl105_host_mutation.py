@@ -1,11 +1,18 @@
-"""CL105 fixture: trace-time mutation of captured host state (fires once)."""
+"""CL105 fixture: trace-time mutation of captured host state (fires once).
+
+Trace context arms through a function-local ``jax.jit(remember)`` call —
+the module-scope decorator form would itself be a CL107 finding.
+"""
 import jax
 import jax.numpy as jnp
 
 _cache = {}
 
 
-@jax.jit
 def remember(x: jnp.ndarray):
     _cache["last_shape"] = x.shape  # BAD: runs at trace time only
     return x + 1
+
+
+def run(x):
+    return jax.jit(remember)(x)

@@ -1,0 +1,18 @@
+"""CL107 fixture: a jitted runner constructed at module import time —
+the compile cache / platform config entrypoints set up later never
+reach it (the PR 10 latent-bug class). Exactly one finding."""
+
+import jax
+
+
+def _copy(tree):
+    return jax.tree.map(lambda x: x + 0, tree)
+
+
+step = jax.jit(_copy)  # <- CL107: executes at import
+
+
+def run(tree):
+    # calling the import-time runner is fine per se — the construction
+    # above is the finding, not this dispatch
+    return step(tree)

@@ -1,0 +1,16 @@
+"""CL108 fixture: an unpinned argsort whose result becomes scatter
+ranks — one signature-default change (or a refactor onto lax.sort,
+whose default is UNSTABLE) away from nondeterministic ranking. Exactly
+one finding, at the sort call."""
+
+import jax.numpy as jnp
+
+
+def deliver(table, key, vals):
+    order = jnp.argsort(key)  # <- CL108: stability not pinned
+    return table.at[order].set(vals)
+
+
+def deliver_pinned(table, key, vals):
+    order = jnp.argsort(key, stable=True)  # pinned: clean
+    return table.at[order].set(vals)

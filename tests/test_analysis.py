@@ -111,6 +111,26 @@ def test_donation_in_if_body_does_not_flag_else_arm(tmp_path):
     assert [f.rule for f in lint_paths([str(p2)]).findings] == ["CL106"]
 
 
+def test_cl107_compound_statement_fires_once(tmp_path):
+    """A jit call under a module-scope compound statement (the
+    `if __name__ == "__main__":` / try-import-guard patterns) must
+    produce exactly ONE finding — not one per traversal path."""
+    p = tmp_path / "guarded_jit.py"
+    p.write_text(
+        "import jax\n"
+        "if True:\n"
+        "    f = jax.jit(lambda x: x)\n"
+        "try:\n"
+        "    g = jax.jit(lambda x: x)\n"
+        "except Exception:\n"
+        "    pass\n"
+    )
+    res = lint_paths([str(p)])
+    assert [(f.rule, f.line) for f in res.findings] == [
+        ("CL107", 3), ("CL107", 5),
+    ]
+
+
 def test_collect_files_excludes_lint_fixtures():
     """A tree-wide walk must not lint the deliberately-bad fixtures
     (quick-start documents `corro_lint.py .` as a clean-tree check),
@@ -387,5 +407,5 @@ def test_lint_result_shape():
     # one finding per bad fixture, none from the suppressed one
     assert sorted(f.rule for f in res.findings) == sorted(RULES)
     d = res.as_dict()
-    assert d["files_scanned"] == 7
+    assert d["files_scanned"] == 9
     assert sum(d["by_rule"].values()) == len(RULES)

@@ -392,6 +392,38 @@ def test_lint_family_renders_and_validates(cluster):
     _validate_exposition(text)
 
 
+def test_audit_contract_family_renders_and_validates(cluster):
+    """ISSUE 14 satellite: the corro_audit_contract_* family — the
+    program-contract auditor's per-family check/violation counters
+    (analysis/contracts.py export_metrics) — renders through the
+    exposition and the whole thing still validates. Fed a synthetic
+    report (one proven program + one violated vacuity problem) so the
+    test costs no trace."""
+    from corro_sim.analysis.contracts import export_metrics
+
+    export_metrics({
+        "programs": {"toy": {"vacuity": {
+            "probe": {"status": "proven"},
+            "leaky": {"status": "violated", "leaks": [".core"]},
+        }}},
+        "collectives": {"sweep_mesh": {"stablehlo": {}}},
+        "problems": ["vacuity violated: disabled feature 'leaky' ..."],
+        "drift": [],
+    })
+    text = render_prometheus(cluster)
+    assert (
+        'corro_audit_contract_checks_total{family="vacuity"}' in text
+    )
+    assert (
+        'corro_audit_contract_checks_total{family="collectives"}' in text
+    )
+    assert (
+        'corro_audit_contract_violations_total{family="vacuity"}'
+        in text
+    )
+    _validate_exposition(text)
+
+
 def test_workload_and_sub_latency_families_render_and_validate():
     """ISSUE 7 satellite: the corro_workload_* counters and the
     corro_sub_latency_* histograms — recorded by the live load harness

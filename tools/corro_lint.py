@@ -24,7 +24,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="corro-lint",
         description="static trace-safety analysis for corro-sim "
-                    "(AST rules CL101-CL106)",
+                    "(AST rules CL101-CL108)",
     )
     p.add_argument("paths", nargs="*",
                    help="files/directories to lint (default: corro_sim)")

@@ -31,8 +31,13 @@ nothing executes, no state is materialized beyond eval_shape).
 Usage::
 
     python tools/prime_cache.py [--chunk 8] [--report PRIME.json]
-    python tools/prime_cache.py --check     # drift/miss = exit 2
+    python tools/prime_cache.py --check     # drift/miss/unaudited = exit 2
     python tools/prime_cache.py --update    # re-baseline the manifest
+
+``--check`` additionally asserts every primed program classifies into a
+contract family the committed program-contract manifest covers
+(ISSUE 14, ``analysis/golden/program_contracts.json``) — a new program
+shape cannot ship unaudited.
 """
 
 from __future__ import annotations
@@ -691,6 +696,31 @@ def manifest_diff(manifest: dict, golden: dict) -> dict:
     }
 
 
+def contract_coverage_gaps(manifest: dict) -> list[tuple[str, str]]:
+    """Primed programs the committed contract manifest does NOT cover:
+    a name that classifies into no family, or into a family the
+    manifest omits (`prime_cache --check` fails on either — the
+    contract auditor's "no unaudited programs" gate, ISSUE 14)."""
+    from corro_sim.analysis.contracts import classify_program
+    from corro_sim.analysis.contracts import load_golden as load_contracts
+
+    golden = load_contracts()
+    if golden is None:
+        return [(
+            "<all>",
+            "no program-contract manifest committed "
+            "(analysis/golden/program_contracts.json)",
+        )]
+    out: list[tuple[str, str]] = []
+    for name in sorted(manifest["programs"]):
+        fam = classify_program(name)
+        if fam is None:
+            out.append((name, "no contract family classifies it"))
+        elif fam not in golden.get("families", {}):
+            out.append((name, f"family '{fam}' not in the manifest"))
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--chunk", type=int, default=8,
@@ -776,6 +806,22 @@ def main(argv: list[str] | None = None) -> int:
             "supposedly warm cache"
         )
         rc = 2
+    if args.check:
+        # ISSUE 14: no unaudited programs — every primed program must
+        # classify into a contract family the committed contract
+        # manifest (analysis/golden/program_contracts.json) covers, so
+        # a new program shape cannot ship without a contract entry
+        uncovered = contract_coverage_gaps(manifest)
+        for name, reason in uncovered:
+            print(f"UNAUDITED {name}: {reason}")
+        if uncovered:
+            print(
+                "CHECK FAILED: primed program(s) without a program-"
+                "contract entry — extend analysis/contracts.py "
+                "(classify_program / FAMILIES) and re-baseline with "
+                "`corro-sim audit --contracts --update-golden`"
+            )
+            rc = 2
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:
             json.dump({
