@@ -195,6 +195,18 @@ class AdminServer:
             out["exported"] = export
         return out
 
+    def _cmd_sweep(self, req):
+        """The fleet observatory's sweep snapshot (corro_sim/obs/
+        lanes.py) — the admin-socket face of GET /v1/sweep: live
+        per-chunk lane-state while a sweep runs in this process, the
+        final summary after."""
+        from corro_sim.obs.lanes import sweep_status
+
+        st = sweep_status()
+        if st is None:
+            raise AdminError("no sweep has run in this process")
+        return {"sweep": st}
+
     def _cmd_probes(self, req):
         """Probe-tracer provenance + the per-node lag observatory
         (`corro-sim probes`). ``lag_only`` trims to the observatory;

@@ -239,6 +239,8 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif path == "/v1/flight":
             self._traced(name, lambda: self._get_flight(params))
+        elif path == "/v1/sweep":
+            self._traced(name, self._get_sweep)
         elif path == "/v1/probes":
             self._traced(name, lambda: self._get_probes(params))
         elif path == "/v1/faults":
@@ -451,6 +453,19 @@ class _Handler(BaseHTTPRequestHandler):
             if last < 0:
                 raise _ApiError(400, "n must be >= 0")
         self._send_json(fl.timeline(last_rounds=last))
+
+    def _get_sweep(self):
+        """GET /v1/sweep — the fleet observatory's live sweep snapshot
+        (corro_sim/obs/lanes.py): per-chunk lane-state counts, the
+        one-char-per-lane state string and cumulative wasted
+        frozen-lane rounds while a sweep runs in this process, the
+        final summary after. 404 until a sweep has run."""
+        from corro_sim.obs.lanes import sweep_status
+
+        st = sweep_status()
+        if st is None:
+            raise _ApiError(404, "no sweep has run in this process")
+        self._send_json(st)
 
     def _get_probes(self, params):
         """GET /v1/probes — probe-tracer provenance + lag observatory.

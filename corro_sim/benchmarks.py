@@ -1088,6 +1088,26 @@ def run_config_8(nodes: int | None = None) -> dict:
     )
     res = run_sweep(plan, max_rounds=1024, chunk=16)
     frontier = build_frontier(res.lanes)
+    # fleet-occupancy stats (ISSUE 15, corro_sim/obs/lanes.py): the
+    # committed before-number for on-device lane freezing (ROADMAP
+    # giga-sweep item (c)) — how many dispatched lane-rounds were spent
+    # on lanes that had already bit-frozen
+    from corro_sim.obs.lanes import fleet_occupancy
+
+    occ = fleet_occupancy(res)
+    occupancy = {
+        k: occ[k]
+        for k in (
+            "lanes", "dispatches", "executed_lane_rounds",
+            "useful_lane_rounds", "wasted_frozen_lane_rounds",
+            "occupancy_ratio",
+        )
+    }
+    # curve summary: active-lane count per dispatch — the shape of the
+    # fleet draining, without the per-entry bulk
+    occupancy["active_per_chunk"] = [
+        e["lanes_active"] for e in occ["curve"]
+    ]
 
     # the serial reference lane: the grid's first scenario at seed 0,
     # run through the exact path the sequential soak loop dispatches
@@ -1133,6 +1153,7 @@ def run_config_8(nodes: int | None = None) -> dict:
             f"round {serial.converged_round}"
         ),
         "frontier": frontier,
+        "occupancy": occupancy,
         "all_settled": all(
             lr.converged_round is not None and not lr.poisoned
             for lr in res.lanes

@@ -981,15 +981,30 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         + ") in one dispatch",
         file=sys.stderr, flush=True,
     )
+    def _on_chunk(p):
+        if args.progress:
+            # per-chunk lane-state line (fleet observatory): one char
+            # per lane — A racing, C bit-frozen converged, P poisoned
+            print(
+                f"# chunk {p['chunk']}: rounds {p['rounds_done']} | "
+                f"{p['lanes_active']}A {p['lanes_converged']}C "
+                f"{p['lanes_poisoned']}P | wasted "
+                f"{p['wasted_lane_rounds_total']} frozen lane-rounds | "
+                f"{p['lane_states']} ({p['chunk_wall_s']}s)",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            print(
+                f"# chunk {p['chunk']}: rounds {p['rounds_done']}, "
+                f"{p['lanes_active']} lanes racing, "
+                f"{p['lanes_settled']} settled "
+                f"({p['chunk_wall_s']}s)",
+                file=sys.stderr, flush=True,
+            )
+
     res = run_sweep(
         plan, max_rounds=args.max_rounds, chunk=args.chunk, mesh=mesh,
-        on_chunk=lambda p: print(
-            f"# chunk {p['chunk']}: rounds {p['rounds_done']}, "
-            f"{p['lanes_active']} lanes racing, "
-            f"{p['lanes_settled']} settled "
-            f"({p['chunk_wall_s']}s)",
-            file=sys.stderr, flush=True,
-        ),
+        on_chunk=_on_chunk,
     )
     frontier = build_frontier(res.lanes)
     thresholds = load_thresholds()
@@ -1038,6 +1053,43 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         "invariants": inv_summary,
         "ok": not (any_violation or any_unsettled or breaches),
     }
+    # fleet observatory artifacts (corro_sim/obs/lanes.py): occupancy
+    # stats always ride the report; per-lane flight timelines and the
+    # grid heatmap are demuxed from the dispatch's own outputs — no
+    # lane is ever re-run for its telemetry
+    from corro_sim.obs.lanes import (
+        demux_flights,
+        fleet_occupancy,
+        grid_heatmaps,
+        render_heatmap,
+        write_lane_flights,
+    )
+
+    report["occupancy"] = fleet_occupancy(res)
+    if args.flight_dir:
+        paths = write_lane_flights(
+            demux_flights(plan, res, breaches=breaches),
+            args.flight_dir,
+        )
+        report["lane_flights"] = {
+            "dir": args.flight_dir, "count": len(paths),
+        }
+    if args.heatmap:
+        heatmaps = grid_heatmaps(res.lanes)
+        with open(args.heatmap, "w", encoding="utf-8") as f:
+            json.dump(heatmaps, f, indent=2)
+            f.write("\n")
+        report["heatmap_artifact"] = args.heatmap
+        metric = (
+            "recovery_rounds"
+            if any(
+                v is not None
+                for row in heatmaps["maps"]["recovery_rounds"]
+                for v in row
+            )
+            else "rounds_to_convergence"
+        )
+        print(render_heatmap(heatmaps, metric), file=sys.stderr, end="")
     if args.workload:
         report["workload"] = args.workload
     if args.frontier:
@@ -1184,10 +1236,6 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         report["checkpoint"] = checkpoint_path
     if args.resume:
         report["resumed_from"] = args.resume
-    if args.flight_out:
-        wrote = res.flight.sink_active
-        res.flight.close()
-        report["flight"] = args.flight_out if wrote else None
 
     rc = 0
     if res.poisoned:
@@ -1205,6 +1253,7 @@ def _cmd_twin(args: argparse.Namespace) -> int:
             tok, forecast_grid["scenario"], forecast_grid["seed"],
             rounds=args.forecast_rounds, max_rounds=args.max_rounds,
             chunk=args.chunk, thresholds=thresholds,
+            flight_dir=args.flight_dir,
             on_chunk=lambda p: print(
                 f"# forecast chunk {p['chunk']}: rounds "
                 f"{p['rounds_done']}, {p['lanes_active']} lanes racing",
@@ -1213,6 +1262,21 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         )
         report["fork"] = fork_path
         report["forecast"] = fc
+        # the projected-recovery trend next to the shadow headlines:
+        # one point per fork (continuous re-forking appends points —
+        # the list IS the trend line), and the same point annotates
+        # the shadow's flight record at the fork round
+        report["forecast_trend"] = [fc["trend"]]
+        for cell in fc["trend"]["cells"]:
+            rec = cell["recovery_rounds"] or {}
+            res.flight.annotate(
+                res.rounds, "forecast_trend",
+                cell=cell["cell"], projected=True,
+                fork_round=fc["trend"]["fork_round"],
+                recovery_worst=rec.get("worst"),
+                recovery_p95=rec.get("p95"),
+                rows_lost_worst=cell["rows_lost_worst"],
+            )
         if args.frontier:
             with open(args.frontier, "w", encoding="utf-8") as f:
                 json.dump(fc["frontier"], f, indent=2)
@@ -1225,6 +1289,12 @@ def _cmd_twin(args: argparse.Namespace) -> int:
     elif args.fork_out and not res.poisoned:
         fork_twin(res, args.fork_out, chunk=args.chunk)
         report["fork"] = args.fork_out
+    if args.flight_out:
+        # closed AFTER the forecast so the forecast_trend annotations
+        # journal into the shadow timeline they grade
+        wrote = res.flight.sink_active
+        res.flight.close()
+        report["flight"] = args.flight_out if wrote else None
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2)
@@ -1937,6 +2007,27 @@ def build_parser() -> argparse.ArgumentParser:
              "worst/p95 over seeds + worst-seed repro commands) to "
              "PATH (default FRONTIER.json)",
     )
+    psw.add_argument(
+        "--progress", action="store_true",
+        help="per-chunk lane-state progress lines (fleet observatory): "
+             "racing/converged/poisoned counts, cumulative wasted "
+             "frozen-lane rounds, and a one-char-per-lane state string",
+    )
+    psw.add_argument(
+        "--flight-dir", metavar="DIR",
+        help="demux every lane's flight timeline (per-round metrics + "
+             "derived diagnostics + annotations, field-identical to "
+             "its serial twin's) into per-lane ND-JSON files under DIR "
+             "— no lane is re-run; read them with `corro-sim flight "
+             "<file>` (doc/observability.md §lane-observatory)",
+    )
+    psw.add_argument(
+        "--heatmap", metavar="PATH",
+        help="write the cell x seed grid heatmap artifact "
+             "(rounds-to-convergence / recovery / rows_lost / "
+             "degradation_p99 matrices) to PATH "
+             "and print an ASCII rendering to stderr",
+    )
     psw.add_argument("--out", help="also write the full report JSON here")
     psw.set_defaults(fn=_cmd_sweep, pipeline=None)
 
@@ -2032,6 +2123,13 @@ def build_parser() -> argparse.ArgumentParser:
                      help="journal the shadow's flight timeline "
                           "(ND-JSON) with twin_chunk/twin_bad_line "
                           "annotations")
+    pt2.add_argument(
+        "--flight-dir", metavar="DIR",
+        help="with --forecast: demux every forecast lane's flight "
+             "timeline (projected: true in its meta) into per-lane "
+             "ND-JSON files under DIR — the fleet observatory surface "
+             "(doc/observability.md §lane-observatory)",
+    )
     pt2.add_argument("--out", help="also write the report JSON here")
     pt2.set_defaults(fn=_cmd_twin)
 
@@ -2262,6 +2360,12 @@ def build_parser() -> argparse.ArgumentParser:
         "flight", help="per-round telemetry timeline (flight recorder)"
     )
     admin_args(pfl)
+    pfl.add_argument(
+        "path", nargs="?",
+        help="read a flight ND-JSON export directly (a `run "
+             "--flight-out` journal, or a per-lane file from `sweep/"
+             "twin --flight-dir`) instead of dialing the admin socket",
+    )
     pfl.add_argument("-n", type=int, help="only the last N rounds")
     pfl.add_argument(
         "--diag", action="store_true",
@@ -2409,7 +2513,38 @@ def _cmd_reload(args) -> int:
 
 
 def _cmd_flight(args) -> int:
-    """Dump the agent's flight-recorder timeline (or just diagnostics)."""
+    """Dump the agent's flight-recorder timeline (or just diagnostics).
+
+    With a positional PATH, the timeline is read from an ND-JSON
+    export on disk instead — the fleet-observatory workflow: every
+    per-lane file a `sweep --flight-dir` demuxed loads here with the
+    full diagnostics/timeline surface, no agent required."""
+    if args.path:
+        from corro_sim.obs.flight import FlightRecorder
+
+        try:
+            fl = FlightRecorder.load(args.path)
+        except OSError as e:
+            print(f"error: cannot read flight export "
+                  f"{args.path!r}: {e}", file=sys.stderr)
+            return 2
+        tl = fl.timeline()
+        if not (tl["meta"] or tl["rounds"] or tl["events"]):
+            # load() tolerates unparseable lines (the torn-tail case),
+            # so a non-NDJSON file decodes to nothing — say so instead
+            # of printing an empty timeline with rc 0
+            print(f"error: no flight records in {args.path!r} "
+                  "(not a flight ND-JSON export?)", file=sys.stderr)
+            return 2
+        if args.export:
+            fl.dump(args.export)
+        if args.diag:
+            out = {"diagnostics": fl.diagnostics()}
+        else:
+            out = fl.timeline(last_rounds=args.n)
+        if args.export:
+            out["exported"] = args.export
+        return _print_json(out)
     return _print_json(
         _admin(args).call(
             "flight", n=args.n, diag_only=args.diag, export=args.export

@@ -582,13 +582,28 @@ def run_forecast(
     chunk: int = 8,
     thresholds: dict | None = None,
     on_chunk=None,
+    flight_dir: str | None = None,
 ) -> dict:
     """Race the what-if grid from a fork token: ONE vmapped dispatch of
     (scenario × seed) warm-start lanes, frontier-graded against the
     ``twin_forecast`` threshold section. Returns the forecast block the
     twin CLI publishes; ``breaches`` non-empty is the exit-6 condition
-    (semantics unchanged from the soak/sweep gate)."""
+    (semantics unchanged from the soak/sweep gate).
+
+    ``flight_dir``: demux every forecast lane's flight timeline
+    (``projected: true`` in its meta — a projection, never a
+    measurement) as per-lane ND-JSON under this directory, the fleet
+    observatory surface (corro_sim/obs/lanes.py; doc/observability.md
+    §lane-observatory). The returned block always carries a ``trend``
+    point (per-cell projected recovery at this fork round — the trend
+    line the twin report publishes next to its shadow headlines) and
+    the fleet ``occupancy`` stats."""
     from corro_sim.config import FaultConfig, NodeFaultConfig
+    from corro_sim.obs.lanes import (
+        demux_flights,
+        fleet_occupancy,
+        write_lane_flights,
+    )
     from corro_sim.sweep.engine import run_sweep
     from corro_sim.sweep.frontier import build_frontier, check_frontier
     from corro_sim.sweep.plan import build_plan
@@ -611,6 +626,31 @@ def run_forecast(
     )
     frontier["thresholds_ok"] = not breaches
     frontier["breaches"] = breaches
+    lane_flight_paths = None
+    if flight_dir:
+        lane_flight_paths = write_lane_flights(
+            demux_flights(plan, res, breaches=breaches, projected=True),
+            flight_dir,
+        )
+    # the projected-recovery trend POINT for this fork round: repeated
+    # forecasts (continuous re-forking, ROADMAP twin round 2 (c))
+    # append one per fork, forming the trend lines the twin report
+    # publishes next to its shadow headlines
+    trend = {
+        "fork_round": fork.fork_round,
+        "projected": True,
+        "cells": [
+            {
+                "cell": c["cell"],
+                "scenario": c["scenario"],
+                "lanes": c["lanes"],
+                "converged": c["converged"],
+                "recovery_rounds": c["recovery_rounds"],
+                "rows_lost_worst": c["rows_lost_worst"],
+            }
+            for c in frontier["cells"]
+        ],
+    }
     for lane in res.lanes:
         counters.inc(
             TWIN_FORECAST_LANES_TOTAL,
@@ -644,6 +684,14 @@ def run_forecast(
             for lr in res.lanes
         ],
         "frontier": frontier,
+        "trend": trend,
+        "occupancy": fleet_occupancy(res),
+        **(
+            {"lane_flights": {
+                "dir": flight_dir, "count": len(lane_flight_paths),
+            }}
+            if lane_flight_paths is not None else {}
+        ),
         "ok": not breaches and all(
             lr.converged_round is not None and not lr.poisoned
             for lr in res.lanes

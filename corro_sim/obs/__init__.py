@@ -1,4 +1,14 @@
 from corro_sim.obs.flight import FlightRecorder
+from corro_sim.obs.lanes import (
+    comparable_timeline,
+    demux_flights,
+    fleet_occupancy,
+    grid_heatmaps,
+    lane_flight,
+    render_heatmap,
+    sweep_status,
+    write_lane_flights,
+)
 from corro_sim.obs.probes import (
     ProbeTrace,
     bfs_hops,
@@ -10,6 +20,14 @@ __all__ = [
     "FlightRecorder",
     "ProbeTrace",
     "bfs_hops",
+    "comparable_timeline",
+    "demux_flights",
+    "fleet_occupancy",
+    "grid_heatmaps",
     "ground_truth_adjacency",
+    "lane_flight",
     "node_lag_observatory",
+    "render_heatmap",
+    "sweep_status",
+    "write_lane_flights",
 ]

@@ -269,6 +269,8 @@ class FlightRecorder:
                 obj = json.loads(line)
             except json.JSONDecodeError:
                 continue  # torn tail of a killed run
+            if not isinstance(obj, dict):
+                continue  # a JSON line that is not a journal record
             t = obj.get("t")
             if t == "meta":
                 rec._meta.update(

@@ -53,8 +53,27 @@ import numpy as np
 from corro_sim.engine.driver import converged_at
 from corro_sim.engine.state import init_state
 from corro_sim.engine.step import make_step, make_workload_step
+from corro_sim.obs.lanes import (
+    publish_sweep_progress,
+    publish_sweep_result,
+)
 from corro_sim.utils.compile_cache import CompileCacheProbe
-from corro_sim.utils.metrics import counters, histograms
+from corro_sim.utils.metrics import (
+    ROUNDS_BUCKETS,
+    SWEEP_LANES_ACTIVE,
+    SWEEP_LANES_ACTIVE_HELP,
+    SWEEP_LANES_CONVERGED,
+    SWEEP_LANES_CONVERGED_HELP,
+    SWEEP_LANES_POISONED,
+    SWEEP_LANES_POISONED_HELP,
+    SWEEP_RECOVERY_ROUNDS,
+    SWEEP_RECOVERY_ROUNDS_HELP,
+    SWEEP_WASTED_LANE_ROUNDS_TOTAL,
+    SWEEP_WASTED_LANE_ROUNDS_HELP,
+    counters,
+    gauges,
+    histograms,
+)
 from corro_sim.utils.tracing import tracer
 from corro_sim.workload.generators import empty_slice
 
@@ -99,6 +118,12 @@ class SweepResult:
     compile_seconds: float
     devices: int
     compile_cache: dict | None = None
+    chunk: int = 16  # the dispatch chunk — chunk-boundary semantics of
+    # the demuxed lane flights (corro_sim/obs/lanes.py) depend on it
+    occupancy: list | None = None  # per-dispatch lane-state history:
+    # {chunk, base, rounds, lanes_active, lanes_frozen, lanes_poisoned,
+    # wasted_lane_rounds} — fleet_occupancy() derives the curve/waste
+    # totals that motivate on-device lane freezing (ROADMAP)
 
     @property
     def clusters_per_second_per_device(self) -> float | None:
@@ -325,11 +350,18 @@ def run_sweep(
     dispatches = 0
     rounds = 0
     ci = 0
+    occupancy: list[dict] = []
+    wasted_total = 0
     while active.any() and rounds < max_rounds:
         args, sched_alive, sched_part = sweep_chunk_args(
             plan, ci, rounds, chunk, roots
         )
         act = jnp.asarray(active)
+        # pre-dispatch lane states: settled lanes still ride this
+        # dispatch through the freeze select — their rounds are the
+        # occupancy waste the fleet observatory accounts
+        pre_active = int(active.sum())
+        pre_poisoned = sum(poisoned)
         if ci == 0 and mesh is None:
             # AOT compile up front (compile wall separated from sim
             # wall, the driver discipline). Mesh runs stay on plain jit
@@ -396,14 +428,53 @@ def run_sweep(
                     cards[li].on_converged(lane_state, a[-1], p[-1])
                 if checks[li] is not None:
                     checks[li].on_converged(lane_state, a[-1], p[-1])
+        # ---- fleet observatory bookkeeping (corro_sim/obs/lanes.py):
+        # occupancy history + live lane-state metrics, all host-side
+        wasted = (L - pre_active) * chunk
+        wasted_total += wasted
+        if wasted:
+            counters.inc(
+                SWEEP_WASTED_LANE_ROUNDS_TOTAL, n=wasted,
+                help_=SWEEP_WASTED_LANE_ROUNDS_HELP,
+            )
+        occupancy.append({
+            "chunk": ci,
+            "base": base,
+            "rounds": chunk,
+            "lanes_active": pre_active,
+            "lanes_frozen": L - pre_active - pre_poisoned,
+            "lanes_poisoned": pre_poisoned,
+            "wasted_lane_rounds": wasted,
+        })
+        n_active = int(active.sum())
+        n_poisoned = sum(poisoned)
+        n_converged = L - n_active - n_poisoned
+        gauges.set(SWEEP_LANES_ACTIVE, n_active,
+                   help_=SWEEP_LANES_ACTIVE_HELP)
+        gauges.set(SWEEP_LANES_CONVERGED, n_converged,
+                   help_=SWEEP_LANES_CONVERGED_HELP)
+        gauges.set(SWEEP_LANES_POISONED, n_poisoned,
+                   help_=SWEEP_LANES_POISONED_HELP)
+        progress = {
+            "chunk": ci,
+            "rounds_done": rounds,
+            "lanes_active": n_active,
+            "lanes_settled": L - n_active,
+            "lanes_converged": n_converged,
+            "lanes_poisoned": n_poisoned,
+            "wasted_lane_rounds_total": wasted_total,
+            # one char per lane: A = racing, C = bit-frozen converged,
+            # P = poisoned — the at-a-glance fleet state line
+            "lane_states": "".join(
+                "A" if active[li] else ("P" if poisoned[li] else "C")
+                for li in range(L)
+            ),
+            "chunk_wall_s": round(elapsed, 3),
+        }
+        publish_sweep_progress({"lanes": L, "dispatches": ci + 1,
+                                **progress})
         if on_chunk is not None:
-            on_chunk({
-                "chunk": ci,
-                "rounds_done": rounds,
-                "lanes_active": int(active.sum()),
-                "lanes_settled": L - int(active.sum()),
-                "chunk_wall_s": round(elapsed, 3),
-            })
+            on_chunk(progress)
         ci += 1
     jax.block_until_ready(jax.tree.leaves(state)[0])
     histograms.observe(
@@ -453,6 +524,38 @@ def run_sweep(
             ),
             state=lane_state,
         ))
+    for lr in results:
+        if lr.recovery_rounds is not None:
+            # the per-cell recovery distribution the frontier quantiles
+            # summarize, scrape-visible (corro_sweep_recovery_rounds)
+            histograms.observe(
+                SWEEP_RECOVERY_ROUNDS, float(lr.recovery_rounds),
+                labels=f'{{cell="{lr.cell}"}}',
+                help_=SWEEP_RECOVERY_ROUNDS_HELP,
+                buckets=ROUNDS_BUCKETS,
+            )
+    n_poisoned = sum(poisoned)
+    n_converged = sum(
+        1 for li in range(L)
+        if converged[li] is not None and not poisoned[li]
+    )
+    publish_sweep_result({
+        "lanes": L,
+        "rounds": rounds,
+        "dispatches": dispatches,
+        "wall_seconds": round(wall, 3),
+        "compile_seconds": round(compile_seconds, 3),
+        "lanes_converged": n_converged,
+        "lanes_poisoned": n_poisoned,
+        "lanes_unsettled": L - n_converged - n_poisoned,
+        "wasted_lane_rounds_total": wasted_total,
+        "lane_states": "".join(
+            "P" if poisoned[li]
+            else ("C" if converged[li] is not None else "A")
+            for li in range(L)
+        ),
+        "projected": plan.fork is not None,
+    })
     return SweepResult(
         lanes=results,
         rounds=rounds,
@@ -461,4 +564,6 @@ def run_sweep(
         compile_seconds=compile_seconds,
         devices=(mesh.size if mesh is not None else 1),
         compile_cache=cache_probe.summary(),
+        chunk=chunk,
+        occupancy=occupancy,
     )

@@ -23,7 +23,21 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["build_frontier", "check_frontier"]
+__all__ = ["breaches_by_cell", "build_frontier", "check_frontier"]
+
+
+def breaches_by_cell(breaches) -> dict:
+    """Group :func:`check_frontier` breach strings by the cell tag each
+    leads with. Every breach is formatted ``<cell>: <msg> (worst seed
+    ...)`` and cell tags never contain spaces (scenario specs + knob
+    suffixes), so the tag is everything before the first ``": "`` —
+    a format contract the fleet observatory depends on to pin
+    ``threshold_breach`` annotations onto the right lane flights
+    (corro_sim/obs/lanes.py demux_flights)."""
+    out: dict[str, list] = {}
+    for b in breaches:
+        out.setdefault(b.split(": ", 1)[0], []).append(b)
+    return out
 
 
 def _p95(values: list) -> float | None:

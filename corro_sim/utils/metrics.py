@@ -245,6 +245,51 @@ WORKLOAD_ROUNDS_TOTAL = "corro_workload_rounds_total"
 WORKLOAD_COALESCED_TOTAL = "corro_workload_coalesced_total"
 WORKLOAD_QUERIES_TOTAL = "corro_workload_queries_total"
 
+# ---- corro_sweep_*: fleet observatory (corro_sim/obs/lanes.py over
+# corro_sim/sweep/engine.py; doc/observability.md §lane-observatory).
+# The lane-batched chunk loop publishes per-dispatch lane-state gauges
+# (how many lanes are still racing vs bit-frozen vs poisoned), a
+# counter of FLOPs burned on already-settled lanes (a settled lane
+# still rides every later dispatch through the freeze select — the
+# number that motivates ROADMAP on-device lane freezing), and a
+# per-cell recovery-rounds histogram so the quantiles the frontier
+# grades are scrape-visible too:
+#   corro_sweep_lanes_active        lanes still racing (gauge)
+#   corro_sweep_lanes_converged     lanes bit-frozen at convergence
+#   corro_sweep_lanes_poisoned      lanes frozen by the ring-wrap
+#                                   tripwire
+#   corro_sweep_wasted_lane_rounds_total  rounds dispatched for lanes
+#                                   that had already settled
+#   corro_sweep_recovery_rounds{cell}     histogram: heal →
+#                                   re-convergence per frontier cell
+#                                   (ROUNDS_BUCKETS)
+# Emission and the exposition-validator coverage (tests/test_metrics.py)
+# both use THESE constants, so coverage cannot drift from emission.
+SWEEP_LANES_ACTIVE = "corro_sweep_lanes_active"
+SWEEP_LANES_ACTIVE_HELP = (
+    "sweep lanes still racing (not yet converged or poisoned; "
+    "corro_sim/sweep/engine.py)"
+)
+SWEEP_LANES_CONVERGED = "corro_sweep_lanes_converged"
+SWEEP_LANES_CONVERGED_HELP = (
+    "sweep lanes bit-frozen at their convergence chunk"
+)
+SWEEP_LANES_POISONED = "corro_sweep_lanes_poisoned"
+SWEEP_LANES_POISONED_HELP = (
+    "sweep lanes frozen by the ring-wrap poison tripwire"
+)
+SWEEP_WASTED_LANE_ROUNDS_TOTAL = "corro_sweep_wasted_lane_rounds_total"
+SWEEP_WASTED_LANE_ROUNDS_HELP = (
+    "lane-rounds dispatched for already-settled (frozen) lanes — the "
+    "FLOP waste on-device lane freezing would reclaim "
+    "(corro_sim/obs/lanes.py fleet occupancy)"
+)
+SWEEP_RECOVERY_ROUNDS = "corro_sweep_recovery_rounds"
+SWEEP_RECOVERY_ROUNDS_HELP = (
+    "per-lane heal -> re-convergence rounds by frontier cell "
+    "(scenario spec + knob suffix; corro_sim/sweep/engine.py)"
+)
+
 # Digital-twin shadow (corro_sim/engine/twin.py; doc/twin.md):
 #   corro_twin_feed_lines_total        feed lines consumed (good + bad)
 #   corro_twin_bad_lines_total{reason} quarantined hostile feed lines by
@@ -424,6 +469,47 @@ class CounterRegistry:
 
 
 counters = CounterRegistry()
+
+
+class GaugeRegistry:
+    """Process-wide named gauges for LAST-VALUE instrumentation outside
+    any cluster (the counter registry's set-valued sibling): headless
+    drivers like the sweep engine have no LiveCluster to render from,
+    so their live state (lanes racing/frozen/poisoned) lands here and
+    rides every /metrics scrape in the process."""
+
+    def __init__(self):
+        import threading
+
+        self._g: dict[tuple, float] = {}
+        self._help: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def set(self, name: str, value: float, labels: str = "",
+            help_: str = "") -> None:
+        with self._lock:
+            self._g[(name, labels)] = value
+            if help_:
+                self._help.setdefault(name, help_)
+
+    def get(self, name: str, labels: str = "") -> float | None:
+        with self._lock:
+            return self._g.get((name, labels))
+
+    def render(self) -> list[str]:
+        with self._lock:
+            out = []
+            seen = set()
+            for (name, labels), v in sorted(self._g.items()):
+                if name not in seen:
+                    seen.add(name)
+                    out.append(f"# HELP {name} {self._help.get(name, name)}")
+                    out.append(f"# TYPE {name} gauge")
+                out.append(f"{name}{labels} {v}")
+            return out
+
+
+gauges = GaugeRegistry()
 
 
 class ChannelMetrics:
@@ -1185,4 +1271,5 @@ def render_prometheus(cluster) -> str:
         lines.extend(ch_reg.render())
     lines.extend(histograms.render())
     lines.extend(counters.render())
+    lines.extend(gauges.render())
     return "\n".join(lines) + "\n"

@@ -74,6 +74,44 @@ def test_ndjson_roundtrip_bit_identical(tmp_path):
     assert back.timeline() == fl.timeline()
 
 
+def test_ingest_ndjson_roundtrip_bit_identical(tmp_path):
+    """ISSUE 15 satellite: the `--flight-dir` workflow round-trip — a
+    dumped export ingested into a FRESH recorder via ingest_ndjson and
+    re-dumped is byte-identical (same contract the demuxed per-lane
+    files rely on; tests/test_lanes.py exercises the lane side)."""
+    fl = _synthetic()
+    p1, p2 = str(tmp_path / "a.ndjson"), str(tmp_path / "b.ndjson")
+    fl.dump(p1)
+    fresh = FlightRecorder()
+    fresh.ingest_ndjson(p1)
+    fresh.dump(p2)
+    assert open(p1, "rb").read() == open(p2, "rb").read()
+
+
+def test_cli_flight_reads_export_file(tmp_path, capsys):
+    """`corro-sim flight <path>` reads an ND-JSON export directly —
+    the read surface for `run --flight-out` journals and per-lane
+    `sweep --flight-dir` files, no admin socket involved."""
+    from corro_sim.cli import main
+
+    fl = _synthetic()
+    p = str(tmp_path / "export.ndjson")
+    fl.dump(p)
+    rc = main(["flight", p, "--diag"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["diagnostics"] == fl.diagnostics()
+    rc = main(["flight", p, "-n", "3",
+               "--export", str(tmp_path / "re.ndjson")])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["rounds"]) == 3
+    assert out["exported"] == str(tmp_path / "re.ndjson")
+    assert FlightRecorder.load(
+        str(tmp_path / "re.ndjson")
+    ).diagnostics() == fl.diagnostics()
+
+
 def test_load_tolerates_torn_tail(tmp_path):
     fl = _synthetic()
     p = str(tmp_path / "torn.ndjson")

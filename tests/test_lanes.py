@@ -1,0 +1,293 @@
+"""Fleet observatory acceptance (ISSUE 15, corro_sim/obs/lanes.py).
+
+The load-bearing claim: a lane's flight timeline — per-round metric
+series, derived convergence diagnostics, and every serial-comparable
+annotation — demuxed HOST-SIDE from the one vmapped dispatch's packed
+metric stacks is **field-identical to the serial twin's flight
+recorder**, with zero re-runs and zero step-program changes. Plus the
+surfaces built on it: per-lane ND-JSON exports (``--flight-dir`` →
+``corro-sim flight <file>``), grid heatmaps, the fleet occupancy curve
+(the on-device-freeze before-number), and the live sweep status
+snapshot (``GET /v1/sweep``).
+
+Plan literals ride in from tests/test_sweep.py so the chunk programs
+come out of the primed cache inside tier-1.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+import pytest
+from test_sweep import CHUNK, MAX_ROUNDS, _fake_lane, _mixed_plan, _run_twin
+
+from corro_sim.obs.flight import FlightRecorder
+from corro_sim.obs.lanes import (
+    comparable_timeline,
+    demux_flights,
+    fleet_occupancy,
+    grid_heatmaps,
+    lane_flight_filename,
+    render_heatmap,
+    sweep_status,
+    write_lane_flights,
+)
+from corro_sim.sweep.engine import run_sweep
+
+
+@pytest.fixture(scope="module")
+def mixed():
+    """One mixed-scenario sweep (the prime_cache `sweep/test-mixed`
+    plan) shared by every test here — the dispatch whose outputs get
+    demuxed."""
+    plan = _mixed_plan()
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    return plan, res
+
+
+@pytest.fixture(scope="module")
+def flights(mixed):
+    plan, res = mixed
+    return demux_flights(plan, res)
+
+
+def test_demuxed_lane_flight_field_identical_to_serial_twin(
+    mixed, flights,
+):
+    """THE acceptance criterion: a lane's demuxed flight equals its
+    serial twin's on every comparable field — metric series, derived
+    diagnostics (converged round, gap half-life, epidemic window), and
+    the deterministic annotations (fault/workload events, write-phase
+    end, convergence, resilience) — for a link-fault lane AND a
+    node-wipe lane, without re-running either."""
+    plan, res = mixed
+    # lane 0 = lossy seed 0 (link faults), lane 2 = crash_amnesia
+    # seed 0 (node wipes + scorecard-graded recovery)
+    for li in (0, 2):
+        serial, _inv = _run_twin(plan.lanes[li])
+        want = comparable_timeline(serial.flight)
+        got = comparable_timeline(
+            flights[li], metrics=set(want["series"]),
+        )
+        for key in ("meta", "diagnostics", "series", "events"):
+            assert got[key] == want[key], (li, key)
+        # the lane flight additionally carries what the serial run
+        # cannot: the freeze round and the fault window
+        names = [e["name"] for e in flights[li].timeline()["events"]]
+        assert "lane_freeze" in names
+
+
+def test_lane_flight_meta_and_freeze_annotation(mixed, flights):
+    plan, res = mixed
+    for lane, lr, fl in zip(plan.lanes, res.lanes, flights):
+        meta = fl.meta
+        assert meta["cell"] == lr.cell and meta["seed"] == lr.seed
+        assert meta["chunk"] == CHUNK
+        assert meta["scenario"] == lane.spec
+        (freeze,) = fl.events("lane_freeze")
+        assert freeze["r"] == lr.rounds
+        assert freeze["attrs"]["reason"] == (
+            "poisoned" if lr.poisoned
+            else "converged" if lr.converged_round is not None
+            else "budget"
+        )
+
+
+def test_flight_dir_roundtrip_and_flight_cli(
+    mixed, flights, tmp_path, capsys,
+):
+    """Satellite: per-lane ND-JSON exports round-trip bit-identically
+    through FlightRecorder.ingest_ndjson, and `corro-sim flight <file>`
+    reads them directly (no admin socket)."""
+    plan, res = mixed
+    paths = write_lane_flights(flights, str(tmp_path / "lanes"))
+    assert len(paths) == plan.num_lanes
+    assert paths[0].endswith(
+        lane_flight_filename(res.lanes[0].cell, res.lanes[0].seed)
+    )
+    # bit-identical ingest round-trip (the soak-resume stitch API)
+    fresh = FlightRecorder()
+    fresh.ingest_ndjson(paths[0])
+    rt = str(tmp_path / "roundtrip.ndjson")
+    fresh.dump(rt)
+    assert open(paths[0], "rb").read() == open(rt, "rb").read()
+
+    from corro_sim.cli import main
+
+    rc = main(["flight", paths[2], "--diag"])
+    assert rc == 0
+    body = json.loads(capsys.readouterr().out)
+    assert body["diagnostics"]["rounds_recorded"] == res.lanes[2].rounds
+    assert (
+        body["diagnostics"]["converged_round"]
+        == res.lanes[2].converged_round
+    )
+    rc = main(["flight", paths[2], "-n", "2"])
+    assert rc == 0
+    tl = json.loads(capsys.readouterr().out)
+    assert len(tl["rounds"]) == 2
+    assert tl["meta"]["cell"] == res.lanes[2].cell
+    # a missing file is a clean error, not a socket dial
+    assert main(["flight", str(tmp_path / "nope.ndjson")]) == 2
+    capsys.readouterr()
+    # so is a non-NDJSON file (the easy mix-up: feeding it the sweep
+    # report or heatmap artifact) — including a JSON-array line, which
+    # must not crash the loader
+    bogus = tmp_path / "report.json"
+    bogus.write_text('{\n  "ok": true\n}\n[1, 2]\n')
+    assert main(["flight", str(bogus)]) == 2
+    capsys.readouterr()
+
+
+def test_lane_flight_filenames_never_collide():
+    """Distinct cells differing only in stripped punctuation must map
+    to distinct files — otherwise write_lane_flights would silently
+    overwrite one lane's timeline with another's."""
+    a = lane_flight_filename("lossy:p=0.1", 0)
+    b = lane_flight_filename("lossy#p=0.1", 0)
+    assert a != b
+    # an already-safe cell stays readable (no hash suffix)
+    assert lane_flight_filename("churn", 3) == "churn.seed3.ndjson"
+    # same cell, different seed: distinct; same inputs: stable
+    assert lane_flight_filename("lossy:p=0.1", 1) != a
+    assert lane_flight_filename("lossy:p=0.1", 0) == a
+
+
+def test_roundless_violation_anchors_at_convergence_round():
+    """A round=None violation (only the on_converged convergence-
+    honesty check emits those) anchors at the convergence round —
+    exactly where the serial driver pins it — while chunk violations
+    anchor at their round + 1."""
+    from corro_sim.obs.lanes import lane_flight
+
+    class _Sched:
+        name = "lossy:p=0.1"
+        write_rounds = 0
+
+        def events_in(self, a, b):
+            return []
+
+    class _Cfg:
+        num_nodes = 4
+
+    class _Lane:
+        cfg = _Cfg()
+        schedule = _Sched()
+        workload = None
+        scenario = None
+
+    lr = _fake_lane("lossy:p=0.1", 0, "lossy:p=0.1", recovery=None)
+    lr.invariants = {"ok": False, "violations": [
+        {"round": None, "invariant": "convergence_disagreement",
+         "detail": "nodes 0 and 1 differ"},
+        {"round": 6, "invariant": "conservation", "detail": "x"},
+    ]}
+    fl = lane_flight(_Lane(), lr, chunk=8)
+    anchors = {
+        e["attrs"]["invariant"]: e["r"]
+        for e in fl.events("invariant_violation")
+    }
+    assert anchors["convergence_disagreement"] == lr.converged_round
+    assert anchors["conservation"] == 7
+
+
+def test_fleet_occupancy_invariants(mixed):
+    """useful + wasted == executed == lanes × dispatched rounds, useful
+    equals the sum of per-lane executed rounds, and the active curve is
+    non-increasing (lanes never unfreeze)."""
+    plan, res = mixed
+    occ = fleet_occupancy(res)
+    assert occ["lanes"] == plan.num_lanes
+    assert occ["dispatches"] == res.dispatches == len(occ["curve"])
+    assert occ["executed_lane_rounds"] == plan.num_lanes * res.rounds
+    assert (
+        occ["useful_lane_rounds"] + occ["wasted_frozen_lane_rounds"]
+        == occ["executed_lane_rounds"]
+    )
+    assert occ["useful_lane_rounds"] == sum(
+        lr.rounds for lr in res.lanes
+    )
+    actives = [e["lanes_active"] for e in occ["curve"]]
+    assert actives[0] == plan.num_lanes
+    assert all(a >= b for a, b in zip(actives, actives[1:]))
+
+
+def test_sweep_status_and_http_endpoint(mixed):
+    """The live-progress surface: run_sweep publishes the process-wide
+    snapshot that GET /v1/sweep serves."""
+    plan, res = mixed
+    st = sweep_status()
+    assert st is not None and st["phase"] == "done"
+    assert st["lanes"] == plan.num_lanes
+    assert st["rounds"] == res.rounds
+    assert len(st["lane_states"]) == plan.num_lanes
+    assert set(st["lane_states"]) <= {"A", "C", "P"}
+    json.dumps(st)  # the /v1/sweep body must be JSON-safe
+
+    from corro_sim.api.http import ApiServer
+    from corro_sim.harness.cluster import LiveCluster
+
+    c = LiveCluster(
+        "CREATE TABLE kv (k TEXT NOT NULL PRIMARY KEY, "
+        "v TEXT NOT NULL DEFAULT '');",
+        num_nodes=2, default_capacity=16,
+    )
+    with ApiServer(c) as api:
+        body = json.loads(
+            urllib.request.urlopen(api.url + "/v1/sweep").read()
+        )
+    assert body == st
+
+
+def test_grid_heatmaps_and_render():
+    lanes = [
+        _fake_lane("lossy:p=0.1", s, "lossy:p=0.1", recovery=r)
+        for s, r in enumerate([4, 6, 5, 40])
+    ] + [
+        _fake_lane("churn", 0, "churn", recovery=None, converged=None),
+        _fake_lane("churn", 2, "churn", recovery=9, poisoned=True,
+                   converged=None),
+    ]
+    hm = grid_heatmaps(lanes)
+    assert hm["rows"] == ["churn", "lossy:p=0.1"]
+    assert hm["cols"] == [0, 1, 2, 3]
+    assert hm["maps"]["recovery_rounds"][1] == [4, 6, 5, 40]
+    # a hole in the grid (churn seeds 1/3 never ran) is null, not 0
+    assert hm["maps"]["recovery_rounds"][0][1] is None
+    assert hm["state"][0][0] == "unconverged"
+    assert hm["state"][0][2] == "poisoned"
+    assert hm["state"][1][0] == "converged"
+    assert hm["maps"]["rows_lost"][1][0] == 0
+    assert hm["maps"]["degradation_p99"][1][0] == 1.5
+    json.dumps(hm)  # the artifact is JSON
+
+    text = render_heatmap(hm, "recovery_rounds")
+    assert "recovery_rounds" in text and "lossy:p=0.1" in text
+    lines = text.splitlines()
+    churn_row = next(ln for ln in lines if ln.startswith("churn"))
+    assert "!" in churn_row and "P" in churn_row
+
+
+def test_demux_attaches_threshold_breaches(mixed):
+    """check_frontier breach strings pin onto the breached cell's lane
+    flights as threshold_breach annotations."""
+    from corro_sim.sweep.frontier import build_frontier, check_frontier
+
+    plan, res = mixed
+    frontier = build_frontier(res.lanes)
+    # impossible bound: every converged cell breaches
+    breaches = check_frontier(frontier, {
+        "default": {"recovery_rounds_worst_max": -1},
+        "scenarios": {},
+    })
+    crash_breaches = [
+        b for b in breaches if b.startswith(res.lanes[2].cell + ": ")
+    ]
+    assert crash_breaches  # crash_amnesia has a heal -> recovery number
+    flights = demux_flights(plan, res, breaches=breaches)
+    evs = flights[2].events("threshold_breach")
+    assert evs and evs[0]["attrs"]["breach"] in crash_breaches
+    assert evs[0]["attrs"]["cell"] == res.lanes[2].cell
+    # the lossy cell has no recovery number — no breach, no annotation
+    assert not flights[0].events("threshold_breach")

@@ -591,6 +591,57 @@ def test_compile_cache_and_batched_subs_families_render_and_validate(
     _validate_exposition(text)
 
 
+def test_sweep_families_render_and_validate(cluster):
+    """ISSUE 15 satellite: the fleet-observatory families — lane-state
+    gauges (corro_sweep_lanes_{active,converged,poisoned}), the
+    wasted-frozen-lane-rounds counter, and the per-cell recovery-rounds
+    histogram — render through the exposition and the whole thing still
+    passes the scraper-contract validator. Names/labels come from the
+    same utils.metrics constants corro_sim/sweep/engine.py emits with,
+    so this coverage cannot drift from the runtime emission."""
+    from corro_sim.utils.metrics import (
+        ROUNDS_BUCKETS,
+        SWEEP_LANES_ACTIVE,
+        SWEEP_LANES_ACTIVE_HELP,
+        SWEEP_LANES_CONVERGED,
+        SWEEP_LANES_CONVERGED_HELP,
+        SWEEP_LANES_POISONED,
+        SWEEP_LANES_POISONED_HELP,
+        SWEEP_RECOVERY_ROUNDS,
+        SWEEP_RECOVERY_ROUNDS_HELP,
+        SWEEP_WASTED_LANE_ROUNDS_HELP,
+        SWEEP_WASTED_LANE_ROUNDS_TOTAL,
+        counters,
+        gauges,
+        histograms,
+    )
+
+    gauges.set(SWEEP_LANES_ACTIVE, 5, help_=SWEEP_LANES_ACTIVE_HELP)
+    gauges.set(SWEEP_LANES_CONVERGED, 2,
+               help_=SWEEP_LANES_CONVERGED_HELP)
+    gauges.set(SWEEP_LANES_POISONED, 1, help_=SWEEP_LANES_POISONED_HELP)
+    counters.inc(SWEEP_WASTED_LANE_ROUNDS_TOTAL, n=48,
+                 help_=SWEEP_WASTED_LANE_ROUNDS_HELP)
+    histograms.observe(
+        SWEEP_RECOVERY_ROUNDS, 9.0,
+        labels='{cell="crash_amnesia:nodes=3#loss=0.2"}',
+        help_=SWEEP_RECOVERY_ROUNDS_HELP, buckets=ROUNDS_BUCKETS,
+    )
+    text = render_prometheus(cluster)
+    # presence-only values: earlier tests' real sweeps (test_lanes.py)
+    # may have already bumped these process-wide series
+    assert f"# TYPE {SWEEP_LANES_ACTIVE} gauge" in text
+    assert SWEEP_LANES_ACTIVE in text
+    assert SWEEP_LANES_CONVERGED in text
+    assert SWEEP_LANES_POISONED in text
+    assert SWEEP_WASTED_LANE_ROUNDS_TOTAL in text
+    assert (
+        f'{SWEEP_RECOVERY_ROUNDS}_bucket'
+        '{cell="crash_amnesia:nodes=3#loss=0.2",le="+Inf"}' in text
+    )
+    _validate_exposition(text)
+
+
 def test_twin_families_render_and_validate(cluster):
     """ISSUE 13 satellite: the digital-twin families — the per-reason
     hostile-line quarantine counter (corro_twin_bad_lines_total{reason},

@@ -144,12 +144,35 @@ def test_workload_coupled_lanes_and_lane_freeze():
     early lanes must freeze bit-exactly at their convergence chunk
     while it keeps running (the lane-freeze contract)."""
     plan = _wl_plan()
-    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    progress: list = []
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                    on_chunk=progress.append)
     rounds = [lr.rounds for lr in res.lanes]
     # the freeze is only proven if lanes actually settle at different
     # chunks — the straggler lane outlives the wipe lanes by design
     assert len(set(rounds)) > 1, rounds
     assert max(rounds) > min(rounds)
+    # fleet occupancy (ISSUE 15): early-frozen lanes still ride every
+    # later dispatch, so this sweep provably wastes frozen lane-rounds
+    # — the before-number for ROADMAP on-device lane freezing
+    from corro_sim.obs.lanes import fleet_occupancy
+
+    occ = fleet_occupancy(res)
+    assert occ["wasted_frozen_lane_rounds"] > 0, occ
+    assert (
+        occ["useful_lane_rounds"] + occ["wasted_frozen_lane_rounds"]
+        == occ["executed_lane_rounds"]
+    )
+    assert occ["wasted_frozen_lane_rounds"] == (
+        occ["executed_lane_rounds"] - sum(rounds)
+    )
+    # per-chunk lane-state progress lines (`sweep --progress` payload)
+    assert progress[-1]["lanes_active"] == 0
+    assert progress[-1]["wasted_lane_rounds_total"] == (
+        occ["wasted_frozen_lane_rounds"]
+    )
+    assert set(progress[-1]["lane_states"]) <= {"A", "C", "P"}
+    assert len(progress[-1]["lane_states"]) == plan.num_lanes
     for lane_result, lane in zip(res.lanes, plan.lanes):
         serial, inv = _run_twin(lane)
         # an early-frozen lane's state equals the twin that STOPPED at

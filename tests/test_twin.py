@@ -418,8 +418,56 @@ def forecast(shadow, tmp_path_factory):
                 "crash_amnesia": {"recovery_rounds_worst_max": 48},
             },
         }},
+        flight_dir=str(tmp / "lane_flights"),
     )
     return res, tok, fc
+
+
+def test_forecast_trend_and_projected_lane_flights(forecast):
+    """ISSUE 15 (c): forecast lanes get the fleet-observatory surface —
+    per-lane flight timelines with ``projected: true`` in their meta
+    (a projection must never read as a measurement), the per-fork
+    projected-recovery trend point, and occupancy stats."""
+    import os
+
+    from corro_sim.obs.flight import FlightRecorder
+    from corro_sim.obs.lanes import lane_flight_filename
+
+    res, tok, fc = forecast
+    trend = fc["trend"]
+    assert trend["projected"] is True
+    assert trend["fork_round"] == tok.fork_round == res.rounds
+    cells = {c["scenario"].split(":")[0]: c for c in trend["cells"]}
+    assert cells["crash_amnesia"]["recovery_rounds"]["worst"] is not None
+    assert cells["crash_amnesia"]["rows_lost_worst"] == 0
+    occ = fc["occupancy"]
+    assert occ["lanes"] == fc["lanes"]
+    assert (
+        occ["useful_lane_rounds"] + occ["wasted_frozen_lane_rounds"]
+        == occ["executed_lane_rounds"]
+    )
+
+    lf = fc["lane_flights"]
+    assert lf["count"] == fc["lanes"]
+    detail = fc["lanes_detail"][0]
+    path = os.path.join(
+        lf["dir"], lane_flight_filename(detail["cell"], detail["seed"])
+    )
+    fl = FlightRecorder.load(path)
+    meta = fl.meta
+    assert meta["projected"] is True
+    assert meta["fork_round"] == tok.fork_round
+    # the driver-frame timeline matches the serial `run --fork` repro's
+    # (fork tokens are round-0 resume points): rounds recorded 1..N
+    d = fl.diagnostics()
+    assert d["rounds_recorded"] == detail["rounds_run"]
+    assert d["first_round"] == 1
+    assert d["converged_round"] == detail["converged_round"]
+    # the fault window rides in both frames (mapped through the fork)
+    windows = fl.events("fault_window")
+    if windows:
+        w = windows[0]["attrs"]
+        assert w["first_absolute"] == w["first"] + tok.fork_round
 
 
 def test_forecast_grid_and_frontier(forecast):
