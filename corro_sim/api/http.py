@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import re
 import select
 import threading
@@ -241,6 +242,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._traced(name, lambda: self._get_flight(params))
         elif path == "/v1/sweep":
             self._traced(name, self._get_sweep)
+        elif path == "/v1/perf":
+            self._traced(name, self._get_perf)
         elif path == "/v1/probes":
             self._traced(name, lambda: self._get_probes(params))
         elif path == "/v1/faults":
@@ -465,6 +468,30 @@ class _Handler(BaseHTTPRequestHandler):
         st = sweep_status()
         if st is None:
             raise _ApiError(404, "no sweep has run in this process")
+        self._send_json(st)
+
+    def _get_perf(self):
+        """GET /v1/perf — the performance-ledger snapshot
+        (corro_sim/obs/ledger.py, doc/performance.md §9): the last
+        ledger operation run in THIS process (ingest/show/check or a
+        bench/sweep/twin auto-append), falling back to the committed
+        seed-history trajectory. 404 only when neither exists."""
+        from corro_sim.obs import ledger as perf_ledger
+
+        st = perf_ledger.perf_status()
+        if st is None:
+            golden = perf_ledger.golden_ledger_path()
+            if not os.path.exists(golden):
+                raise _ApiError(
+                    404, "no perf-ledger operation has run in this "
+                         "process and no committed seed ledger exists "
+                         "(corro-sim perf --ingest)"
+                )
+            records, _bad = perf_ledger.load_ledger(golden)
+            st = {
+                "ledger": golden,
+                "trajectory": perf_ledger.build_trajectory(records),
+            }
         self._send_json(st)
 
     def _get_probes(self, params):

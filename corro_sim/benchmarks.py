@@ -857,6 +857,32 @@ def run_config_6(nodes: int | None = None, subs: int | None = None,
     }
 
 
+def _bench_out_dir() -> str:
+    """Where live bench droppings (flight journals, partial artifacts,
+    progress trails, the working perf ledger) land: the gitignored
+    ``bench_out/`` dir, created on demand — the repo root stays clean
+    and the LEDGER is the durable record (corro_sim/obs/ledger.py).
+    ``CORRO_BENCH_OUT`` overrides."""
+    d = os.environ.get("CORRO_BENCH_OUT") or "bench_out"
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError:
+        return "."
+    return d
+
+
+def _ledger_append(out: dict, cfg_id: int) -> None:
+    """Every capture — including partial/preflight-failure shapes —
+    appends to the perf ledger automatically (best-effort: the ledger
+    must never kill or fail the bench that feeds it)."""
+    try:
+        from corro_sim.obs.ledger import auto_append, normalize_bench_output
+
+        auto_append(normalize_bench_output(out, config=cfg_id))
+    except Exception:
+        pass
+
+
 def _mesh_env() -> dict:
     """Bench hygiene (ISSUE 8): every BENCH_r/MULTICHIP_r artifact
     records where it ran — the MULTICHIP_r05 ``"tail": ""`` told us
@@ -1238,6 +1264,11 @@ def main(config: int | None = None, **kw) -> int:
             out["partial_artifact"] = _write_partial_artifact(
                 cfg_id, out["error"]
             )
+            # the r05 lesson, closed (ISSUE 16): the dead round lands
+            # in the perf ledger as an explicit `unmeasured` record
+            # instead of vanishing into an rc=1. No env block — this
+            # path must not import jax (the dead tunnel hangs it).
+            _ledger_append(out, cfg_id)
             print(json.dumps(out))
             return 1
     from corro_sim.utils.compile_cache import enable_compile_cache
@@ -1249,19 +1280,30 @@ def main(config: int | None = None, **kw) -> int:
     # curve. CORRO_BENCH_FLIGHT overrides the path; "0" disables.
     global _FLIGHT
     flight_path = os.environ.get(
-        "CORRO_BENCH_FLIGHT", f"BENCH_flight_config{cfg_id}.ndjson"
+        "CORRO_BENCH_FLIGHT",
+        os.path.join(
+            _bench_out_dir(), f"BENCH_flight_config{cfg_id}.ndjson"
+        ),
     )
     if flight_path and flight_path != "0":
         from corro_sim.obs.flight import FlightRecorder
 
         _FLIGHT = FlightRecorder(sink_path=flight_path)
         _FLIGHT.set_meta(bench_config=cfg_id)
+    # config 5's chunk-by-chunk progress trail journals under
+    # bench_out/ by default — the partial-artifact writer reads it back
+    if cfg_id == 5 and "progress_path" not in kw:
+        kw["progress_path"] = os.path.join(
+            _bench_out_dir(), f"BENCH_config{cfg_id}_PROGRESS.json"
+        )
     try:
         out = fn(**kw)
         if isinstance(out, dict) and "env" not in out:
             # bench hygiene (ISSUE 8): every artifact names the
             # platform/devices it was measured on
             out["env"] = _mesh_env()
+        if isinstance(out, dict):
+            _ledger_append(out, cfg_id)
         print(json.dumps(out))
     except Exception as e:
         # a leg dying mid-run (the r05 "device unresponsive" class)
@@ -1270,13 +1312,19 @@ def main(config: int | None = None, **kw) -> int:
         # resume trail, then reports the failure as ONE honest JSON
         # line (the stdout contract) with rc=1
         err = f"{type(e).__name__}: {e}"
-        print(json.dumps({
+        out = {
             "metric": f"bench_config{cfg_id}_died",
             "value": None,
             "vs_baseline": None,
             "error": err,
             "partial_artifact": _write_partial_artifact(cfg_id, err),
-        }))
+        }
+        try:
+            out["env"] = _mesh_env()
+        except Exception:
+            pass
+        _ledger_append(out, cfg_id)
+        print(json.dumps(out))
         return 1
     finally:
         if _FLIGHT is not None:
@@ -1298,7 +1346,12 @@ def _write_partial_artifact(cfg_id: int, error: str) -> str | None:
         diag = _FLIGHT.diagnostics()
         last_round = diag.get("last_round")
     progress = None
-    prog_path = f"BENCH_config{cfg_id}_PROGRESS.json"
+    prog_path = os.path.join(
+        _bench_out_dir(), f"BENCH_config{cfg_id}_PROGRESS.json"
+    )
+    if not os.path.exists(prog_path):
+        # a pre-ISSUE-16 run may have left its trail at the repo root
+        prog_path = f"BENCH_config{cfg_id}_PROGRESS.json"
     if os.path.exists(prog_path):
         try:
             with open(prog_path) as f:
@@ -1322,7 +1375,9 @@ def _write_partial_artifact(cfg_id: int, error: str) -> str | None:
                     "chunk",
         },
     }
-    path = f"BENCH_partial_config{cfg_id}.json"
+    path = os.path.join(
+        _bench_out_dir(), f"BENCH_partial_config{cfg_id}.json"
+    )
     try:
         _atomic_json_dump(path, partial)
         return path if os.path.exists(path) else None

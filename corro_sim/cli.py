@@ -1002,10 +1002,11 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
                 file=sys.stderr, flush=True,
             )
 
-    res = run_sweep(
-        plan, max_rounds=args.max_rounds, chunk=args.chunk, mesh=mesh,
-        on_chunk=_on_chunk,
-    )
+    with _profiled(args.profile_dir):
+        res = run_sweep(
+            plan, max_rounds=args.max_rounds, chunk=args.chunk,
+            mesh=mesh, on_chunk=_on_chunk,
+        )
     frontier = build_frontier(res.lanes)
     thresholds = load_thresholds()
     breaches = (
@@ -1053,6 +1054,8 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         "invariants": inv_summary,
         "ok": not (any_violation or any_unsettled or breaches),
     }
+    if args.profile_dir:
+        report["profile_dir"] = args.profile_dir
     # fleet observatory artifacts (corro_sim/obs/lanes.py): occupancy
     # stats always ride the report; per-lane flight timelines and the
     # grid heatmap are demuxed from the dispatch's own outputs — no
@@ -1101,6 +1104,13 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2)
             f.write("\n")
+    # every sweep number rides the perf ledger (corro_sim/obs/ledger.py;
+    # best-effort — a ledger write must never fail the sweep)
+    from corro_sim.obs.ledger import auto_append, normalize_sweep_report
+
+    auto_append(normalize_sweep_report(
+        report, profile_dir=args.profile_dir
+    ))
     print(json.dumps(report, indent=2))
     if any_violation:
         return 5
@@ -1215,17 +1225,24 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         f"{args.out}.ckpt.npz" if args.out else None
     )
     try:
-        res = run_twin(
-            feed=args.feed, cfg=cfg, lines=lines, seed=args.seed,
-            checkpoint_path=checkpoint_path, resume=resume,
-            flight=flight, universe=universe,
-            on_chunk=lambda h: print(
-                f"# twin chunk {h['chunk']}: {h['lines']} lines "
-                f"({h['bad']} bad), {h['rounds']} rounds, "
-                f"gap {h['gap']:.0f}",
-                file=sys.stderr, flush=True,
-            ),
-        )
+        # PR 2 profiler hook, extended to the twin path: the shadow's
+        # scan chunks and the forecast dispatch trace into separate
+        # subdirs (two phases, two Perfetto-loadable traces)
+        with _profiled(
+            args.profile_dir
+            and os.path.join(args.profile_dir, "shadow")
+        ):
+            res = run_twin(
+                feed=args.feed, cfg=cfg, lines=lines, seed=args.seed,
+                checkpoint_path=checkpoint_path, resume=resume,
+                flight=flight, universe=universe,
+                on_chunk=lambda h: print(
+                    f"# twin chunk {h['chunk']}: {h['lines']} lines "
+                    f"({h['bad']} bad), {h['rounds']} rounds, "
+                    f"gap {h['gap']:.0f}",
+                    file=sys.stderr, flush=True,
+                ),
+            )
     except ValueError as e:
         # the strict hostile-feed refusal: ONE error naming every bad
         # line, before any sim work (io/traces.py validate_feed)
@@ -1249,17 +1266,22 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         )
         tok = fork_twin(res, fork_path, chunk=args.chunk)
         thresholds = load_thresholds()  # raises on a corrupt golden
-        fc = run_forecast(
-            tok, forecast_grid["scenario"], forecast_grid["seed"],
-            rounds=args.forecast_rounds, max_rounds=args.max_rounds,
-            chunk=args.chunk, thresholds=thresholds,
-            flight_dir=args.flight_dir,
-            on_chunk=lambda p: print(
-                f"# forecast chunk {p['chunk']}: rounds "
-                f"{p['rounds_done']}, {p['lanes_active']} lanes racing",
-                file=sys.stderr, flush=True,
-            ),
-        )
+        with _profiled(
+            args.profile_dir
+            and os.path.join(args.profile_dir, "forecast")
+        ):
+            fc = run_forecast(
+                tok, forecast_grid["scenario"], forecast_grid["seed"],
+                rounds=args.forecast_rounds, max_rounds=args.max_rounds,
+                chunk=args.chunk, thresholds=thresholds,
+                flight_dir=args.flight_dir,
+                on_chunk=lambda p: print(
+                    f"# forecast chunk {p['chunk']}: rounds "
+                    f"{p['rounds_done']}, {p['lanes_active']} lanes "
+                    "racing",
+                    file=sys.stderr, flush=True,
+                ),
+            )
         report["fork"] = fork_path
         report["forecast"] = fc
         # the projected-recovery trend next to the shadow headlines:
@@ -1295,10 +1317,19 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         wrote = res.flight.sink_active
         res.flight.close()
         report["flight"] = args.flight_out if wrote else None
+    if args.profile_dir:
+        report["profile_dir"] = args.profile_dir
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             json.dump(report, f, indent=2)
             f.write("\n")
+    # the shadow-delivery headline rides the perf ledger (best-effort,
+    # corro_sim/obs/ledger.py)
+    from corro_sim.obs.ledger import auto_append, normalize_twin_report
+
+    auto_append(normalize_twin_report(
+        report, profile_dir=args.profile_dir
+    ))
     print(json.dumps(report, indent=2))
     return rc
 
@@ -1466,6 +1497,196 @@ def _cmd_bench(args: argparse.Namespace) -> int:
         kw["n" if args.bench_config in (None, 0, 4) else "nodes"] = \
             args.bench_nodes
     return bench_main(config=args.bench_config, **kw) or 0
+
+
+def _cmd_perf(args: argparse.Namespace) -> int:
+    """`corro-sim perf` — the performance ledger & regression sentinel
+    (corro_sim/obs/ledger.py, doc/performance.md §9).
+
+    Modes (one per invocation):
+
+    * ``--ingest [ARTIFACT...]`` — schema-normalize perf artifacts
+      (BENCH_rNN/MULTICHIP_rNN round wrappers, bench one-line JSON,
+      sweep/twin reports; default: the committed round artifacts in the
+      cwd) and append them to the ledger;
+    * ``--show`` (default) — per-(config, platform) trajectories with
+      ASCII sparklines;
+    * ``--check`` — grade each series' latest measured value against
+      the committed tolerance bands; **exit 6 on breach** (the soak
+      tripwire code). Cross-platform comparisons honest-skip: a CPU
+      capture is never graded against a device band. ``--update``
+      re-baselines the bands from the ledger instead (the audit-golden
+      discipline — commit the diff with the change that moved the
+      number).
+
+    ``--out`` writes the JSON trajectory artifact in any mode.
+    Exit codes: 0 ok, 2 bad args/unreadable artifact, 6 band breach.
+    """
+    from corro_sim.obs import ledger as perf_ledger
+
+    ledger_path = args.ledger
+    if ledger_path is None:
+        golden = perf_ledger.golden_ledger_path()
+        ledger_path = (
+            golden if os.path.exists(golden)
+            else perf_ledger.default_ledger_path()
+        )
+    modes = sum(1 for f in (args.ingest, args.check) if f)
+    if modes > 1:
+        print("error: --ingest and --check are exclusive modes",
+              file=sys.stderr)
+        return 2
+
+    if args.ingest:
+        paths = args.artifacts or perf_ledger.default_ingest_paths()
+        if not paths:
+            print(
+                "error: nothing to ingest (no artifact paths given and "
+                "no BENCH_r*/MULTICHIP_r* round artifacts in the cwd)",
+                file=sys.stderr,
+            )
+            return 2
+        records = []
+        for path in paths:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    obj = json.load(f)
+                records.extend(perf_ledger.normalize_artifact(
+                    obj, source=os.path.basename(path)
+                ))
+            except (OSError, ValueError) as e:
+                print(f"error: {path}: {e}", file=sys.stderr)
+                return 2
+        try:
+            perf_ledger.append_records(ledger_path, records)
+        except OSError as e:
+            print(f"error: cannot append to {ledger_path!r}: {e}",
+                  file=sys.stderr)
+            return 2
+        all_records, bad = perf_ledger.load_ledger(ledger_path)
+        traj = perf_ledger.build_trajectory(all_records)
+        perf_ledger.update_perf_gauges(traj)
+        perf_ledger.set_perf_status({
+            "ledger": ledger_path, "trajectory": traj,
+        })
+        if args.out:
+            from corro_sim.utils.runtime import atomic_json_dump
+
+            atomic_json_dump(args.out, traj, indent=2)
+        print(json.dumps({
+            "ledger": ledger_path,
+            "ingested": len(records),
+            "from": [os.path.basename(p) for p in paths],
+            "records": len(all_records),
+            "bad_lines": bad,
+            "series": sorted(traj["series"]),
+        }, indent=2))
+        return 0
+
+    try:
+        all_records, bad = perf_ledger.load_ledger(ledger_path)
+    except OSError as e:
+        print(f"error: cannot read ledger {ledger_path!r}: {e}",
+              file=sys.stderr)
+        return 2
+    traj = perf_ledger.build_trajectory(all_records)
+    if args.out:
+        from corro_sim.utils.runtime import atomic_json_dump
+
+        atomic_json_dump(args.out, traj, indent=2)
+
+    if args.check:
+        bands_path = args.bands or perf_ledger.golden_bands_path()
+        if args.update:
+            prior = None
+            if os.path.exists(bands_path):
+                try:
+                    prior = perf_ledger.load_bands(bands_path)
+                except (OSError, ValueError) as e:
+                    print(f"error: {bands_path}: {e}", file=sys.stderr)
+                    return 2
+            bands = perf_ledger.update_bands(
+                all_records, prior=prior,
+                tolerance_pct=args.tolerance_pct,
+            )
+            from corro_sim.utils.runtime import atomic_json_dump
+
+            if not atomic_json_dump(bands_path, bands, indent=2):
+                print(f"error: cannot write {bands_path!r}",
+                      file=sys.stderr)
+                return 2
+            print(json.dumps({
+                "updated": bands_path,
+                "bands": sorted(bands["bands"]),
+            }, indent=2))
+            return 0
+        try:
+            bands = perf_ledger.load_bands(bands_path)
+        except (OSError, ValueError) as e:
+            print(
+                f"error: cannot read bands {bands_path!r}: {e} "
+                "(baseline with `corro-sim perf --check --update`)",
+                file=sys.stderr,
+            )
+            return 2
+        check = perf_ledger.check_bands(all_records, bands)
+        check["ledger"] = ledger_path
+        check["bands"] = bands_path
+        perf_ledger.update_perf_gauges(traj, check)
+        perf_ledger.set_perf_status({
+            "ledger": ledger_path, "trajectory": traj, "check": check,
+        })
+        print(json.dumps(check, indent=2))
+        from corro_sim.obs.ledger import BREACH_EXIT
+
+        return BREACH_EXIT if check["breaches"] else 0
+
+    # --show (the default mode)
+    perf_ledger.update_perf_gauges(traj)
+    perf_ledger.set_perf_status({
+        "ledger": ledger_path, "trajectory": traj,
+    })
+    print(f"# ledger {ledger_path}: {len(all_records)} records"
+          + (f" ({bad} bad lines skipped)" if bad else ""),
+          file=sys.stderr)
+    print(perf_ledger.render_trajectory(traj))
+    return 0
+
+
+def _profiled(profile_dir: str | None):
+    """The PR 2 ``--profile-dir`` hook (jax.profiler.trace), shared by
+    the sweep/twin CLI paths: a failed trace start must never kill the
+    dispatch it instruments — it increments the same counter the run
+    path does and the work proceeds unprofiled."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _cm():
+        started = False
+        if profile_dir:
+            import jax
+
+            from corro_sim.utils.metrics import counters
+            try:
+                jax.profiler.start_trace(profile_dir)
+                started = True
+            except Exception:
+                counters.inc(
+                    "corro_profile_trace_failures_total",
+                    help_="jax.profiler.trace start failures "
+                          "(profile skipped)",
+                )
+        try:
+            yield
+        finally:
+            if started:
+                import jax
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+
+    return _cm()
 
 
 def _cmd_agent(args: argparse.Namespace) -> int:
@@ -2028,6 +2249,12 @@ def build_parser() -> argparse.ArgumentParser:
              "degradation_p99 matrices) to PATH "
              "and print an ASCII rendering to stderr",
     )
+    psw.add_argument(
+        "--profile-dir",
+        help="capture a jax.profiler trace of the fleet dispatch into "
+             "this directory (TensorBoard/Perfetto-loadable); the path "
+             "rides the sweep's perf-ledger record",
+    )
     psw.add_argument("--out", help="also write the full report JSON here")
     psw.set_defaults(fn=_cmd_sweep, pipeline=None)
 
@@ -2130,6 +2357,13 @@ def build_parser() -> argparse.ArgumentParser:
              "ND-JSON files under DIR — the fleet observatory surface "
              "(doc/observability.md §lane-observatory)",
     )
+    pt2.add_argument(
+        "--profile-dir",
+        help="capture jax.profiler traces of the shadow scan "
+             "(<dir>/shadow) and the forecast dispatch "
+             "(<dir>/forecast); the path rides the twin's "
+             "perf-ledger record",
+    )
     pt2.add_argument("--out", help="also write the report JSON here")
     pt2.set_defaults(fn=_cmd_twin)
 
@@ -2209,6 +2443,65 @@ def build_parser() -> argparse.ArgumentParser:
     pb.add_argument("--nodes", dest="bench_nodes", type=int,
                     help="override the config's cluster size")
     pb.set_defaults(fn=_cmd_bench)
+
+    pp = sub.add_parser(
+        "perf",
+        help="performance ledger & regression sentinel: platform-keyed "
+             "trajectories for every bench/sweep/twin number, gated by "
+             "committed tolerance bands (doc/performance.md section 9)",
+    )
+    pp.add_argument(
+        "artifacts", nargs="*", metavar="ARTIFACT",
+        help="with --ingest: perf artifacts to normalize and append "
+             "(BENCH_rNN/MULTICHIP_rNN round wrappers, bench one-line "
+             "JSON, sweep/twin reports; default: the BENCH_r*/"
+             "MULTICHIP_r* round artifacts in the cwd)",
+    )
+    pp.add_argument(
+        "--ingest", action="store_true",
+        help="normalize the artifacts into ledger records and append "
+             "them (append-only ND-JSON; one record per number, keyed "
+             "by config, platform, device_kind, git rev, seq)",
+    )
+    pp.add_argument(
+        "--show", action="store_true",
+        help="per-(config, platform) trajectories with ASCII "
+             "sparklines (the default mode)",
+    )
+    pp.add_argument(
+        "--check", action="store_true",
+        help="grade each series' latest measured value against the "
+             "committed tolerance bands — exit 6 on breach; "
+             "cross-platform comparisons honest-skip and unmeasured "
+             "records never grade",
+    )
+    pp.add_argument(
+        "--update", action="store_true",
+        help="with --check: re-baseline the bands from the ledger's "
+             "latest measured values (the audit-golden discipline — "
+             "commit the diff with the change that moved the number)",
+    )
+    pp.add_argument(
+        "--ledger", metavar="PATH",
+        help="ND-JSON ledger path (default: the committed "
+             "analysis/golden/perf_ledger.ndjson when it exists, else "
+             "the bench_out/ working ledger)",
+    )
+    pp.add_argument(
+        "--bands", metavar="PATH",
+        help="tolerance-bands file (default: the committed "
+             "analysis/golden/perf_bands.json)",
+    )
+    pp.add_argument(
+        "--tolerance-pct", type=float, default=25.0,
+        help="default band width for --update (per-band values in the "
+             "committed file survive re-baselines)",
+    )
+    pp.add_argument(
+        "--out", metavar="PATH",
+        help="also write the JSON trajectory artifact here",
+    )
+    pp.set_defaults(fn=_cmd_perf)
 
     pa = sub.add_parser("agent", help="run a live cluster (HTTP API + admin)")
     pa.add_argument("--schema", help="schema DDL file")

@@ -1,4 +1,13 @@
 from corro_sim.obs.flight import FlightRecorder
+from corro_sim.obs.ledger import (
+    build_trajectory,
+    check_bands,
+    load_ledger,
+    normalize_artifact,
+    perf_status,
+    sparkline,
+    update_bands,
+)
 from corro_sim.obs.lanes import (
     comparable_timeline,
     demux_flights,
@@ -20,14 +29,21 @@ __all__ = [
     "FlightRecorder",
     "ProbeTrace",
     "bfs_hops",
+    "build_trajectory",
+    "check_bands",
     "comparable_timeline",
     "demux_flights",
     "fleet_occupancy",
     "grid_heatmaps",
     "ground_truth_adjacency",
     "lane_flight",
+    "load_ledger",
     "node_lag_observatory",
+    "normalize_artifact",
+    "perf_status",
     "render_heatmap",
+    "sparkline",
     "sweep_status",
+    "update_bands",
     "write_lane_flights",
 ]

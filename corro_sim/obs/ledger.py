@@ -1,0 +1,923 @@
+"""Performance ledger & regression sentinel (doc/performance.md §9).
+
+The ROADMAP's binding measurement gap: every r06+ perf number is
+CPU-relative until the device returns, yet the numbers that DO exist —
+the committed ``BENCH_r01–r05`` / ``MULTICHIP_r01–r05`` round artifacts,
+the bench one-line JSON outputs (configs 0–8), sweep and twin reports —
+are loose one-shot JSON with no trajectory, no platform separation and
+no gate. The day the v5e-8 returns there is nothing to catch a
+regression against the r02 615 ms/round target.
+
+This module is the durable record those artifacts feed:
+
+* an **append-only ND-JSON ledger** (one JSON object per line) of
+  schema-normalized records keyed by ``(config, platform, device_kind,
+  git_rev, seq/ts)``, with the wall **decomposed** from fields the runs
+  already carry (compile vs sim vs fetch-wait vs host-side demux —
+  ``RunResult.compile_seconds``/``.pipeline``, sweep chunk walls) so no
+  number is ever again a single opaque scalar;
+* **trajectory** computation per ``(config, platform)`` series with
+  ASCII sparklines (``corro-sim perf --show``) and a JSON trajectory
+  artifact;
+* a **regression sentinel** (``corro-sim perf --check``) gated by the
+  committed ``analysis/golden/perf_bands.json`` tolerance bands — the
+  audit-golden ``--update`` re-baseline discipline, exit 6 on breach
+  (the soak/frontier tripwire code) — that **honest-skips**
+  cross-platform comparisons: a CPU-relative capture is NEVER graded
+  against a device baseline, and a device preflight failure lands as an
+  explicit ``unmeasured`` record (the r05 shape) instead of vanishing.
+
+Everything here is host-side bookkeeping over already-written report
+dicts: zero step-program changes by construction.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import time
+
+SCHEMA = 1
+
+#: the sentinel's breach exit code — same tripwire semantics as the
+#: soak/sweep/twin frontier gates (cli.py exit-code table)
+BREACH_EXIT = 6
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+# record.status values: a number was measured; the leg ran and failed
+# (MULTICHIP_r01, bench *_died); the device was unreachable and NO
+# measurement was possible (BENCH_r05 — kept, never graded)
+STATUSES = ("measured", "failed", "unmeasured")
+
+
+# ------------------------------------------------------------------ paths
+
+def _golden_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "analysis", "golden",
+    )
+
+
+def golden_ledger_path() -> str:
+    """The committed seed history (``analysis/golden/perf_ledger.ndjson``)."""
+    return os.path.join(_golden_dir(), "perf_ledger.ndjson")
+
+
+def golden_bands_path() -> str:
+    """The committed tolerance bands (``analysis/golden/perf_bands.json``)."""
+    return os.path.join(_golden_dir(), "perf_bands.json")
+
+
+def default_ledger_path() -> str:
+    """Auto-append target for live bench/sweep/twin captures: the
+    gitignored ``bench_out/`` working ledger. ``CORRO_PERF_LEDGER``
+    overrides the path; ``CORRO_PERF_LEDGER=0`` disables auto-append
+    (the callers treat a falsy path as off). Promote working records
+    into the committed golden with ``corro-sim perf --ingest``."""
+    env = os.environ.get("CORRO_PERF_LEDGER")
+    if env is not None:
+        return "" if env == "0" else env
+    return os.path.join("bench_out", "perf_ledger.ndjson")
+
+
+def git_rev() -> str:
+    """Short git revision of the tree the number was measured on —
+    ``CORRO_GIT_REV`` overrides (CI, tests), ``unknown`` when the
+    ledger lives outside any checkout."""
+    env = os.environ.get("CORRO_GIT_REV")
+    if env:
+        return env
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+        if r.returncode == 0 and r.stdout.strip():
+            return r.stdout.strip()
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return "unknown"
+
+
+def runtime_env() -> dict:
+    """Platform provenance of THIS process (the benchmarks._mesh_env
+    shape): never raises — a ledger append must not kill the run it
+    documents, even before jax imports cleanly."""
+    try:
+        import jax
+
+        devices = jax.devices()
+        return {
+            "platform": jax.default_backend(),
+            "device_count": len(devices),
+            "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        }
+    except Exception:
+        return {
+            "platform": "unknown", "device_count": None,
+            "device_kind": "unknown",
+        }
+
+
+# ---------------------------------------------------------------- records
+
+def wall_decomposition(total_s=None, compile_s=None, sim_s=None,
+                       fetch_wait_s=None, demux_s=None) -> dict:
+    """The decomposed wall block every record carries. Components come
+    from fields the runs already journal (``compile_seconds``,
+    ``pipeline.fetch_wait_s``, sweep chunk walls); any the artifact
+    didn't carry stay ``None`` — the ledger never invents a number.
+    ``compile_s`` may sit OUTSIDE ``total_s`` (the north-star wall
+    excludes compile by definition)."""
+
+    def _f(v):
+        return round(float(v), 6) if isinstance(v, (int, float)) else None
+
+    return {
+        "total_s": _f(total_s),
+        "compile_s": _f(compile_s),
+        "sim_s": _f(sim_s),
+        "fetch_wait_s": _f(fetch_wait_s),
+        "demux_s": _f(demux_s),
+    }
+
+
+def make_record(config: str, metric: str, value, unit: str | None = None,
+                *, platform: str = "unknown",
+                device_kind: str = "unknown",
+                device_count: int | None = None,
+                status: str = "measured",
+                wall: dict | None = None,
+                source: str | None = None,
+                seq: float | None = None,
+                ts: str | None = None,
+                rev: str | None = None,
+                vs_baseline=None,
+                profile_dir: str | None = None,
+                extra: dict | None = None) -> dict:
+    """One normalized ledger record.
+
+    ``config`` is the series slug (``north_star_wall``, ``sweep``, …);
+    ``(config, platform)`` is the trajectory/band key. ``seq`` is the
+    sort key within a series: seed BENCH_rNN artifacts use their round
+    number ``n`` (1–5, deterministic for the committed golden), live
+    captures default to epoch seconds — which always sorts after any
+    seed round."""
+    assert status in STATUSES, status
+    if seq is None:
+        seq = round(time.time(), 3)
+        ts = ts or time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    return {
+        "schema": SCHEMA,
+        "config": config,
+        "metric": metric,
+        "value": (
+            round(float(value), 6) if isinstance(value, (int, float))
+            and not isinstance(value, bool) else value
+        ),
+        "unit": unit,
+        "platform": platform or "unknown",
+        "device_kind": device_kind or "unknown",
+        "device_count": device_count,
+        "git_rev": rev if rev is not None else git_rev(),
+        "seq": seq,
+        "ts": ts,
+        "status": status,
+        "wall": wall or wall_decomposition(),
+        "vs_baseline": vs_baseline,
+        "source": source,
+        "profile_dir": profile_dir,
+        "extra": extra or {},
+    }
+
+
+def series_key(rec: dict) -> str:
+    return f"{rec.get('config', '?')}@{rec.get('platform', 'unknown')}"
+
+
+def _direction(unit: str | None) -> str:
+    """Regression direction from the unit: rates go up, walls go down.
+    Unknown units default to lower-is-better (most series are walls)."""
+    u = (unit or "").lower()
+    if "/s" in u or "per_sec" in u or u == "ok":
+        return "higher_is_better"
+    return "lower_is_better"
+
+
+def _config_slug(metric: str) -> str:
+    """Series slug from a bench metric name: strips the size/shape
+    numerals baked into metric strings so the SAME measurement at
+    different cluster sizes (64-node CI smoke vs the 10k device run)
+    lands in one series — platform keying keeps those from ever being
+    graded against each other; the shape rides ``extra``."""
+    m = metric or "unknown"
+    if "changes_applied_per_sec" in m:
+        return "north_star_throughput"
+    if m.startswith("northstar") and m.endswith("wall_s"):
+        return "north_star_wall"
+    if "north_star" in m and m.endswith("_unmeasured"):
+        return "north_star_wall"
+    if m.startswith("devcluster"):
+        return "devcluster_wall"
+    if m.endswith("_unmeasured") and m.startswith("bench_run_"):
+        return "bench/" + m[len("bench_run_"):-len("_unmeasured")]
+    if m.startswith("bench_config") and m.endswith("_died"):
+        return "bench/" + m[len("bench_"):-len("_died")]
+    if m.startswith("config5_"):
+        return "outage_catchup_rounds"
+    if m == "sweep_clusters_per_sec_per_device":
+        return "sweep_throughput"
+    return m
+
+
+def _platform_from_tail(tail: str | None) -> str:
+    """Seed-era BENCH_rNN wrappers predate the env block (ISSUE 8) —
+    the only platform evidence is the captured process tail. The r05
+    preflight-dead tail carries no marker at all: ``unknown``, which
+    the sentinel never grades."""
+    t = (tail or "").lower()
+    if "axon" in t or "libtpu" in t or "tpu" in t:
+        return "axon"
+    if "cpu" in t:
+        return "cpu"
+    return "unknown"
+
+
+# ------------------------------------------------------------ normalizers
+
+def normalize_bench_round(obj: dict, source: str = "") -> list[dict]:
+    """A committed ``BENCH_rNN.json`` round wrapper: ``{n, cmd, rc,
+    tail, parsed}``. The r02+ north-star shape also carries the
+    devcluster leg — that lands as its OWN record (its own series; the
+    north-star ``vs_baseline`` already encodes the ratio)."""
+    parsed = obj.get("parsed") or {}
+    n = obj.get("n")
+    metric = parsed.get("metric", "unknown")
+    env = parsed.get("env") or {}
+    platform = env.get("platform") or _platform_from_tail(obj.get("tail"))
+    unmeasured = (
+        parsed.get("value") is None and parsed.get("error") is not None
+    ) or metric.endswith("_unmeasured")
+    status = "unmeasured" if unmeasured else (
+        "measured" if obj.get("rc", 0) == 0 else "failed"
+    )
+    if unmeasured:
+        # the r05 shape: the device was unreachable — the round is an
+        # explicit hole in the trajectory, never a silent gap
+        platform = env.get("platform", "unknown")
+    rounds = parsed.get("sim_rounds_to_convergence")
+    per_round_ms = parsed.get("sim_wall_per_round_ms")
+    sim_s = None
+    if isinstance(per_round_ms, (int, float)) and isinstance(rounds, int):
+        sim_s = per_round_ms * rounds / 1000.0
+    value = parsed.get("value")
+    records = [make_record(
+        _config_slug(metric), metric, value, parsed.get("unit"),
+        platform=platform,
+        device_kind=env.get("device_kind", "unknown"),
+        device_count=env.get("device_count"),
+        status=status,
+        wall=wall_decomposition(
+            total_s=value if parsed.get("unit") == "s" else None,
+            sim_s=sim_s,
+        ),
+        source=source, seq=n, rev="unknown",
+        vs_baseline=parsed.get("vs_baseline"),
+        extra={k: parsed[k] for k in (
+            "sim_rounds_to_convergence", "sim_wall_per_round_ms",
+            "sim_converged", "error", "note", "baseline_drift_pct",
+            "baseline_drift_exceeded",
+        ) if k in parsed},
+    )]
+    devc = parsed.get("devcluster_64_agents_wall_s")
+    if isinstance(devc, (int, float)):
+        records.append(make_record(
+            "devcluster_wall", "devcluster_64_agents_wall_s", devc, "s",
+            platform=platform, status="measured",
+            wall=wall_decomposition(total_s=devc),
+            source=source, seq=n, rev="unknown",
+            extra={k: parsed[k] for k in (
+                "devcluster_converged", "baseline_frozen_wall_s",
+            ) if k in parsed},
+        ))
+    return records
+
+
+def normalize_multichip_round(obj: dict, source: str = "") -> list[dict]:
+    """A committed ``MULTICHIP_rNN.json`` leg: ``{n_devices, rc, ok,
+    skipped, tail}``. A failed leg (r01's libtpu fault) is a
+    ``failed`` measurement of the leg gate, value 0 — it happened and
+    the trajectory shows it."""
+    ok = bool(obj.get("ok"))
+    skipped = bool(obj.get("skipped"))
+    platform = _platform_from_tail(obj.get("tail"))
+    return [make_record(
+        "multichip_leg", "multichip_leg_ok",
+        None if skipped else (1.0 if ok else 0.0), "ok",
+        platform=platform,
+        device_count=obj.get("n_devices"),
+        status="unmeasured" if skipped else (
+            "measured" if ok else "failed"
+        ),
+        source=source, seq=obj.get("n"), rev="unknown",
+        extra={"rc": obj.get("rc"), "skipped": skipped},
+    )]
+
+
+def normalize_bench_output(out: dict, config: int | None = None,
+                           source: str = "bench",
+                           profile_dir: str | None = None) -> list[dict]:
+    """A live ``benchmarks.main`` one-line JSON result (any config,
+    including the preflight-``unmeasured`` and ``*_died`` shapes).
+    Wall decomposition digs the fields the artifact already carries:
+    north-star ``runs[]`` (compile/pipeline per repeat), config 8's
+    ``sweep_wall_s``/``sweep_compile_s``, the generic
+    ``compile_seconds`` + ``pipeline`` pair."""
+    metric = out.get("metric", "unknown")
+    env = out.get("env") or {}
+    status = "measured"
+    if metric.endswith("_unmeasured"):
+        status = "unmeasured"
+    elif metric.endswith("_died") or out.get("error"):
+        status = "failed"
+    value = out.get("value")
+    unit = out.get("unit")
+
+    compile_s = out.get("compile_seconds")
+    fetch_wait = (out.get("pipeline") or {}).get("fetch_wait_s")
+    sim_s = None
+    total = value if unit == "s" and isinstance(value, (int, float)) \
+        else None
+    runs = out.get("runs")
+    if isinstance(runs, list) and runs:
+        # north-star shape: repeat 0 pays any cold compiles; the
+        # headline value IS the (compile-excluded) sim wall
+        compile_s = runs[0].get("compile_seconds", compile_s)
+        fetch_wait = (runs[0].get("pipeline") or {}).get(
+            "fetch_wait_s", fetch_wait
+        )
+        sim_s = total
+    if "sweep_wall_s" in out:  # config 8
+        total = out.get("sweep_wall_s")
+        compile_s = out.get("sweep_compile_s", compile_s)
+        sim_s = total
+    extra = {k: out[k] for k in (
+        "sim_rounds_to_convergence", "sim_wall_per_round_ms",
+        "sim_converged", "converged", "lanes", "nodes_per_lane",
+        "dispatches", "occupancy", "devices", "error", "note",
+        "per_insert_ms", "inserts_per_sec", "baseline_drift_pct",
+        "baseline_drift_exceeded", "partial_artifact", "chunks",
+    ) if k in out}
+    if isinstance(out.get("occupancy"), dict):
+        extra["occupancy"] = {
+            k: v for k, v in out["occupancy"].items()
+            if not isinstance(v, list)
+        }
+    records = [make_record(
+        _config_slug(metric), metric, value, unit,
+        platform=env.get("platform", "unknown"),
+        device_kind=env.get("device_kind", "unknown"),
+        device_count=env.get("device_count"),
+        status=status,
+        wall=wall_decomposition(
+            total_s=total, compile_s=compile_s, sim_s=sim_s,
+            fetch_wait_s=fetch_wait,
+        ),
+        source=source if config is None else f"{source}:config{config}",
+        vs_baseline=out.get("vs_baseline"),
+        profile_dir=profile_dir, extra=extra,
+    )]
+    devc = out.get("devcluster_64_agents_wall_s")
+    if isinstance(devc, (int, float)):
+        records.append(make_record(
+            "devcluster_wall", "devcluster_64_agents_wall_s", devc, "s",
+            platform=env.get("platform", "unknown"),
+            device_kind=env.get("device_kind", "unknown"),
+            device_count=env.get("device_count"),
+            wall=wall_decomposition(total_s=devc),
+            source=source if config is None
+            else f"{source}:config{config}",
+            extra={k: out[k] for k in (
+                "devcluster_converged", "baseline_frozen_wall_s",
+            ) if k in out},
+        ))
+    return records
+
+
+def normalize_sweep_report(rep: dict, source: str = "sweep",
+                           env: dict | None = None,
+                           profile_dir: str | None = None) -> list[dict]:
+    """A ``corro-sim sweep`` CLI report: the fleet throughput number
+    (clusters/sec/device) with the dispatch wall decomposed
+    (compile vs execute) and the occupancy accounting in ``extra``."""
+    env = env or runtime_env()
+    occ = rep.get("occupancy") or {}
+    return [make_record(
+        "sweep_throughput", "sweep_clusters_per_sec_per_device",
+        rep.get("clusters_per_second_per_device"),
+        "clusters/s/device",
+        platform=env.get("platform", "unknown"),
+        device_kind=env.get("device_kind", "unknown"),
+        device_count=env.get("device_count"),
+        status=(
+            "measured"
+            if rep.get("clusters_per_second_per_device") is not None
+            else "unmeasured"
+        ),
+        wall=wall_decomposition(
+            total_s=rep.get("wall_seconds"),
+            compile_s=rep.get("compile_seconds"),
+            sim_s=rep.get("wall_seconds"),
+        ),
+        source=source, profile_dir=profile_dir,
+        extra={
+            "lanes": rep.get("lanes"),
+            "nodes": rep.get("nodes"),
+            "dispatches": rep.get("dispatches"),
+            "devices": rep.get("devices"),
+            "ok": rep.get("ok"),
+            "occupancy_ratio": occ.get("occupancy_ratio"),
+            "wasted_frozen_lane_rounds": occ.get(
+                "wasted_frozen_lane_rounds"
+            ),
+        },
+    )]
+
+
+def normalize_twin_report(rep: dict, source: str = "twin",
+                          env: dict | None = None,
+                          profile_dir: str | None = None) -> list[dict]:
+    """A ``corro-sim twin`` CLI report: the shadow's delivery p99 on
+    the sim clock (the SWARM replication-latency headline), plus a
+    forecast-throughput record when a what-if grid rode the run."""
+    env = env or runtime_env()
+    delivery = rep.get("shadow_delivery") or {}
+    p99_ms = delivery.get("p99_ms")
+    records = [make_record(
+        "twin_shadow_delivery", "twin_shadow_delivery_p99_ms",
+        p99_ms, "ms",
+        platform=env.get("platform", "unknown"),
+        device_kind=env.get("device_kind", "unknown"),
+        device_count=env.get("device_count"),
+        status="measured" if p99_ms is not None else "unmeasured",
+        wall=wall_decomposition(
+            sim_s=(
+                rep["sim_ms"] / 1000.0
+                if isinstance(rep.get("sim_ms"), (int, float)) else None
+            ),
+        ),
+        source=source, profile_dir=profile_dir,
+        extra={k: rep[k] for k in (
+            "chunks", "rounds", "converged_round", "bad_lines",
+            "lines", "feed_ts", "poisoned",
+        ) if k in rep},
+    )]
+    fc = rep.get("forecast") or {}
+    if isinstance(fc.get("wall_seconds"), (int, float)):
+        records.append(make_record(
+            "twin_forecast_wall", "twin_forecast_dispatch_wall_s",
+            fc["wall_seconds"], "s",
+            platform=env.get("platform", "unknown"),
+            device_kind=env.get("device_kind", "unknown"),
+            device_count=env.get("device_count"),
+            wall=wall_decomposition(
+                total_s=fc.get("wall_seconds"),
+                compile_s=fc.get("compile_seconds"),
+                sim_s=fc.get("wall_seconds"),
+            ),
+            source=source, profile_dir=profile_dir,
+            extra={"lanes": fc.get("lanes"), "ok": fc.get("ok")},
+        ))
+    return records
+
+
+def normalize_artifact(obj: dict, source: str = "") -> list[dict]:
+    """Shape-sniffing dispatch for ``perf --ingest PATH...``: committed
+    round wrappers, live bench outputs, sweep/twin reports. Raises
+    ``ValueError`` on a dict no normalizer recognizes — an ingest must
+    never silently drop an artifact."""
+    if not isinstance(obj, dict):
+        raise ValueError("artifact is not a JSON object")
+    if "parsed" in obj and "tail" in obj:
+        return normalize_bench_round(obj, source=source)
+    if "n_devices" in obj:
+        return normalize_multichip_round(obj, source=source)
+    if "shadow_delivery" in obj:
+        return normalize_twin_report(
+            obj, source=source,
+            env=obj.get("env") or {"platform": "unknown",
+                                   "device_kind": "unknown"},
+        )
+    if "clusters_per_second_per_device" in obj and "lanes_detail" in obj:
+        return normalize_sweep_report(
+            obj, source=source,
+            env=obj.get("env") or {"platform": "unknown",
+                                   "device_kind": "unknown"},
+        )
+    if "metric" in obj:
+        return normalize_bench_output(obj, source=source)
+    raise ValueError(
+        "unrecognized perf artifact shape (expected a BENCH_rNN/"
+        "MULTICHIP_rNN wrapper, a bench one-line JSON, or a sweep/twin "
+        f"report); keys: {sorted(obj)[:8]}"
+    )
+
+
+def default_ingest_paths(root: str = ".") -> list[str]:
+    """The committed round-artifact set, in round order."""
+    return sorted(
+        glob.glob(os.path.join(root, "BENCH_r[0-9]*.json"))
+    ) + sorted(glob.glob(os.path.join(root, "MULTICHIP_r[0-9]*.json")))
+
+
+# ------------------------------------------------------------- ledger I/O
+
+def append_records(path: str, records: list[dict]) -> int:
+    """Append-only ND-JSON write (one sorted-key JSON object per line,
+    so identical records are byte-identical). Creates the parent dir.
+    Raises OSError — auto-append call sites guard; the CLI wants the
+    error."""
+    if not records:
+        return 0
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        for rec in records:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(records)
+
+
+def load_ledger(path: str) -> tuple[list[dict], int]:
+    """Read an ND-JSON ledger → (records, bad_line_count). Torn or
+    hostile lines are counted and skipped, never fatal — an append-only
+    file killed mid-write must still load."""
+    records: list[dict] = []
+    bad = 0
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad += 1
+                continue
+            if not isinstance(rec, dict) or "config" not in rec:
+                bad += 1
+                continue
+            records.append(rec)
+    return records, bad
+
+
+def _ordered(records: list[dict]) -> list[dict]:
+    return sorted(
+        records,
+        key=lambda r: (
+            r.get("seq") if isinstance(r.get("seq"), (int, float))
+            else 0.0,
+            r.get("metric", ""),
+        ),
+    )
+
+
+# -------------------------------------------------- trajectory + sparkline
+
+def sparkline(values: list) -> str:
+    """ASCII(-art) sparkline over the measured values of one series —
+    min..max scaled to 8 block heights; a flat series renders mid-band."""
+    vals = [
+        float(v) for v in values
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    ]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[3] * len(vals)
+    return "".join(
+        _SPARK[min(int((v - lo) / (hi - lo) * 8), 7)] for v in vals
+    )
+
+
+def build_trajectory(records: list[dict]) -> dict:
+    """Per-(config, platform) trajectories: the ordered point list,
+    latest/best measured values, latest-vs-previous trend, sparkline,
+    and the unmeasured-hole count. Deterministic for a given ledger
+    (pure function of the records, series sorted by key)."""
+    series: dict[str, dict] = {}
+    for rec in _ordered(records):
+        key = series_key(rec)
+        ent = series.setdefault(key, {
+            "config": rec.get("config"),
+            "platform": rec.get("platform", "unknown"),
+            "unit": rec.get("unit"),
+            "direction": _direction(rec.get("unit")),
+            "points": [],
+        })
+        if ent["unit"] is None and rec.get("unit") is not None:
+            ent["unit"] = rec["unit"]
+            ent["direction"] = _direction(rec["unit"])
+        ent["points"].append({
+            "seq": rec.get("seq"),
+            "ts": rec.get("ts"),
+            "git_rev": rec.get("git_rev"),
+            "metric": rec.get("metric"),
+            "value": rec.get("value"),
+            "status": rec.get("status"),
+            "source": rec.get("source"),
+        })
+    for key, ent in series.items():
+        measured = [
+            p["value"] for p in ent["points"]
+            if p["status"] == "measured"
+            and isinstance(p["value"], (int, float))
+        ]
+        higher = ent["direction"] == "higher_is_better"
+        ent["measured_points"] = len(measured)
+        ent["unmeasured_points"] = sum(
+            1 for p in ent["points"] if p["status"] == "unmeasured"
+        )
+        ent["failed_points"] = sum(
+            1 for p in ent["points"] if p["status"] == "failed"
+        )
+        ent["latest"] = measured[-1] if measured else None
+        ent["best"] = (
+            (max(measured) if higher else min(measured))
+            if measured else None
+        )
+        ent["trend_pct"] = (
+            round(100.0 * (measured[-1] - measured[-2]) / measured[-2], 2)
+            if len(measured) >= 2 and measured[-2] else None
+        )
+        ent["sparkline"] = sparkline(measured)
+    return {
+        "schema": SCHEMA,
+        "records": len(records),
+        "series": {k: series[k] for k in sorted(series)},
+    }
+
+
+def render_trajectory(traj: dict) -> str:
+    """The ``perf --show`` table: one line per (config, platform)
+    series — sparkline, latest/best, trend, and the honest hole count."""
+    lines = []
+    keys = sorted(traj.get("series", {}))
+    width = max((len(k) for k in keys), default=6)
+    for key in keys:
+        ent = traj["series"][key]
+        unit = ent.get("unit") or ""
+        latest = ent.get("latest")
+        latest_s = (
+            f"{latest:g} {unit}".strip() if latest is not None
+            else "(no measured point)"
+        )
+        arrow = {"higher_is_better": "↑", "lower_is_better": "↓"}[
+            ent["direction"]
+        ]
+        trend = (
+            f" {ent['trend_pct']:+.1f}%" if ent.get("trend_pct")
+            is not None else ""
+        )
+        holes = ""
+        if ent.get("unmeasured_points"):
+            holes = f" [{ent['unmeasured_points']} unmeasured]"
+        if ent.get("failed_points"):
+            holes += f" [{ent['failed_points']} failed]"
+        lines.append(
+            f"{key:<{width}}  {ent.get('sparkline', ''):<12} "
+            f"latest {latest_s}{trend} (best {arrow} "
+            f"{ent.get('best') if ent.get('best') is not None else '—'})"
+            f"{holes}"
+        )
+    return "\n".join(lines)
+
+
+# ------------------------------------------------------ regression bands
+
+def load_bands(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        bands = json.load(f)
+    if not isinstance(bands, dict) or "bands" not in bands:
+        raise ValueError(f"{path}: not a perf-bands file (no 'bands')")
+    return bands
+
+
+def update_bands(records: list[dict], prior: dict | None = None,
+                 tolerance_pct: float = 25.0) -> dict:
+    """Re-baseline (the audit-golden ``--update`` discipline): every
+    series with a measured latest value on a KNOWN platform gets a band
+    at that value; existing bands keep their hand-set tolerance, and
+    bands for series absent from the ledger survive untouched — the
+    device going away must not delete the device baselines."""
+    prior_bands = dict((prior or {}).get("bands", {}))
+    traj = build_trajectory(records)
+    for key, ent in traj["series"].items():
+        if ent.get("latest") is None:
+            continue
+        if ent.get("platform", "unknown") == "unknown":
+            continue  # an unknown platform can never be graded — no band
+        old = prior_bands.get(key, {})
+        prior_bands[key] = {
+            "config": ent["config"],
+            "platform": ent["platform"],
+            "unit": ent.get("unit"),
+            "direction": ent["direction"],
+            "baseline": ent["latest"],
+            "tolerance_pct": old.get("tolerance_pct", tolerance_pct),
+            "baselined_rev": next(
+                (p["git_rev"] for p in reversed(ent["points"])
+                 if p["status"] == "measured"), "unknown"
+            ),
+        }
+    return {
+        "schema": SCHEMA,
+        "default_tolerance_pct": tolerance_pct,
+        "bands": {k: prior_bands[k] for k in sorted(prior_bands)},
+    }
+
+
+def check_bands(records: list[dict], bands: dict) -> dict:
+    """The regression sentinel. Grades each series' LATEST measured
+    value against its exact ``config@platform`` band; breach =
+    direction-aware drift beyond ``tolerance_pct``.
+
+    Honest-skip rules (the whole point of platform keying):
+
+    * a series whose platform has no band, but whose config IS banded
+      on a DIFFERENT platform, is reported under
+      ``skipped_cross_platform`` — a CPU-relative capture is never
+      graded against a device baseline, in either direction;
+    * ``unknown``-platform series are never graded;
+    * ``unmeasured`` records (the r05 preflight shape) are surfaced
+      under ``unmeasured`` and never breach anything;
+    * a banded series with no ledger points at all lands in
+      ``missing_series`` (the device is away) — visible, not fatal.
+    """
+    band_map = bands.get("bands", {})
+    by_config: dict[str, list[str]] = {}
+    for key, b in band_map.items():
+        by_config.setdefault(b.get("config", key.split("@")[0]),
+                             []).append(key)
+    traj = build_trajectory(records)
+    checked, breaches, skipped, unmeasured = [], [], [], []
+    for key, ent in traj["series"].items():
+        for p in reversed(ent["points"]):
+            if p["status"] == "unmeasured":
+                unmeasured.append({
+                    "series": key,
+                    "note": "explicit unmeasured record (device "
+                            "preflight failure) — surfaced, never "
+                            "graded",
+                })
+            break  # only the latest point's status matters here
+        band = band_map.get(key)
+        if band is None:
+            others = [
+                k for k in by_config.get(ent["config"], []) if k != key
+            ]
+            if others and ent.get("latest") is not None:
+                skipped.append({
+                    "series": key,
+                    "platform": ent.get("platform", "unknown"),
+                    "banded_as": sorted(others),
+                    "reason": (
+                        f"cross-platform: capture is "
+                        f"{ent.get('platform')!r}, band(s) exist for "
+                        f"{sorted(others)} — honest-skip, never graded"
+                    ),
+                })
+            continue
+        latest = ent.get("latest")
+        if latest is None:
+            continue  # only unmeasured/failed points — surfaced above
+        baseline = band.get("baseline")
+        tol = band.get(
+            "tolerance_pct",
+            bands.get("default_tolerance_pct", 25.0),
+        )
+        direction = band.get("direction", ent["direction"])
+        if not isinstance(baseline, (int, float)) or baseline == 0:
+            continue
+        if direction == "higher_is_better":
+            limit = baseline * (1.0 - tol / 100.0)
+            breached = latest < limit
+        else:
+            limit = baseline * (1.0 + tol / 100.0)
+            breached = latest > limit
+        entry = {
+            "series": key,
+            "value": latest,
+            "baseline": baseline,
+            "limit": round(limit, 6),
+            "tolerance_pct": tol,
+            "direction": direction,
+            "drift_pct": round(
+                100.0 * (latest - baseline) / baseline, 2
+            ),
+        }
+        checked.append(entry)
+        if breached:
+            breaches.append(entry)
+    missing = sorted(
+        k for k in band_map if k not in traj["series"]
+    )
+    return {
+        "schema": SCHEMA,
+        "ok": not breaches,
+        "checked": checked,
+        "breaches": breaches,
+        "skipped_cross_platform": skipped,
+        "unmeasured": unmeasured,
+        "missing_series": missing,
+    }
+
+
+# ----------------------------------------- metrics + live status snapshot
+
+_PERF_STATUS: dict | None = None
+
+
+def set_perf_status(status: dict | None) -> None:
+    """Publish the last ledger operation's summary for ``GET /v1/perf``
+    (the ``sweep_status`` posture: module-global, process-local)."""
+    global _PERF_STATUS
+    _PERF_STATUS = status
+
+
+def perf_status() -> dict | None:
+    return _PERF_STATUS
+
+
+def update_perf_gauges(traj: dict, check: dict | None = None) -> None:
+    """Publish the corro_perf_* families through the PR 15
+    GaugeRegistry so every /metrics scrape carries the ledger's shape —
+    emission and the exposition-validator coverage share the
+    utils.metrics constants, so they cannot drift."""
+    from corro_sim.utils.metrics import (
+        PERF_CHECK_BREACHES,
+        PERF_CHECK_BREACHES_HELP,
+        PERF_CHECK_SKIPPED,
+        PERF_CHECK_SKIPPED_HELP,
+        PERF_LATEST_VALUE,
+        PERF_LATEST_VALUE_HELP,
+        PERF_LEDGER_RECORDS,
+        PERF_LEDGER_RECORDS_HELP,
+        PERF_LEDGER_SERIES,
+        PERF_LEDGER_SERIES_HELP,
+        PERF_UNMEASURED_RECORDS,
+        PERF_UNMEASURED_RECORDS_HELP,
+        gauges,
+    )
+
+    series = traj.get("series", {})
+    gauges.set(PERF_LEDGER_RECORDS, traj.get("records", 0),
+               help_=PERF_LEDGER_RECORDS_HELP)
+    gauges.set(PERF_LEDGER_SERIES, len(series),
+               help_=PERF_LEDGER_SERIES_HELP)
+    gauges.set(
+        PERF_UNMEASURED_RECORDS,
+        sum(e.get("unmeasured_points", 0) for e in series.values()),
+        help_=PERF_UNMEASURED_RECORDS_HELP,
+    )
+    for key, ent in series.items():
+        if ent.get("latest") is not None:
+            gauges.set(
+                PERF_LATEST_VALUE, ent["latest"],
+                labels='{series="%s"}' % key,
+                help_=PERF_LATEST_VALUE_HELP,
+            )
+    if check is not None:
+        gauges.set(PERF_CHECK_BREACHES, len(check.get("breaches", [])),
+                   help_=PERF_CHECK_BREACHES_HELP)
+        gauges.set(
+            PERF_CHECK_SKIPPED,
+            len(check.get("skipped_cross_platform", [])),
+            help_=PERF_CHECK_SKIPPED_HELP,
+        )
+
+
+def auto_append(records: list[dict], path: str | None = None) -> str | None:
+    """Best-effort append for live bench/sweep/twin captures — the
+    ledger write must NEVER kill (or fail) the run it documents.
+    Returns the path written, or None (disabled / write failed)."""
+    path = default_ledger_path() if path is None else path
+    if not path:
+        return None
+    try:
+        append_records(path, records)
+        traj = build_trajectory(records)
+        update_perf_gauges(traj)
+        set_perf_status({
+            "ledger": path,
+            "appended": len(records),
+            "series": sorted(traj.get("series", {})),
+        })
+        return path
+    except Exception:
+        return None

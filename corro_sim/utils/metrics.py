@@ -315,6 +315,48 @@ ROUNDS_BUCKETS = (
     64.0, 96.0, 128.0,
 )
 
+# Perf ledger & regression sentinel (corro_sim/obs/ledger.py;
+# doc/performance.md §9):
+#   corro_perf_ledger_records          records in the loaded ledger
+#   corro_perf_ledger_series           distinct (config, platform) series
+#   corro_perf_latest_value{series}    latest measured value per series
+#   corro_perf_check_breaches          band breaches at the last --check
+#   corro_perf_check_skipped_cross_platform
+#                                      series honest-skipped (capture
+#                                      platform != band platform)
+#   corro_perf_unmeasured_records      explicit unmeasured records (the
+#                                      r05 preflight-failure shape)
+PERF_LEDGER_RECORDS = "corro_perf_ledger_records"
+PERF_LEDGER_RECORDS_HELP = (
+    "records in the loaded performance ledger "
+    "(corro_sim/obs/ledger.py; doc/performance.md section 9)"
+)
+PERF_LEDGER_SERIES = "corro_perf_ledger_series"
+PERF_LEDGER_SERIES_HELP = (
+    "distinct (config, platform) series in the performance ledger"
+)
+PERF_LATEST_VALUE = "corro_perf_latest_value"
+PERF_LATEST_VALUE_HELP = (
+    "latest measured value per ledger series (label: series = "
+    "config@platform)"
+)
+PERF_CHECK_BREACHES = "corro_perf_check_breaches"
+PERF_CHECK_BREACHES_HELP = (
+    "series breaching their perf_bands.json tolerance band at the last "
+    "`perf --check` (the exit-6 regression sentinel)"
+)
+PERF_CHECK_SKIPPED = "corro_perf_check_skipped_cross_platform"
+PERF_CHECK_SKIPPED_HELP = (
+    "series honest-skipped at the last check: the capture's platform "
+    "differs from every banded platform for its config — CPU-relative "
+    "numbers are never graded against device baselines"
+)
+PERF_UNMEASURED_RECORDS = "corro_perf_unmeasured_records"
+PERF_UNMEASURED_RECORDS_HELP = (
+    "explicit unmeasured ledger records (device preflight failures, "
+    "the BENCH_r05 shape) — holes the trajectory shows, never grades"
+)
+
 
 class Histogram:
     """A Prometheus histogram with the reference exporter's buckets

@@ -358,3 +358,29 @@ def test_workload_report_route(server, client):
         body = json.loads(resp.read())
     assert body == {"live": {"observed": 3}}
     server.cluster.workload_report = None
+
+
+def test_perf_route(server, client):
+    """GET /v1/perf (ISSUE 16): the in-process ledger status when one
+    exists, else the committed seed-history trajectory."""
+    import json
+    import urllib.request
+
+    from corro_sim.obs.ledger import perf_status, set_perf_status
+
+    url = f"http://{server.addr[0]}:{server.addr[1]}/v1/perf"
+    prior = perf_status()
+    try:
+        set_perf_status(None)  # force the committed-golden fallback
+        with urllib.request.urlopen(url) as resp:
+            body = json.loads(resp.read())
+        assert body["ledger"].endswith("perf_ledger.ndjson")
+        assert "north_star_wall@axon" in body["trajectory"]["series"]
+
+        set_perf_status({"ledger": "bench_out/x.ndjson", "appended": 2,
+                         "series": ["sweep_throughput@cpu"]})
+        with urllib.request.urlopen(url) as resp:
+            body = json.loads(resp.read())
+        assert body["appended"] == 2
+    finally:
+        set_perf_status(prior)

@@ -1,0 +1,579 @@
+"""Perf ledger & regression sentinel (corro_sim/obs/ledger.py, §9).
+
+Covers the contract end to end: ingest normalization across every
+artifact shape the repo has actually produced (the committed
+BENCH_r01–r05 / MULTICHIP_r01–r05 wrappers — including the r05
+device-preflight ``unmeasured`` shape — live bench one-line JSON,
+sweep/twin reports), platform-separated trajectories and baselines,
+the injected-regression breach exiting 6 through the real CLI, the
+cross-platform honest-skip, and trajectory determinism. The committed
+golden ledger + bands are themselves an acceptance fixture: the seed
+history must pass its own committed gate.
+"""
+
+import copy
+import json
+import os
+
+import pytest
+
+from corro_sim.obs import ledger
+
+# ---------------------------------------------------------------- fixtures
+# Inline copies of the committed round-artifact shapes (BENCH_rNN.json /
+# MULTICHIP_rNN.json) — verbatim structure, values abbreviated. The tail
+# is the only platform evidence the seed wrappers carry.
+
+R01 = {
+    "n": 1,
+    "cmd": "python -m corro_sim bench --config 6",
+    "rc": 0,
+    "tail": "... Platform 'axon' is experimental ...\n{...}",
+    "parsed": {
+        "metric": "crdt_changes_applied_per_sec_10000_node_sim",
+        "value": 674082.99,
+        "unit": "changes/s",
+        "vs_baseline": 4319.94,
+    },
+}
+
+R02 = {
+    "n": 2,
+    "cmd": "python -m corro_sim bench --config 7",
+    "rc": 0,
+    "tail": "... libtpu ... \n{...}",
+    "parsed": {
+        "metric": "northstar_10000_node_sim_convergence_wall_s",
+        "value": 118.157,
+        "unit": "s",
+        "vs_baseline": 0.4,
+        "sim_rounds_to_convergence": 192,
+        "sim_wall_per_round_ms": 615.4,
+        "sim_converged": True,
+        "devcluster_64_agents_wall_s": 1.076,
+    },
+}
+
+R04 = {
+    "n": 4,
+    "cmd": "python -m corro_sim bench --config 7",
+    "rc": 0,
+    "tail": "... Platform 'axon' is experimental ...\n{...}",
+    "parsed": {
+        "metric": "northstar_10000_node_sim_convergence_wall_s",
+        "value": 48.785,
+        "unit": "s",
+        "vs_baseline": 0.97,
+        "sim_rounds_to_convergence": 33,
+        "sim_wall_per_round_ms": 1478.321,
+        "sim_converged": True,
+        "devcluster_64_agents_wall_s": 0.964,
+        "baseline_frozen_wall_s": 1.134,
+        "baseline_drift_pct": -15.0,
+        "baseline_drift_exceeded": False,
+    },
+}
+
+R05_UNMEASURED = {
+    "n": 5,
+    "cmd": "python -m corro_sim bench --config 7",
+    "rc": 1,
+    "tail": "device preflight: waiting ... gave up",
+    "parsed": {
+        "metric": "bench_run_north_star_unmeasured",
+        "value": None,
+        "vs_baseline": None,
+        "error": "device preflight failed: device unresponsive after 240s",
+        "note": "round recorded as an explicit hole",
+    },
+}
+
+MC_FAILED = {
+    "n": 1, "n_devices": 8, "rc": 1, "ok": False, "skipped": False,
+    "tail": "... libtpu ... INTERNAL: ...",
+}
+MC_OK = {
+    "n": 2, "n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+    "tail": "",
+}
+
+SWEEP_REPORT = {
+    "ok": True,
+    "lanes": 4,
+    "nodes": 64,
+    "devices": 1,
+    "dispatches": 3,
+    "wall_seconds": 2.5,
+    "compile_seconds": 1.1,
+    "clusters_per_second_per_device": 1.6,
+    "lanes_detail": [{"lane": 0}],
+    "occupancy": {
+        "occupancy_ratio": 0.9, "wasted_frozen_lane_rounds": 4,
+    },
+}
+
+TWIN_REPORT = {
+    "shadow_delivery": {
+        "method": "sim_clock", "p50_rounds": 2, "p99_rounds": 5,
+        "p50_ms": 400.0, "p99_ms": 1000.0, "units": "sim-ms",
+    },
+    "sim_ms": 12800.0,
+    "chunks": 4, "rounds": 64, "converged_round": 60,
+    "bad_lines": 0, "lines": 128, "poisoned": False,
+    "forecast": {
+        "lanes": 3, "ok": True,
+        "wall_seconds": 1.9, "compile_seconds": 0.7,
+    },
+}
+
+CPU_ENV = {"platform": "cpu", "device_count": 1, "device_kind": "cpu"}
+
+
+def _seed_records():
+    recs = []
+    for obj in (R01, R02, R04, R05_UNMEASURED):
+        recs.extend(ledger.normalize_bench_round(obj, source="test"))
+    for obj in (MC_FAILED, MC_OK):
+        recs.extend(ledger.normalize_multichip_round(obj, source="test"))
+    return recs
+
+
+# ------------------------------------------------------------ normalizers
+
+def test_normalize_round_throughput_platform_from_tail():
+    (rec,) = ledger.normalize_bench_round(R01, source="BENCH_r01.json")
+    assert rec["config"] == "north_star_throughput"
+    assert rec["platform"] == "axon"  # tail marker, pre-env-block era
+    assert rec["value"] == 674082.99
+    assert rec["status"] == "measured"
+    assert rec["seq"] == 1 and rec["git_rev"] == "unknown"
+    assert rec["vs_baseline"] == 4319.94
+    assert ledger.series_key(rec) == "north_star_throughput@axon"
+
+
+def test_normalize_round_wall_emits_devcluster_secondary():
+    recs = ledger.normalize_bench_round(R02)
+    assert [r["config"] for r in recs] == [
+        "north_star_wall", "devcluster_wall",
+    ]
+    ns, dc = recs
+    # wall decomposition from fields the artifact already carries
+    assert ns["wall"]["total_s"] == 118.157
+    assert ns["wall"]["sim_s"] == pytest.approx(615.4 * 192 / 1000.0)
+    assert ns["extra"]["sim_rounds_to_convergence"] == 192
+    assert dc["value"] == 1.076 and dc["platform"] == "axon"
+    assert dc["seq"] == ns["seq"] == 2
+
+
+def test_normalize_round_r05_is_explicit_unmeasured():
+    (rec,) = ledger.normalize_bench_round(R05_UNMEASURED)
+    assert rec["status"] == "unmeasured"
+    assert rec["value"] is None
+    # no tail marker, no env block: never attributed to a platform
+    assert rec["platform"] == "unknown"
+    assert rec["config"] == "north_star_wall"  # the hole lands in-series
+    assert "preflight" in rec["extra"]["error"]
+
+
+def test_normalize_multichip_failed_and_ok():
+    (failed,) = ledger.normalize_multichip_round(MC_FAILED)
+    assert failed["config"] == "multichip_leg"
+    assert failed["status"] == "failed" and failed["value"] == 0.0
+    assert failed["platform"] == "axon"  # libtpu traceback in the tail
+    assert failed["device_count"] == 8
+    (ok,) = ledger.normalize_multichip_round(MC_OK)
+    assert ok["status"] == "measured" and ok["value"] == 1.0
+    assert ok["platform"] == "unknown"  # empty tail
+    (skipped,) = ledger.normalize_multichip_round(
+        {"n": 3, "n_devices": 8, "rc": 0, "ok": False, "skipped": True,
+         "tail": ""}
+    )
+    assert skipped["status"] == "unmeasured" and skipped["value"] is None
+
+
+def test_normalize_bench_output_north_star_decomposition():
+    out = {
+        "metric": "northstar_64_node_sim_convergence_wall_s",
+        "value": 3.2, "unit": "s", "vs_baseline": 1.0,
+        "env": CPU_ENV,
+        "runs": [{
+            "wall_s": 3.2, "compile_seconds": 1.4,
+            "pipeline": {"fetch_wait_s": 0.3},
+        }],
+        "sim_rounds_to_convergence": 40,
+    }
+    (rec,) = ledger.normalize_bench_output(out, config=7)
+    assert rec["platform"] == "cpu"
+    assert rec["wall"]["total_s"] == 3.2
+    assert rec["wall"]["compile_s"] == 1.4
+    assert rec["wall"]["fetch_wait_s"] == 0.3
+    assert rec["source"] == "bench:config7"
+
+
+def test_normalize_bench_output_preflight_dead_has_no_platform():
+    # the dead-tunnel path never imports jax, so there is no env block
+    out = {
+        "metric": "bench_run_north_star_unmeasured", "value": None,
+        "error": "device preflight failed", "vs_baseline": None,
+    }
+    (rec,) = ledger.normalize_bench_output(out, config=7)
+    assert rec["status"] == "unmeasured"
+    assert rec["platform"] == "unknown"
+
+
+def test_normalize_sweep_and_twin_reports():
+    (rec,) = ledger.normalize_sweep_report(SWEEP_REPORT, env=CPU_ENV)
+    assert rec["config"] == "sweep_throughput"
+    assert rec["value"] == 1.6
+    assert rec["wall"]["compile_s"] == 1.1
+    assert rec["extra"]["occupancy_ratio"] == 0.9
+    assert rec["unit"] == "clusters/s/device"
+
+    recs = ledger.normalize_twin_report(TWIN_REPORT, env=CPU_ENV)
+    assert [r["config"] for r in recs] == [
+        "twin_shadow_delivery", "twin_forecast_wall",
+    ]
+    shadow, fc = recs
+    assert shadow["value"] == 1000.0 and shadow["unit"] == "ms"
+    assert shadow["wall"]["sim_s"] == 12.8
+    assert fc["value"] == 1.9 and fc["wall"]["compile_s"] == 0.7
+
+
+def test_normalize_artifact_dispatch_and_rejection():
+    assert ledger.normalize_artifact(R01)[0]["config"] == \
+        "north_star_throughput"
+    assert ledger.normalize_artifact(MC_OK)[0]["config"] == "multichip_leg"
+    assert ledger.normalize_artifact(TWIN_REPORT)[0]["config"] == \
+        "twin_shadow_delivery"
+    assert ledger.normalize_artifact(SWEEP_REPORT)[0]["config"] == \
+        "sweep_throughput"
+    assert ledger.normalize_artifact(
+        {"metric": "devcluster_3_agents_10_inserts_wall_s",
+         "value": 0.5, "unit": "s", "env": CPU_ENV}
+    )[0]["config"] == "devcluster_wall"
+    with pytest.raises(ValueError, match="unrecognized"):
+        ledger.normalize_artifact({"bogus": 1})
+    with pytest.raises(ValueError):
+        ledger.normalize_artifact([1, 2])
+
+
+def test_direction_and_slug_rules():
+    assert ledger._direction("changes/s") == "higher_is_better"
+    assert ledger._direction("ok") == "higher_is_better"
+    assert ledger._direction("s") == "lower_is_better"
+    assert ledger._direction(None) == "lower_is_better"
+    # size numerals are stripped: 64-node smoke and the 10k run share a
+    # series; platform keying keeps them from being graded together
+    assert ledger._config_slug(
+        "northstar_64_node_sim_convergence_wall_s"
+    ) == ledger._config_slug(
+        "northstar_10000_node_sim_convergence_wall_s"
+    ) == "north_star_wall"
+    assert ledger._config_slug(
+        "devcluster_3_agents_10_inserts_wall_s") == "devcluster_wall"
+    assert ledger._config_slug("config5_catchup_rounds") == \
+        "outage_catchup_rounds"
+
+
+# ------------------------------------------------------------- ledger I/O
+
+def test_append_load_roundtrip_skips_torn_lines(tmp_path):
+    path = str(tmp_path / "ledger.ndjson")
+    recs = _seed_records()
+    assert ledger.append_records(path, recs) == len(recs)
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"torn": ')  # killed mid-write
+        f.write("\nnot json at all\n")
+        f.write('{"no_config_key": 1}\n')
+    loaded, bad = ledger.load_ledger(path)
+    assert len(loaded) == len(recs)
+    assert bad == 3
+    # byte-identical round-trip for the real records
+    assert [json.dumps(r, sort_keys=True) for r in recs] == \
+        [json.dumps(r, sort_keys=True) for r in loaded]
+
+
+def test_auto_append_env_disable(tmp_path, monkeypatch):
+    monkeypatch.setenv("CORRO_PERF_LEDGER", "0")
+    assert ledger.auto_append(_seed_records()) is None
+    target = str(tmp_path / "auto.ndjson")
+    monkeypatch.setenv("CORRO_PERF_LEDGER", target)
+    assert ledger.auto_append(_seed_records()[:1]) == target
+    loaded, bad = ledger.load_ledger(target)
+    assert len(loaded) == 1 and bad == 0
+    st = ledger.perf_status()
+    assert st and st["appended"] == 1
+
+
+# -------------------------------------------------------------- trajectory
+
+def test_trajectory_platform_separated_series():
+    traj = ledger.build_trajectory(_seed_records())
+    keys = set(traj["series"])
+    # the r05 hole lands in the wall series under its own platform key —
+    # never merged into the axon trajectory
+    assert {"north_star_wall@axon", "north_star_wall@unknown",
+            "devcluster_wall@axon", "north_star_throughput@axon",
+            "multichip_leg@axon", "multichip_leg@unknown"} <= keys
+    ns = traj["series"]["north_star_wall@axon"]
+    assert ns["measured_points"] == 2
+    assert ns["latest"] == 48.785 and ns["best"] == 48.785
+    assert ns["direction"] == "lower_is_better"
+    assert ns["trend_pct"] == pytest.approx(
+        100.0 * (48.785 - 118.157) / 118.157, abs=0.01)
+    hole = traj["series"]["north_star_wall@unknown"]
+    assert hole["unmeasured_points"] == 1 and hole["latest"] is None
+    assert traj["series"]["multichip_leg@axon"]["failed_points"] == 1
+
+
+def test_trajectory_deterministic():
+    a = ledger.build_trajectory(_seed_records())
+    b = ledger.build_trajectory(list(reversed(_seed_records())))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_sparkline():
+    assert ledger.sparkline([]) == ""
+    assert ledger.sparkline([5, 5, 5]) == "▄▄▄"  # flat renders mid-band
+    s = ledger.sparkline([1, 2, 3, 8])
+    assert len(s) == 4 and s[0] == "▁" and s[-1] == "█"
+    assert ledger.sparkline([1, None, "x", 2]) == "▁█"  # non-numeric skip
+
+
+def test_render_trajectory_mentions_holes():
+    text = ledger.render_trajectory(
+        ledger.build_trajectory(_seed_records()))
+    assert "north_star_wall@axon" in text
+    assert "unmeasured" in text  # the r05 hole is visible, not silent
+    assert "failed" in text  # MULTICHIP r01
+
+
+# ----------------------------------------------------------------- bands
+
+def test_update_bands_known_platform_only_and_tolerance_preserved():
+    recs = _seed_records()
+    bands = ledger.update_bands(recs, tolerance_pct=25.0)
+    # nothing on platform 'unknown' is ever banded
+    assert all("@unknown" not in k for k in bands["bands"])
+    assert "north_star_wall@axon" in bands["bands"]
+    band = bands["bands"]["north_star_wall@axon"]
+    assert band["baseline"] == 48.785
+    assert band["direction"] == "lower_is_better"
+    # hand-set tolerances + bands for absent series survive re-baseline
+    prior = copy.deepcopy(bands)
+    prior["bands"]["north_star_wall@axon"]["tolerance_pct"] = 10.0
+    prior["bands"]["sweep_throughput@axon"] = {
+        "config": "sweep_throughput", "platform": "axon",
+        "unit": "clusters/s/device", "direction": "higher_is_better",
+        "baseline": 3.0, "tolerance_pct": 25.0,
+        "baselined_rev": "unknown",
+    }
+    updated = ledger.update_bands(recs, prior=prior)
+    assert updated["bands"]["north_star_wall@axon"]["tolerance_pct"] == 10.0
+    assert updated["bands"]["sweep_throughput@axon"]["baseline"] == 3.0
+
+
+def test_check_passes_on_own_baseline_and_surfaces_unmeasured():
+    recs = _seed_records()
+    bands = ledger.update_bands(recs)
+    check = ledger.check_bands(recs, bands)
+    assert check["ok"] and not check["breaches"]
+    assert {e["series"] for e in check["checked"]} == set(bands["bands"])
+    assert any(
+        e["series"] == "north_star_wall@unknown"
+        for e in check["unmeasured"]
+    )
+
+
+def test_check_same_platform_regression_breaches():
+    recs = _seed_records()
+    bands = ledger.update_bands(recs)
+    recs.append(ledger.make_record(
+        "north_star_wall", "northstar_10000_node_sim_convergence_wall_s",
+        100.0, "s", platform="axon", seq=6, rev="deadbee",
+    ))
+    check = ledger.check_bands(recs, bands)
+    assert not check["ok"]
+    (breach,) = check["breaches"]
+    assert breach["series"] == "north_star_wall@axon"
+    assert breach["value"] == 100.0
+    assert breach["drift_pct"] > 25.0
+
+
+def test_check_improvement_direction_aware():
+    recs = _seed_records()
+    bands = ledger.update_bands(recs)
+    # a 50% FASTER wall is an improvement, not a breach (lower_is_better)
+    recs.append(ledger.make_record(
+        "north_star_wall", "northstar_10000_node_sim_convergence_wall_s",
+        24.0, "s", platform="axon", seq=6, rev="deadbee",
+    ))
+    # but a 50% throughput DROP breaches (higher_is_better)
+    recs.append(ledger.make_record(
+        "north_star_throughput", "crdt_changes_applied_per_sec_10000_node_sim",
+        337041.0, "changes/s", platform="axon", seq=6, rev="deadbee",
+    ))
+    check = ledger.check_bands(recs, bands)
+    assert [b["series"] for b in check["breaches"]] == [
+        "north_star_throughput@axon"
+    ]
+
+
+def test_check_cross_platform_honest_skip():
+    recs = _seed_records()
+    bands = ledger.update_bands(recs)  # axon-only bands
+    # a CPU capture of a config banded on axon — 5x slower than the
+    # device baseline, and it must STILL not be graded
+    recs.append(ledger.make_record(
+        "devcluster_wall", "devcluster_64_agents_wall_s",
+        5.0, "s", platform="cpu", seq=6, rev="deadbee",
+    ))
+    check = ledger.check_bands(recs, bands)
+    assert check["ok"]
+    (skip,) = check["skipped_cross_platform"]
+    assert skip["series"] == "devcluster_wall@cpu"
+    assert skip["banded_as"] == ["devcluster_wall@axon"]
+    assert "never graded" in skip["reason"]
+
+
+def test_check_missing_series_visible_not_fatal():
+    recs = _seed_records()
+    bands = ledger.update_bands(recs)
+    # the device went away: axon series vanish from the working ledger
+    cpu_only = [r for r in recs if r["platform"] != "axon"]
+    check = ledger.check_bands(cpu_only, bands)
+    assert check["ok"]
+    assert set(check["missing_series"]) == set(bands["bands"])
+
+
+# ------------------------------------------------------------------- CLI
+
+def _write_artifacts(tmp_path):
+    paths = []
+    for name, obj in (
+        ("BENCH_r01.json", R01), ("BENCH_r02.json", R02),
+        ("BENCH_r04.json", R04), ("BENCH_r05.json", R05_UNMEASURED),
+        ("MULTICHIP_r01.json", MC_FAILED), ("MULTICHIP_r02.json", MC_OK),
+    ):
+        p = tmp_path / name
+        p.write_text(json.dumps(obj))
+        paths.append(str(p))
+    return paths
+
+
+def test_cli_ingest_check_breach_exits_6(tmp_path, capsys, monkeypatch):
+    from corro_sim import cli
+
+    monkeypatch.setenv("CORRO_GIT_REV", "testrev")
+    led = str(tmp_path / "ledger.ndjson")
+    bands = str(tmp_path / "bands.json")
+    traj_out = str(tmp_path / "traj.json")
+
+    rc = cli.main(["perf", "--ingest", *_write_artifacts(tmp_path),
+                   "--ledger", led, "--out", traj_out])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["ingested"] == 8  # 6 artifacts, 2 secondary records
+    assert "north_star_wall@axon" in summary["series"]
+    traj = json.load(open(traj_out))
+    assert traj["series"]["north_star_wall@axon"]["latest"] == 48.785
+
+    # baseline, then pass on the ledger's own history
+    assert cli.main(["perf", "--check", "--update",
+                     "--ledger", led, "--bands", bands]) == 0
+    capsys.readouterr()
+    assert cli.main(["perf", "--check",
+                     "--ledger", led, "--bands", bands]) == 0
+    check = json.loads(capsys.readouterr().out)
+    assert check["ok"] and check["unmeasured"]
+
+    # inject a same-platform regression → BREACH_EXIT
+    ledger.append_records(led, [ledger.make_record(
+        "north_star_wall", "northstar_10000_node_sim_convergence_wall_s",
+        100.0, "s", platform="axon", seq=6,
+    )])
+    rc = cli.main(["perf", "--check", "--ledger", led, "--bands", bands])
+    assert rc == ledger.BREACH_EXIT == 6
+    check = json.loads(capsys.readouterr().out)
+    assert check["breaches"][0]["series"] == "north_star_wall@axon"
+
+    # a cross-platform capture on top of the breach-free prefix skips
+    led2 = str(tmp_path / "ledger2.ndjson")
+    ingest = [p for p in _write_artifacts(tmp_path)]
+    assert cli.main(["perf", "--ingest", *ingest, "--ledger", led2]) == 0
+    capsys.readouterr()
+    ledger.append_records(led2, [ledger.make_record(
+        "devcluster_wall", "devcluster_64_agents_wall_s",
+        5.0, "s", platform="cpu", seq=7,
+    )])
+    assert cli.main(["perf", "--check",
+                     "--ledger", led2, "--bands", bands]) == 0
+    check = json.loads(capsys.readouterr().out)
+    assert check["skipped_cross_platform"][0]["series"] == \
+        "devcluster_wall@cpu"
+
+
+def test_cli_show_renders_sparklines(tmp_path, capsys, monkeypatch):
+    from corro_sim import cli
+
+    monkeypatch.setenv("CORRO_GIT_REV", "testrev")
+    led = str(tmp_path / "ledger.ndjson")
+    ledger.append_records(led, _seed_records())
+    assert cli.main(["perf", "--ledger", led]) == 0
+    out = capsys.readouterr().out
+    assert "north_star_wall@axon" in out
+    assert any(ch in out for ch in ledger._SPARK)
+
+
+def test_cli_perf_bad_args(tmp_path, capsys):
+    from corro_sim import cli
+
+    assert cli.main(["perf", "--ingest", "--check",
+                     "--ledger", str(tmp_path / "x")]) == 2
+    # unreadable artifact
+    assert cli.main(["perf", "--ingest", str(tmp_path / "missing.json"),
+                     "--ledger", str(tmp_path / "x")]) == 2
+    # check without bands
+    led = str(tmp_path / "ledger.ndjson")
+    ledger.append_records(led, _seed_records())
+    assert cli.main(["perf", "--check", "--ledger", led,
+                     "--bands", str(tmp_path / "nobands.json")]) == 2
+    capsys.readouterr()
+
+
+# ------------------------------------------------- committed golden gate
+
+def test_committed_seed_history_passes_its_own_gate():
+    """Acceptance: the committed golden ledger must pass the committed
+    bands — and carry the r05 hole + the honest platform split."""
+    led = ledger.golden_ledger_path()
+    bandp = ledger.golden_bands_path()
+    assert os.path.exists(led) and os.path.exists(bandp)
+    records, bad = ledger.load_ledger(led)
+    assert bad == 0 and len(records) == 13
+    check = ledger.check_bands(records, ledger.load_bands(bandp))
+    assert check["ok"], check["breaches"]
+    assert check["unmeasured"]  # r05 surfaced
+    traj = ledger.build_trajectory(records)
+    assert "north_star_wall@axon" in traj["series"]
+    assert "north_star_wall@unknown" in traj["series"]
+    # committed trajectory artifact matches a fresh build of the ledger
+    golden_traj = json.load(open(os.path.join(
+        os.path.dirname(led), "perf_trajectory.json")))
+    assert json.dumps(golden_traj, sort_keys=True) == \
+        json.dumps(traj, sort_keys=True)
+
+
+def test_perf_gauges_published():
+    from corro_sim.utils.metrics import PERF_LEDGER_RECORDS, gauges
+
+    recs = _seed_records()
+    traj = ledger.build_trajectory(recs)
+    check = ledger.check_bands(recs, ledger.update_bands(recs))
+    ledger.update_perf_gauges(traj, check)
+    assert gauges.get(PERF_LEDGER_RECORDS) == len(recs)
+    assert gauges.get(
+        "corro_perf_latest_value",
+        '{series="north_star_wall@axon"}',
+    ) == 48.785
+    assert gauges.get("corro_perf_check_breaches") == 0

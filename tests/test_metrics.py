@@ -707,3 +707,57 @@ def test_twin_families_render_and_validate(cluster):
     )
     assert 'corro_twin_delivery_rounds_bucket{le="+Inf"}' in text
     _validate_exposition(text)
+
+
+def test_perf_ledger_families_render_and_validate(cluster):
+    """ISSUE 16: the perf-ledger gauge families (corro_perf_*) through
+    the GaugeRegistry — ledger/series/unmeasured counts, the labeled
+    per-series latest-value gauge, and the sentinel's breach/skip
+    counts — render and pass the scraper-contract validator. Emission
+    (obs/ledger.update_perf_gauges) and this coverage share the
+    utils.metrics constants, so they cannot drift."""
+    from corro_sim.obs.ledger import (
+        build_trajectory,
+        check_bands,
+        make_record,
+        update_bands,
+        update_perf_gauges,
+    )
+    from corro_sim.utils.metrics import (
+        PERF_CHECK_BREACHES,
+        PERF_CHECK_SKIPPED,
+        PERF_LATEST_VALUE,
+        PERF_LEDGER_RECORDS,
+        PERF_LEDGER_SERIES,
+        PERF_UNMEASURED_RECORDS,
+    )
+
+    records = [
+        make_record("north_star_wall", "northstar_wall_s", 48.785, "s",
+                    platform="axon", seq=1, rev="test"),
+        make_record("north_star_wall", "bench_run_north_star_unmeasured",
+                    None, None, platform="unknown", status="unmeasured",
+                    seq=2, rev="test"),
+        make_record("north_star_wall", "northstar_64_node_sim_wall_s",
+                    5.0, "s", platform="cpu", seq=3, rev="test"),
+    ]
+    bands = update_bands(records[:1])  # axon-only baseline
+    traj = build_trajectory(records)
+    update_perf_gauges(traj, check_bands(records, bands))
+
+    text = render_prometheus(cluster)
+    vals = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, _, val = line.rpartition(" ")
+            vals[key] = float(val)
+    assert vals[PERF_LEDGER_RECORDS] == 3
+    assert vals[PERF_LEDGER_SERIES] == 3
+    assert vals[PERF_UNMEASURED_RECORDS] == 1
+    assert vals[
+        PERF_LATEST_VALUE + '{series="north_star_wall@axon"}'
+    ] == 48.785
+    assert vals[PERF_CHECK_BREACHES] == 0
+    # the cpu north-star capture honest-skipped against the axon band
+    assert vals[PERF_CHECK_SKIPPED] == 1
+    _validate_exposition(text)
