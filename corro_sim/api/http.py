@@ -244,6 +244,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._traced(name, self._get_sweep)
         elif path == "/v1/perf":
             self._traced(name, self._get_perf)
+        elif path == "/v1/doctor":
+            self._traced(name, self._get_doctor)
         elif path == "/v1/probes":
             self._traced(name, lambda: self._get_probes(params))
         elif path == "/v1/faults":
@@ -492,6 +494,28 @@ class _Handler(BaseHTTPRequestHandler):
                 "ledger": golden,
                 "trajectory": perf_ledger.build_trajectory(records),
             }
+        self._send_json(st)
+
+    def _get_doctor(self):
+        """GET /v1/doctor — the cross-artifact diagnosis snapshot
+        (corro_sim/obs/doctor.py, doc/observability.md §8): the last
+        `corro-sim doctor` report produced in THIS process, falling
+        back to a fresh diagnosis over the committed golden ledger.
+        404 only when neither exists."""
+        from corro_sim.obs import doctor as doctor_mod
+        from corro_sim.obs import ledger as perf_ledger
+
+        st = doctor_mod.doctor_status()
+        if st is None:
+            golden = perf_ledger.golden_ledger_path()
+            if not os.path.exists(golden):
+                raise _ApiError(
+                    404, "no diagnosis has run in this process and no "
+                         "committed golden ledger exists to diagnose "
+                         "(corro-sim doctor <artifacts>)"
+                )
+            st = doctor_mod.diagnose([golden])
+            doctor_mod.update_doctor_gauges(st)
         self._send_json(st)
 
     def _get_probes(self, params):

@@ -904,6 +904,12 @@ def _soak_swept(base, specs, sweep, workload_spec, scorecard_path) -> int:
         with open(f"{out}.report.json", "w") as f:
             json.dump(report, f, indent=2)
         report["report"] = f"{out}.report.json"
+    # swept-soak fleet numbers ride the perf ledger like plain sweeps
+    # (normalize_sweep_report flattens the nested "sweep" block;
+    # best-effort — a ledger write must never fail the soak)
+    from corro_sim.obs.ledger import auto_append, normalize_sweep_report
+
+    auto_append(normalize_sweep_report(report, source="soak"))
     print(json.dumps(report, indent=2))
     if any_violation:
         return 5
@@ -1650,6 +1656,58 @@ def _cmd_perf(args: argparse.Namespace) -> int:
           + (f" ({bad} bad lines skipped)" if bad else ""),
           file=sys.stderr)
     print(perf_ledger.render_trajectory(traj))
+    return 0
+
+
+def _cmd_doctor(args: argparse.Namespace) -> int:
+    """`corro-sim doctor` — cross-artifact run diagnosis
+    (corro_sim/obs/doctor.py, doc/observability.md §8).
+
+    Classifies every given artifact by shape (flight journals, lane
+    flights, sweep/soak/twin reports, frontiers, perf ledgers, bands,
+    check results, profiler traces — a directory expands to all of
+    them), joins the evidence, and prints a ranked finding report:
+    each finding cites the artifact + field it read, suggests an
+    action, and carries a one-command repro where one exists.
+
+    ``--out`` writes the deterministic JSON report; ``--check`` exits
+    6 (the soak/frontier/perf tripwire code) when a critical finding
+    fires. Exit codes: 0 ok, 2 bad args/missing artifact, 6 critical
+    finding under --check.
+    """
+    from corro_sim.obs import doctor as doctor_mod
+
+    paths = list(args.artifacts)
+    if not paths:
+        from corro_sim.obs import ledger as perf_ledger
+
+        golden = perf_ledger.golden_ledger_path()
+        if os.path.exists(golden):
+            paths.append(golden)
+        if os.path.isdir("bench_out"):
+            paths.append("bench_out")
+    if not paths:
+        print(
+            "error: nothing to diagnose (no artifact paths given, no "
+            "committed golden ledger, no bench_out/)",
+            file=sys.stderr,
+        )
+        return 2
+    missing = [p for p in args.artifacts if not os.path.exists(p)]
+    if missing:
+        print(f"error: no such artifact: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    report = doctor_mod.diagnose(paths)
+    doctor_mod.update_doctor_gauges(report)
+    doctor_mod.set_doctor_status(report)
+    if args.out:
+        from corro_sim.utils.runtime import atomic_json_dump
+
+        atomic_json_dump(args.out, report, indent=2)
+    print(doctor_mod.render_report(report))
+    if args.check and not report["ok"]:
+        return doctor_mod.CRITICAL_EXIT
     return 0
 
 
@@ -2502,6 +2560,31 @@ def build_parser() -> argparse.ArgumentParser:
         help="also write the JSON trajectory artifact here",
     )
     pp.set_defaults(fn=_cmd_perf)
+
+    pdoc = sub.add_parser(
+        "doctor",
+        help="cross-artifact run diagnosis: classify flight/sweep/twin/"
+             "ledger/profile artifacts by shape, join the evidence, and "
+             "rank findings with citations, actions and repro commands "
+             "(doc/observability.md section 8)",
+    )
+    pdoc.add_argument(
+        "artifacts", nargs="*", metavar="ARTIFACT",
+        help="artifact files or directories to diagnose (flight "
+             "journals, sweep/soak/twin reports, frontiers, perf "
+             "ledgers/bands/check results, --profile-dir traces; "
+             "default: the committed golden ledger plus bench_out/)",
+    )
+    pdoc.add_argument(
+        "--check", action="store_true",
+        help="exit 6 when a critical finding fires (the soak/frontier/"
+             "perf tripwire code)",
+    )
+    pdoc.add_argument(
+        "--out", metavar="PATH",
+        help="also write the deterministic JSON report here",
+    )
+    pdoc.set_defaults(fn=_cmd_doctor)
 
     pa = sub.add_parser("agent", help="run a live cluster (HTTP API + admin)")
     pa.add_argument("--schema", help="schema DDL file")

@@ -24,15 +24,28 @@ from corro_sim.obs.probes import (
     ground_truth_adjacency,
     node_lag_observatory,
 )
+from corro_sim.obs.doctor import (
+    diagnose,
+    doctor_status,
+    render_report,
+)
+from corro_sim.obs.profile import (
+    analyze_profile_dir,
+    parse_trace,
+    profile_breakdowns,
+)
 
 __all__ = [
     "FlightRecorder",
     "ProbeTrace",
+    "analyze_profile_dir",
     "bfs_hops",
     "build_trajectory",
     "check_bands",
     "comparable_timeline",
     "demux_flights",
+    "diagnose",
+    "doctor_status",
     "fleet_occupancy",
     "grid_heatmaps",
     "ground_truth_adjacency",
@@ -40,8 +53,11 @@ __all__ = [
     "load_ledger",
     "node_lag_observatory",
     "normalize_artifact",
+    "parse_trace",
     "perf_status",
+    "profile_breakdowns",
     "render_heatmap",
+    "render_report",
     "sparkline",
     "sweep_status",
     "update_bands",

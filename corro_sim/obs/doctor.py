@@ -1,0 +1,687 @@
+"""``corro-sim doctor``: cross-artifact run diagnosis.
+
+The simulator emits a dozen telemetry artifact types — flight journals,
+per-lane flights, occupancy curves, sweep frontiers, twin shadow
+reports, the perf ledger and its bands, compile-cache probe blocks,
+profiler traces — but answering "why was this run slow / why didn't it
+converge" used to mean a human cross-referencing five JSON files. This
+module reads *across* them: evidence collectors classify every artifact
+by shape (never by filename), and a rules engine turns the joined
+evidence into ranked findings.
+
+Every finding carries:
+
+- ``rule`` / ``severity`` — one of :data:`SEVERITIES`
+  (``critical`` > ``warning`` > ``info``);
+- ``summary`` — one human sentence;
+- ``evidence`` — the citation: ``{artifact, field, value}`` naming the
+  file and the exact field the rule read (a diagnosis that cannot name
+  its evidence is an opinion);
+- ``action`` — the suggested next move;
+- ``repro`` — a one-command reproduction where one exists (lane
+  ``repro_cmd`` strings, frontier ``worst_repro``, ``perf --check``).
+
+The report is a pure function of the artifacts scanned: same files in,
+byte-identical JSON out (findings sorted by severity, then rule, then
+artifact). Unreadable or unrecognized files are honest-skipped with a
+counted reason, never fatal — the doctor must survive a half-written
+``bench_out/``. Exit semantics live in the CLI: ``--check`` exits
+:data:`CRITICAL_EXIT` (6, the soak/frontier/perf tripwire code) when a
+critical finding fires.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import threading
+
+from .profile import analyze_profile_dir, find_traces
+
+__all__ = [
+    "DOCTOR_SCHEMA",
+    "SEVERITIES",
+    "CRITICAL_EXIT",
+    "classify_artifact",
+    "collect_evidence",
+    "diagnose",
+    "render_report",
+    "set_doctor_status",
+    "doctor_status",
+    "update_doctor_gauges",
+]
+
+DOCTOR_SCHEMA = "corro-sim/doctor/v1"
+SEVERITIES = ("critical", "warning", "info")
+
+#: ``--check`` exit code on a critical finding — the same tripwire code
+#: soak thresholds, frontier gates and perf bands already use.
+CRITICAL_EXIT = 6
+
+# Rule thresholds, module-level so tests and doc cite one source.
+FETCH_WAIT_SHARE = 0.25     # fetch-wait above this share of wall
+COLD_COMPILE_MIN_S = 1.0    # ignore sub-second compiles
+OCCUPANCY_FLOOR = 0.5       # frozen-lane collapse threshold
+QUARANTINE_SHARE = 0.10     # bad feed lines above this share
+STRAGGLER_FACTOR = 2.0      # lane converged_round vs cell median
+STRAGGLER_MIN_LANES = 3     # need peers to call a lane a straggler
+
+_REPRO_RE = re.compile(r"repro: (.+?)\)?$")
+
+
+# ------------------------------------------------------ classification
+
+def classify_artifact(obj) -> str | None:
+    """Shape-sniff one loaded JSON artifact. Order matters: the most
+    specific keys first (a sweep report also has ``occupancy``, a twin
+    report also has ``rounds``)."""
+    if not isinstance(obj, dict):
+        return None
+    if "lanes_detail" in obj:
+        return "sweep"
+    if "scenarios" in obj and isinstance(obj.get("scenarios"), list):
+        return "soak"
+    if "shadow_delivery" in obj:
+        return "twin"
+    if "cells" in obj and isinstance(obj.get("cells"), list):
+        return "frontier"
+    if "checked" in obj and "breaches" in obj:
+        return "check"
+    if isinstance(obj.get("bands"), dict):
+        return "bands"
+    if "converged_round" in obj and "rounds_run" in obj:
+        return "run"
+    # a one-line ND-JSON file parses as a plain JSON object — classify
+    # the single record the way the line classifier would
+    if "config" in obj and "metric" in obj:
+        return "ledger"
+    if "t" in obj and isinstance(obj.get("t"), str):
+        return "flight"
+    return None
+
+
+def _classify_ndjson(lines: list[str]) -> str | None:
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            return None
+        if not isinstance(rec, dict):
+            return None
+        if "t" in rec:
+            return "flight"
+        if "config" in rec and "metric" in rec:
+            return "ledger"
+        return None
+    return None
+
+
+def _expand_paths(paths) -> list[str]:
+    """Resolve directories into their diagnosable files (sorted — the
+    scan order is part of determinism). A directory holding profiler
+    traces contributes itself as one profile artifact."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files = sorted(
+                glob.glob(os.path.join(glob.escape(p), "**", "*.json"),
+                          recursive=True)
+                + glob.glob(os.path.join(glob.escape(p), "**",
+                                         "*.ndjson"),
+                            recursive=True)
+            )
+            out.extend(files)
+            if find_traces(p):
+                out.append(p)
+        else:
+            out.append(p)
+    return out
+
+
+def collect_evidence(paths) -> dict:
+    """Load and classify every artifact into the evidence pool the
+    rules read. Never raises on a bad file: unreadable / unparseable /
+    unrecognized artifacts land in ``skipped`` with a reason."""
+    ev: dict = {
+        "runs": [], "sweeps": [], "soaks": [], "twins": [],
+        "frontiers": [], "checks": [], "flights": [],
+        "ledgers": [], "bands": [], "profiles": [],
+        "scanned": [], "skipped": [],
+    }
+
+    def _skip(artifact, reason):
+        ev["skipped"].append({"artifact": artifact, "reason": reason})
+
+    for path in _expand_paths(paths):
+        if os.path.isdir(path):
+            # only dirs with traces survive _expand_paths
+            analysis = analyze_profile_dir(path)
+            ev["profiles"].append((path, analysis))
+            ev["scanned"].append({"artifact": path, "kind": "profile"})
+            continue
+        if path.endswith((".trace.json.gz", ".trace.json")):
+            analysis = analyze_profile_dir(path)
+            ev["profiles"].append((path, analysis))
+            ev["scanned"].append({"artifact": path, "kind": "profile"})
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                raw = f.read()
+        except OSError:
+            _skip(path, "unreadable")
+            continue
+        kind = None
+        obj = None
+        try:
+            obj = json.loads(raw)
+            kind = classify_artifact(obj)
+        except ValueError:
+            lines = raw.splitlines()
+            kind = _classify_ndjson(lines)
+            obj = lines
+        if kind is None:
+            _skip(path, "unrecognized")
+            continue
+        ev["scanned"].append({"artifact": path, "kind": kind})
+        if kind == "flight":
+            from .flight import FlightRecorder
+            try:
+                rec = FlightRecorder.load(raw.splitlines())
+                ev["flights"].append((path, rec.diagnostics()))
+            except Exception:
+                ev["scanned"].pop()
+                _skip(path, "torn_flight")
+        elif kind == "ledger":
+            from .ledger import load_ledger
+            records, bad = load_ledger(path)
+            ev["ledgers"].append((path, records))
+            if bad:
+                _skip(path, f"torn_ledger_lines:{bad}")
+            # join parsed profiles onto the records pointing at them
+            seen = {art for art, _ in ev["profiles"]}
+            for pd in sorted({
+                r.get("profile_dir") for r in records
+                if r.get("profile_dir")
+            }):
+                if pd not in seen and find_traces(pd):
+                    ev["profiles"].append(
+                        (pd, analyze_profile_dir(pd)))
+                    ev["scanned"].append(
+                        {"artifact": pd, "kind": "profile"})
+        elif kind == "sweep":
+            ev["sweeps"].append((path, obj))
+            fr = obj.get("frontier")
+            if isinstance(fr, dict) and "cells" in fr:
+                ev["frontiers"].append((path, fr))
+        elif kind == "soak":
+            ev["soaks"].append((path, obj))
+        elif kind == "twin":
+            ev["twins"].append((path, obj))
+        elif kind == "frontier":
+            ev["frontiers"].append((path, obj))
+        elif kind == "check":
+            ev["checks"].append((path, obj))
+        elif kind == "bands":
+            ev["bands"].append((path, obj))
+        elif kind == "run":
+            ev["runs"].append((path, obj))
+            pd = obj.get("profile_dir")
+            if pd and find_traces(pd):
+                ev["profiles"].append((pd, analyze_profile_dir(pd)))
+                ev["scanned"].append(
+                    {"artifact": pd, "kind": "profile"})
+    return ev
+
+
+# --------------------------------------------------------------- rules
+
+def _finding(rule, severity, summary, artifact, field, value,
+             action, repro=None) -> dict:
+    return {
+        "rule": rule,
+        "severity": severity,
+        "summary": summary,
+        "evidence": {
+            "artifact": artifact, "field": field, "value": value,
+        },
+        "action": action,
+        "repro": repro,
+    }
+
+
+def _rule_convergence_stall(ev):
+    """A run / flight / sweep lane that never hit gap==0."""
+    act = ("raise --max-rounds or inspect the gossip schedule; replay "
+           "the exact lane with the repro command")
+    for art, rep in ev["runs"]:
+        if rep.get("converged_round") is None:
+            yield _finding(
+                "convergence_stall", "critical",
+                f"run did not converge in "
+                f"{rep.get('rounds_run')} rounds",
+                art, "converged_round", None, act)
+    for art, diag in ev["flights"]:
+        if (diag.get("converged_round") is None
+                and diag.get("rounds_recorded", 0) > 0):
+            yield _finding(
+                "convergence_stall", "critical",
+                f"flight records {diag.get('rounds_recorded')} rounds "
+                f"with final gap {diag.get('final_gap')} — never "
+                "converged",
+                art, "diagnostics.converged_round", None, act)
+    for art, rep in ev["sweeps"]:
+        for lane in rep.get("lanes_detail") or []:
+            if (lane.get("converged_round") is None
+                    and not lane.get("poisoned")):
+                yield _finding(
+                    "convergence_stall", "critical",
+                    f"lane {lane.get('cell')} seed "
+                    f"{lane.get('seed')} unconverged after "
+                    f"{lane.get('rounds_run')} rounds",
+                    art, "lanes_detail[].converged_round", None,
+                    act, repro=lane.get("repro_cmd"))
+
+
+def _rule_poisoned_log_ring(ev):
+    """The bounded log ring wrapped past an unsynced row — data loss."""
+    act = ("grow --window or tighten the sync cadence; the poisoned "
+           "round is pinned in the flight events")
+    for art, rep in ev["runs"]:
+        if rep.get("poisoned"):
+            yield _finding(
+                "poisoned_log_ring", "critical",
+                "run poisoned: ring wrapped past an unsynced row",
+                art, "poisoned", True, act)
+    for art, diag in ev["flights"]:
+        if diag.get("poisoned"):
+            yield _finding(
+                "poisoned_log_ring", "critical",
+                "flight marks the log ring poisoned",
+                art, "diagnostics.poisoned", True, act)
+    for art, rep in ev["sweeps"]:
+        for lane in rep.get("lanes_detail") or []:
+            if lane.get("poisoned"):
+                yield _finding(
+                    "poisoned_log_ring", "critical",
+                    f"lane {lane.get('cell')} seed "
+                    f"{lane.get('seed')} poisoned",
+                    art, "lanes_detail[].poisoned", True, act,
+                    repro=lane.get("repro_cmd"))
+
+
+def _rule_fetch_wait_bound(ev):
+    """The host spends > FETCH_WAIT_SHARE of the wall blocked on
+    device fetches — the pipeline is not hiding the demux."""
+    act = ("raise --chunk so host demux overlaps more device "
+           "dispatch; see doc/performance.md §8 (pipelined driver)")
+    for art, rep in ev["runs"]:
+        pipe = rep.get("pipeline") or {}
+        fetch = pipe.get("fetch_wait_s")
+        wall = None
+        wpr, rounds = rep.get("wall_per_round_ms"), rep.get("rounds_run")
+        if isinstance(wpr, (int, float)) and isinstance(rounds, int):
+            wall = wpr * rounds / 1000.0
+        if (isinstance(fetch, (int, float)) and wall
+                and fetch > FETCH_WAIT_SHARE * wall):
+            yield _finding(
+                "fetch_wait_bound", "warning",
+                f"fetch-wait {fetch:.3f}s is "
+                f"{fetch / wall:.0%} of the {wall:.3f}s sim wall",
+                art, "pipeline.fetch_wait_s", fetch, act)
+    for art, records in ev["ledgers"]:
+        for rec in records:
+            wall = rec.get("wall") or {}
+            fetch, total = wall.get("fetch_wait_s"), wall.get("total_s")
+            if (isinstance(fetch, (int, float))
+                    and isinstance(total, (int, float)) and total > 0
+                    and fetch > FETCH_WAIT_SHARE * total):
+                yield _finding(
+                    "fetch_wait_bound", "warning",
+                    f"{rec.get('config')}@{rec.get('platform')} seq "
+                    f"{rec.get('seq')}: fetch-wait {fetch:.3f}s of "
+                    f"{total:.3f}s wall",
+                    art, "wall.fetch_wait_s", fetch, act)
+    for art, analysis in ev["profiles"]:
+        share = analysis.get("fetch_gap_share")
+        if (isinstance(share, (int, float))
+                and share > FETCH_WAIT_SHARE):
+            yield _finding(
+                "fetch_wait_bound", "warning",
+                f"profiler trace attributes {share:.0%} of the "
+                "captured span to device-fetch gaps",
+                art, "fetch_gap_share", share, act)
+
+
+def _rule_cold_compile_dominated(ev):
+    """Compilation outweighs the simulation it compiled for."""
+    act = ("prime the persistent compile cache before the run: "
+           "python tools/prime_cache.py (then prime_cache --check)")
+    for art, rep in ev["runs"]:
+        compile_s = rep.get("compile_seconds")
+        wpr, rounds = rep.get("wall_per_round_ms"), rep.get("rounds_run")
+        sim_s = (wpr * rounds / 1000.0
+                 if isinstance(wpr, (int, float))
+                 and isinstance(rounds, int) else None)
+        cc = rep.get("compile_cache") or {}
+        if (isinstance(compile_s, (int, float))
+                and compile_s > COLD_COMPILE_MIN_S
+                and sim_s is not None and compile_s > sim_s):
+            yield _finding(
+                "cold_compile_dominated", "warning",
+                f"compile {compile_s:.3f}s exceeds the "
+                f"{sim_s:.3f}s sim wall "
+                f"({cc.get('misses', 0)} cache misses, "
+                f"{cc.get('cold_seconds', 0.0):.3f}s cold)",
+                art, "compile_seconds", compile_s, act)
+    for art, rep in ev["sweeps"] + [
+        (a, r.get("sweep") or {}) for a, r in ev["soaks"]
+    ]:
+        compile_s = rep.get("compile_seconds")
+        wall_s = rep.get("wall_seconds")
+        if (isinstance(compile_s, (int, float))
+                and compile_s > COLD_COMPILE_MIN_S
+                and isinstance(wall_s, (int, float))
+                and compile_s > wall_s):
+            yield _finding(
+                "cold_compile_dominated", "warning",
+                f"fleet compile {compile_s:.3f}s exceeds the "
+                f"{wall_s:.3f}s dispatch wall",
+                art, "compile_seconds", compile_s, act)
+
+
+def _rule_occupancy_collapse(ev):
+    """Most executed lane-rounds were wasted on frozen lanes."""
+    act = ("demux frozen lanes earlier (sweep --demux) or lower the "
+           "freeze threshold; the occupancy curve names the round "
+           "the fleet went idle")
+    for art, rep in ev["sweeps"]:
+        occ = rep.get("occupancy") or {}
+        ratio = occ.get("occupancy_ratio")
+        if (isinstance(ratio, (int, float))
+                and ratio < OCCUPANCY_FLOOR):
+            yield _finding(
+                "occupancy_collapse", "warning",
+                f"fleet occupancy {ratio:.2f} is below the "
+                f"{OCCUPANCY_FLOOR} frozen-lane floor "
+                f"({occ.get('wasted_frozen_lane_rounds')} wasted "
+                "lane-rounds)",
+                art, "occupancy.occupancy_ratio", ratio, act)
+
+
+def _rule_quarantine_storm(ev):
+    """The twin quarantined an implausible share of its feed."""
+    act = ("classify the quarantine reasons "
+           "(corro_twin_bad_lines_total) and validate the feed "
+           "up-front with twin --strict")
+    for art, rep in ev["twins"]:
+        bad, lines = rep.get("bad_lines"), rep.get("lines")
+        if (isinstance(bad, int) and isinstance(lines, int)
+                and lines > 0 and bad / lines > QUARANTINE_SHARE):
+            yield _finding(
+                "quarantine_storm", "critical",
+                f"twin quarantined {bad}/{lines} feed lines "
+                f"({bad / lines:.0%} > {QUARANTINE_SHARE:.0%})",
+                art, "bad_lines", bad, act)
+
+
+def _rule_frontier_breach(ev):
+    """A resilience-frontier cell or soak threshold tripped."""
+    act = ("replay the worst seed with the repro command; re-baseline "
+           "only with the change that moved the frontier")
+    for art, fr in ev["frontiers"]:
+        for breach in fr.get("breaches") or []:
+            m = _REPRO_RE.search(str(breach))
+            yield _finding(
+                "frontier_breach", "critical", str(breach),
+                art, "frontier.breaches", str(breach), act,
+                repro=m.group(1) if m else None)
+    for art, rep in ev["sweeps"] + ev["soaks"]:
+        for breach in rep.get("threshold_breaches") or []:
+            yield _finding(
+                "frontier_breach", "critical", str(breach),
+                art, "threshold_breaches", str(breach), act)
+
+
+def _band_findings(art, result):
+    """Findings off one ``check_bands``-shaped result (live or from a
+    committed PERF_check.json artifact)."""
+    for b in result.get("breaches") or []:
+        yield _finding(
+            "regression_band_breach", "critical",
+            f"{b.get('series')}: {b.get('value')} breaches the "
+            f"{b.get('baseline')} baseline "
+            f"(drift {b.get('drift_pct')}%, tolerance "
+            f"{b.get('tolerance_pct')}%)",
+            art, "breaches[].series", b.get("series"),
+            "bisect the regression, or re-baseline with "
+            "perf --check --update and commit the band diff with "
+            "the change that moved the number",
+            repro="corro-sim perf --check")
+    for s in result.get("skipped_cross_platform") or []:
+        yield _finding(
+            "cross_platform_grading", "info",
+            f"{s.get('series')} captured on {s.get('platform')} but "
+            f"banded as {s.get('banded_as')} — never graded "
+            "cross-platform",
+            art, "skipped_cross_platform[].series", s.get("series"),
+            "capture on the banded platform, or add a platform band "
+            "with perf --check --update")
+
+
+def _rule_band_checks(ev):
+    """Grade every scanned ledger against the bands in evidence (or
+    the committed golden bands), plus any pre-computed check artifact.
+    Emits both regression_band_breach and cross_platform_grading."""
+    from .ledger import check_bands, golden_bands_path, load_bands
+    bands_list = list(ev["bands"])
+    if not bands_list and ev["ledgers"]:
+        gb = golden_bands_path()
+        if os.path.exists(gb):
+            bands_list.append((gb, load_bands(gb)))
+    for art, records in ev["ledgers"]:
+        for _, bands in bands_list:
+            yield from _band_findings(
+                art, check_bands(records, bands))
+    for art, result in ev["checks"]:
+        yield from _band_findings(art, result)
+
+
+def _rule_straggler_lane(ev):
+    """A lane converged far behind its cell peers."""
+    act = ("replay the straggler with its repro command; a straggler "
+           "with a fault cell usually means the recovery path, a "
+           "straggler without one means the schedule")
+    for art, rep in ev["sweeps"]:
+        by_cell: dict = {}
+        for lane in rep.get("lanes_detail") or []:
+            if isinstance(lane.get("converged_round"), int):
+                by_cell.setdefault(lane.get("cell"), []).append(lane)
+        for cell, lanes in sorted(by_cell.items(),
+                                  key=lambda kv: str(kv[0])):
+            if len(lanes) < STRAGGLER_MIN_LANES:
+                continue
+            rounds = sorted(
+                ln["converged_round"] for ln in lanes)
+            median = rounds[len(rounds) // 2]
+            if median <= 0:
+                continue
+            for lane in lanes:
+                if (lane["converged_round"]
+                        > STRAGGLER_FACTOR * median):
+                    yield _finding(
+                        "straggler_lane", "warning",
+                        f"lane {cell} seed {lane.get('seed')} "
+                        f"converged at round "
+                        f"{lane['converged_round']} vs cell median "
+                        f"{median}",
+                        art, "lanes_detail[].converged_round",
+                        lane["converged_round"], act,
+                        repro=lane.get("repro_cmd"))
+
+
+def _rule_unmeasured_staleness(ev):
+    """A perf series whose latest point is a hole, not a number."""
+    act = ("re-run the capture on the device (the r05 preflight "
+           "shape); an unmeasured latest means the series is graded "
+           "on stale history")
+    from .ledger import build_trajectory
+    for art, records in ev["ledgers"]:
+        traj = build_trajectory(records)
+        for key, series in sorted(traj.get("series", {}).items()):
+            points = series.get("points") or []
+            if not points:
+                continue
+            status = points[-1].get("status")
+            if status in ("unmeasured", "failed"):
+                yield _finding(
+                    "unmeasured_device_staleness", "info",
+                    f"latest point of {key} is {status} — the "
+                    "device number is stale",
+                    art, f"series.{key}.latest.status", status, act)
+    for art, result in ev["checks"]:
+        for u in result.get("unmeasured") or []:
+            yield _finding(
+                "unmeasured_device_staleness", "info",
+                f"{u.get('series')}: {u.get('note')}",
+                art, "unmeasured[].series", u.get("series"), act)
+
+
+#: The rule registry, in documentation order. Each entry yields zero
+#: or more findings off the shared evidence pool.
+RULES = (
+    _rule_convergence_stall,
+    _rule_poisoned_log_ring,
+    _rule_fetch_wait_bound,
+    _rule_cold_compile_dominated,
+    _rule_occupancy_collapse,
+    _rule_quarantine_storm,
+    _rule_frontier_breach,
+    _rule_band_checks,
+    _rule_straggler_lane,
+    _rule_unmeasured_staleness,
+)
+
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+def diagnose(paths) -> dict:
+    """Run every rule over the evidence collected from ``paths`` and
+    return the ranked, deterministic doctor report."""
+    ev = collect_evidence(paths)
+    findings: list[dict] = []
+    for rule in RULES:
+        findings.extend(rule(ev))
+    findings.sort(key=lambda f: (
+        _SEV_RANK.get(f["severity"], len(SEVERITIES)),
+        f["rule"],
+        f["evidence"]["artifact"],
+        f["evidence"]["field"],
+        json.dumps(f["evidence"]["value"], sort_keys=True,
+                   default=str),
+    ))
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f["severity"]] = counts.get(f["severity"], 0) + 1
+    profiles = {
+        art: {k: analysis.get(k) for k in (
+            "parsed", "skipped", "host_ms", "device_ms",
+            "device_share", "fetch_gap_ms", "fetch_gap_share",
+        )}
+        for art, analysis in ev["profiles"]
+    }
+    return {
+        "schema": DOCTOR_SCHEMA,
+        "scanned": sorted(ev["scanned"],
+                          key=lambda s: (s["artifact"], s["kind"])),
+        "skipped": sorted(ev["skipped"],
+                          key=lambda s: (s["artifact"], s["reason"])),
+        "counts": counts,
+        "findings": findings,
+        "profiles": profiles,
+        "ok": counts["critical"] == 0,
+    }
+
+
+# ------------------------------------------------------------ surfaces
+
+_SEV_TAG = {"critical": "CRIT", "warning": "WARN", "info": "info"}
+
+
+def render_report(report: dict) -> str:
+    """The ranked ASCII report ``corro-sim doctor`` prints."""
+    counts = report.get("counts", {})
+    lines = [
+        f"corro-sim doctor — {len(report.get('scanned', []))} "
+        f"artifacts scanned, {len(report.get('skipped', []))} "
+        f"skipped; {counts.get('critical', 0)} critical / "
+        f"{counts.get('warning', 0)} warning / "
+        f"{counts.get('info', 0)} info"
+    ]
+    for f in report.get("findings", []):
+        evd = f["evidence"]
+        lines.append(
+            f"  {_SEV_TAG.get(f['severity'], '????'):<4} "
+            f"{f['rule']:<28} {f['summary']}")
+        lines.append(
+            f"       evidence: {evd['artifact']} :: {evd['field']}")
+        lines.append(f"       action:   {f['action']}")
+        if f.get("repro"):
+            lines.append(f"       repro:    {f['repro']}")
+    for s in report.get("skipped", []):
+        lines.append(
+            f"  skip {s['artifact']} ({s['reason']})")
+    if not report.get("findings"):
+        lines.append("  no findings — all scanned artifacts healthy")
+    return "\n".join(lines)
+
+
+_status_lock = threading.Lock()
+_status: dict | None = None
+
+
+def set_doctor_status(report: dict | None) -> None:
+    """Publish the last doctor report for ``GET /v1/doctor`` (None
+    clears it — test isolation)."""
+    global _status
+    with _status_lock:
+        _status = report
+
+
+def doctor_status() -> dict | None:
+    with _status_lock:
+        return _status
+
+
+def update_doctor_gauges(report: dict) -> None:
+    """Publish the report through the PR 15 registries:
+    ``corro_doctor_findings_total{rule,severity}`` plus scan/skip and
+    critical-count companions."""
+    from ..utils import metrics as M
+    per: dict = {}
+    for f in report.get("findings", []):
+        per[(f["rule"], f["severity"])] = per.get(
+            (f["rule"], f["severity"]), 0) + 1
+    for (rule, sev), n in sorted(per.items()):
+        M.gauges.set(
+            M.DOCTOR_FINDINGS_TOTAL, n,
+            labels=f'{{rule="{rule}",severity="{sev}"}}',
+            help_=M.DOCTOR_FINDINGS_TOTAL_HELP,
+        )
+    M.gauges.set(
+        M.DOCTOR_ARTIFACTS_SCANNED, len(report.get("scanned", [])),
+        help_=M.DOCTOR_ARTIFACTS_SCANNED_HELP,
+    )
+    M.gauges.set(
+        M.DOCTOR_ARTIFACTS_SKIPPED, len(report.get("skipped", [])),
+        help_=M.DOCTOR_ARTIFACTS_SKIPPED_HELP,
+    )
+    M.gauges.set(
+        M.DOCTOR_CRITICAL_FINDINGS,
+        report.get("counts", {}).get("critical", 0),
+        help_=M.DOCTOR_CRITICAL_FINDINGS_HELP,
+    )

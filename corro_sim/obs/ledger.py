@@ -413,8 +413,16 @@ def normalize_sweep_report(rep: dict, source: str = "sweep",
                            profile_dir: str | None = None) -> list[dict]:
     """A ``corro-sim sweep`` CLI report: the fleet throughput number
     (clusters/sec/device) with the dispatch wall decomposed
-    (compile vs execute) and the occupancy accounting in ``extra``."""
+    (compile vs execute) and the occupancy accounting in ``extra``.
+
+    Also accepts the swept-soak report shape, where the fleet numbers
+    nest under a ``"sweep"`` block instead of riding the top level —
+    flattened here so chaos-matrix soaks land in the same
+    ``sweep_throughput`` series as plain sweeps."""
     env = env or runtime_env()
+    if (isinstance(rep.get("sweep"), dict)
+            and "clusters_per_second_per_device" not in rep):
+        rep = {**rep, **rep["sweep"]}
     occ = rep.get("occupancy") or {}
     return [make_record(
         "sweep_throughput", "sweep_clusters_per_sec_per_device",
@@ -518,12 +526,18 @@ def normalize_artifact(obj: dict, source: str = "") -> list[dict]:
             env=obj.get("env") or {"platform": "unknown",
                                    "device_kind": "unknown"},
         )
+    if "scenarios" in obj and isinstance(obj.get("sweep"), dict):
+        return normalize_sweep_report(
+            obj, source=source or "soak",
+            env=obj.get("env") or {"platform": "unknown",
+                                   "device_kind": "unknown"},
+        )
     if "metric" in obj:
         return normalize_bench_output(obj, source=source)
     raise ValueError(
         "unrecognized perf artifact shape (expected a BENCH_rNN/"
-        "MULTICHIP_rNN wrapper, a bench one-line JSON, or a sweep/twin "
-        f"report); keys: {sorted(obj)[:8]}"
+        "MULTICHIP_rNN wrapper, a bench one-line JSON, or a sweep/twin/"
+        f"swept-soak report); keys: {sorted(obj)[:8]}"
     )
 
 

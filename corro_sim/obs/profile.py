@@ -1,0 +1,294 @@
+"""Profiler-trace analyzer: read what ``--profile-dir`` writes.
+
+``jax.profiler.start_trace`` drops a Chrome-trace capture under
+``<dir>/plugins/profile/<timestamp>/<host>.trace.json.gz`` — and until
+now nothing in the repo read it back: the perf ledger carried a
+``profile_dir`` pointer per record, but decomposing *where* a wall went
+(which program, which fusion, host vs device, fetch gaps) meant opening
+TensorBoard by hand. This module parses the trace with pure stdlib
+(``gzip`` + ``json`` — no tensorboard, no protobuf) into a structured
+device-time breakdown the doctor can cite as evidence.
+
+Trace anatomy (empirically, from real captures):
+
+- ``traceEvents`` carries ``ph: "M"`` metadata events naming processes
+  (``process_name`` keyed by ``pid``) and threads (``thread_name`` keyed
+  by ``pid``/``tid``), and ``ph: "X"`` complete events with ``ts`` and
+  ``dur`` in microseconds.
+- Device work lives on processes named ``/device:TPU:0`` etc.; a
+  CPU-only capture has a single ``/host:CPU`` process whose ``python``
+  thread carries the host tracing and whose ``tf_xla*`` threads carry
+  XLA runtime/codegen spans.
+- Per-program dispatch walls appear as host ``PjitFunction(<name>)``
+  slices (one per jitted call) and, on real devices, as the program's
+  module name on the device pid.
+
+Honest-skip posture: a missing, truncated, non-gzip, non-JSON or
+event-free trace yields ``{"trace": ..., "skipped": <reason>}`` — a
+counted reason, never an exception. A diagnosis pass over a directory
+of artifacts must not die because one capture was torn.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+
+__all__ = [
+    "find_traces",
+    "parse_trace",
+    "analyze_profile_dir",
+    "profile_breakdowns",
+    "SKIP_REASONS",
+]
+
+PROFILE_SCHEMA = "corro-sim/profile/v1"
+
+#: Every reason :func:`parse_trace` may skip with (the counted-reason
+#: contract: anything unparseable lands in exactly one of these).
+SKIP_REASONS = (
+    "missing",
+    "unreadable",
+    "bad_json",
+    "no_trace_events",
+    "empty_trace",
+)
+
+_PJIT_RE = re.compile(r"^PjitFunction\((.+)\)$")
+
+# Host slices that are the pipeline's fetch gap: the driver blocking on
+# device results / device->host copies. Matched as substrings against
+# host event names (jax's python tracing uses `<file>:<line> <fn>`).
+_FETCH_PATTERNS = (
+    "block_until_ready",
+    "device_get",
+    "TransferFromDevice",
+    "copy_to_host",
+    "_single_device_array_to_np_array",
+)
+
+
+def find_traces(path: str) -> list[str]:
+    """Locate trace files under ``path``.
+
+    Accepts the ``--profile-dir`` root (searches the
+    ``plugins/profile/<ts>/`` layout jax writes), any directory holding
+    ``*.trace.json.gz`` files, or a direct path to one trace file.
+    Returns sorted paths (deterministic scan order)."""
+    if os.path.isfile(path):
+        return [path]
+    if not os.path.isdir(path):
+        return []
+    hits = glob.glob(
+        os.path.join(glob.escape(path), "**", "*.trace.json.gz"),
+        recursive=True,
+    )
+    hits += glob.glob(
+        os.path.join(glob.escape(path), "**", "*.trace.json"),
+        recursive=True,
+    )
+    return sorted(set(hits))
+
+
+def _load_events(path: str):
+    """Decode a trace file into its ``traceEvents`` list, or a skip
+    reason string."""
+    if not os.path.exists(path):
+        return "missing"
+    try:
+        if path.endswith(".gz"):
+            with gzip.open(path, "rb") as f:
+                raw = f.read()
+        else:
+            with open(path, "rb") as f:
+                raw = f.read()
+    except (OSError, EOFError, gzip.BadGzipFile):
+        return "unreadable"
+    try:
+        doc = json.loads(raw)
+    except (ValueError, UnicodeDecodeError):
+        return "bad_json"
+    if not isinstance(doc, dict) or not isinstance(
+        doc.get("traceEvents"), list
+    ):
+        return "no_trace_events"
+    return doc["traceEvents"]
+
+
+def parse_trace(path: str, top_k: int = 10) -> dict:
+    """Parse one Chrome-trace file into a device-time breakdown.
+
+    Returns either ``{"trace", "skipped"}`` (honest skip, reason from
+    :data:`SKIP_REASONS`) or a breakdown dict:
+
+    - ``events`` — counted ``ph:"X"`` slices;
+    - ``span_ms`` — wall covered by the capture (max end - min start);
+    - ``host_ms`` / ``device_ms`` / ``device_share`` — time on host
+      processes vs ``/device:*`` processes (share of accounted time);
+    - ``programs`` — top-k per-program walls: device-pid slices plus
+      host ``PjitFunction(<name>)`` dispatches, ``{name, calls,
+      total_ms}`` sorted by wall;
+    - ``top_ops`` — top-k op/fusion/runtime spans off the python
+      tracing thread (device fusions on real hardware, XLA runtime
+      spans on CPU);
+    - ``fetch_gap_ms`` — host slices matching the fetch-gap patterns
+      (the profiler's view of ``pipeline.fetch_wait_s``);
+    - ``processes`` — accounted ms per process name.
+    """
+    events = _load_events(path)
+    if isinstance(events, str):
+        return {"trace": path, "skipped": events}
+
+    pid_name: dict = {}
+    tid_name: dict = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "M":
+            continue
+        args = ev.get("args") or {}
+        if ev.get("name") == "process_name":
+            pid_name[ev.get("pid")] = str(args.get("name", ""))
+        elif ev.get("name") == "thread_name":
+            tid_name[(ev.get("pid"), ev.get("tid"))] = str(
+                args.get("name", "")
+            )
+
+    n_events = 0
+    t_min = t_max = None
+    host_ms = device_ms = fetch_ms = 0.0
+    per_process: dict = {}
+    programs: dict = {}
+    ops: dict = {}
+    for ev in events:
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        ts = ev.get("ts")
+        if not isinstance(dur, (int, float)) or not isinstance(
+            ts, (int, float)
+        ):
+            continue
+        n_events += 1
+        ms = dur / 1000.0
+        t_min = ts if t_min is None else min(t_min, ts)
+        t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+        pid = ev.get("pid")
+        proc = pid_name.get(pid, f"pid:{pid}")
+        thread = tid_name.get((pid, ev.get("tid")), "")
+        name = str(ev.get("name", ""))
+        is_device = proc.startswith("/device:")
+        per_process[proc] = per_process.get(proc, 0.0) + ms
+        if is_device:
+            device_ms += ms
+            entry = programs.setdefault(name, [0, 0.0])
+            entry[0] += 1
+            entry[1] += ms
+        else:
+            host_ms += ms
+            m = _PJIT_RE.match(name)
+            if m:
+                entry = programs.setdefault(m.group(1), [0, 0.0])
+                entry[0] += 1
+                entry[1] += ms
+            if any(p in name for p in _FETCH_PATTERNS):
+                fetch_ms += ms
+        if is_device or thread != "python":
+            ops[name] = ops.get(name, 0.0) + ms
+
+    if n_events == 0:
+        return {"trace": path, "skipped": "empty_trace"}
+
+    span_ms = (t_max - t_min) / 1000.0
+    accounted = host_ms + device_ms
+
+    def _round(x):
+        return round(x, 3)
+
+    top_programs = sorted(
+        programs.items(), key=lambda kv: (-kv[1][1], kv[0])
+    )[:top_k]
+    top_ops = sorted(ops.items(), key=lambda kv: (-kv[1], kv[0]))[:top_k]
+    return {
+        "schema": PROFILE_SCHEMA,
+        "trace": path,
+        "events": n_events,
+        "span_ms": _round(span_ms),
+        "host_ms": _round(host_ms),
+        "device_ms": _round(device_ms),
+        "device_share": (
+            _round(device_ms / accounted) if accounted > 0 else 0.0
+        ),
+        "fetch_gap_ms": _round(fetch_ms),
+        "fetch_gap_share": (
+            _round(fetch_ms / span_ms) if span_ms > 0 else 0.0
+        ),
+        "programs": [
+            {"name": k, "calls": v[0], "total_ms": _round(v[1])}
+            for k, v in top_programs
+        ],
+        "top_ops": [
+            {"name": k, "total_ms": _round(v)} for k, v in top_ops
+        ],
+        "processes": {
+            k: _round(v) for k, v in sorted(per_process.items())
+        },
+    }
+
+
+def analyze_profile_dir(path: str, top_k: int = 10) -> dict:
+    """Parse every trace under a ``--profile-dir`` into one summary.
+
+    ``parsed`` counts usable traces, ``skipped`` counts reasons (the
+    honest-skip ledger); aggregate host/device/fetch totals sum over
+    the parsed traces so the doctor can cite one number per run."""
+    traces = find_traces(path)
+    out: dict = {
+        "schema": PROFILE_SCHEMA,
+        "profile_dir": path,
+        "traces": [],
+        "parsed": 0,
+        "skipped": {},
+    }
+    if not traces:
+        out["skipped"]["missing"] = 1
+        return out
+    host_ms = device_ms = fetch_ms = span_ms = 0.0
+    for t in traces:
+        br = parse_trace(t, top_k=top_k)
+        out["traces"].append(br)
+        if "skipped" in br:
+            reason = br["skipped"]
+            out["skipped"][reason] = out["skipped"].get(reason, 0) + 1
+            continue
+        out["parsed"] += 1
+        host_ms += br["host_ms"]
+        device_ms += br["device_ms"]
+        fetch_ms += br["fetch_gap_ms"]
+        span_ms += br["span_ms"]
+    accounted = host_ms + device_ms
+    out["host_ms"] = round(host_ms, 3)
+    out["device_ms"] = round(device_ms, 3)
+    out["device_share"] = (
+        round(device_ms / accounted, 3) if accounted > 0 else 0.0
+    )
+    out["fetch_gap_ms"] = round(fetch_ms, 3)
+    out["fetch_gap_share"] = (
+        round(fetch_ms / span_ms, 3) if span_ms > 0 else 0.0
+    )
+    return out
+
+
+def profile_breakdowns(records: list[dict], top_k: int = 10) -> dict:
+    """Join parsed profiles onto ledger records via ``profile_dir``.
+
+    Returns ``{profile_dir: analysis}`` for every distinct non-empty
+    ``profile_dir`` a record points at — the (b)-side of the tentpole:
+    the ledger row says *how slow*, the joined breakdown says *where*."""
+    dirs = sorted({
+        r.get("profile_dir")
+        for r in records
+        if isinstance(r, dict) and r.get("profile_dir")
+    })
+    return {d: analyze_profile_dir(d, top_k=top_k) for d in dirs}

@@ -357,6 +357,38 @@ PERF_UNMEASURED_RECORDS_HELP = (
     "the BENCH_r05 shape) — holes the trajectory shows, never grades"
 )
 
+# Run-diagnosis doctor (corro_sim/obs/doctor.py; doc/observability.md
+# §8):
+#   corro_doctor_findings_total{rule,severity}
+#                                      findings at the last diagnosis,
+#                                      per rule and severity
+#   corro_doctor_artifacts_scanned     artifacts the last diagnosis read
+#   corro_doctor_artifacts_skipped     artifacts honest-skipped with a
+#                                      reason (unreadable/unrecognized)
+#   corro_doctor_critical_findings     critical findings at the last
+#                                      diagnosis (the --check exit-6
+#                                      tripwire)
+DOCTOR_FINDINGS_TOTAL = "corro_doctor_findings_total"
+DOCTOR_FINDINGS_TOTAL_HELP = (
+    "findings at the last doctor diagnosis, labeled by rule and "
+    "severity (corro_sim/obs/doctor.py; doc/observability.md "
+    "section 8)"
+)
+DOCTOR_ARTIFACTS_SCANNED = "corro_doctor_artifacts_scanned"
+DOCTOR_ARTIFACTS_SCANNED_HELP = (
+    "telemetry artifacts the last doctor diagnosis classified and read"
+)
+DOCTOR_ARTIFACTS_SKIPPED = "corro_doctor_artifacts_skipped"
+DOCTOR_ARTIFACTS_SKIPPED_HELP = (
+    "artifacts the last doctor diagnosis honest-skipped with a counted "
+    "reason (unreadable, unrecognized, torn) — visible, never fatal"
+)
+DOCTOR_CRITICAL_FINDINGS = "corro_doctor_critical_findings"
+DOCTOR_CRITICAL_FINDINGS_HELP = (
+    "critical findings at the last doctor diagnosis — nonzero trips "
+    "`doctor --check` exit 6, the shared regression tripwire code"
+)
+
 
 class Histogram:
     """A Prometheus histogram with the reference exporter's buckets

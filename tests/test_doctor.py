@@ -1,0 +1,480 @@
+"""Cross-artifact diagnosis engine (corro_sim/obs/doctor.py) and the
+profiler-trace analyzer (corro_sim/obs/profile.py).
+
+The discipline is trace_vacuous applied to diagnosis: every finding
+rule gets an injected-pathology test (synthesize exactly the artifact
+that should trip it, assert the rule fires with the right evidence
+citation) AND the rule must stay silent on the healthy committed
+goldens — a doctor that cries wolf on a passing repo is worse than no
+doctor. The profile parser is pinned to a committed fixture trimmed
+from a real 3-node CPU capture, with totals derived independently of
+the parser; malformed/empty traces honest-skip with a counted reason.
+"""
+
+import gzip
+import json
+import os
+
+import pytest
+
+from corro_sim.obs import doctor, ledger
+from corro_sim.obs import profile as prof
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+TRACE_FIXTURE = os.path.join(
+    FIXTURES, "profiles", "cpu_3node.trace.json.gz")
+FLIGHT_FIXTURE = os.path.join(
+    FIXTURES, "flights", "healthy_3node.ndjson")
+
+GOLDEN_ARTIFACTS = [
+    ledger.golden_ledger_path(),
+    ledger.golden_bands_path(),
+    FLIGHT_FIXTURE,
+    TRACE_FIXTURE,
+]
+
+
+def _findings(report, rule):
+    return [f for f in report["findings"] if f["rule"] == rule]
+
+
+@pytest.fixture(scope="module")
+def golden_report():
+    """One diagnosis over the committed goldens, shared by every
+    per-rule silence assertion."""
+    return doctor.diagnose(GOLDEN_ARTIFACTS)
+
+
+# ------------------------------------------------------ profile parser
+
+def test_trace_fixture_pinned_totals():
+    """The committed fixture (trimmed from a real capture) parses to
+    hand-derived totals — the parser contract, byte for byte."""
+    br = prof.parse_trace(TRACE_FIXTURE)
+    assert "skipped" not in br
+    assert br["events"] == 214
+    assert br["span_ms"] == 1637.261
+    assert br["host_ms"] == 1197.464
+    assert br["device_ms"] == 0.0
+    assert br["device_share"] == 0.0
+    assert br["processes"] == {"/host:CPU": 1197.464}
+    # top programs by dispatch wall, from the host PjitFunction slices
+    assert br["programs"][0] == {
+        "name": "_threefry_split", "calls": 2, "total_ms": 344.646}
+    assert br["programs"][1] == {
+        "name": "_threefry_fold_in", "calls": 2, "total_ms": 264.552}
+    assert br["programs"][2] == {
+        "name": "searchsorted", "calls": 6, "total_ms": 143.59}
+    # XLA runtime spans ride top_ops (non-python threads)
+    assert br["top_ops"][0] == {
+        "name": "TaskDispatcher::dispatch", "total_ms": 32.064}
+
+
+def test_trace_parser_honest_skips(tmp_path):
+    """Missing / non-gzip / non-JSON / event-free traces yield a
+    counted skip reason, never an exception."""
+    missing = str(tmp_path / "nope.trace.json.gz")
+    assert prof.parse_trace(missing) == {
+        "trace": missing, "skipped": "missing"}
+
+    notgz = tmp_path / "torn.trace.json.gz"
+    notgz.write_bytes(b"this is not gzip")
+    assert prof.parse_trace(str(notgz))["skipped"] == "unreadable"
+
+    badjson = tmp_path / "bad.trace.json.gz"
+    with gzip.open(badjson, "wt") as f:
+        f.write("{not json")
+    assert prof.parse_trace(str(badjson))["skipped"] == "bad_json"
+
+    noevents = tmp_path / "noev.trace.json.gz"
+    with gzip.open(noevents, "wt") as f:
+        json.dump({"displayTimeUnit": "ns"}, f)
+    assert prof.parse_trace(str(noevents))["skipped"] == (
+        "no_trace_events")
+
+    metaonly = tmp_path / "meta.trace.json.gz"
+    with gzip.open(metaonly, "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/host:CPU"}},
+        ]}, f)
+    assert prof.parse_trace(str(metaonly))["skipped"] == "empty_trace"
+
+    analysis = prof.analyze_profile_dir(str(tmp_path))
+    assert analysis["parsed"] == 0
+    assert analysis["skipped"] == {
+        "unreadable": 1, "bad_json": 1, "no_trace_events": 1,
+        "empty_trace": 1,
+    }
+    for reason in analysis["skipped"]:
+        assert reason in prof.SKIP_REASONS
+
+
+def test_find_traces_plugin_layout(tmp_path):
+    """Traces are found under jax's plugins/profile/<ts>/ nesting and
+    joined onto ledger records via profile_dir."""
+    nest = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    nest.mkdir(parents=True)
+    with gzip.open(TRACE_FIXTURE, "rb") as f:
+        (nest / "host.trace.json.gz").write_bytes(
+            gzip.compress(f.read()))
+    assert prof.find_traces(str(tmp_path)) == [
+        str(nest / "host.trace.json.gz")]
+    rec = ledger.make_record(
+        "demo", "demo_metric", 1.0, "s", profile_dir=str(tmp_path))
+    joined = prof.profile_breakdowns([rec])
+    assert joined[str(tmp_path)]["parsed"] == 1
+    assert joined[str(tmp_path)]["host_ms"] == 1197.464
+
+
+def test_ledger_profile_dir_joins_into_diagnosis(tmp_path):
+    """A scanned ledger whose record carries a profile_dir gets the
+    parsed breakdown joined into the report's profiles block."""
+    import shutil
+
+    nest = tmp_path / "prof" / "plugins" / "profile" / "ts"
+    nest.mkdir(parents=True)
+    shutil.copy(TRACE_FIXTURE, nest / "host.trace.json.gz")
+    led = str(tmp_path / "led.ndjson")
+    ledger.append_records(led, [ledger.make_record(
+        "demo_wall", "demo_wall_s", 2.0, "s", platform="cpu",
+        profile_dir=str(tmp_path / "prof"),
+    )])
+    rep = doctor.diagnose([led])
+    assert rep["profiles"][str(tmp_path / "prof")]["parsed"] == 1
+    assert {s["kind"] for s in rep["scanned"]} == {
+        "ledger", "profile"}
+
+
+# ------------------------------------------------- per-rule pathology
+
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def _run_report(**over):
+    rep = {
+        "nodes": 3, "converged_round": 5, "rounds_run": 8,
+        "wall_per_round_ms": 100.0, "compile_seconds": 0.5,
+        "pipeline": {"fetch_wait_s": 0.01},
+        "compile_cache": {"hits": 1, "misses": 0, "unknown": 0,
+                          "cold_seconds": 0.0},
+    }
+    rep.update(over)
+    return rep
+
+
+def test_rule_convergence_stall(tmp_path, golden_report):
+    art = _write(tmp_path, "run.json",
+                 _run_report(converged_round=None))
+    rep = doctor.diagnose([art])
+    (f,) = _findings(rep, "convergence_stall")
+    assert f["severity"] == "critical"
+    assert f["evidence"] == {
+        "artifact": art, "field": "converged_round", "value": None}
+    assert not rep["ok"]
+    assert not _findings(golden_report, "convergence_stall")
+
+
+def test_rule_convergence_stall_flight(tmp_path, golden_report):
+    lines = [
+        json.dumps({"t": "meta", "nodes": 3}),
+        json.dumps({"t": "round", "r": 1, "m": {"gap": 4.0}}),
+        json.dumps({"t": "round", "r": 2, "m": {"gap": 2.0}}),
+    ]
+    art = tmp_path / "stalled.ndjson"
+    art.write_text("\n".join(lines) + "\n")
+    rep = doctor.diagnose([str(art)])
+    (f,) = _findings(rep, "convergence_stall")
+    assert f["evidence"]["field"] == "diagnostics.converged_round"
+
+
+def test_rule_poisoned_log_ring(tmp_path, golden_report):
+    lines = [
+        json.dumps({"t": "meta", "nodes": 3}),
+        json.dumps({"t": "round", "r": 1, "m": {"gap": 0.0}}),
+        json.dumps({"t": "event", "r": 1, "name": "log_wrapped",
+                    "attrs": {}}),
+    ]
+    art = tmp_path / "poisoned.ndjson"
+    art.write_text("\n".join(lines) + "\n")
+    rep = doctor.diagnose([str(art)])
+    (f,) = _findings(rep, "poisoned_log_ring")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["field"] == "diagnostics.poisoned"
+    run_art = _write(tmp_path, "prun.json", _run_report(poisoned=True))
+    (f2,) = _findings(doctor.diagnose([run_art]), "poisoned_log_ring")
+    assert f2["evidence"]["field"] == "poisoned"
+    assert not _findings(golden_report, "poisoned_log_ring")
+
+
+def test_rule_fetch_wait_bound(tmp_path, golden_report):
+    # 0.5s fetch-wait of a 0.8s sim wall: far past the 25% share
+    art = _write(tmp_path, "run.json", _run_report(
+        pipeline={"fetch_wait_s": 0.5}))
+    rep = doctor.diagnose([art])
+    (f,) = _findings(rep, "fetch_wait_bound")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["field"] == "pipeline.fetch_wait_s"
+    assert f["evidence"]["value"] == 0.5
+    assert rep["ok"]  # warnings never trip --check
+    assert not _findings(golden_report, "fetch_wait_bound")
+
+
+def test_rule_fetch_wait_bound_from_ledger(tmp_path):
+    led = str(tmp_path / "led.ndjson")
+    ledger.append_records(led, [ledger.make_record(
+        "demo_wall", "demo_wall_s", 10.0, "s", platform="cpu",
+        wall=ledger.wall_decomposition(total_s=10.0, fetch_wait_s=6.0),
+    )])
+    (f,) = _findings(doctor.diagnose([led]), "fetch_wait_bound")
+    assert f["evidence"]["field"] == "wall.fetch_wait_s"
+
+
+def test_rule_cold_compile_dominated(tmp_path, golden_report):
+    art = _write(tmp_path, "run.json", _run_report(
+        compile_seconds=10.0,
+        compile_cache={"hits": 0, "misses": 3, "unknown": 0,
+                       "cold_seconds": 9.5},
+    ))
+    (f,) = _findings(doctor.diagnose([art]), "cold_compile_dominated")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["field"] == "compile_seconds"
+    assert "3 cache misses" in f["summary"]
+    assert "prime_cache" in f["action"]
+    assert not _findings(golden_report, "cold_compile_dominated")
+
+
+def test_rule_occupancy_collapse(tmp_path, golden_report):
+    art = _write(tmp_path, "sweep.json", {
+        "lanes_detail": [], "lanes": 8, "ok": True,
+        "occupancy": {"occupancy_ratio": 0.2,
+                      "wasted_frozen_lane_rounds": 96},
+    })
+    (f,) = _findings(doctor.diagnose([art]), "occupancy_collapse")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["field"] == "occupancy.occupancy_ratio"
+    assert f["evidence"]["value"] == 0.2
+    assert not _findings(golden_report, "occupancy_collapse")
+
+
+def test_rule_quarantine_storm(tmp_path, golden_report):
+    art = _write(tmp_path, "twin.json", {
+        "shadow_delivery": {"p99_ms": 12.0},
+        "lines": 100, "bad_lines": 20, "chunks": 4,
+    })
+    rep = doctor.diagnose([art])
+    (f,) = _findings(rep, "quarantine_storm")
+    assert f["severity"] == "critical"
+    assert f["evidence"] == {
+        "artifact": art, "field": "bad_lines", "value": 20}
+    assert not _findings(golden_report, "quarantine_storm")
+
+
+def test_rule_frontier_breach(tmp_path, golden_report):
+    breach = ("part2x: recovery_rounds worst 14 > 8 "
+              "(worst seed 3; repro: python -m corro_sim run "
+              "--scenario part2x --seed 3)")
+    art = _write(tmp_path, "frontier.json", {
+        "cells": [{"cell": "part2x"}], "breaches": [breach],
+    })
+    rep = doctor.diagnose([art])
+    (f,) = _findings(rep, "frontier_breach")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["field"] == "frontier.breaches"
+    assert f["repro"] == (
+        "python -m corro_sim run --scenario part2x --seed 3")
+    assert not _findings(golden_report, "frontier_breach")
+
+
+def test_rule_frontier_breach_soak_thresholds(tmp_path):
+    art = _write(tmp_path, "soak.json", {
+        "scenarios": [{"scenario": "part2x"}], "ok": False,
+        "threshold_breaches": ["part2x: rows_lost 3 > 0"],
+        "sweep": {"lanes": 4, "wall_seconds": 1.0,
+                  "compile_seconds": 0.1,
+                  "clusters_per_second_per_device": 5.0},
+    })
+    (f,) = _findings(doctor.diagnose([art]), "frontier_breach")
+    assert f["evidence"]["field"] == "threshold_breaches"
+
+
+def test_rule_regression_band_breach(tmp_path, golden_report,
+                                     monkeypatch):
+    monkeypatch.setenv("CORRO_GIT_REV", "testrev")
+    led = str(tmp_path / "led.ndjson")
+    # north_star_wall@axon banded at 48.785s (lower_is_better, 25%):
+    # a 100s capture breaches against the committed golden bands
+    ledger.append_records(led, [ledger.make_record(
+        "north_star_wall", "northstar_wall_s", 100.0, "s",
+        platform="axon", seq=99,
+    )])
+    rep = doctor.diagnose([led])
+    (f,) = _findings(rep, "regression_band_breach")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["field"] == "breaches[].series"
+    assert f["evidence"]["value"] == "north_star_wall@axon"
+    assert f["repro"] == "corro-sim perf --check"
+    assert not rep["ok"]
+    assert not _findings(golden_report, "regression_band_breach")
+
+
+def test_rule_cross_platform_grading(tmp_path, golden_report):
+    led = str(tmp_path / "led.ndjson")
+    # devcluster_wall is banded on axon only — a cpu capture must
+    # honest-skip and the doctor surfaces the skip as info
+    ledger.append_records(led, [ledger.make_record(
+        "devcluster_wall", "devcluster_64_agents_wall_s", 0.5, "s",
+        platform="cpu",
+    )])
+    rep = doctor.diagnose([led])
+    (f,) = _findings(rep, "cross_platform_grading")
+    assert f["severity"] == "info"
+    assert f["evidence"]["field"] == "skipped_cross_platform[].series"
+    assert f["evidence"]["value"] == "devcluster_wall@cpu"
+    assert not _findings(rep, "regression_band_breach")
+    assert not _findings(golden_report, "cross_platform_grading")
+
+
+def test_rule_straggler_lane(tmp_path, golden_report):
+    lanes = [
+        {"cell": "base", "seed": s, "converged_round": r,
+         "rounds_run": 32, "poisoned": False,
+         "repro_cmd": f"python -m corro_sim run --seed {s}"}
+        for s, r in ((0, 5), (1, 5), (2, 6), (3, 20))
+    ]
+    art = _write(tmp_path, "sweep.json", {
+        "lanes_detail": lanes, "lanes": 4, "ok": True,
+        "occupancy": {"occupancy_ratio": 0.9},
+    })
+    rep = doctor.diagnose([art])
+    (f,) = _findings(rep, "straggler_lane")
+    assert f["severity"] == "warning"
+    assert f["evidence"]["field"] == "lanes_detail[].converged_round"
+    assert f["evidence"]["value"] == 20
+    assert f["repro"] == "python -m corro_sim run --seed 3"
+    assert not _findings(rep, "convergence_stall")
+    assert not _findings(golden_report, "straggler_lane")
+
+
+def test_rule_unmeasured_staleness(golden_report):
+    """The committed golden ledger honestly carries the r05 preflight
+    hole and the MULTICHIP r01 failed leg — the staleness rule SHOULD
+    surface both, as info (the one rule whose evidence lives in the
+    goldens by design; it never trips --check)."""
+    fs = _findings(golden_report, "unmeasured_device_staleness")
+    assert {f["evidence"]["field"] for f in fs} == {
+        "series.north_star_wall@unknown.latest.status",
+        "series.multichip_leg@axon.latest.status",
+    }
+    assert all(f["severity"] == "info" for f in fs)
+    assert golden_report["ok"]
+
+
+def test_rule_fetch_wait_bound_from_profile(tmp_path):
+    """A trace whose host slices are mostly fetch-gap patterns
+    attributes the wall to device fetches."""
+    tr = tmp_path / "fetch.trace.json.gz"
+    with gzip.open(tr, "wt") as f:
+        json.dump({"traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/host:CPU"}},
+            {"ph": "M", "name": "thread_name", "pid": 1, "tid": 1,
+             "args": {"name": "python"}},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 600,
+             "name": "profiler.py:120 block_until_ready"},
+            {"ph": "X", "pid": 1, "tid": 1, "ts": 600, "dur": 400,
+             "name": "dispatch.py:90 other_host_work"},
+        ]}, f)
+    br = prof.parse_trace(str(tr))
+    assert br["fetch_gap_ms"] == 0.6
+    assert br["fetch_gap_share"] == 0.6
+    (f,) = _findings(doctor.diagnose([str(tr)]), "fetch_wait_bound")
+    assert f["evidence"]["field"] == "fetch_gap_share"
+
+
+# ----------------------------------------------- report-level contract
+
+def test_healthy_goldens_zero_critical(golden_report):
+    assert golden_report["ok"]
+    assert golden_report["counts"]["critical"] == 0
+    assert golden_report["counts"]["warning"] == 0
+    assert not golden_report["skipped"]
+    kinds = {s["kind"] for s in golden_report["scanned"]}
+    assert kinds == {"ledger", "bands", "flight", "profile"}
+
+
+def test_report_deterministic():
+    a = doctor.diagnose(GOLDEN_ARTIFACTS)
+    b = doctor.diagnose(GOLDEN_ARTIFACTS)
+    assert json.dumps(a, sort_keys=True) == json.dumps(
+        b, sort_keys=True)
+
+
+def test_ranking_severity_order(tmp_path):
+    """Criticals outrank warnings outrank infos, whatever order the
+    rules emitted them in."""
+    run = _write(tmp_path, "run.json", _run_report(
+        converged_round=None, pipeline={"fetch_wait_s": 0.5}))
+    rep = doctor.diagnose([run])
+    sevs = [f["severity"] for f in rep["findings"]]
+    assert sevs == sorted(
+        sevs, key=lambda s: doctor.SEVERITIES.index(s))
+    assert sevs[0] == "critical"
+
+
+def test_unrecognized_artifact_skipped_not_fatal(tmp_path):
+    art = _write(tmp_path, "heatmap.json",
+                 {"rows": [], "cols": [], "maps": {}})
+    junk = tmp_path / "junk.ndjson"
+    junk.write_text("not json at all\n")
+    rep = doctor.diagnose([art, str(junk)])
+    assert rep["ok"]
+    assert {s["reason"] for s in rep["skipped"]} == {"unrecognized"}
+
+
+def test_render_report_ascii(tmp_path):
+    art = _write(tmp_path, "twin.json", {
+        "shadow_delivery": {"p99_ms": 12.0},
+        "lines": 100, "bad_lines": 50,
+    })
+    rep = doctor.diagnose([art])
+    text = doctor.render_report(rep)
+    assert "CRIT" in text
+    assert "quarantine_storm" in text
+    assert "evidence:" in text and "bad_lines" in text
+
+
+# ------------------------------------------------------------ surfaces
+
+def test_cli_doctor_check_exits_6(tmp_path, capsys):
+    from corro_sim import cli
+
+    art = _write(tmp_path, "run.json",
+                 _run_report(converged_round=None))
+    out = str(tmp_path / "DOCTOR.json")
+    try:
+        rc = cli.main(["doctor", art, "--check", "--out", out])
+    finally:
+        doctor.set_doctor_status(None)
+    assert rc == doctor.CRITICAL_EXIT == 6
+    report = json.load(open(out))
+    assert report["counts"]["critical"] == 1
+    assert "convergence_stall" in capsys.readouterr().out
+
+
+def test_cli_doctor_healthy_and_bad_args(tmp_path, capsys):
+    from corro_sim import cli
+
+    try:
+        rc = cli.main(["doctor", *GOLDEN_ARTIFACTS, "--check"])
+        assert rc == 0
+        st = doctor.doctor_status()
+        assert st is not None and st["ok"]
+    finally:
+        doctor.set_doctor_status(None)
+    capsys.readouterr()
+    assert cli.main(
+        ["doctor", str(tmp_path / "missing.json")]) == 2

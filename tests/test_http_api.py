@@ -384,3 +384,33 @@ def test_perf_route(server, client):
         assert body["appended"] == 2
     finally:
         set_perf_status(prior)
+
+
+def test_doctor_route(server, client):
+    """GET /v1/doctor (ISSUE 17): the in-process diagnosis snapshot
+    when one exists, else a fresh diagnosis over the committed golden
+    ledger (zero criticals — the seed history is healthy)."""
+    import json
+    import urllib.request
+
+    from corro_sim.obs.doctor import doctor_status, set_doctor_status
+
+    url = f"http://{server.addr[0]}:{server.addr[1]}/v1/doctor"
+    prior = doctor_status()
+    try:
+        set_doctor_status(None)  # force the committed-golden fallback
+        with urllib.request.urlopen(url) as resp:
+            body = json.loads(resp.read())
+        assert body["schema"] == "corro-sim/doctor/v1"
+        assert body["ok"] is True
+        assert body["counts"]["critical"] == 0
+        assert any(s["kind"] == "ledger" for s in body["scanned"])
+
+        set_doctor_status({"schema": "corro-sim/doctor/v1",
+                           "ok": False,
+                           "counts": {"critical": 1}})
+        with urllib.request.urlopen(url) as resp:
+            body = json.loads(resp.read())
+        assert body["ok"] is False
+    finally:
+        set_doctor_status(prior)

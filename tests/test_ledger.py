@@ -239,6 +239,37 @@ def test_normalize_sweep_and_twin_reports():
     assert fc["value"] == 1.9 and fc["wall"]["compile_s"] == 0.7
 
 
+def test_normalize_soak_swept_report_flattens_sweep_block():
+    """ISSUE 17 satellite: the swept-soak report nests the fleet
+    numbers under a "sweep" block — normalize_sweep_report flattens it
+    into the same sweep_throughput series a plain sweep lands in, and
+    normalize_artifact sniffs the shape (so `perf --ingest` and the
+    soak auto-append both work without a manual reshape)."""
+    soak = {
+        "nodes": 64, "rounds": 128, "seed": 0,
+        "scenarios": [{"scenario": "part2x", "converged_round": 30}],
+        "ok": True,
+        "sweep": {
+            "lanes": 4, "dispatches": 9, "wall_seconds": 2.5,
+            "compile_seconds": 0.9,
+            "clusters_per_second_per_device": 3.2,
+            "compile_cache": {"hits": 2, "misses": 0},
+        },
+    }
+    (rec,) = ledger.normalize_sweep_report(
+        soak, source="soak", env=CPU_ENV)
+    assert rec["config"] == "sweep_throughput"
+    assert rec["value"] == 3.2
+    assert rec["wall"]["total_s"] == 2.5
+    assert rec["wall"]["compile_s"] == 0.9
+    assert rec["extra"]["lanes"] == 4
+    assert rec["extra"]["nodes"] == 64
+    assert rec["source"] == "soak"
+    (via_sniff,) = ledger.normalize_artifact(soak)
+    assert via_sniff["config"] == "sweep_throughput"
+    assert via_sniff["source"] == "soak"
+
+
 def test_normalize_artifact_dispatch_and_rejection():
     assert ledger.normalize_artifact(R01)[0]["config"] == \
         "north_star_throughput"

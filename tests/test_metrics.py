@@ -761,3 +761,51 @@ def test_perf_ledger_families_render_and_validate(cluster):
     # the cpu north-star capture honest-skipped against the axon band
     assert vals[PERF_CHECK_SKIPPED] == 1
     _validate_exposition(text)
+
+
+def test_doctor_families_render_and_validate(cluster):
+    """ISSUE 17: the doctor gauge families (corro_doctor_*) through the
+    GaugeRegistry — per-(rule, severity) finding counts plus the
+    scan/skip/critical companions — render and pass the
+    scraper-contract validator. Emission (obs/doctor.
+    update_doctor_gauges) and this coverage share the utils.metrics
+    constants, so they cannot drift."""
+    from corro_sim.obs.doctor import update_doctor_gauges
+    from corro_sim.utils.metrics import (
+        DOCTOR_ARTIFACTS_SCANNED,
+        DOCTOR_ARTIFACTS_SKIPPED,
+        DOCTOR_CRITICAL_FINDINGS,
+        DOCTOR_FINDINGS_TOTAL,
+    )
+
+    update_doctor_gauges({
+        "scanned": [
+            {"artifact": "a.ndjson", "kind": "ledger"},
+            {"artifact": "b.json", "kind": "sweep"},
+        ],
+        "skipped": [{"artifact": "c.bin", "reason": "unrecognized"}],
+        "counts": {"critical": 1, "warning": 1, "info": 0},
+        "findings": [
+            {"rule": "convergence_stall", "severity": "critical"},
+            {"rule": "fetch_wait_bound", "severity": "warning"},
+        ],
+    })
+
+    text = render_prometheus(cluster)
+    vals = {}
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            key, _, val = line.rpartition(" ")
+            vals[key] = float(val)
+    assert vals[
+        DOCTOR_FINDINGS_TOTAL
+        + '{rule="convergence_stall",severity="critical"}'
+    ] == 1
+    assert vals[
+        DOCTOR_FINDINGS_TOTAL
+        + '{rule="fetch_wait_bound",severity="warning"}'
+    ] == 1
+    assert vals[DOCTOR_ARTIFACTS_SCANNED] == 2
+    assert vals[DOCTOR_ARTIFACTS_SKIPPED] == 1
+    assert vals[DOCTOR_CRITICAL_FINDINGS] == 1
+    _validate_exposition(text)
