@@ -258,11 +258,52 @@ class _Handler(BaseHTTPRequestHandler):
             # sub-delivery latency quantiles, coalescing, query fan — 404
             # until a load has been driven through this cluster
             self._traced(name, self._get_workload)
+        elif path == "/v1/changes":
+            self._traced(name, lambda: self._get_changes(params))
         elif path == "/metrics":
             self._traced(name, self._get_metrics)
         else:
             self._traced(name, lambda: self._send_json(
                 {"error": "not found"}, status=404))
+
+    # GET /v1/changes?offset=N&limit=K — relay a growing ND-JSON
+    # changeset feed by line position: the serving side of the twin's
+    # live HTTP watch (corro_sim/io/feedsource.py HTTPWatchSource). The
+    # body is raw ND-JSON starting at line `offset`; an unterminated
+    # final line is served as-is (the watcher holds torn fragments back
+    # and re-fetches), so the relay never invents a newline the writer
+    # has not committed.
+    def _get_changes(self, params):
+        path = getattr(self.api, "feed_path", None)
+        if path is None:
+            raise _ApiError(
+                404, "no changeset feed attached to this server "
+                     "(ApiServer(feed_path=...))"
+            )
+        try:
+            offset = max(0, int(params.get("offset", "0")))
+            limit = int(params.get("limit", "4096"))
+        except ValueError:
+            raise _ApiError(400, "offset/limit must be integers") \
+                from None
+        limit = max(1, min(limit, 65536))
+        out: list = []
+        try:
+            with open(path, "rb") as f:
+                for i, raw in enumerate(f):
+                    if i < offset:
+                        continue
+                    out.append(raw)
+                    if len(out) >= limit:
+                        break
+        except OSError as e:
+            raise _ApiError(503, f"feed unreadable: {e}") from None
+        body = b"".join(out)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _get_workload(self):
         rep = getattr(self.api.cluster, "workload_report", None)
@@ -628,9 +669,14 @@ class ApiServer:
         authz_token: str | None = None,
         tick_interval: float | None = None,
         ssl_context=None,
+        feed_path: str | None = None,
     ):
         self.cluster = cluster
         self.authz_token = authz_token
+        # ND-JSON changeset feed relayed at GET /v1/changes — the
+        # serving side of the twin's HTTPWatchSource (`twin
+        # http://host/v1/changes --tail`); 404 when unset
+        self.feed_path = feed_path
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.api = self  # type: ignore[attr-defined]

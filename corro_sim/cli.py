@@ -1138,9 +1138,22 @@ def _cmd_twin(args: argparse.Namespace) -> int:
     vmapped dispatch, graded against the `twin_forecast` threshold
     section (breach = exit 6, the soak tripwire semantics).
 
+    `--tail` is the LIVE operator loop (doc/twin.md §9): FEED becomes a
+    growing source — a polled file tail (rotation re-binds, truncation
+    refuses) or an http(s):// `/v1/changes` watch (reconnect with a
+    backoff budget) — shadowed chunk by chunk as lines arrive,
+    bit-identical to file-mode replay of the same lines. Source death
+    past the backoff/idle budget is the tail's normal end: final
+    partial chunk, drain, report, exit 5 with a resumable cursor.
+    `--forecast-every N` re-forks the live state every N chunks and
+    races the `--forecast` grid continuously; `--forecast-load R`
+    additionally replays up to R rounds of the trailing feed window
+    into every lane as coupled workload.
+
     Exit codes: 0 ok; 2 hostile feed refused (strict mode) / bad args;
     3 the shadow failed to drain to convergence; 4 poisoned (log ring
-    wrapped); 6 forecast threshold breach.
+    wrapped); 5 live source died (tail mode — the cursor checkpoint
+    resumes); 6 forecast threshold breach. Precedence: 4 > 5 > 3 > 6.
     """
     import dataclasses
 
@@ -1178,12 +1191,6 @@ def _cmd_twin(args: argparse.Namespace) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 2
 
-    try:
-        lines = load_feed_lines(args.feed)
-    except OSError as e:
-        print(f"error: cannot read feed {args.feed!r}: {e}",
-              file=sys.stderr)
-        return 2
     resume = None
     universe = None
     if args.resume:
@@ -1193,18 +1200,90 @@ def _cmd_twin(args: argparse.Namespace) -> int:
             print(f"error: --resume {args.resume!r}: {e}",
                   file=sys.stderr)
             return 2
+
+    source = None
+    if args.tail:
+        from corro_sim.io.feedsource import (
+            FeedSourceError,
+            FileTailSource,
+            HTTPWatchSource,
+        )
+
+        scan = (
+            resume.cfg.twin.scan_lines if resume is not None
+            else args.scan_lines
+        )
+        if scan <= 0:
+            print(
+                "error: --tail needs --scan-lines N: a closed world "
+                "cannot be frozen from 'the whole feed' while the feed "
+                "is still growing",
+                file=sys.stderr,
+            )
+            return 2
+        kw = dict(
+            poll_ms=args.tail_poll_ms,
+            reconnect_max_s=args.reconnect_max_s,
+            idle_timeout_s=args.idle_timeout_s,
+            max_lag_lines=args.max_lag_lines,
+            jitter_seed=args.seed,
+        )
+        if args.feed.startswith(("http://", "https://")):
+            source = HTTPWatchSource(args.feed, **kw)
+        else:
+            source = FileTailSource(args.feed, **kw)
+        # block until the scan window (plus, on resume, the already-
+        # consumed prefix the cursor's feed_sha guards) is available
+        need0 = scan
+        if resume is not None:
+            need0 = max(need0, int(
+                ((resume.meta or {}).get("twin") or {})
+                .get("cursor", {}).get("lines_seen", 0)
+            ))
+        try:
+            lines = source.wait_lines(need0)
+        except FeedSourceError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 5
+        if len(lines) < need0:
+            print(
+                f"error: live source died ({source.death_reason}) "
+                f"after {len(lines)}/{need0} lines — before the "
+                "universe scan window (or the resume prefix) filled",
+                file=sys.stderr,
+            )
+            return 5
+    else:
+        try:
+            lines = load_feed_lines(args.feed)
+        except OSError as e:
+            print(f"error: cannot read feed {args.feed!r}: {e}",
+                  file=sys.stderr)
+            return 2
+    if resume is not None:
         # the token is self-contained (the soak --resume posture): the
         # killed twin's own config continues, shape flags are ignored
         cfg = resume.cfg
     else:
-        twin_knobs = TwinConfig(
-            enabled=True,
-            scan_lines=args.scan_lines,
-            chunk_lines=args.chunk_lines,
-            skip_bad=args.skip_bad,
-            drain_rounds=args.drain_rounds,
-            checkpoint_every=args.checkpoint_every,
-        )
+        try:
+            twin_knobs = TwinConfig(
+                enabled=True,
+                scan_lines=args.scan_lines,
+                chunk_lines=args.chunk_lines,
+                skip_bad=args.skip_bad,
+                drain_rounds=args.drain_rounds,
+                checkpoint_every=args.checkpoint_every,
+                tail_poll_ms=args.tail_poll_ms,
+                reconnect_max_s=args.reconnect_max_s,
+                idle_timeout_s=args.idle_timeout_s,
+                max_lag_lines=args.max_lag_lines,
+                refresh_threshold=args.refresh_threshold,
+                refresh_window_lines=args.refresh_window,
+                forecast_every=args.forecast_every,
+            )
+        except AssertionError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
         universe = twin_universe(lines, twin_knobs.scan_lines)
         heads = probe_feed_heads(lines, universe)
         overrides = {}
@@ -1230,6 +1309,88 @@ def _cmd_twin(args: argparse.Namespace) -> int:
     checkpoint_path = args.checkpoint or (
         f"{args.out}.ckpt.npz" if args.out else None
     )
+
+    on_cycle = None
+    cycles: list = []
+    if args.forecast_every > 0:
+        if forecast_grid is None:
+            print(
+                "error: --forecast-every needs a --forecast grid to "
+                "re-race",
+                file=sys.stderr,
+            )
+            return 2
+        from corro_sim.engine.twin import save_fork
+        from corro_sim.workload.inject import trace_workload
+
+        cycle_thresholds = load_thresholds()
+        cycle_base = args.fork_out or (
+            f"{args.out}.fork.npz" if args.out else "TWIN.fork.npz"
+        )
+
+        def on_cycle(ctx):
+            # the cadence re-fork loop: fork the IN-FLIGHT state, race
+            # the grid, append a trend point. One failed cycle logs and
+            # degrades — it never kills the tail it grades.
+            n = len(cycles) + 1
+            path = f"{cycle_base}.cycle{n}.npz"
+            try:
+                tok = save_fork(
+                    path, cfg=ctx["cfg"], state=ctx["state"],
+                    seed=ctx["seed"], rounds=ctx["round"],
+                    feed=args.feed,
+                    lines_seen=ctx["stream"].lines_seen,
+                    chunk=args.chunk,
+                )
+                wl = None
+                if args.forecast_load > 0:
+                    wl = trace_workload(ctx["window_chunks"], ctx["cfg"])
+                    if wl is not None and wl.rounds > args.forecast_load:
+                        k = args.forecast_load
+                        wl = dataclasses.replace(
+                            wl, rounds=k, writers=wl.writers[-k:],
+                            rows=wl.rows[-k:], cols=wl.cols[-k:],
+                            vals=wl.vals[-k:], dels=wl.dels[-k:],
+                            ncells=wl.ncells[-k:], events=[],
+                        )
+                fc = run_forecast(
+                    tok, forecast_grid["scenario"],
+                    forecast_grid["seed"], rounds=args.forecast_rounds,
+                    max_rounds=args.max_rounds, chunk=args.chunk,
+                    thresholds=cycle_thresholds, coupled_workload=wl,
+                )
+            except (ValueError, AssertionError, OSError) as e:
+                print(
+                    f"# forecast cycle {n} @ chunk {ctx['chunk']} "
+                    f"failed (degrading, tail continues): {e}",
+                    file=sys.stderr, flush=True,
+                )
+                cycles.append({
+                    "cycle": n, "chunk": ctx["chunk"], "error": str(e),
+                })
+                return None
+            cycles.append({
+                "cycle": n, "chunk": ctx["chunk"], "fork": path,
+                "fork_round": fc["fork_round"], "lanes": fc["lanes"],
+                "ok": fc["ok"],
+                "breaches": len(fc["frontier"]["breaches"]),
+                **(
+                    {"coupled_load": fc["coupled_load"]}
+                    if "coupled_load" in fc else {}
+                ),
+            })
+            print(
+                f"# forecast cycle {n} @ chunk {ctx['chunk']}: "
+                f"{fc['lanes']} lanes from round {fc['fork_round']}"
+                + (
+                    f", coupled {wl.rounds} load rounds"
+                    if wl is not None else ""
+                )
+                + ("" if fc["ok"] else " [NOT OK]"),
+                file=sys.stderr, flush=True,
+            )
+            return {"trend": fc["trend"]}
+
     try:
         # PR 2 profiler hook, extended to the twin path: the shadow's
         # scan chunks and the forecast dispatch trace into separate
@@ -1241,7 +1402,8 @@ def _cmd_twin(args: argparse.Namespace) -> int:
             res = run_twin(
                 feed=args.feed, cfg=cfg, lines=lines, seed=args.seed,
                 checkpoint_path=checkpoint_path, resume=resume,
-                flight=flight, universe=universe,
+                flight=flight, universe=universe, source=source,
+                on_cycle=on_cycle,
                 on_chunk=lambda h: print(
                     f"# twin chunk {h['chunk']}: {h['lines']} lines "
                     f"({h['bad']} bad), {h['rounds']} rounds, "
@@ -1254,6 +1416,19 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         # line, before any sim work (io/traces.py validate_feed)
         print(f"error: {e}", file=sys.stderr)
         return 2
+    except Exception as e:
+        from corro_sim.io.feedsource import FeedSourceError
+
+        if not isinstance(e, FeedSourceError):
+            raise
+        # feed truncation mid-tail: committed history rewound under the
+        # shadow — refuse loudly (exit 5; the last chunk-boundary
+        # cursor, if any, is still resumable against an intact feed)
+        print(f"error: {e}", file=sys.stderr)
+        return 5
+    finally:
+        if source is not None:
+            source.close()
     report = dict(res.report)
     if checkpoint_path:
         report["checkpoint"] = checkpoint_path
@@ -1263,8 +1438,15 @@ def _cmd_twin(args: argparse.Namespace) -> int:
     rc = 0
     if res.poisoned:
         rc = 4
+    elif source is not None and source.dead:
+        # the tail's NORMAL end: every live source eventually dies
+        # (idle timeout when the writer finishes, backoff budget when
+        # it vanishes) — distinct exit, full report, resumable cursor
+        rc = 5
     elif res.converged_round is None:
         rc = 3
+    if cycles:
+        report["forecast_cycles"] = cycles
     if forecast_grid is not None and not res.poisoned:
         fork_path = args.fork_out or (
             f"{args.out}.fork.npz" if args.out
@@ -1292,9 +1474,10 @@ def _cmd_twin(args: argparse.Namespace) -> int:
         report["forecast"] = fc
         # the projected-recovery trend next to the shadow headlines:
         # one point per fork (continuous re-forking appends points —
-        # the list IS the trend line), and the same point annotates
-        # the shadow's flight record at the fork round
-        report["forecast_trend"] = [fc["trend"]]
+        # the list IS the trend line: cadence cycles first, the final
+        # fork last), and the final point annotates the shadow's
+        # flight record at the fork round
+        report["forecast_trend"] = list(res.trend) + [fc["trend"]]
         for cell in fc["trend"]["cells"]:
             rec = cell["recovery_rounds"] or {}
             res.flight.annotate(
@@ -2373,6 +2556,63 @@ def build_parser() -> argparse.ArgumentParser:
              "remaining feed plays out bit-identically to the "
              "uninterrupted run (shape flags are ignored; the token "
              "carries the config)",
+    )
+    pt2.add_argument(
+        "--tail", action="store_true",
+        help="LIVE mode: treat FEED as a growing source — a polled "
+             "file tail (rotation re-binds via inode + consumed-prefix "
+             "sha, truncation refuses) or, for an http(s):// FEED, a "
+             "reconnecting /v1/changes watch — and shadow chunks as "
+             "they arrive, bit-identically to file-mode replay of the "
+             "same lines; needs --scan-lines; exits 5 with a resumable "
+             "cursor when the source dies past its backoff/idle budget",
+    )
+    pt2.add_argument(
+        "--tail-poll-ms", type=int, default=250,
+        help="tail poll interval in ms (file stat / HTTP request "
+             "cadence between arrivals)",
+    )
+    pt2.add_argument(
+        "--reconnect-max-s", type=float, default=30.0,
+        help="total jittered-backoff budget retrying a vanished "
+             "source before declaring it dead",
+    )
+    pt2.add_argument(
+        "--idle-timeout-s", type=float, default=10.0,
+        help="a reachable source delivering NOTHING for this long is "
+             "dead (the tail's clean end when the writer finishes)",
+    )
+    pt2.add_argument(
+        "--max-lag-lines", type=int, default=65536,
+        help="backpressure bound: stop reading ahead when this many "
+             "fetched lines await the shadow",
+    )
+    pt2.add_argument(
+        "--refresh-threshold", type=float, default=0.0,
+        help="stale-universe refresh trigger: when the windowed "
+             "unknown-actor/row/col/value quarantine rate crosses this "
+             "fraction, re-freeze the closed world from the trailing "
+             "window at the next chunk boundary "
+             "(corro_twin_refresh_total; 0 = never; needs --skip-bad)",
+    )
+    pt2.add_argument(
+        "--refresh-window", type=int, default=256, metavar="LINES",
+        help="trailing feed-line window the refresh rate is measured "
+             "over (also the re-scan window on refresh)",
+    )
+    pt2.add_argument(
+        "--forecast-every", type=int, default=0, metavar="CHUNKS",
+        help="cadence re-fork loop: every N shadowed chunks, fork the "
+             "live state and race the --forecast grid, appending one "
+             "forecast_trend point per cycle (0 = only the final "
+             "forecast)",
+    )
+    pt2.add_argument(
+        "--forecast-load", type=int, default=0, metavar="ROUNDS",
+        help="with --forecast-every: replay up to ROUNDS of the "
+             "trailing feed window into every forecast lane as coupled "
+             "workload (workload/inject.py trace_workload) so recovery "
+             "is graded under the live traffic (0 = uncoupled)",
     )
     pt2.add_argument(
         "--forecast", nargs="+", metavar="AXIS=VALUES",

@@ -288,6 +288,28 @@ class TwinConfig:
     drain_rounds: int = 256  # post-feed round budget chasing gap -> 0
     checkpoint_every: int = 1  # feed chunks between cursor checkpoints
 
+    # ---- live-tail bounds (corro_sim/io/feedsource.py): how hard a
+    # `corro-sim twin --tail` shadow chases a source that stalls, moves
+    # or dies. All host-side; none of these touch the step program.
+    tail_poll_ms: int = 250  # base poll cadence; also the backoff floor
+    reconnect_max_s: float = 30.0  # cumulative retry budget against a
+    # missing file / failing endpoint before the source is declared dead
+    idle_timeout_s: float = 10.0  # a source that yields no new complete
+    # line for this long is dead (a live tail's only natural exit)
+    max_lag_lines: int = 65536  # backpressure bound: the source stops
+    # reading ahead once this many undelivered lines are buffered
+
+    # ---- stale-universe refresh: when the windowed unknown_actor +
+    # unknown_value quarantine rate crosses the threshold, the closed
+    # world re-freezes from a trailing scan window at the next chunk
+    # boundary (a scheduled re-key event; engine/twin.py).
+    refresh_threshold: float = 0.0  # quarantine-rate trigger; 0 = never
+    refresh_window_lines: int = 256  # trailing lines rescanned per
+    # refresh (also the rate window the trigger is measured over)
+
+    forecast_every: int = 0  # run a fork -> forecast cycle every N feed
+    # chunks (0 = only the explicit final --forecast, if any)
+
     def validate(self) -> "TwinConfig":
         assert self.scan_lines >= 0, "twin.scan_lines must be >= 0"
         assert self.chunk_lines >= 1, "twin.chunk_lines must be >= 1"
@@ -295,6 +317,26 @@ class TwinConfig:
         assert self.checkpoint_every >= 0, (
             "twin.checkpoint_every must be >= 0 (0 = no cursor "
             "checkpoints)"
+        )
+        assert self.tail_poll_ms >= 1, "twin.tail_poll_ms must be >= 1"
+        assert self.reconnect_max_s >= 0, (
+            "twin.reconnect_max_s must be >= 0"
+        )
+        assert self.idle_timeout_s > 0, "twin.idle_timeout_s must be > 0"
+        assert self.max_lag_lines >= 1, "twin.max_lag_lines must be >= 1"
+        assert 0.0 <= self.refresh_threshold <= 1.0, (
+            "twin.refresh_threshold must be in [0, 1]"
+        )
+        assert self.refresh_window_lines >= 1, (
+            "twin.refresh_window_lines must be >= 1"
+        )
+        assert self.refresh_threshold == 0.0 or self.skip_bad, (
+            "twin.refresh_threshold needs skip_bad: the refresh trigger "
+            "is the windowed quarantine rate, and strict mode refuses "
+            "the feed before anything can quarantine"
+        )
+        assert self.forecast_every >= 0, (
+            "twin.forecast_every must be >= 0 (0 = no cadence re-forks)"
         )
         return self
 

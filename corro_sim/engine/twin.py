@@ -46,8 +46,13 @@ from corro_sim.config import SimConfig
 from corro_sim.engine.replay import make_injector, make_shadow_step
 from corro_sim.engine.state import init_state
 from corro_sim.io.traces import (
+    BAD_UNKNOWN_ACTOR,
+    BAD_UNKNOWN_COLUMN,
+    BAD_UNKNOWN_ROW,
+    BAD_UNKNOWN_VALUE,
     TraceStream,
     TraceUniverse,
+    extend_universe,
     scan_universe,
     validate_feed,
 )
@@ -59,7 +64,14 @@ from corro_sim.utils.metrics import (
     TWIN_DELIVERY_ROUNDS,
     TWIN_FEED_LINES_TOTAL,
     TWIN_FORECAST_LANES_TOTAL,
+    TWIN_REFRESH_EPOCH,
+    TWIN_REFRESH_EPOCH_HELP,
+    TWIN_REFRESH_HELP,
+    TWIN_REFRESH_TOTAL,
+    TWIN_TAIL_LAG_LINES,
+    TWIN_TAIL_LAG_LINES_HELP,
     counters,
+    gauges,
     histograms,
 )
 from corro_sim.workload.inject import pad_trace_cells, trace_round_args
@@ -71,8 +83,17 @@ __all__ = [
     "probe_feed_heads",
     "run_forecast",
     "run_twin",
+    "save_fork",
     "twin_universe",
 ]
+
+# the quarantine reasons whose windowed rate triggers a stale-universe
+# refresh: everything a re-scan of the feed itself can actually fix
+# (stale/duplicate/oversized/malformed lines stay hostile forever)
+_REFRESH_REASONS = (
+    BAD_UNKNOWN_ACTOR, BAD_UNKNOWN_VALUE, BAD_UNKNOWN_ROW,
+    BAD_UNKNOWN_COLUMN,
+)
 
 
 @dataclasses.dataclass
@@ -94,6 +115,12 @@ class TwinResult:
     seed: int
     wall_seconds: float
     checkpoint_path: str | None = None
+    refreshes: list = dataclasses.field(default_factory=list)
+    # stale-universe re-freeze events (cursor epochs, doc/twin.md §9)
+    trend: list = dataclasses.field(default_factory=list)
+    # cadence re-fork forecast_trend points (one per --forecast-every
+    # cycle; the CLI appends the final explicit forecast's point)
+    source: dict | None = None  # live-source report (tail mode only)
 
 
 def load_feed_lines(path: str) -> list:
@@ -133,6 +160,8 @@ def run_twin(
     flight: FlightRecorder | None = None,
     on_chunk=None,
     universe: TraceUniverse | None = None,
+    source=None,
+    on_cycle=None,
 ) -> TwinResult:
     """Shadow a changeset feed chunk by chunk.
 
@@ -147,13 +176,34 @@ def run_twin(
     all restore, the per-round key stream continues at its absolute
     round, and the remaining feed plays out BIT-IDENTICALLY to the
     uninterrupted run (tests/test_twin.py pins report field identity
-    after a mid-feed kill)."""
+    after a mid-feed kill).
+
+    ``source``: a live :class:`corro_sim.io.feedsource.FeedSource` —
+    tail mode. ``lines`` then seeds the already-available prefix (the
+    scan window, plus the consumed prefix on resume) and the loop
+    blocks on ``source.wait_lines`` for each FULL chunk, so chunk
+    boundaries — and therefore classification, injection and the whole
+    shadow — are bit-identical to replaying the same lines file-mode.
+    When the source dies past its backoff/idle budget the shadow
+    consumes the final partial chunk, drains, and returns with
+    ``result.source["dead"]`` set (the CLI's exit-5 path) — never a
+    traceback, never a truncated report. Strict (non ``skip_bad``)
+    posture cannot pre-validate a feed that is still being written; it
+    is enforced per chunk instead (the stream raises before the cursor
+    moves).
+
+    ``on_cycle``: the cadence re-fork hook (``twin.forecast_every``) —
+    called at every Nth chunk boundary with ``{chunk, round, state,
+    cfg, seed, stream, feed, window_chunks}``; a returned dict's
+    ``"trend"`` entry is appended to ``result.trend`` (and rides the
+    cursor checkpoint, so a resumed twin keeps its trend history)."""
     from corro_sim.io.checkpoint import save_sim_checkpoint
 
     if lines is None:
         if feed is None:
             raise ValueError("run_twin needs a feed path or lines")
         lines = load_feed_lines(feed)
+    lines = list(lines)
     if resume is not None and cfg is None:
         cfg = resume.cfg
     twin_knobs = (cfg.twin if cfg is not None else None)
@@ -184,8 +234,10 @@ def run_twin(
     # feed with one error naming each bad line (the PR 12 pattern);
     # --skip-bad defers to per-chunk quarantine instead. The validation
     # pass MUST chunk exactly like the run below — classification is
-    # chunk-boundary-dependent (io/traces.py validate_feed docstring)
-    if not twin_knobs.skip_bad:
+    # chunk-boundary-dependent (io/traces.py validate_feed docstring).
+    # A live tail cannot see the whole feed up front: strict mode is
+    # then enforced per chunk (stream.feed raises, cursor unmoved).
+    if not twin_knobs.skip_bad and source is None:
         bad = validate_feed(
             lines, universe, chunk_lines=twin_knobs.chunk_lines
         )
@@ -204,7 +256,7 @@ def run_twin(
     flight.set_meta(
         driver="run_twin", nodes=cfg.num_nodes, seed=seed,
         feed=feed, chunk_lines=twin_knobs.chunk_lines,
-        skip_bad=twin_knobs.skip_bad,
+        skip_bad=twin_knobs.skip_bad, live=source is not None,
     )
 
     inject = make_injector(cfg)
@@ -213,6 +265,10 @@ def run_twin(
 
     metrics_parts: list = []  # dict-of-arrays blocks to concatenate
     headlines: list = []
+    refreshes: list = []  # re-key events (cursor epochs)
+    refresh_refused: list = []  # extensions that would not fit cfg
+    trend: list = []  # cadence forecast_trend points
+    late_applied = 0  # retroactively cleared log slots
     rounds = 0
     feed_rounds = 0
     chunk_index = 0
@@ -251,6 +307,31 @@ def run_twin(
                 "edited feed would silently diverge"
             )
         state = resume.install_state(init_state(cfg, seed=seed))
+        refreshes = list(twin_meta.get("refreshes", []))
+        for ev in refreshes:
+            # deterministic re-freeze replay: the cursor's refresh
+            # epochs name the exact trailing windows the killed run
+            # extended the universe from; the checkpointed STATE is
+            # already in the final epoch's rank space (the remap
+            # happened before the checkpoint), so only the universe
+            # (and therefore the stream's encoder) is rebuilt here
+            at = int(ev["at_line"])
+            w = int(ev["window_lines"])
+            uni2, info = extend_universe(
+                universe, lines[max(0, at - w):at],
+                max_actors=cfg.num_nodes, max_rows=cfg.num_rows,
+                max_cols=cfg.num_cols, max_seqs=cfg.seqs_per_version,
+            )
+            if uni2 is None:
+                raise ValueError(
+                    "resume refresh replay failed at epoch "
+                    f"{ev.get('epoch')}: {'; '.join(info['refused'])} — "
+                    "the feed prefix no longer reproduces the refresh "
+                    "the token recorded"
+                )
+            universe = uni2
+        trend = list(twin_meta.get("trend", []))
+        late_applied = int(twin_meta.get("late_applied", 0))
         stream = TraceStream.from_cursor(
             universe, twin_meta["cursor"]
         )
@@ -287,6 +368,10 @@ def run_twin(
                 "chunk_index": chunk_index,
                 "feed_rounds": feed_rounds,
                 "headlines": headlines,
+                "refreshes": refreshes,
+                "refresh_epoch": len(refreshes),
+                "trend": trend,
+                "late_applied": late_applied,
             }},
         )
         flight.annotate(rounds, "twin_checkpoint", chunk=chunk_index,
@@ -323,9 +408,159 @@ def run_twin(
         metrics_parts.append(stacked)
         flight.record_rounds(base + 1, stacked)
 
+    def _apply_late_clears(state, entries):
+        """Retroactive EmptySet application (host-side, value-neutral):
+        mark the already-committed log slots of a late clear as cleared
+        so sync peers serve the Empty answer — the same
+        cleared/cleared_hlc bookkeeping :func:`corro_sim.workload.
+        inject.inject_round` does for in-chunk clears, applied after
+        the fact. The slot CONTENT stays (LWW already superseded it)."""
+        nonlocal late_applied
+        import jax.numpy as jnp
+
+        cleared = chlc = None
+        capacity = cfg.log_capacity
+        applied = 0
+        for ai, lo, hi, ts_ in entries:
+            head = int(stream.heads[ai])
+            for v in range(max(1, lo), hi + 1):
+                if head - v >= capacity:
+                    continue  # slot recycled (the twin poisons on wrap
+                    # before this can matter; belt and braces)
+                if cleared is None:
+                    cleared = np.array(state.log.cleared)
+                    chlc = np.array(state.cleared_hlc)
+                slot = (v - 1) % capacity
+                cleared[ai, slot] = True
+                if ts_ > chlc[ai, slot]:
+                    chlc[ai, slot] = ts_
+                applied += 1
+        if cleared is None:
+            return state, 0
+        late_applied += applied
+        return state.replace(
+            log=state.log.replace(cleared=jnp.asarray(cleared)),
+            cleared_hlc=jnp.asarray(chlc),
+        ), applied
+
+    def _refresh_window() -> tuple:
+        """Trailing (lines, unknown) sums covering at least the
+        configured rate window — chunk-granular, so a resumed run
+        measures the identical rate at the identical boundary."""
+        lines_sum = unk_sum = 0
+        for n_l, n_u in reversed(window_hist):
+            lines_sum += n_l
+            unk_sum += n_u
+            if lines_sum >= twin_knobs.refresh_window_lines:
+                break
+        return lines_sum, unk_sum
+
+    def _maybe_refresh(state):
+        """The scheduled re-key event: when the windowed unknown-name
+        quarantine rate crosses the threshold, re-freeze the closed
+        world from the trailing scan window at this chunk boundary.
+        Ordinals extend in place; value ranks re-sort, so the three
+        rank-typed state planes translate (the checkpoint installer's
+        exact remap set). An extension that would not fit the compiled
+        shapes REFUSES loudly and the shadow keeps quarantining."""
+        nonlocal universe, late_applied
+        if twin_knobs.refresh_threshold <= 0.0:
+            return state
+        lines_sum, unk_sum = _refresh_window()
+        if (
+            lines_sum < twin_knobs.refresh_window_lines
+            or unk_sum / lines_sum < twin_knobs.refresh_threshold
+        ):
+            return state
+        at = stream.lines_seen
+        window = lines[max(0, at - lines_sum):at]
+        new_uni, info = extend_universe(
+            universe, window,
+            max_actors=cfg.num_nodes, max_rows=cfg.num_rows,
+            max_cols=cfg.num_cols, max_seqs=cfg.seqs_per_version,
+        )
+        window_hist.clear()  # one verdict per window, either way
+        if new_uni is None:
+            refresh_refused.append({
+                "chunk": chunk_index, "at_line": at,
+                "reasons": info["refused"],
+            })
+            flight.annotate(
+                rounds, "twin_refresh_refused", chunk=chunk_index,
+                at_line=at, reasons="; ".join(info["refused"]),
+            )
+            counters.inc(
+                TWIN_REFRESH_TOTAL, labels='{trigger="refused"}',
+                help_=TWIN_REFRESH_HELP,
+            )
+            return state
+        if info["rank_moves"]:
+            import jax.numpy as jnp
+
+            from corro_sim.core.changelog import CELL_VR
+            from corro_sim.utils.ranks import translate_ranks
+
+            old, new = info["old_ranks"], info["new_ranks"]
+            cells = np.array(state.log.cells)
+            cells[..., CELL_VR] = translate_ranks(
+                cells[..., CELL_VR], old, new
+            )
+            state = state.replace(
+                table=state.table.replace(vr=jnp.asarray(translate_ranks(
+                    np.asarray(state.table.vr), old, new
+                ))),
+                own=state.own.replace(vr=jnp.asarray(translate_ranks(
+                    np.asarray(state.own.vr), old, new
+                ))),
+                log=state.log.replace(cells=jnp.asarray(cells)),
+            )
+        universe = new_uni
+        stream.rebind(new_uni)
+        event = {
+            "epoch": len(refreshes) + 1,
+            "chunk": chunk_index,
+            "at_line": at,
+            "window_lines": lines_sum,
+            "unknown_lines": unk_sum,
+            "actors_added": info["actors_added"],
+            "rows_added": info["rows_added"],
+            "cols_added": info["cols_added"],
+            "values_added": info["values_added"],
+            "rank_moves": info["rank_moves"],
+        }
+        refreshes.append(event)
+        counters.inc(
+            TWIN_REFRESH_TOTAL, labels='{trigger="quarantine"}',
+            help_=TWIN_REFRESH_HELP,
+        )
+        gauges.set(
+            TWIN_REFRESH_EPOCH, float(len(refreshes)),
+            help_=TWIN_REFRESH_EPOCH_HELP,
+        )
+        flight.annotate(rounds, "twin_refresh", **event)
+        return state
+
     start_line = stream.lines_seen
     step_width = twin_knobs.chunk_lines
-    while start_line < len(lines) and not poisoned:
+    window_hist: list = []  # per-chunk (lines, unknown_*) pairs the
+    # refresh trigger windows over
+    window_chunks: list = []  # encoded chunks since the last cadence
+    # cycle — the coupled-forecast replay window
+    while not poisoned:
+        if source is not None and not source.dead:
+            need = step_width - (len(lines) - start_line)
+            if need > 0:
+                # block for a FULL chunk (or source death): chunk
+                # boundaries — and so the whole shadow — stay
+                # bit-identical to file-mode replay of the same lines
+                lines.extend(source.wait_lines(need))
+            gauges.set(
+                TWIN_TAIL_LAG_LINES,
+                float(len(lines) - start_line + source.lag_lines),
+                help_=TWIN_TAIL_LAG_LINES_HELP,
+            )
+        if start_line >= len(lines):
+            break
         chunk_lines = lines[start_line:start_line + step_width]
         start_line += len(chunk_lines)
         out = stream.feed(chunk_lines, skip_bad=twin_knobs.skip_bad)
@@ -367,6 +602,16 @@ def run_twin(
                 if poisoned:
                     break
             _flush_rounds(base, chunk_metrics)
+        late_n = 0
+        if out.late_apply:
+            # retroactive EmptySets: clear the superseded log slots the
+            # clear arrived too late to catch in-chunk
+            state, late_n = _apply_late_clears(state, out.late_apply)
+            if late_n:
+                flight.annotate(
+                    rounds, "twin_late_apply", slots=late_n,
+                    chunk=chunk_index,
+                )
         headline = {
             "chunk": chunk_index,
             "lines": out.lines,
@@ -388,6 +633,7 @@ def run_twin(
                 if out.ts_hi is not None else None
             ),
             "sim_ms": round(out.rounds * cfg.round_ms, 3),
+            "late_applied": late_n,
         }
         headlines.append(headline)
         flight.annotate(
@@ -401,7 +647,33 @@ def run_twin(
         )
         if on_chunk is not None:
             on_chunk(dict(headline))
+        unk = sum(
+            1 for _no, reason, _d in out.bad
+            if reason in _REFRESH_REASONS
+        )
+        window_hist.append((out.lines, unk))
+        if not poisoned:
+            state = _maybe_refresh(state)
+        if out.rounds:
+            window_chunks.append(out)
         chunk_index += 1
+        if (
+            twin_knobs.forecast_every and on_cycle is not None
+            and not poisoned
+            and chunk_index % twin_knobs.forecast_every == 0
+        ):
+            # cadence re-fork: the operator hook forks the live state
+            # and grades recovery, optionally replaying the trailing
+            # window as coupled workload; runs BEFORE the checkpoint at
+            # the same boundary so the trend point rides the cursor
+            point = on_cycle({
+                "chunk": chunk_index, "round": rounds, "state": state,
+                "cfg": cfg, "seed": seed, "stream": stream,
+                "feed": feed, "window_chunks": list(window_chunks),
+            })
+            window_chunks.clear()
+            if isinstance(point, dict) and "trend" in point:
+                trend.append(point["trend"])
         if (
             checkpoint_path and twin_knobs.checkpoint_every
             and chunk_index % twin_knobs.checkpoint_every == 0
@@ -450,9 +722,12 @@ def run_twin(
         if not poisoned:
             _save_checkpoint()
 
+    source_report = source.report() if source is not None else None
     report = _shadow_report(
         cfg, stream, metrics, headlines, rounds, feed_rounds,
         converged, poisoned, feed,
+        late_applied=late_applied, refreshes=refreshes,
+        refresh_refused=refresh_refused, source=source_report,
     )
     flight.annotate(
         rounds, "twin_report",
@@ -465,7 +740,8 @@ def run_twin(
         converged_round=None if poisoned else converged,
         poisoned=poisoned, metrics=metrics, headlines=headlines,
         report=report, flight=flight, seed=seed, wall_seconds=wall,
-        checkpoint_path=checkpoint_path,
+        checkpoint_path=checkpoint_path, refreshes=refreshes,
+        trend=trend, source=source_report,
     )
 
 
@@ -480,7 +756,8 @@ def _concat_metrics(parts: list) -> dict:
 
 def _shadow_report(
     cfg, stream, metrics, headlines, rounds, feed_rounds, converged,
-    poisoned, feed,
+    poisoned, feed, late_applied=0, refreshes=None,
+    refresh_refused=None, source=None,
 ) -> dict:
     """The shadow headline block: feed hygiene + convergence + the FIFO
     delivery read scored against the feed's own clock."""
@@ -547,30 +824,55 @@ def _shadow_report(
         "sim_ms": round(rounds * cfg.round_ms, 3),
         "feed_ts": feed_ts,
         "shadow_delivery": delivery,
+        # retroactive EmptySet slots cleared after their versions were
+        # already injected (value-neutral; sync peers now serve Empty)
+        "late_applied": late_applied,
+        "refresh": {
+            "epoch": len(refreshes or ()),
+            "events": list(refreshes or ()),
+            "refused": list(refresh_refused or ()),
+        },
+        # live-source telemetry (None for file-mode replay — the block
+        # is excluded from live-vs-file identity comparisons, which pin
+        # everything else)
+        "source": source,
     }
 
 
 # --------------------------------------------------------------- forecast
 
-def fork_twin(result: TwinResult, path: str,
-              chunk: int = 8) -> "object":
-    """Write the live twin state as a what-if FORK token and return the
-    loaded :class:`~corro_sim.io.checkpoint.SimCheckpoint` — the state
-    every forecast lane (and every serial repro) warm-starts from."""
+def save_fork(
+    path: str, *, cfg, state, seed, rounds, feed=None, lines_seen=0,
+    chunk: int = 8,
+) -> "object":
+    """Write ANY twin state (final or mid-tail) as a what-if FORK token
+    and return the loaded
+    :class:`~corro_sim.io.checkpoint.SimCheckpoint`. The cadence
+    re-fork loop calls this from ``on_cycle`` with the in-flight state;
+    :func:`fork_twin` is the end-of-run convenience wrapper."""
     from corro_sim.io.checkpoint import (
         load_sim_checkpoint,
         save_fork_checkpoint,
     )
 
     save_fork_checkpoint(
-        path, cfg=result.cfg, state=result.state, seed=result.seed,
-        chunk=chunk, fork_round=result.rounds,
-        meta={
-            "feed": result.report.get("feed"),
-            "lines_seen": result.stream.lines_seen,
-        },
+        path, cfg=cfg, state=state, seed=seed, chunk=chunk,
+        fork_round=rounds,
+        meta={"feed": feed, "lines_seen": lines_seen},
     )
     return load_sim_checkpoint(path)
+
+
+def fork_twin(result: TwinResult, path: str,
+              chunk: int = 8) -> "object":
+    """Write the live twin state as a what-if FORK token and return the
+    loaded :class:`~corro_sim.io.checkpoint.SimCheckpoint` — the state
+    every forecast lane (and every serial repro) warm-starts from."""
+    return save_fork(
+        path, cfg=result.cfg, state=result.state, seed=result.seed,
+        rounds=result.rounds, feed=result.report.get("feed"),
+        lines_seen=result.stream.lines_seen, chunk=chunk,
+    )
 
 
 def run_forecast(
@@ -583,6 +885,7 @@ def run_forecast(
     thresholds: dict | None = None,
     on_chunk=None,
     flight_dir: str | None = None,
+    coupled_workload=None,
 ) -> dict:
     """Race the what-if grid from a fork token: ONE vmapped dispatch of
     (scenario × seed) warm-start lanes, frontier-graded against the
@@ -597,7 +900,13 @@ def run_forecast(
     §lane-observatory). The returned block always carries a ``trend``
     point (per-cell projected recovery at this fork round — the trend
     line the twin report publishes next to its shadow headlines) and
-    the fleet ``occupancy`` stats."""
+    the fleet ``occupancy`` stats.
+
+    ``coupled_workload``: a prebuilt
+    :class:`~corro_sim.workload.generators.Workload` (typically
+    :func:`corro_sim.workload.inject.trace_workload` over the feed's
+    trailing window) replayed INTO every lane right after the fork —
+    recovery graded under live traffic, not against a quiet cluster."""
     from corro_sim.config import FaultConfig, NodeFaultConfig
     from corro_sim.obs.lanes import (
         demux_flights,
@@ -614,7 +923,7 @@ def run_forecast(
     ).validate()
     plan = build_plan(
         base, scenarios, seeds, rounds=rounds, write_rounds=0,
-        fork=fork,
+        fork=fork, workload=coupled_workload,
     )
     res = run_sweep(
         plan, max_rounds=max_rounds, chunk=chunk, on_chunk=on_chunk,
@@ -686,6 +995,14 @@ def run_forecast(
         "frontier": frontier,
         "trend": trend,
         "occupancy": fleet_occupancy(res),
+        **(
+            {"coupled_load": {
+                "workload": coupled_workload.spec,
+                "rounds": coupled_workload.rounds,
+                "events": coupled_workload.events,
+            }}
+            if coupled_workload is not None else {}
+        ),
         **(
             {"lane_flights": {
                 "dir": flight_dir, "count": len(lane_flight_paths),

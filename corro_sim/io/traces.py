@@ -387,6 +387,106 @@ def scan_universe(lines, layout=None, lenient: bool = False) -> TraceUniverse:
     return universe
 
 
+def extend_universe(
+    universe: TraceUniverse,
+    window_lines,
+    *,
+    max_actors: int,
+    max_rows: int,
+    max_cols: int,
+    max_seqs: int,
+) -> tuple:
+    """Grow a frozen :class:`TraceUniverse` from a fresh scan window —
+    the stale-universe REFRESH (a scheduled re-key event; doc/twin.md
+    §9). Returns ``(new_universe, info)`` or ``(None, info)`` when the
+    extension would not fit the shadow's compiled shapes
+    (``info["refused"]`` names every violated bound — honest refusal,
+    never a silent resize).
+
+    Ordinal discipline: every existing actor ordinal, row slot and
+    column plane is PRESERVED (new ones append), so committed state
+    tensors stay addressable. Value ranks CANNOT be preserved — the
+    interner's dense crsql conflict order (io/values.py) is the merge
+    kernel's LWW tiebreak, so the union re-freezes and
+    ``info["old_ranks"]/["new_ranks"]`` carry the translation every
+    rank-typed state plane must apply
+    (:func:`corro_sim.utils.ranks.translate_ranks`; the checkpoint
+    installer's exact remap set: table/vr, own/vr, log cells' vr)."""
+    if any(k is None for k in universe.row_keys):
+        return None, {"refused": [
+            "layout-pinned universe (schema row slots) cannot be "
+            "extended from a scan window"
+        ]}
+    fresh = scan_universe(window_lines, lenient=True)
+
+    actors = dict(universe.actors)
+    for aid in fresh.actors:  # discovery order — deterministic
+        if aid not in actors:
+            actors[aid] = len(actors)
+
+    row_keys = list(universe.row_keys)
+    row_of = dict(universe.row_of)
+    new_rows = sorted(
+        (k for k in fresh.row_of if k not in row_of),
+        key=lambda tp: (tp[0], tuple(sqlite_sort_key(p) for p in tp[1])),
+    )
+    for k in new_rows:
+        row_of[k] = len(row_keys)
+        row_keys.append(k)
+
+    col_keys = dict(universe.col_keys)
+    for (t, cid) in sorted(k for k in fresh.col_keys if k not in col_keys):
+        col_keys[(t, cid)] = sum(1 for (t2, _) in col_keys if t2 == t)
+
+    interner = ValueInterner()
+    for v in universe.values:
+        interner.add(v)
+    for v in fresh.values:
+        interner.add(v)
+    interner.freeze()
+    values = [None] * len(interner)
+    for v in list(universe.values) + list(fresh.values):
+        rk = interner.rank(v)
+        if values[rk] is None:
+            # keep the OLD universe's representatives (readback
+            # stability: a refresh must not flip 1 -> True in reports)
+            values[rk] = v
+
+    s = max(universe.seqs_per_version, min(fresh.seqs_per_version, max_seqs))
+    num_cols = max([p + 1 for p in col_keys.values()], default=1)
+    refused = []
+    if len(actors) > max_actors:
+        refused.append(
+            f"{len(actors)} actors > {max_actors} shadow nodes"
+        )
+    if len(row_keys) > max_rows:
+        refused.append(f"{len(row_keys)} rows > {max_rows} row slots")
+    if num_cols > max_cols:
+        refused.append(f"{num_cols} column planes > {max_cols}")
+    old_ranks = np.arange(len(universe.values), dtype=np.int64)
+    new_ranks = np.asarray(
+        [interner.rank(v) for v in universe.values], np.int64
+    )
+    info = {
+        "refused": refused,
+        "actors_added": len(actors) - universe.num_actors,
+        "rows_added": len(new_rows),
+        "cols_added": len(col_keys) - len(universe.col_keys),
+        "values_added": len(values) - len(universe.values),
+        "seqs_per_version": s,
+        "old_ranks": old_ranks,
+        "new_ranks": new_ranks,
+        "rank_moves": int((old_ranks != new_ranks).sum()),
+    }
+    if refused:
+        return None, info
+    return TraceUniverse(
+        actors=actors, row_of=row_of, row_keys=row_keys,
+        col_keys=col_keys, interner=interner, values=values,
+        seqs_per_version=s,
+    ), info
+
+
 def ingest(lines, layout=None) -> EncodedTrace:
     """Two-phase ingest of an iterable of trace lines (str or parsed).
 
@@ -502,10 +602,20 @@ BAD_STALE_VERSION = "stale_version"  # at/below the injected horizon
 BAD_DUPLICATE = "duplicate"  # second Full changeset for one version
 BAD_OVERSIZED = "oversized"  # more cells than the frozen seq capacity
 
+# A final feed line with NO trailing newline that fails to parse is a
+# TORN TAIL — almost always a writer caught mid-append, not hostile
+# bytes. It is RETRYABLE: a live tail simply waits for the rest of the
+# line (corro_sim/io/feedsource.py never delivers an unterminated
+# line), and the one-shot validation pass (validate_feed) reports it
+# under this reason so callers can distinguish "poll again" from
+# "quarantine forever". A torn line that is NOT final (or that ends in
+# a newline) stays `malformed` — nothing is coming to complete it.
+BAD_TORN_TAIL = "torn_tail"
+
 BAD_REASONS = (
     BAD_MALFORMED, BAD_UNKNOWN_ACTOR, BAD_UNKNOWN_ROW,
     BAD_UNKNOWN_COLUMN, BAD_UNKNOWN_VALUE, BAD_STALE_VERSION,
-    BAD_DUPLICATE, BAD_OVERSIZED,
+    BAD_DUPLICATE, BAD_OVERSIZED, BAD_TORN_TAIL,
 )
 
 # NOT a quarantine reason: an EmptySet entirely at/below the horizon is
@@ -541,6 +651,13 @@ class StreamChunk:
     lines: int  # feed lines consumed this chunk (good + bad)
     late: list = dataclasses.field(default_factory=list)  # benign
     # late clears dropped this chunk (module comment at LATE_CLEAR)
+    late_apply: list = dataclasses.field(default_factory=list)
+    # (actor_ordinal, lo_version, hi_version, ts) ranges from EmptySets
+    # whose versions are at/below the injected horizon — the already-
+    # committed log slots a sync peer should now serve the Empty answer
+    # for. Value-neutral: the superseding content is injected; only the
+    # cleared/cleared_hlc bookkeeping moves (engine/twin.py applies
+    # these host-side after each chunk's injection).
     ts_lo: int | None = None  # earliest `ts` stamp absorbed this chunk
     ts_hi: int | None = None  # latest — (ts_lo, ts_hi) is the chunk's
     # span on the FEED's own clock, what the shadow's sim wall is
@@ -600,6 +717,21 @@ class TraceStream:
     @property
     def bad_lines(self) -> int:
         return sum(self.counters.values())
+
+    # ----------------------------------------------------------- rebind
+    def rebind(self, universe: TraceUniverse) -> None:
+        """Swap in a refreshed (extended) universe mid-stream — the
+        re-key event: new actor ordinals start at horizon 0; every
+        existing ordinal keeps its horizon and counters. The caller
+        owns the matching state-side rank translation
+        (:func:`extend_universe`)."""
+        assert universe.num_actors >= self.universe.num_actors, (
+            "rebind only grows the universe (ordinals are preserved)"
+        )
+        heads = np.zeros(universe.num_actors, np.int64)
+        heads[: len(self.heads)] = self.heads
+        self.universe = universe
+        self.heads = heads
 
     # ---------------------------------------------------- classification
     def _classify(self, ev, book: dict) -> tuple[str, str] | None:
@@ -676,6 +808,7 @@ class TraceStream:
         book: dict[int, dict[int, object]] = {}
         bad: list = []
         late: list = []
+        late_apply: list = []
         n_lines = 0
         ts_lo: int | None = None
         ts_hi: int | None = None
@@ -696,6 +829,14 @@ class TraceStream:
             if verdict is not None:
                 if verdict[0] == LATE_CLEAR:
                     late.append((line_no, *verdict))
+                    # retroactive application: the slot content stays
+                    # (value-neutral) but the cleared bookkeeping moves
+                    # so sync peers serve the Empty answer
+                    ai = uni.actors[ev.actor_id]
+                    late_apply.append((
+                        ai, int(ev.versions[0]), int(ev.versions[1]),
+                        -1 if ev.ts is None else int(ev.ts),
+                    ))
                 else:
                     bad.append((line_no, *verdict))
                 continue
@@ -710,6 +851,14 @@ class TraceStream:
                 )
             if isinstance(ev, TraceEmpty):
                 lo = max(ev.versions[0], int(self.heads[ai]) + 1)
+                if ev.versions[0] < lo:
+                    # the straddling range's already-injected part gets
+                    # the same retroactive clearing a fully-late
+                    # EmptySet does (versions ahead encode normally)
+                    late_apply.append((
+                        ai, int(ev.versions[0]), lo - 1,
+                        -1 if ev.ts is None else int(ev.ts),
+                    ))
                 for v in range(lo, ev.versions[1] + 1):
                     # last-wins, the batch-ingest book rule: a clearing
                     # that follows a Full changeset compacts it (the
@@ -743,7 +892,7 @@ class TraceStream:
                 rounds=0, valid=None, empty=None, ts=None, delete=None,
                 ncells=None, row=None, col=None, vr=None, cv=None,
                 cl=None, bad=bad, lines=n_lines, late=late,
-                ts_lo=ts_lo, ts_hi=ts_hi,
+                late_apply=late_apply, ts_lo=ts_lo, ts_hi=ts_hi,
             )
         slices = int((new_heads - self.heads).max(initial=0))
         valid = np.zeros((slices, a), bool)
@@ -789,7 +938,7 @@ class TraceStream:
             rounds=slices, valid=valid, empty=empty, ts=ts,
             delete=delete, ncells=ncells, row=row, col=col, vr=vr,
             cv=cv, cl=cl, bad=bad, lines=n_lines, late=late,
-            ts_lo=ts_lo, ts_hi=ts_hi,
+            late_apply=late_apply, ts_lo=ts_lo, ts_hi=ts_hi,
         )
 
 
@@ -805,12 +954,28 @@ def validate_feed(lines, universe: TraceUniverse,
     classification is chunk-boundary-dependent (an out-of-order version
     inside one chunk reorders through the pending book; across a
     boundary it is stale), so validating under a different chunking
-    would pass feeds the run then refuses mid-stream, or vice versa."""
+    would pass feeds the run then refuses mid-stream, or vice versa.
+
+    A FINAL line that fails to parse and carries no trailing newline
+    reports as ``torn_tail``, not ``malformed`` — a writer caught
+    mid-append, retryable by polling again, never a poisoned feed
+    (module comment at :data:`BAD_TORN_TAIL`)."""
+    lines = list(lines)
     probe = TraceStream(universe)
     bad: list = []
     for chunk in _chunked(lines, max(1, chunk_lines)):
         out = probe.feed(chunk, skip_bad=True, encode=False)
         bad.extend(out.bad)
+    if (
+        bad and lines and isinstance(lines[-1], str)
+        and not lines[-1].endswith("\n")
+        and bad[-1][0] == len(lines) and bad[-1][1] == BAD_MALFORMED
+    ):
+        no, _reason, detail = bad[-1]
+        bad[-1] = (no, BAD_TORN_TAIL, (
+            f"unterminated final line ({detail}) — retryable: a live "
+            "tail waits for the writer to finish it"
+        ))
     return bad
 
 

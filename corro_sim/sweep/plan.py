@@ -58,6 +58,9 @@ class SweepLane:
     min_rounds: int
     schedule: object = None  # the lane's driver Schedule (attached at
     # plan time, the serial driver's workload write-round rule applied)
+    workload_prebuilt: bool = False  # workload handed in as a built
+    # object (e.g. a trace_workload replay window) rather than a spec —
+    # make_workload cannot re-parse it, so repro_cmd omits --workload
 
     @property
     def cell(self) -> str:
@@ -121,7 +124,7 @@ class SweepLane:
         )
         for k, v in sorted(self.knob_overrides.items()):
             cmd += f" --knob {k}={v:g}"
-        if self.workload is not None:
+        if self.workload is not None and not self.workload_prebuilt:
             cmd += f" --workload '{self.workload.spec}'"
         return cmd
 
@@ -255,6 +258,7 @@ def build_plan(
     write_rounds: int = 16,
     workload_spec: str | None = None,
     fork=None,
+    workload=None,
 ) -> SweepPlan:
     """Compile the grid into a validated :class:`SweepPlan`.
 
@@ -268,10 +272,27 @@ def build_plan(
     schedule shifts into the fork's absolute round frame
     (:func:`corro_sim.config.shift_node_faults`), so "wipe at relative
     round k" fires k rounds after the fork on a ``state.round`` that
-    keeps counting from the twin's timeline."""
+    keeps counting from the twin's timeline.
+
+    ``workload``: a PREBUILT
+    :class:`~corro_sim.workload.generators.Workload` shared by every
+    lane — the coupled-load forecast path
+    (:func:`corro_sim.workload.inject.trace_workload` replaying a live
+    feed's trailing window into a fork). Unlike ``workload_spec`` it
+    composes with ``fork``: the sweep engine plays workload rounds in
+    the SWEEP-relative frame, i.e. immediately after the fork, which is
+    exactly when the replayed traffic happened. Mutually exclusive with
+    ``workload_spec`` (a spec is re-seeded per lane; a prebuilt object
+    is one fixed tape)."""
     knob_combos = knob_combos or [{}]
     errors: list[str] = []
     fork_round = 0
+    prebuilt = workload  # the loop below rebinds `workload` per lane
+    if workload is not None and workload_spec is not None:
+        raise ValueError(
+            "build_plan takes workload_spec (per-lane seeded generator) "
+            "or workload (one prebuilt tape), not both"
+        )
     if fork is not None:
         if not fork.is_fork:
             raise ValueError(
@@ -336,6 +357,14 @@ def build_plan(
                     except (ValueError, AssertionError) as e:
                         errors.append(f"{cell}: {e}")
                         continue
+                elif prebuilt is not None:
+                    try:
+                        prebuilt.validate(cfg)
+                        sc.check_workload(prebuilt)
+                    except (ValueError, AssertionError) as e:
+                        errors.append(f"{cell}: {e}")
+                        continue
+                    workload = prebuilt
                 blackholes.add(tuple(cfg.faults.blackhole))
                 sched = sc.schedule()
                 if (
@@ -351,6 +380,7 @@ def build_plan(
                     index=index, spec=sc.spec, seed=int(seed),
                     knob_overrides=dict(knobs_over), scenario=sc, cfg=cfg,
                     knobs={}, workload=workload,
+                    workload_prebuilt=prebuilt is not None,
                     min_rounds=max(
                         sc.heal_round or 0, write_rounds,
                         workload.rounds if workload is not None else 0,
@@ -381,7 +411,7 @@ def build_plan(
         stale=any(lane.cfg.node_faults.stale for lane in lanes),
         skew=any(lane.cfg.node_faults.skew for lane in lanes),
         straggle=any(lane.cfg.node_faults.straggle for lane in lanes),
-        workload=workload_spec is not None,
+        workload=workload_spec is not None or prebuilt is not None,
     )
     union_cfg = dataclasses.replace(
         base_cfg,

@@ -310,6 +310,57 @@ TWIN_BAD_LINES_HELP = (
 TWIN_FEED_LINES_TOTAL = "corro_twin_feed_lines_total"
 TWIN_DELIVERY_ROUNDS = "corro_twin_delivery_rounds"
 TWIN_FORECAST_LANES_TOTAL = "corro_twin_forecast_lanes_total"
+
+# Live tail + stale-universe refresh (corro_sim/io/feedsource.py,
+# corro_sim/engine/twin.py; doc/twin.md §9):
+#   corro_twin_tail_polls_total{source}    feed polls issued by a live
+#                                          source (file|http)
+#   corro_twin_tail_retries_total{source}  jittered-backoff retries after
+#                                          a missing file / failed request
+#   corro_twin_tail_rotations_total        feed rotations re-bound
+#                                          (inode moved under the tail)
+#   corro_twin_tail_source_deaths_total{reason}
+#                                          sources declared dead past the
+#                                          backoff/idle budget
+#   corro_twin_tail_lag_lines              gauge: lines buffered ahead of
+#                                          the shadow's cursor
+#   corro_twin_refresh_total{trigger}      closed-world re-freezes (the
+#                                          scheduled re-key events)
+#   corro_twin_refresh_epoch               gauge: current refresh epoch
+TWIN_TAIL_POLLS_TOTAL = "corro_twin_tail_polls_total"
+TWIN_TAIL_POLLS_HELP = (
+    "live feed polls issued, by source kind (corro_sim/io/feedsource.py)"
+)
+TWIN_TAIL_RETRIES_TOTAL = "corro_twin_tail_retries_total"
+TWIN_TAIL_RETRIES_HELP = (
+    "jittered exponential-backoff retries against a missing or failing "
+    "live feed source (corro_sim/io/feedsource.py)"
+)
+TWIN_TAIL_ROTATIONS_TOTAL = "corro_twin_tail_rotations_total"
+TWIN_TAIL_ROTATIONS_HELP = (
+    "feed-file rotations the tail re-bound to (inode changed under the "
+    "consumed-prefix sha guard; corro_sim/io/feedsource.py)"
+)
+TWIN_TAIL_SOURCE_DEATHS_TOTAL = "corro_twin_tail_source_deaths_total"
+TWIN_TAIL_SOURCE_DEATHS_HELP = (
+    "live feed sources declared dead, by reason (idle_timeout|"
+    "source_gone|reconnect_budget|truncated; corro_sim/io/feedsource.py)"
+)
+TWIN_TAIL_LAG_LINES = "corro_twin_tail_lag_lines"
+TWIN_TAIL_LAG_LINES_HELP = (
+    "feed lines buffered ahead of the shadow's cursor (bounded by "
+    "twin.max_lag_lines; corro_sim/engine/twin.py)"
+)
+TWIN_REFRESH_TOTAL = "corro_twin_refresh_total"
+TWIN_REFRESH_HELP = (
+    "stale-universe re-freezes (scheduled re-key events), by trigger "
+    "(corro_sim/engine/twin.py)"
+)
+TWIN_REFRESH_EPOCH = "corro_twin_refresh_epoch"
+TWIN_REFRESH_EPOCH_HELP = (
+    "current closed-world refresh epoch of the running twin shadow "
+    "(corro_sim/engine/twin.py)"
+)
 ROUNDS_BUCKETS = (
     0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 48.0,
     64.0, 96.0, 128.0,

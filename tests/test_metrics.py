@@ -709,6 +709,82 @@ def test_twin_families_render_and_validate(cluster):
     _validate_exposition(text)
 
 
+def test_twin_live_families_render_and_validate(cluster):
+    """ISSUE 18 satellite: the live-tail and stale-universe families —
+    per-source-kind poll/retry counters, rotation re-binds, per-reason
+    source deaths, the lag-lines backpressure gauge, per-trigger
+    closed-world refresh counts and the refresh-epoch gauge — render
+    through the exposition and pass the scraper-contract validator.
+    Names, labels, and help strings come from the same utils.metrics
+    constants io/feedsource.py and engine/twin.py emit with, so this
+    coverage cannot drift from the runtime emission."""
+    from corro_sim.io.feedsource import (
+        DEATH_GONE,
+        DEATH_IDLE,
+        DEATH_RECONNECT,
+        DEATH_TRUNCATED,
+    )
+    from corro_sim.utils.metrics import (
+        TWIN_REFRESH_EPOCH,
+        TWIN_REFRESH_EPOCH_HELP,
+        TWIN_REFRESH_HELP,
+        TWIN_REFRESH_TOTAL,
+        TWIN_TAIL_LAG_LINES,
+        TWIN_TAIL_LAG_LINES_HELP,
+        TWIN_TAIL_POLLS_HELP,
+        TWIN_TAIL_POLLS_TOTAL,
+        TWIN_TAIL_RETRIES_HELP,
+        TWIN_TAIL_RETRIES_TOTAL,
+        TWIN_TAIL_ROTATIONS_HELP,
+        TWIN_TAIL_ROTATIONS_TOTAL,
+        TWIN_TAIL_SOURCE_DEATHS_HELP,
+        TWIN_TAIL_SOURCE_DEATHS_TOTAL,
+        counters,
+        gauges,
+    )
+
+    for kind in ("file", "http"):
+        counters.inc(
+            TWIN_TAIL_POLLS_TOTAL, n=7, labels=f'{{source="{kind}"}}',
+            help_=TWIN_TAIL_POLLS_HELP,
+        )
+        counters.inc(
+            TWIN_TAIL_RETRIES_TOTAL, labels=f'{{source="{kind}"}}',
+            help_=TWIN_TAIL_RETRIES_HELP,
+        )
+    counters.inc(TWIN_TAIL_ROTATIONS_TOTAL, help_=TWIN_TAIL_ROTATIONS_HELP)
+    for reason in (DEATH_IDLE, DEATH_GONE, DEATH_RECONNECT, DEATH_TRUNCATED):
+        counters.inc(
+            TWIN_TAIL_SOURCE_DEATHS_TOTAL,
+            labels=f'{{reason="{reason}"}}',
+            help_=TWIN_TAIL_SOURCE_DEATHS_HELP,
+        )
+    gauges.set(TWIN_TAIL_LAG_LINES, 12.0, help_=TWIN_TAIL_LAG_LINES_HELP)
+    for trigger in ("quarantine", "refused"):
+        counters.inc(
+            TWIN_REFRESH_TOTAL, labels=f'{{trigger="{trigger}"}}',
+            help_=TWIN_REFRESH_HELP,
+        )
+    gauges.set(TWIN_REFRESH_EPOCH, 2.0, help_=TWIN_REFRESH_EPOCH_HELP)
+    text = render_prometheus(cluster)
+    for kind in ("file", "http"):
+        assert f'corro_twin_tail_polls_total{{source="{kind}"}} 7' in text
+        assert f'corro_twin_tail_retries_total{{source="{kind}"}}' in text
+    assert "corro_twin_tail_rotations_total 1" in text
+    for reason in (DEATH_IDLE, DEATH_GONE, DEATH_RECONNECT, DEATH_TRUNCATED):
+        assert (
+            f'corro_twin_tail_source_deaths_total{{reason="{reason}"}}'
+            in text
+        ), reason
+    assert "corro_twin_tail_lag_lines 12" in text
+    for trigger in ("quarantine", "refused"):
+        assert (
+            f'corro_twin_refresh_total{{trigger="{trigger}"}}' in text
+        ), trigger
+    assert "corro_twin_refresh_epoch 2" in text
+    _validate_exposition(text)
+
+
 def test_perf_ledger_families_render_and_validate(cluster):
     """ISSUE 16: the perf-ledger gauge families (corro_perf_*) through
     the GaugeRegistry — ledger/series/unmeasured counts, the labeled

@@ -205,8 +205,13 @@ def test_hostile_feed_collects_every_error_into_one(lines):
     # strict refusal is side-effect-free: nothing consumed, no counters
     assert st.lines_seen == 0 and st.counters == {}
 
-    # validate_feed is the twin's up-front pass over the WHOLE feed
+    # validate_feed is the twin's up-front pass over the WHOLE feed.
+    # An unparseable FINAL line with no newline is a torn tail — the
+    # writer may still be mid-write — reported retryable, not hostile
     bad = validate_feed(lines + hostile[:1], uni)
+    assert len(bad) == 1 and bad[0][1] == "torn_tail"
+    # the same junk anywhere BUT the tail stays malformed
+    bad = validate_feed(lines[:5] + hostile[:1] + lines[5:], uni)
     assert len(bad) == 1 and bad[0][1] == "malformed"
 
     # quarantine mode: same lines, counted by reason, good ones encode

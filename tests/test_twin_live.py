@@ -1,0 +1,588 @@
+"""Live twin operator loop (corro_sim/io/feedsource.py + engine/twin.py).
+
+The acceptance anchor: a live-tailed twin over a growing feed is
+BIT-IDENTICAL to file-mode replay of the same lines — state, report,
+headlines and metric series — including across SIGKILL + ``--resume``
+mid-tail and across feed rotation. Around that anchor:
+
+- **tail sources** — torn-tail wait-don't-quarantine, rotation re-bind
+  (inode + consumed-prefix sha), truncation refusal, backoff-budget
+  death, the HTTP ``/v1/changes`` watch against the API relay;
+- **stale-universe refresh** — the windowed quarantine-rate trigger
+  re-freezes the closed world at a chunk boundary, deterministically
+  across kill/resume (the cursor carries the refresh epochs);
+- **retroactive EmptySets** — late clears mark the superseded log slots
+  cleared (``flyio_live.ndjson`` = the committed fixture + a late
+  clear; replay identity pinned);
+- **cadence re-forks** — ``forecast_every`` drives the ``on_cycle``
+  hook with monotone fork rounds, and :func:`trace_workload` folds the
+  trailing window into a coupled forecast load.
+"""
+
+import dataclasses
+import json
+import os
+import pathlib
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from corro_sim.config import TwinConfig
+from corro_sim.engine.twin import (
+    probe_feed_heads,
+    run_twin,
+    save_fork,
+    twin_universe,
+)
+from corro_sim.io.feedsource import (
+    FeedSourceError,
+    FileTailSource,
+    HTTPWatchSource,
+)
+from corro_sim.io.traces import TraceStream
+from corro_sim.workload.inject import trace_workload
+
+FIXTURE = (
+    pathlib.Path(__file__).parent / "fixtures" / "traces"
+    / "flyio_live.ndjson"
+)
+TA1 = "7c2e1a00-0001-4000-8000-000000000001"
+TA2 = "7c2e1a00-0002-4000-8000-000000000002"
+NEW_ACTOR = "7c2e1a00-000e-4000-8000-00000000000e"
+
+FAST = dict(poll_ms=10, reconnect_max_s=0.4, idle_timeout_s=0.5)
+
+
+@pytest.fixture(scope="module")
+def live_lines():
+    with open(FIXTURE, encoding="utf-8") as f:
+        return [ln for ln in f if ln.strip()]
+
+
+def _twin_cfg(lines, scan_lines=0, **twin_kw):
+    uni = twin_universe(lines, scan_lines)
+    heads = probe_feed_heads(lines, uni)
+    overrides = twin_kw.pop("cfg_overrides", {})
+    return dataclasses.replace(
+        uni.suggest_config(
+            rounds=int(heads.max(initial=0)) + 1, **overrides
+        ),
+        twin=TwinConfig(
+            enabled=True, scan_lines=scan_lines, chunk_lines=4,
+            **twin_kw,
+        ),
+    ).validate()
+
+
+def _strip_live(report: dict) -> dict:
+    """Drop the keys that legitimately differ between a live tail and a
+    file-mode replay of the same lines — everything else is pinned."""
+    return {
+        k: v for k, v in report.items()
+        if k not in ("source", "feed", "checkpoint", "resumed_from")
+    }
+
+
+def _assert_bit_identical(a, b):
+    assert _strip_live(a.report) == _strip_live(b.report)
+    assert a.headlines == b.headlines
+    assert set(a.metrics) == set(b.metrics)
+    for k in a.metrics:
+        assert np.array_equal(
+            np.asarray(a.metrics[k]), np.asarray(b.metrics[k])
+        ), k
+    for la, lb in zip(jax.tree.leaves(a.state), jax.tree.leaves(b.state)):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ---------------------------------------------------------- feed sources
+
+def test_file_tail_waits_for_torn_final_line(tmp_path):
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text('{"a": 1}\n{"a": 2}\n{"a": 3')  # torn tail
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        assert src.wait_lines(2) == ['{"a": 1}\n', '{"a": 2}\n']
+        # the torn line is HELD, not delivered and not quarantined
+        assert src.lag_lines == 0 and src.report()["torn_tail"]
+        with open(feed, "a") as f:
+            f.write("3}\n")
+        assert src.wait_lines(1) == ['{"a": 33}\n']
+        assert not src.dead and not src.report()["torn_tail"]
+    finally:
+        src.close()
+
+
+def test_file_tail_rotation_rebinds(tmp_path):
+    feed = tmp_path / "feed.ndjson"
+    lines = [f'{{"n": {i}}}\n' for i in range(10)]
+    feed.write_text("".join(lines[:6]))
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        assert src.wait_lines(4) == lines[:4]
+        # rename-rotation: the old segment keeps its tail; a NEW inode
+        # appears under the path carrying the rest of history
+        os.rename(feed, tmp_path / "feed.ndjson.1")
+        feed.write_text("".join(lines[6:]))
+        got = src.wait_lines(6)
+        assert got == lines[4:10]  # old segment drained, then the new
+        assert src.stats["rotations"] == 1
+        assert not src.dead
+    finally:
+        src.close()
+
+
+def test_file_tail_rotation_superset_copy_resumes_by_sha(tmp_path):
+    feed = tmp_path / "feed.ndjson"
+    lines = [f'{{"n": {i}}}\n' for i in range(6)]
+    feed.write_text("".join(lines[:4]))
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        assert src.wait_lines(4) == lines[:4]
+        # copy-rotation that PRESERVES history: same prefix, new inode
+        os.remove(feed)
+        feed.write_text("".join(lines))
+        assert src.wait_lines(2) == lines[4:]  # no duplicates
+        assert src.stats["lines_delivered"] == 6
+    finally:
+        src.close()
+
+
+def test_file_tail_truncation_refuses(tmp_path):
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text('{"n": 0}\n{"n": 1}\n{"n": 2}\n')
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        assert len(src.wait_lines(3)) == 3
+        with open(feed, "w") as f:  # rewind committed history in place
+            f.write('{"n": 0}\n')
+        with pytest.raises(FeedSourceError, match="truncated"):
+            src.wait_lines(1)
+        assert src.dead and src.death_reason == "truncated"
+    finally:
+        src.close()
+
+
+def test_file_tail_backoff_budget_death(tmp_path):
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text('{"n": 0}\n')
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        assert len(src.wait_lines(1)) == 1
+        os.remove(feed)
+        t0 = time.monotonic()
+        assert src.wait_lines(1) == []  # short return IS the death cue
+        assert src.dead and src.death_reason == "source_gone"
+        assert src.stats["retries"] >= 1
+        # the jittered ladder retried within the budget, not forever
+        assert time.monotonic() - t0 < 10 * FAST["reconnect_max_s"]
+    finally:
+        src.close()
+
+
+def test_idle_timeout_is_the_tails_natural_end(tmp_path):
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text('{"n": 0}\n')
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        assert len(src.wait_lines(1)) == 1
+        assert src.wait_lines(1) == []
+        assert src.dead and src.death_reason == "idle_timeout"
+    finally:
+        src.close()
+
+
+def test_http_watch_source_against_api_relay(tmp_path, live_lines):
+    from corro_sim.api.http import ApiServer
+    from corro_sim.harness.cluster import LiveCluster
+
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text("".join(live_lines[:8]))
+    cluster = LiveCluster(
+        "CREATE TABLE t (id INTEGER PRIMARY KEY, v INTEGER NOT NULL "
+        "DEFAULT 0);",
+        num_nodes=2, default_capacity=16,
+    )
+    try:
+        with ApiServer(cluster, feed_path=str(feed)) as srv:
+            url = f"http://{srv.addr[0]}:{srv.addr[1]}/v1/changes"
+            src = HTTPWatchSource(url, **FAST)
+            assert src.wait_lines(8) == live_lines[:8]
+            # the cursor is the line position: appends resume from it
+            with open(feed, "a") as f:
+                f.write("".join(live_lines[8:]))
+            assert src.wait_lines(3) == live_lines[8:]
+            src.close()
+            # a vanished endpoint consumes the reconnect budget and dies
+        src2 = HTTPWatchSource(url, **FAST)
+        assert src2.wait_lines(1) == []
+        assert src2.dead and src2.death_reason == "reconnect_budget"
+        assert src2.stats["reconnects"] >= 1
+    finally:
+        cluster.tripwire.trip()
+
+
+# ------------------------------------------ the anchor: live == file
+
+def test_tail_mode_bit_identical_to_file_mode(tmp_path, live_lines):
+    cfg = _twin_cfg(live_lines, scan_lines=10)
+    ref = run_twin(cfg=cfg, lines=live_lines, seed=0)
+
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text("".join(live_lines))
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        prefix = src.wait_lines(10)  # the CLI's scan-window wait
+        live = run_twin(cfg=cfg, lines=prefix, seed=0, source=src)
+    finally:
+        src.close()
+    assert live.source is not None and live.source["dead"]
+    assert live.source["death_reason"] == "idle_timeout"
+    _assert_bit_identical(ref, live)
+    # the committed fixture's two late clears applied retroactively:
+    # the superseded slots now serve the Empty answer
+    assert ref.report["late_clears"] == 2
+    assert ref.report["late_applied"] == 2
+    cleared = np.asarray(live.state.log.cleared)
+    assert cleared[0, 2] and cleared[1, 0]  # TA1 v3, TA2 v1
+
+
+def test_tail_bit_identical_across_rotation(tmp_path, live_lines):
+    cfg = _twin_cfg(live_lines, scan_lines=10)
+    ref = run_twin(cfg=cfg, lines=live_lines, seed=0)
+
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text("".join(live_lines[:10]))
+    src = FileTailSource(str(feed), **FAST)
+    try:
+        prefix = src.wait_lines(10)
+        # rotate mid-tail: the remaining line arrives on a NEW inode
+        os.rename(feed, tmp_path / "feed.ndjson.1")
+        feed.write_text("".join(live_lines[10:]))
+        live = run_twin(cfg=cfg, lines=prefix, seed=0, source=src)
+    finally:
+        src.close()
+    assert live.source["rotations"] == 1
+    _assert_bit_identical(ref, live)
+
+
+# ------------------------------------------------- stale-universe refresh
+
+def _refresh_feed(live_lines):
+    """The committed fixture + 8 lines from an actor OUTSIDE the frozen
+    scan window writing values the interner never saw — the stale-
+    universe scenario a long-lived tail hits when the agent fleet
+    changes under it."""
+    web1_pk = [1, 11, 5, 119, 101, 98, 45, 49]
+    extra = []
+    for v in range(1, 9):
+        extra.append(json.dumps({
+            "actor_id": NEW_ACTOR, "version": v,
+            "changes": [{
+                # one repeated value: the re-scan window must cover the
+                # names the POST-refresh lines use (a window that has
+                # never seen a value cannot intern it)
+                "table": "services", "pk": web1_pk, "cid": "name",
+                "val": "refreshed", "col_version": 3 + v,
+                "db_version": v, "seq": 0, "site_id": [0] * 16, "cl": 1,
+            }],
+            "seqs": [0, 0], "last_seq": 0, "ts": 1200 + 10 * v,
+        }) + "\n")
+    return list(live_lines) + extra
+
+
+def _refresh_cfg(feed_lines, **twin_kw):
+    return _twin_cfg(
+        feed_lines, scan_lines=10, skip_bad=True,
+        refresh_threshold=0.5, refresh_window_lines=4,
+        cfg_overrides={"num_nodes": 4},
+        **twin_kw,
+    )
+
+
+def test_quarantine_rate_triggers_refresh(live_lines):
+    feed_lines = _refresh_feed(live_lines)
+    cfg = _refresh_cfg(feed_lines)
+    res = run_twin(cfg=cfg, lines=feed_lines, seed=0)
+    ref = res.report["refresh"]
+    assert ref["epoch"] == 1 and len(ref["events"]) == 1
+    ev = ref["events"][0]
+    assert ev["actors_added"] == 1 and ev["values_added"] >= 1
+    assert ev["window_lines"] >= 4 and ev["at_line"] % 4 == 0
+    # post-refresh the new actor's writes INJECT instead of quarantining
+    assert res.stream.universe.num_actors == 4
+    assert int(res.stream.heads[3]) >= 1
+    assert res.report["bad_by_reason"]["unknown_actor"] < 8
+    # the re-keyed interner re-sorted value ranks: LWW order preserved
+    # via the rank translation (the remapped planes stay consistent —
+    # convergence would break otherwise)
+    assert not res.poisoned and res.converged_round is not None
+
+
+def test_refresh_deterministic_across_kill_resume(live_lines, tmp_path):
+    from corro_sim.io.checkpoint import load_sim_checkpoint
+
+    feed_lines = _refresh_feed(live_lines)
+    cfg = _refresh_cfg(feed_lines, checkpoint_every=1)
+    ckpt = str(tmp_path / "t.ckpt.npz")
+    kill = str(tmp_path / "t.kill.npz")
+
+    def grab(h):
+        # chunk 4's headline lands AFTER the refresh fired at the chunk-3
+        # boundary: the copied token carries refresh epoch 1 mid-feed
+        if h["chunk"] == 4 and pathlib.Path(ckpt).exists():
+            shutil.copy(ckpt, kill)
+
+    full = run_twin(
+        cfg=cfg, lines=feed_lines, seed=0, checkpoint_path=ckpt,
+        on_chunk=grab,
+    )
+    assert full.report["refresh"]["epoch"] == 1
+    tok = load_sim_checkpoint(kill)
+    assert tok.meta["twin"]["refresh_epoch"] == 1
+    resumed = run_twin(
+        cfg=cfg, lines=feed_lines, seed=0, resume=tok,
+    )
+    _assert_bit_identical(full, resumed)
+    assert resumed.report["refresh"] == full.report["refresh"]
+
+
+def test_refresh_refuses_when_extension_cannot_fit(live_lines):
+    # same trigger, but NO node headroom: the extension refuses loudly
+    # and the shadow keeps quarantining — never a silent shape change
+    feed_lines = _refresh_feed(live_lines)
+    cfg = _twin_cfg(
+        feed_lines, scan_lines=10, skip_bad=True,
+        refresh_threshold=0.5, refresh_window_lines=4,
+    )
+    assert cfg.num_nodes == 3
+    res = run_twin(cfg=cfg, lines=feed_lines, seed=0)
+    assert res.report["refresh"]["epoch"] == 0
+    assert res.report["refresh"]["refused"]
+    assert "actor" in res.report["refresh"]["refused"][0]["reasons"][0]
+    assert res.report["bad_by_reason"]["unknown_actor"] == 8
+
+
+# ------------------------------------------------------ cadence re-forks
+
+def test_cadence_hook_runs_every_n_chunks_with_monotone_rounds(
+    live_lines, tmp_path,
+):
+    from corro_sim.io.checkpoint import load_sim_checkpoint
+
+    cfg = _twin_cfg(live_lines, scan_lines=10, forecast_every=2,
+                    checkpoint_every=1)
+    calls = []
+
+    def on_cycle(ctx):
+        calls.append(ctx)
+        return {"trend": {
+            "fork_round": ctx["round"], "projected": True, "cells": [],
+        }}
+
+    ckpt = str(tmp_path / "c.ckpt.npz")
+    res = run_twin(
+        cfg=cfg, lines=live_lines, seed=0, on_cycle=on_cycle,
+        checkpoint_path=ckpt,
+    )
+    # 11 lines / 4 per chunk = 3 chunks; cadence 2 fires at chunk 2 only
+    assert [c["chunk"] for c in calls] == [2]
+    assert res.trend == [{
+        "fork_round": calls[0]["round"], "projected": True, "cells": [],
+    }]
+    # the window_chunks handed to the hook are the chunks SINCE the
+    # last cycle — the coupled-forecast replay window
+    assert sum(ch.rounds for ch in calls[0]["window_chunks"]) > 0
+    # the trend point rides the cursor: a resumed twin keeps its history
+    tok = load_sim_checkpoint(ckpt)
+    assert tok.meta["twin"]["trend"] == res.trend
+
+    cfg1 = _twin_cfg(live_lines, scan_lines=10, forecast_every=1)
+    calls.clear()
+    run_twin(cfg=cfg1, lines=live_lines, seed=0, on_cycle=on_cycle)
+    rounds = [c["round"] for c in calls]
+    assert [c["chunk"] for c in calls] == [1, 2, 3]
+    assert rounds == sorted(rounds)  # re-forks march forward in time
+
+
+def test_cadence_hook_exceptions_do_not_kill_the_shadow(live_lines):
+    # the CLI degrades a failed forecast cycle to a stderr note; the
+    # engine side of that contract is that on_cycle's RETURN drives the
+    # trend and nothing else — a None return is simply no point
+    cfg = _twin_cfg(live_lines, scan_lines=10, forecast_every=1)
+    res = run_twin(
+        cfg=cfg, lines=live_lines, seed=0, on_cycle=lambda ctx: None,
+    )
+    assert res.trend == [] and not res.poisoned
+
+
+def test_trace_workload_folds_feed_window(live_lines):
+    cfg = _twin_cfg(live_lines, scan_lines=0)
+    st = TraceStream(twin_universe(live_lines, 0))
+    chunks = [st.feed(live_lines[i:i + 4]) for i in range(0, 12, 4)]
+    wl = trace_workload(chunks, cfg)
+    assert wl is not None and wl.name == "trace_window"
+    wl.validate(cfg)
+    # value changesets fold; the pure-DELETE drops, counted (the two
+    # EmptySets are LATE clears — they never reach the encoder at all)
+    assert wl.total_writes == 8
+    assert wl.total_deletes == 0
+    ev = wl.events[0][2]
+    assert ev["dropped_sets"] == 1  # checks __crsql_del
+    # an all-drop window folds to None, never an empty tape
+    empty = st.feed([])
+    assert trace_workload([empty], cfg) is None
+
+
+def test_build_plan_prebuilt_workload_composes_with_fork(
+    live_lines, tmp_path,
+):
+    from corro_sim.config import FaultConfig, NodeFaultConfig
+    from corro_sim.sweep.plan import build_plan
+
+    cfg = _twin_cfg(live_lines, scan_lines=0)
+    res = run_twin(cfg=cfg, lines=live_lines, seed=0)
+    tok = save_fork(
+        str(tmp_path / "f.npz"), cfg=res.cfg, state=res.state,
+        seed=0, rounds=res.rounds, lines_seen=res.stream.lines_seen,
+    )
+    st = TraceStream(twin_universe(live_lines, 0))
+    wl = trace_workload([st.feed(live_lines)], cfg)
+    base = dataclasses.replace(
+        tok.cfg, faults=FaultConfig(), node_faults=NodeFaultConfig(),
+        write_rate=0.0,
+    ).validate()
+    plan = build_plan(
+        base, ["lossy:p=0.3"], [0, 1], rounds=16, write_rounds=0,
+        fork=tok, workload=wl,
+    )
+    assert plan.union_cfg.sweep.workload
+    for lane in plan.lanes:
+        assert lane.workload is wl and lane.workload_prebuilt
+        assert lane.min_rounds >= wl.rounds
+        # a prebuilt tape has no re-parseable spec: repro omits it
+        assert "--workload" not in lane.repro_cmd(
+            base, 16, 0, 64, 8, fork_path=tok.path,
+        )
+    # spec + prebuilt together is ambiguous — refused up front
+    with pytest.raises(ValueError, match="not both"):
+        build_plan(
+            base, ["lossy:p=0.3"], [0], workload_spec="uniform:n=4",
+            workload=wl,
+        )
+
+
+# --------------------------------------------- the CLI operator surface
+
+def _cli(*argv, **kw):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-m", "corro_sim.cli", *argv],
+        capture_output=True, text=True, env=env, timeout=300, **kw,
+    )
+
+
+def _popen(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "corro_sim.cli", *argv],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+    )
+
+
+TAIL_FLAGS = (
+    "--scan-lines", "10", "--chunk-lines", "4", "--log-capacity", "8",
+    "--skip-bad",
+)
+FAST_TAIL = (
+    "--tail", "--tail-poll-ms", "20", "--idle-timeout-s", "1.5",
+    "--reconnect-max-s", "1",
+)
+
+
+@pytest.mark.slow
+def test_cli_tail_sigkill_resume_bit_identical(tmp_path, live_lines):
+    """The acceptance anchor end to end: tail a growing feed, SIGKILL
+    the twin mid-tail, resume from its cursor against the completed
+    feed — the final report equals the file-mode replay's."""
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text("".join(live_lines))
+    ref_out = tmp_path / "ref.json"
+    p = _cli("twin", str(feed), *TAIL_FLAGS, "--out", str(ref_out))
+    assert p.returncode == 0, p.stderr
+
+    live_feed = tmp_path / "live.ndjson"
+    live_feed.write_text("".join(live_lines[:10]))
+    ckpt = tmp_path / "live.ckpt.npz"
+    out = tmp_path / "live.json"
+    proc = _popen(
+        "twin", str(live_feed), *TAIL_FLAGS, *FAST_TAIL,
+        "--idle-timeout-s", "120",  # outlive the kill window
+        "--checkpoint", str(ckpt), "--out", str(out),
+    )
+    try:
+        deadline = time.monotonic() + 240
+        while not ckpt.exists() and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert ckpt.exists(), "no cursor checkpoint before the kill"
+        os.kill(proc.pid, signal.SIGKILL)
+    finally:
+        proc.wait(timeout=30)
+    # the feed finishes while the twin is dead; --resume --tail picks
+    # up from the cursor and shadows the remainder live
+    with open(live_feed, "a") as f:
+        f.write("".join(live_lines[10:]))
+    p = _cli(
+        "twin", str(live_feed), *TAIL_FLAGS, *FAST_TAIL,
+        "--resume", str(ckpt), "--out", str(out),
+    )
+    assert p.returncode == 5, p.stderr  # the tail's normal end
+    ref = json.loads(ref_out.read_text())
+    live = json.loads(out.read_text())
+    assert live["source"]["death_reason"] == "idle_timeout"
+    assert _strip_live(ref) == _strip_live(live)
+
+
+@pytest.mark.slow
+def test_cli_tail_source_death_exits_5_with_report(tmp_path, live_lines):
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text("".join(live_lines[:10]))
+    out = tmp_path / "dead.json"
+    ckpt = tmp_path / "dead.ckpt.npz"
+    proc = _popen(
+        "twin", str(feed), *TAIL_FLAGS, *FAST_TAIL,
+        "--idle-timeout-s", "120", "--reconnect-max-s", "2",
+        "--checkpoint", str(ckpt), "--out", str(out),
+    )
+    deadline = time.monotonic() + 240
+    while not ckpt.exists() and time.monotonic() < deadline:
+        time.sleep(0.1)
+    assert ckpt.exists()
+    os.remove(feed)  # the agent vanishes; the backoff budget drains
+    rc = proc.wait(timeout=120)
+    assert rc == 5
+    rep = json.loads(out.read_text())
+    assert rep["source"]["death_reason"] == "source_gone"
+    assert rep["source"]["retries"] >= 1
+    assert rep["checkpoint"]  # the cursor survives for --resume
+
+
+def test_cli_tail_requires_scan_window(tmp_path, live_lines):
+    feed = tmp_path / "feed.ndjson"
+    feed.write_text("".join(live_lines))
+    p = _cli("twin", str(feed), "--tail")
+    assert p.returncode == 2
+    assert "scan-lines" in p.stderr
+
+
+def test_refresh_threshold_requires_skip_bad():
+    with pytest.raises(AssertionError, match="skip_bad"):
+        TwinConfig(
+            enabled=True, refresh_threshold=0.2,
+        ).validate()
