@@ -1135,6 +1135,75 @@ def run_config_8(nodes: int | None = None) -> dict:
         e["lanes_active"] for e in occ["curve"]
     ]
 
+    # --- compaction A/B (ISSUE 19): the SAME grid through the fleet
+    # scheduler — lane compaction + pending-grid refill + pipelined
+    # dispatch — in the same artifact as the lockstep number, so the
+    # ledger carries the before (wasted_frozen_lane_rounds above) and
+    # the after side by side. Width deliberately below the lane count:
+    # a non-empty pending queue is what exercises refill and makes
+    # occupancy-while-pending a measurable claim.
+    width = int(os.environ.get(
+        "CORRO_BENCH_SWEEP_WIDTH", str(max(1, plan.num_lanes // 2))
+    ))
+    res_c = run_sweep(
+        plan, max_rounds=1024, chunk=16,
+        compact=True, width=width, pipeline=True,
+    )
+    occ_c = fleet_occupancy(res_c)
+    pending_entries = [
+        e for e in occ_c["curve"]
+        if e.get("pending", 0) > 0 and e.get("width")
+    ]
+    mean_occ_pending = (
+        round(sum(e["lanes_active"] / e["width"]
+                  for e in pending_entries) / len(pending_entries), 4)
+        if pending_entries else None
+    )
+    cps_c = res_c.clusters_per_second_per_device
+    compact = {
+        "metric": "sweep_compact_clusters_per_sec_per_device",
+        "clusters_per_sec_per_device": (
+            round(cps_c, 3) if cps_c is not None else None
+        ),
+        "unit": "clusters/s/device",
+        "width": width,
+        "sweep_wall_s": round(res_c.wall_seconds, 3),
+        "sweep_compile_s": round(res_c.compile_seconds, 3),
+        "dispatches": res_c.dispatches,
+        "occupancy": {
+            k: occ_c[k]
+            for k in (
+                "lanes", "dispatches", "executed_lane_rounds",
+                "useful_lane_rounds", "wasted_frozen_lane_rounds",
+                "occupancy_ratio",
+            )
+        },
+        "occupancy_curve": [
+            {k: e[k] for k in
+             ("lanes_active", "width", "pending", "refills")
+             if k in e}
+            for e in occ_c["curve"]
+        ],
+        "mean_occupancy_while_pending": mean_occ_pending,
+        "refills": (res_c.compaction or {}).get("refills"),
+        "shrinks": (res_c.compaction or {}).get("shrinks"),
+        "max_pending": (res_c.compaction or {}).get("max_pending"),
+        "pipeline": res_c.pipeline,
+        "speedup_vs_lockstep": (
+            round(res.wall_seconds / res_c.wall_seconds, 2)
+            if res_c.wall_seconds > 0 else None
+        ),
+        # honesty guard: the A/B is only a speedup claim if the compact
+        # run reached the identical per-lane outcomes (full bit-identity
+        # is the test suite's job — tests/test_sweep.py twin grid)
+        "matches_lockstep": all(
+            a.converged_round == b.converged_round
+            and a.poisoned == b.poisoned
+            and a.rounds == b.rounds
+            for a, b in zip(res.lanes, res_c.lanes)
+        ),
+    }
+
     # the serial reference lane: the grid's first scenario at seed 0,
     # run through the exact path the sequential soak loop dispatches
     ref = plan.lanes[0]
@@ -1180,6 +1249,7 @@ def run_config_8(nodes: int | None = None) -> dict:
         ),
         "frontier": frontier,
         "occupancy": occupancy,
+        "compact": compact,
         "all_settled": all(
             lr.converged_round is not None and not lr.poisoned
             for lr in res.lanes

@@ -158,31 +158,45 @@ def _cmd_run(args: argparse.Namespace) -> int:
         cfg = scenario.apply(cfg)
         schedule = scenario.schedule()
     if getattr(args, "knob", None):
-        # `--knob loss=0.2` link-fault threshold overrides — the sweep
-        # frontier's worst-seed repro surface (corro_sim/sweep/plan.py
-        # repro_cmd): a knob-axis grid cell reproduces as one serial
-        # run with the same override applied on top of the scenario
-        from corro_sim.sweep import SWEEP_KNOB_FIELDS
+        # `--knob loss=0.2` / `--knob write_rate=0.3` knob overrides —
+        # the sweep frontier's worst-seed repro surface (corro_sim/
+        # sweep/plan.py repro_cmd): a knob-axis grid cell reproduces as
+        # one serial run with the same override applied on top of the
+        # scenario. Link-fault fields land on cfg.faults; SimConfig
+        # sim-knob fields (write_rate/zipf_alpha/sync_interval/...)
+        # land on cfg itself, int-cast where the field is integral.
+        from corro_sim.sweep import SIM_KNOB_FIELDS, SWEEP_KNOB_FIELDS
+        from corro_sim.sweep.plan import _SIM_INT_FIELDS
 
-        overrides = {}
+        fault_over, sim_over = {}, {}
         for kv in args.knob:
             field, _, value = kv.partition("=")
             try:
                 num = float(value)
             except ValueError:
                 num = None
-            if field not in SWEEP_KNOB_FIELDS or num is None:
+            if field in SWEEP_KNOB_FIELDS and num is not None:
+                fault_over[field] = num
+            elif field in SIM_KNOB_FIELDS and num is not None:
+                sim_over[field] = (
+                    int(num) if field in _SIM_INT_FIELDS else num
+                )
+            else:
                 print(
                     f"error: --knob {kv!r} (expected field=value with "
-                    f"field one of {', '.join(SWEEP_KNOB_FIELDS)} and "
-                    "a numeric value)",
+                    "field one of "
+                    f"{', '.join(SWEEP_KNOB_FIELDS + SIM_KNOB_FIELDS)} "
+                    "and a numeric value)",
                     file=sys.stderr,
                 )
                 return 2
-            overrides[field] = num
-        cfg = dataclasses.replace(
-            cfg, faults=dataclasses.replace(cfg.faults, **overrides)
-        ).validate()
+        if fault_over:
+            cfg = dataclasses.replace(
+                cfg, faults=dataclasses.replace(cfg.faults, **fault_over)
+            )
+        if sim_over:
+            cfg = dataclasses.replace(cfg, **sim_over)
+        cfg = cfg.validate()
     if fork_tok is not None and cfg.node_faults.enabled:
         # the what-if frame shift (config.shift_node_faults): the forked
         # state's round counter continues the twin's timeline, so
@@ -975,6 +989,14 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         from corro_sim.engine.sharding import make_sweep_mesh
 
         mesh = make_sweep_mesh(plan.num_lanes)
+        if args.compact or args.pipeline:
+            from corro_sim.engine.sharding import check_compact_mesh
+
+            try:
+                check_compact_mesh(mesh)
+            except ValueError as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
         print(
             f"# mesh: {plan.num_lanes} lanes over "
             f"{dict(mesh.shape)}", file=sys.stderr,
@@ -991,11 +1013,17 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         if args.progress:
             # per-chunk lane-state line (fleet observatory): one char
             # per lane — A racing, C bit-frozen converged, P poisoned
+            # (+ Q queued and width/pending under the fleet scheduler)
+            fleet = (
+                f" | w{p['width']} q{p['pending']}"
+                if "width" in p else ""
+            )
             print(
                 f"# chunk {p['chunk']}: rounds {p['rounds_done']} | "
                 f"{p['lanes_active']}A {p['lanes_converged']}C "
                 f"{p['lanes_poisoned']}P | wasted "
-                f"{p['wasted_lane_rounds_total']} frozen lane-rounds | "
+                f"{p['wasted_lane_rounds_total']} frozen lane-rounds"
+                f"{fleet} | "
                 f"{p['lane_states']} ({p['chunk_wall_s']}s)",
                 file=sys.stderr, flush=True,
             )
@@ -1012,6 +1040,8 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
         res = run_sweep(
             plan, max_rounds=args.max_rounds, chunk=args.chunk,
             mesh=mesh, on_chunk=_on_chunk,
+            compact=args.compact, width=args.width,
+            pipeline=bool(args.pipeline),
         )
     frontier = build_frontier(res.lanes)
     thresholds = load_thresholds()
@@ -1075,6 +1105,12 @@ def _cmd_sweep(args: argparse.Namespace) -> int:
     )
 
     report["occupancy"] = fleet_occupancy(res)
+    if res.compaction is not None:
+        # fleet-scheduler provenance: bucket widths visited, refill/
+        # shrink counts, and the slot-reuse ledger (doc/sweeping.md)
+        report["compaction"] = res.compaction
+    if res.pipeline is not None:
+        report["pipeline"] = res.pipeline
     if args.flight_dir:
         paths = write_lane_flights(
             demux_flights(plan, res, breaches=breaches),
@@ -2464,6 +2500,29 @@ def build_parser() -> argparse.ArgumentParser:
              "batch data parallelism; doc/sweeping.md)",
     )
     psw.add_argument(
+        "--compact", action="store_true",
+        help="the fleet scheduler (doc/sweeping.md §fleet-scheduler): "
+             "evict settled lanes at chunk boundaries, refill their "
+             "slots from the pending-grid queue, shrink the batch to "
+             "power-of-2 buckets once the queue drains — every lane "
+             "stays bit-identical to its serial twin; does not compose "
+             "with --mesh",
+    )
+    psw.add_argument(
+        "--width", type=int, metavar="W",
+        help="cap the compacted lane-batch width (rounded up to a "
+             "power-of-2 bucket; lanes beyond it queue). Default: the "
+             "whole grid in one batch",
+    )
+    psw.add_argument(
+        "--pipeline", action="store_true",
+        help="speculative dispatch: enqueue chunk N+1 before chunk N's "
+             "convergence scalar lands (predicted on 'no lane "
+             "settles'; a mispredict discards the speculative result "
+             "and re-dispatches, so committed chunks are exactly the "
+             "sequential ones — doc/performance.md §chunk-pipelining)",
+    )
+    psw.add_argument(
         "--frontier", nargs="?", const="FRONTIER.json", metavar="PATH",
         help="write the resilience-frontier artifact (per-cell "
              "worst/p95 over seeds + worst-seed repro commands) to "
@@ -2497,7 +2556,7 @@ def build_parser() -> argparse.ArgumentParser:
              "rides the sweep's perf-ledger record",
     )
     psw.add_argument("--out", help="also write the full report JSON here")
-    psw.set_defaults(fn=_cmd_sweep, pipeline=None)
+    psw.set_defaults(fn=_cmd_sweep)
 
     pt2 = sub.add_parser(
         "twin",

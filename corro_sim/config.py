@@ -380,6 +380,12 @@ class SweepConfig:
     # inputs AND traces the sampler, selecting per lane by the
     # use_workload knob — so schedule-driven and sampler-driven lanes
     # mix in one dispatch
+    sim_knobs: bool = False  # per-lane SimConfig scalars beyond the
+    # link-fault set: write_rate / delete_rate as traced f32 thresholds
+    # and sync_interval / swim_suspect_rounds as traced i32 cadences
+    # (knobs.SIM_KNOB_FIELDS). zipf_alpha needs no gate at all — it
+    # only shapes the host-precomputed row_cdf plane, so a zipf axis is
+    # a pure per-lane data swap with zero program change.
 
     @property
     def enabled(self) -> bool:
@@ -401,6 +407,7 @@ class SweepConfig:
             assert not (
                 self.link_faults or self.burst or self.wipes or self.stale
                 or self.skew or self.straggle or self.workload
+                or self.sim_knobs
             ), "sweep gates need lanes > 0"
         return self
 

@@ -316,6 +316,15 @@ def _dbuf_copy(tree):
     return _dbuf_copy_runner()(tree)
 
 
+# Speculative-dispatch counter names — shared with the sweep engine's
+# pipelined lane-batched loop (corro_sim/sweep/engine.py), which applies
+# this module's PR 4 protocol (dispatch chunk N+1 before chunk N's
+# convergence fetch lands; discard + re-dispatch on mispredict; commit
+# strictly in order) to the fleet scheduler's chunk dispatches.
+PIPELINE_SPECULATIVE_TOTAL = "corro_pipeline_speculative_total"
+PIPELINE_SPECULATIVE_WASTED = "corro_pipeline_speculative_wasted_total"
+
+
 @dataclasses.dataclass
 class _InFlight:
     """One dispatched-but-unprocessed chunk riding the device queue."""
@@ -1221,7 +1230,7 @@ def run_sim(
                     )
                     spec_dispatched += 1
                     counters.inc(
-                        "corro_pipeline_speculative_total",
+                        PIPELINE_SPECULATIVE_TOTAL,
                         help_="chunks dispatched before the previous "
                               "chunk's convergence scalar landed",
                     )
@@ -1281,7 +1290,7 @@ def run_sim(
                         reason = "poisoned" if poisoned else "converged"
                         spec_wasted += 1
                         counters.inc(
-                            "corro_pipeline_speculative_wasted_total",
+                            PIPELINE_SPECULATIVE_WASTED,
                             labels=f'{{reason="{reason}"}}',
                             help_="speculative chunk results discarded, "
                                   "by reason",
@@ -1309,7 +1318,7 @@ def run_sim(
                 if actual_repair != nxt.use_repair:
                     spec_wasted += 1
                     counters.inc(
-                        "corro_pipeline_speculative_wasted_total",
+                        PIPELINE_SPECULATIVE_WASTED,
                         labels='{reason="program_switch"}',
                         help_="speculative chunk results discarded, "
                               "by reason",

@@ -57,6 +57,25 @@ def make_sweep_mesh(lanes: int, devices=None, node_shards: int = 1) -> Mesh:
     return Mesh(grid, axis_names=("sweep", "nodes"))
 
 
+def check_compact_mesh(mesh: Mesh | None) -> None:
+    """Refuse mesh + compacted/pipelined sweep dispatch (sweep/engine.py
+    ``run_sweep(compact=..., pipeline=...)``). Compaction re-packs the
+    lane axis into power-of-2 buckets at chunk boundaries, so the batch
+    width changes mid-run; an AOT-per-width executable set and GSPMD
+    lane sharding would need width % devices == 0 at EVERY bucket and a
+    resharding device_put per re-pack. Until a PR pays that cost, the
+    fleet scheduler runs unsharded — raising here (the sharding layer,
+    where the divisibility rule lives) beats a shape error mid-sweep."""
+    if mesh is not None and mesh.size > 1:
+        raise ValueError(
+            "compacted/pipelined sweep dispatch does not compose with a "
+            "device mesh: lane-batch widths change at re-pack "
+            "boundaries (power-of-2 buckets), which breaks the static "
+            "width-divides-devices sharding rule. Drop --mesh-lanes or "
+            "drop --compact/--pipeline."
+        )
+
+
 def sweep_state_shardings(cfg, stacked, mesh: Mesh):
     """Shardings for the ``(L, ...)``-stacked sweep carry: every leaf's
     leading lane axis over the mesh's ``sweep`` axis; when the mesh

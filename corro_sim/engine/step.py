@@ -212,6 +212,12 @@ def sim_step(
     # become traced per-lane data. cfg.sweep off (every existing
     # config) touches nothing: the program is byte-identical.
     sw = state.features["sweep_knobs"] if cfg.sweep.enabled else None
+    # SimConfig scalar knobs (sweep/knobs.py SIM_KNOB_FIELDS): the
+    # write/delete thresholds and the sync/SWIM cadences read the leaf
+    # instead of the baked constant when the sim_knobs gate is armed —
+    # same comparisons, traced operands, so each lane stays
+    # value-identical to the serial twin that bakes its value.
+    sim_sw = sw if (sw is not None and cfg.sweep.sim_knobs) else None
 
     # ---------------------------------------------- node-lifecycle faults
     # (faults/nodes.py): scheduled crash-restart wipes / stale-rejoin
@@ -272,7 +278,10 @@ def sim_step(
         w_del = w_del & writers
     else:
         writers = (
-            (jax.random.uniform(k_write, (n,)) < cfg.write_rate)
+            (jax.random.uniform(k_write, (n,)) < (
+                sim_sw["write_rate"] if sim_sw is not None
+                else cfg.write_rate
+            ))
             & alive
             & write_enable
         )
@@ -280,7 +289,9 @@ def sim_step(
         w_row = jnp.searchsorted(state.row_cdf, u).astype(jnp.int32).clip(
             0, cfg.num_rows - 1
         )
-        w_del = (jax.random.uniform(k_del, (n,)) < cfg.delete_rate) & writers
+        w_del = (jax.random.uniform(k_del, (n,)) < (
+            sim_sw["delete_rate"] if sim_sw is not None else cfg.delete_rate
+        )) & writers
 
         # Cells: 1..S distinct columns of the written row (a transaction
         # touching several columns — each cell is a seq-numbered Change). The
@@ -588,7 +599,10 @@ def sim_step(
 
     # ----------------------------------------------------------------- SWIM
     swim, swim_metrics = _swim_block(
-        cfg, state.swim, k_swim, alive, reach, state.round
+        cfg, state.swim, k_swim, alive, reach, state.round,
+        suspect_rounds=(
+            sim_sw["swim_suspect_rounds"] if sim_sw is not None else None
+        ),
     )
 
     # last_cleared_ts analog, HLC-gated (handlers.rs:524-719): applying an
@@ -600,7 +614,8 @@ def sim_step(
     ].max(cleared_hlc[g_actor, g_slot], mode="drop")
 
     # ----------------------------------------------------------------- sync
-    is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
+    si = sim_sw["sync_interval"] if sim_sw is not None else cfg.sync_interval
+    is_sync = (state.round % si) == (si - 1)
     if cfg.sync_adaptive:
         # accelerated repair: when the cluster quiesces (zero writes this
         # round) but somebody is still behind, sync on the floor cadence
@@ -728,12 +743,15 @@ def _pairwise_mask(alive: jnp.ndarray, part: jnp.ndarray):
 # clock update live here once so the two paths cannot drift.
 
 
-def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
+def _swim_block(cfg, swim_state, k_swim, alive, reach, round_,
+                suspect_rounds=None):
     """The SWIM cadence: tick every ``swim_interval``-th round.
 
     foca probes every 1-5 s vs the 500 ms broadcast flush — SWIM ticking
     every k-th gossip round is the faithful ratio AND cuts the (N, N)
-    plane traffic k-fold (config.swim_interval)."""
+    plane traffic k-fold (config.swim_interval). ``suspect_rounds``
+    (sweep sim_knobs) overrides the baked suspicion timeout with a
+    traced per-lane scalar."""
     if not cfg.swim_enabled:
         return swim_state, {
             "swim_suspects": jnp.int32(0),
@@ -747,11 +765,13 @@ def _swim_block(cfg, swim_state, k_swim, alive, reach, round_):
     else:
         step_fn = swim_step
     if cfg.swim_interval <= 1:
-        return step_fn(cfg, swim_state, k_swim, alive, reach, round_)
+        return step_fn(cfg, swim_state, k_swim, alive, reach, round_,
+                       suspect_rounds=suspect_rounds)
 
     def tick_swim(args):
         sw, k = args
-        return step_fn(cfg, sw, k, alive, reach, round_)
+        return step_fn(cfg, sw, k, alive, reach, round_,
+                       suspect_rounds=suspect_rounds)
 
     def skip_swim(args):
         sw, _ = args
@@ -904,6 +924,7 @@ def _repair_step(
     # runs the full step so every lane can write/wipe at any chunk —
     # but the two programs must stay trace-equivalent under ANY config)
     sw = state.features["sweep_knobs"] if cfg.sweep.enabled else None
+    sim_sw = sw if (sw is not None and cfg.sweep.sim_knobs) else None
 
     # node-lifecycle faults: the identical prologue the full step runs
     # (masks are pure functions of the round counter — no keys), so a
@@ -957,11 +978,15 @@ def _repair_step(
 
     # SWIM keeps its tick cadence through the tail (shared block)
     swim, swim_metrics = _swim_block(
-        cfg, state.swim, k_swim, alive, reach, state.round
+        cfg, state.swim, k_swim, alive, reach, state.round,
+        suspect_rounds=(
+            sim_sw["swim_suspect_rounds"] if sim_sw is not None else None
+        ),
     )
 
     # ----------------------------------------------------------------- sync
-    is_sync = (state.round % cfg.sync_interval) == (cfg.sync_interval - 1)
+    si = sim_sw["sync_interval"] if sim_sw is not None else cfg.sync_interval
+    is_sync = (state.round % si) == (si - 1)
     if cfg.sync_adaptive:
         # quiesced is identically True here (no writers by precondition)
         floor_hit = (state.round % cfg.sync_floor_rounds) == (

@@ -270,6 +270,8 @@ def swim_step(
     alive: jnp.ndarray,  # (N,) ground-truth up mask
     reachable,  # callable (src, dst) -> bool mask, ground truth links
     round_idx: jnp.ndarray,
+    suspect_rounds=None,  # traced per-lane override (sweep sim_knobs);
+    # None = the baked cfg.swim_suspect_rounds constant
 ):
     """One SWIM protocol round for every node at once."""
     p = swim.p
@@ -321,7 +323,10 @@ def swim_step(
     elapsed = (rnd - (p & lo.since_mask)) & lo.since_mask  # mod-2^k
     timed_out = (
         (status_pl == 1)
-        & (elapsed >= cfg.swim_suspect_rounds)
+        & (elapsed >= (
+            cfg.swim_suspect_rounds if suspect_rounds is None
+            else suspect_rounds.astype(lo.dtype)
+        ))
         & alive[:, None]
     )
     p = jnp.where(

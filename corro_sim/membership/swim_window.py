@@ -205,6 +205,8 @@ def swim_window_step(
     alive: jnp.ndarray,
     reachable,  # callable (src, dst) -> bool mask, ground truth links
     round_idx: jnp.ndarray,
+    suspect_rounds=None,  # traced per-lane override (sweep sim_knobs);
+    # None = the baked cfg.swim_suspect_rounds constant
 ):
     """One windowed SWIM round for every node at once."""
     n, k = st.member.shape
@@ -260,7 +262,10 @@ def swim_window_step(
     elapsed = (rnd - (st.belief & lo.since_mask)) & lo.since_mask
     timed_out = (
         (_status(st.belief) == 1)
-        & (elapsed >= cfg.swim_suspect_rounds)
+        & (elapsed >= (
+            cfg.swim_suspect_rounds if suspect_rounds is None
+            else suspect_rounds.astype(st.belief.dtype)
+        ))
         & alive[:, None]
         & (st.member >= 0)
     )

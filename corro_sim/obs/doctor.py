@@ -395,13 +395,50 @@ def _rule_cold_compile_dominated(ev):
 
 
 def _rule_occupancy_collapse(ev):
-    """Most executed lane-rounds were wasted on frozen lanes."""
+    """Most executed lane-rounds were wasted on frozen lanes.
+
+    Compaction semantics (curve entries carrying ``width``/``pending``
+    — the fleet scheduler, sweep --compact): low occupancy WHILE the
+    pending-grid queue still held work is a scheduler bug — a slot sat
+    frozen when a queued lane could have stolen it — and escalates to
+    CRITICAL naming the guilty dispatches. Low occupancy with the queue
+    drained is the normal tail (the last survivors racing in a bucket
+    that cannot shrink below their count) and can never trip a
+    critical; it stays the legacy warning. Lockstep reports (no width
+    key) keep today's warning unchanged."""
     act = ("demux frozen lanes earlier (sweep --demux) or lower the "
            "freeze threshold; the occupancy curve names the round "
            "the fleet went idle")
+    act_sched = ("the scheduler left slots frozen while the pending "
+                 "queue held lanes — a refill bug in "
+                 "corro_sim/sweep/engine.py _run_compact; the named "
+                 "dispatches show which slots never refilled")
     for art, rep in ev["sweeps"]:
         occ = rep.get("occupancy") or {}
         ratio = occ.get("occupancy_ratio")
+        curve = occ.get("curve") or []
+        compacted = any("width" in e for e in curve)
+        if compacted:
+            # per-dispatch judgement: waste only counts against the
+            # scheduler while the queue could have covered it
+            starved = [
+                e for e in curve
+                if e.get("pending", 0) > 0
+                and e.get("width")
+                and e["lanes_active"] / e["width"] < OCCUPANCY_FLOOR
+            ]
+            if starved:
+                yield _finding(
+                    "occupancy_collapse", "critical",
+                    f"{len(starved)} dispatch(es) ran below the "
+                    f"{OCCUPANCY_FLOOR} occupancy floor while the "
+                    "pending queue held lanes (first at dispatch "
+                    f"{starved[0].get('chunk')}: "
+                    f"{starved[0]['lanes_active']}/"
+                    f"{starved[0]['width']} slots active, "
+                    f"{starved[0]['pending']} queued)",
+                    art, "occupancy.curve", len(starved), act_sched)
+            continue  # drained-queue tail: never a finding
         if (isinstance(ratio, (int, float))
                 and ratio < OCCUPANCY_FLOOR):
             yield _finding(

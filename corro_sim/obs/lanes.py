@@ -413,14 +413,20 @@ def render_heatmap(doc: dict, metric: str = "recovery_rounds") -> str:
 def fleet_occupancy(result) -> dict:
     """The occupancy story of one sweep: per-dispatch lane-state curve
     plus the waste totals. ``wasted_frozen_lane_rounds`` counts rounds
-    the dispatch executed for lanes that had ALREADY settled (their
-    carries ride the freeze select untouched) — the committed
-    before-number for ROADMAP on-device lane freezing. Invariant:
-    ``useful + wasted == executed == lanes × rounds_dispatched``, and
-    ``useful`` equals the sum of per-lane executed rounds."""
+    the dispatch executed for slots holding no racing lane — under
+    lockstep dispatch that is lanes that had ALREADY settled (their
+    carries ride the freeze select untouched, the committed
+    before-number for on-device lane freezing); under the compacted
+    fleet scheduler it is only the residual pad/frozen slots the
+    re-pack could not eliminate. Invariant: ``useful + wasted ==
+    executed == Σ width × rounds`` per dispatch — each dispatch is
+    judged against its OWN batch width (curve entries carry ``width``
+    when the scheduler compacted; lockstep entries fall back to the
+    full lane count), so a compacted run's occupancy honestly reflects
+    the smaller programs it actually dispatched."""
     curve = [dict(e) for e in (getattr(result, "occupancy", None) or [])]
     lanes = len(result.lanes)
-    executed = sum(lanes * e["rounds"] for e in curve)
+    executed = sum(e.get("width", lanes) * e["rounds"] for e in curve)
     useful = sum(e["lanes_active"] * e["rounds"] for e in curve)
     wasted = executed - useful
     return {

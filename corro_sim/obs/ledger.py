@@ -231,6 +231,8 @@ def _config_slug(metric: str) -> str:
         return "outage_catchup_rounds"
     if m == "sweep_clusters_per_sec_per_device":
         return "sweep_throughput"
+    if m == "sweep_compact_clusters_per_sec_per_device":
+        return "sweep_compact_throughput"
     return m
 
 
@@ -404,6 +406,42 @@ def normalize_bench_output(out: dict, config: int | None = None,
             extra={k: out[k] for k in (
                 "devcluster_converged", "baseline_frozen_wall_s",
             ) if k in out},
+        ))
+    # config 8 compaction A/B (ISSUE 19): the fleet-scheduler number
+    # from the same artifact lands as its OWN same-platform series —
+    # the lockstep record above keeps the pre-compaction trajectory
+    # unbroken while the sentinel grades the compact series against
+    # its own committed band.
+    comp = out.get("compact")
+    if isinstance(comp, dict) and isinstance(
+        comp.get("clusters_per_sec_per_device"), (int, float)
+    ):
+        c_extra = {k: comp[k] for k in (
+            "width", "dispatches", "refills", "shrinks", "max_pending",
+            "mean_occupancy_while_pending", "speedup_vs_lockstep",
+            "matches_lockstep",
+        ) if k in comp}
+        if isinstance(comp.get("occupancy"), dict):
+            c_extra["occupancy"] = {
+                k: v for k, v in comp["occupancy"].items()
+                if not isinstance(v, list)
+            }
+        records.append(make_record(
+            "sweep_compact_throughput",
+            "sweep_compact_clusters_per_sec_per_device",
+            comp["clusters_per_sec_per_device"],
+            comp.get("unit", "clusters/s/device"),
+            platform=env.get("platform", "unknown"),
+            device_kind=env.get("device_kind", "unknown"),
+            device_count=env.get("device_count"),
+            wall=wall_decomposition(
+                total_s=comp.get("sweep_wall_s"),
+                compile_s=comp.get("sweep_compile_s"),
+                sim_s=comp.get("sweep_wall_s"),
+            ),
+            source=source if config is None
+            else f"{source}:config{config}",
+            extra=c_extra,
         ))
     return records
 

@@ -31,12 +31,14 @@ See doc/sweeping.md.
 # time (leaf registration), which initializes THIS package — an eager
 # `from .engine import ...` here would re-enter engine/state mid-import.
 from corro_sim.sweep.knobs import (  # noqa: F401  (registration + re-export)
+    SIM_KNOB_FIELDS,
     SWEEP_KNOB_FIELDS,
     lane_knobs,
     neutral_knobs,
 )
 
 __all__ = [
+    "SIM_KNOB_FIELDS",
     "SWEEP_KNOB_FIELDS",
     "LaneResult",
     "SweepLane",

@@ -39,19 +39,44 @@ Mesh composition (PR 8): lanes are embarrassingly parallel, so a device
 mesh shards the LANE axis (``sweep_state_shardings`` — sweep on one
 mesh axis, nodes optionally on the other); GSPMD partitions the batch
 dimension without a single collective.
+
+Fleet scheduler (this PR, ``run_sweep(compact=True)``): the lockstep
+loop above still DISPATCHES frozen lanes — a settled lane rides every
+remaining chunk through the freeze select, burning its slot's FLOPs
+(the ``wasted_frozen_lane_rounds`` before-number PR 15 committed).
+``_run_compact`` turns the loop into a work-stealing slot scheduler:
+each lane owns a host-side ``(ci, base)`` cursor (its OWN serial
+timeline — keys are ``fold_in(PRNGKey(seed), ci)`` regardless of which
+slot or batch width runs it, and ``vmap`` is value-preserving per
+element, so bit-identity survives every re-pack move); at a chunk
+boundary where lanes settled, survivors re-pack into a power-of-2
+bucketed batch (bounded, primeable compile keys), evicted slots refill
+from the pending-grid queue, and the batch shrinks only once the queue
+drains (the normal tail). ``pipeline=True`` adds the PR 4 speculative
+dispatch: chunk N+1 enters the device queue before chunk N's
+convergence fetch lands, predicted on "no lane settles"; a mispredict
+discards the speculative result and re-dispatches from the committed
+carry, so committed chunks are exactly the sequential ones.
+Host-side demux (obs/lanes.py) reconstructs every lane's full flight
+across moves because all bookkeeping is lane-local, never slot-local.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time
+from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from corro_sim.engine.driver import converged_at
-from corro_sim.engine.state import init_state
+from corro_sim.engine.driver import (
+    PIPELINE_SPECULATIVE_TOTAL,
+    PIPELINE_SPECULATIVE_WASTED,
+    converged_at,
+)
+from corro_sim.engine.state import _row_cdf, init_state
 from corro_sim.engine.step import make_step, make_workload_step
 from corro_sim.obs.lanes import (
     publish_sweep_progress,
@@ -74,10 +99,14 @@ from corro_sim.utils.metrics import (
     gauges,
     histograms,
 )
+from corro_sim.utils.runtime import start_async_fetch
 from corro_sim.utils.tracing import tracer
 from corro_sim.workload.generators import empty_slice
 
-__all__ = ["LaneResult", "SweepResult", "run_sweep", "sweep_chunk_args"]
+__all__ = [
+    "LaneResult", "SweepResult", "run_sweep", "sweep_chunk_args",
+    "sweep_slot_args", "sweep_width_avals",
+]
 
 # Collective-budget contract (analysis/contracts.py, checked by
 # `corro-sim audit --contracts`): lanes are independent clusters, so
@@ -123,7 +152,16 @@ class SweepResult:
     occupancy: list | None = None  # per-dispatch lane-state history:
     # {chunk, base, rounds, lanes_active, lanes_frozen, lanes_poisoned,
     # wasted_lane_rounds} — fleet_occupancy() derives the curve/waste
-    # totals that motivate on-device lane freezing (ROADMAP)
+    # totals that motivate on-device lane freezing (ROADMAP). Compacted
+    # runs add {width, pending, refills}: occupancy is then judged
+    # against the BATCH width, and a drained pending queue marks the
+    # normal tail (obs/doctor.py occupancy_collapse semantics)
+    compaction: dict | None = None  # fleet-scheduler provenance when
+    # compact dispatch ran: {widths, refills, shrinks, max_pending,
+    # slot_reuse: [{dispatch, slot, admitted, prev}]} — the re-pack
+    # history the bit-identity tests pin slot reuse against
+    pipeline: dict | None = None  # speculative-dispatch stats when
+    # pipelined: {speculative_dispatched, speculative_wasted}
 
     @property
     def clusters_per_second_per_device(self) -> float | None:
@@ -150,6 +188,26 @@ def _lane_slice(state, lane: int):
     return jax.tree.map(lambda x: x[lane], state)
 
 
+def _lane_state(plan, lane):
+    """ONE lane's fresh carry under the UNION config: its own seed, its
+    own knob values swapped into the sweep leaf, and — when the lane
+    sweeps ``zipf_alpha`` — its own host-precomputed ``row_cdf`` plane
+    (a pure per-lane data swap; the program never changes). The compact
+    scheduler calls this again at refill time, so an evicted slot's
+    replacement lane starts exactly where its serial twin would."""
+    st = init_state(plan.union_cfg, seed=lane.seed)
+    if plan.fork is not None:
+        st = plan.fork.install_state(st)
+    feats = dict(st.features)
+    feats["sweep_knobs"] = {
+        k: jnp.asarray(v) for k, v in lane.knobs.items()
+    }
+    st = st.replace(features=feats)
+    if lane.cfg.zipf_alpha != plan.union_cfg.zipf_alpha:
+        st = st.replace(row_cdf=jnp.asarray(_row_cdf(lane.cfg)))
+    return st
+
+
 def build_lane_states(plan):
     """The stacked ``(L, ...)`` carry: each lane's ``init_state`` under
     the UNION config (identical pytree structure across lanes) with its
@@ -162,17 +220,7 @@ def build_lane_states(plan):
     carries are byte-identical by construction; feature leaves the token
     scrubbed (probe/burst placeholders, registry features) stay at their
     per-lane init values on both sides."""
-    states = []
-    for lane in plan.lanes:
-        st = init_state(plan.union_cfg, seed=lane.seed)
-        if plan.fork is not None:
-            st = plan.fork.install_state(st)
-        feats = dict(st.features)
-        feats["sweep_knobs"] = {
-            k: jnp.asarray(v) for k, v in lane.knobs.items()
-        }
-        states.append(st.replace(features=feats))
-    return _stack(states)
+    return _stack([_lane_state(plan, lane) for lane in plan.lanes])
 
 
 def sweep_runner(cfg, workload: bool = False):
@@ -216,22 +264,28 @@ def sweep_runner(cfg, workload: bool = False):
     return run_chunk
 
 
-def sweep_chunk_args(plan, ci: int, base: int, chunk: int, roots) -> tuple:
-    """Stage chunk ``ci``'s stacked scan inputs: per-lane keys, schedule
-    rows and (when coupled) workload write rows, all ``(L, chunk, ...)``.
-    Every lane's rows are the rows its serial twin would stage at the
-    same absolute rounds — lockstep in ``base``, per-lane in content;
-    the keys are the serial driver's ``fold_in(root, ci)`` verbatim.
+def sweep_slot_args(plan, entries, chunk: int, roots) -> tuple:
+    """Stage one dispatch's stacked scan inputs from a SLOT TABLE:
+    ``entries`` is ``[(lane_index, lane_ci, lane_base), ...]`` — one
+    per slot, each lane at its OWN chunk cursor (a compacted batch
+    mixes survivors deep in their run with freshly refilled lanes at
+    ci 0; a pad slot repeats a live lane's entry and its outputs are
+    discarded by the freeze select). Each slot's keys are the serial
+    driver's ``fold_in(root, ci)`` verbatim and its schedule/workload
+    rows are sliced at the lane's own ``base`` — so a lane's input
+    stream is invariant under slot assignment and batch width, which
+    is the whole bit-identity argument for re-packing.
     Returns ``(device_args, alive_rows, part_rows)`` — the host-side
-    per-lane rows ride along for the post-dispatch bookkeeping."""
+    per-slot rows ride along for the post-dispatch bookkeeping."""
     cfg = plan.union_cfg
     n = cfg.num_nodes
     s = cfg.seqs_per_version
     keys, alive, part, we = [], [], [], []
     wl_cols: list = [[] for _ in range(6)]
-    for lane, root in zip(plan.lanes, roots):
+    for li, ci, base in entries:
+        lane = plan.lanes[li]
         keys.append(np.asarray(
-            jax.random.split(jax.random.fold_in(root, ci), chunk)
+            jax.random.split(jax.random.fold_in(roots[li], ci), chunk)
         ))
         a, p, w = lane.schedule.slice(base, chunk, n)
         alive.append(a)
@@ -253,10 +307,22 @@ def sweep_chunk_args(plan, ci: int, base: int, chunk: int, roots) -> tuple:
     )
     if cfg.sweep.workload:
         out += tuple(jnp.asarray(np.stack(col)) for col in wl_cols)
-    # the host-side per-lane rows ride along so the post-dispatch
+    # the host-side per-slot rows ride along so the post-dispatch
     # bookkeeping (scorecards/invariants) reuses them instead of
     # re-slicing every schedule a second time per chunk
     return out, alive, part
+
+
+def sweep_chunk_args(plan, ci: int, base: int, chunk: int, roots) -> tuple:
+    """Stage chunk ``ci``'s stacked scan inputs: per-lane keys, schedule
+    rows and (when coupled) workload write rows, all ``(L, chunk, ...)``.
+    Every lane's rows are the rows its serial twin would stage at the
+    same absolute rounds — lockstep in ``base``, per-lane in content;
+    the keys are the serial driver's ``fold_in(root, ci)`` verbatim.
+    The lockstep special case of :func:`sweep_slot_args`: every lane in
+    plan order, all at the same cursor."""
+    entries = [(li, ci, base) for li in range(plan.num_lanes)]
+    return sweep_slot_args(plan, entries, chunk, roots)
 
 
 def sweep_chunk_avals(plan, chunk: int) -> tuple:
@@ -288,6 +354,27 @@ def sweep_chunk_avals(plan, chunk: int) -> tuple:
     return avals
 
 
+def sweep_width_avals(plan, width: int, chunk: int) -> tuple:
+    """``sweep_chunk_avals`` at an arbitrary lane-batch ``width`` — the
+    compacted dispatch's program signature at one power-of-2 bucket.
+    tools/prime_cache.py primes every bucket a grid can visit so the
+    re-pack boundaries hit warm executables (the cache-keys manifest
+    names them ``sweep/<grid>-w<width>``)."""
+    full = sweep_chunk_avals(plan, chunk)
+
+    def rewidth(x):
+        return jax.ShapeDtypeStruct((width,) + x.shape[1:], x.dtype)
+
+    state = jax.tree.map(rewidth, full[0])
+    return (state,) + tuple(rewidth(a) for a in full[1:])
+
+
+def _bucket(n: int) -> int:
+    """Smallest power of two >= n — compacted lane-batch widths are
+    bucketed so the compile-key set stays bounded and primeable."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
 def run_sweep(
     plan,
     max_rounds: int = 4096,
@@ -296,6 +383,9 @@ def run_sweep(
     scorecards: bool = True,
     invariants: bool = True,
     on_chunk=None,
+    compact: bool = False,
+    width: int | None = None,
+    pipeline: bool = False,
 ) -> SweepResult:
     """Race the whole plan in lane-batched dispatches.
 
@@ -308,7 +398,27 @@ def run_sweep(
     :class:`~corro_sim.faults.InvariantChecker`, fed each lane's own
     metric rows and schedule slices on the serial cadence (batched over
     the lane axis by slicing the stacked carry).
+
+    ``compact``: the fleet scheduler — evict settled lanes at chunk
+    boundaries, refill their slots from the pending-grid queue, and
+    re-pack survivors into power-of-2 bucketed batches once the queue
+    drains. ``width`` caps the lane-batch (rounded up to a bucket);
+    lanes beyond it queue. ``pipeline``: speculative dispatch of chunk
+    N+1 before chunk N's convergence fetch (the driver's PR 4
+    protocol). Both modes keep every lane bit-identical to its serial
+    twin and neither composes with ``mesh``
+    (:func:`corro_sim.engine.sharding.check_compact_mesh`).
     """
+    if compact or pipeline:
+        from corro_sim.engine.sharding import check_compact_mesh
+
+        check_compact_mesh(mesh)
+        return _run_compact(
+            plan, max_rounds=max_rounds, chunk=chunk,
+            scorecards=scorecards, invariants=invariants,
+            on_chunk=on_chunk, compact=compact, width=width,
+            pipeline=pipeline,
+        )
     from corro_sim.faults import InvariantChecker, ResilienceScorecard
 
     cfg = plan.union_cfg
@@ -566,4 +676,521 @@ def run_sweep(
         compile_cache=cache_probe.summary(),
         chunk=chunk,
         occupancy=occupancy,
+    )
+
+
+@dataclasses.dataclass
+class _SlotDispatch:
+    """One dispatched-but-unprocessed compacted chunk (the sweep's
+    ``_InFlight``): output futures plus the slot table that staged it —
+    the commit step reads lanes back out of slots through this record,
+    never through the (possibly already mutated) scheduler lists."""
+
+    out: tuple  # (state carry, packed int stack, packed float stack)
+    entries: list  # [(lane_index, lane_ci, lane_base)] per slot
+    act: np.ndarray  # (W,) bool — slot activity at dispatch time
+    sched_alive: list  # host-side per-slot schedule rows (bookkeeping)
+    sched_part: list
+    width: int
+    pending_depth: int  # refill-queue depth when dispatched
+    speculative: bool
+    untimed: bool  # jit-fallback first dispatch at this width: the
+    # commit interval is compile+exec mixed — booked as compile
+
+
+def _run_compact(
+    plan,
+    max_rounds: int,
+    chunk: int,
+    scorecards: bool,
+    invariants: bool,
+    on_chunk,
+    compact: bool,
+    width: int | None,
+    pipeline: bool,
+) -> SweepResult:
+    """The fleet scheduler: slot-table dispatch with per-lane cursors.
+
+    Every lane owns a host-side ``(ci, base)`` cursor — its serial
+    timeline. A dispatch runs whatever lanes currently hold slots, each
+    at its own cursor (``sweep_slot_args``); settled lanes are evicted
+    at the boundary, their slots refill from the pending queue, and the
+    batch shrinks to a smaller power-of-2 bucket once the queue drains.
+    ``pipeline`` overlays the driver's PR 4 speculative dispatch.
+    Bit-identity to the serial twin holds per lane because vmap is
+    value-preserving per element and the lane's inputs depend only on
+    its own cursor — never on slot index, batch width, or dispatch
+    order."""
+    from corro_sim.faults import InvariantChecker, ResilienceScorecard
+
+    cfg = plan.union_cfg
+    lanes = plan.lanes
+    L = len(lanes)
+    roots = [jax.random.PRNGKey(lane.seed) for lane in lanes]
+    cards = [
+        ResilienceScorecard(
+            lane.cfg, scenario=lane.scenario, workload=lane.workload,
+            round_offset=plan.fork_round,
+        ) if scorecards else None
+        for lane in lanes
+    ]
+    checks = [
+        InvariantChecker(lane.cfg, round_offset=plan.fork_round)
+        if invariants else None
+        for lane in lanes
+    ]
+    runner = sweep_runner(cfg, workload=cfg.sweep.workload)
+
+    # ---- per-lane scheduler state (all indexed by PLAN lane, not slot)
+    lane_ci = [0] * L
+    lane_base = [0] * L
+    converged: list = [None] * L
+    poisoned = [False] * L
+    lane_rounds = [0] * L
+    lane_metrics: list[list] = [[] for _ in range(L)]
+    final_states: list = [None] * L
+
+    # ---- the slot table: initial admission + pending queue
+    if compact:
+        W = _bucket(min(width, L)) if width else _bucket(L)
+    else:
+        W = L  # fixed full width — pipelined dispatch only
+    slots = list(range(min(W, L)))
+    slot_active = [True] * len(slots)
+    pending: deque = deque(range(len(slots), L))
+    while len(slots) < W:  # pad the first bucket up to its width
+        slots.append(slots[0])
+        slot_active.append(False)
+    state = _stack([_lane_state(plan, lanes[li]) for li in slots])
+
+    compiled: dict[int, object] = {}  # width -> AOT executable (None
+    # after a fallback — that width runs through plain jit)
+    jit_paid: set[int] = set()
+    cache_probe = CompileCacheProbe()
+    compile_seconds = 0.0
+    compile_pending = 0.0  # in-dispatch blocking compile to subtract
+    # from the next commit interval (the driver's pipelined-loop books)
+    wall = 0.0
+    dispatches = 0
+    occupancy: list[dict] = []
+    wasted_total = 0
+    refills_total = 0
+    shrinks = 0
+    slot_reuse: list[dict] = []
+    widths_used: list[int] = []
+    max_pending = len(pending)
+    spec_dispatched = 0
+    spec_wasted = 0
+
+    def _dispatch(st, entries, act_list, speculative) -> _SlotDispatch:
+        nonlocal compile_seconds, compile_pending
+        Wd = len(entries)
+        args, sa, sp = sweep_slot_args(plan, entries, chunk, roots)
+        act = jnp.asarray(np.asarray(act_list, bool))
+        if Wd not in compiled:
+            # AOT compile once per bucket width (compile wall separated
+            # from sim wall, the driver discipline)
+            t0 = time.perf_counter()
+            ex = None
+            try:
+                with tracer.span("sweep aot compile", lanes=Wd,
+                                 slow_warn=False):
+                    lowered = runner.lower(st, act, *args)
+                    cache_probe.begin()
+                    t_c = time.perf_counter()
+                    ex = lowered.compile()
+                    cache_probe.end("sweep", time.perf_counter() - t_c)
+            except Exception:  # AOT unsupported on some backend
+                counters.inc(
+                    "corro_compile_aot_fallback_total",
+                    labels='{program="sweep"}',
+                    help_="AOT lower/compile failures falling back to jit",
+                )
+            compiled[Wd] = ex
+            dt = time.perf_counter() - t0
+            compile_seconds += dt
+            compile_pending += dt
+        first_jit = compiled[Wd] is None and Wd not in jit_paid
+        t0 = time.perf_counter()
+        with tracer.span("sweep chunk", width=Wd,
+                         lanes=int(np.asarray(act_list, bool).sum()),
+                         slow_warn=False):
+            out = (compiled[Wd] or runner)(st, act, *args)
+        if first_jit:
+            # jit fallback: the first call at this width traces and
+            # compiles synchronously inside the dispatch
+            jit_paid.add(Wd)
+            blocked = time.perf_counter() - t0
+            compile_seconds += blocked
+            compile_pending += blocked
+        counters.inc(
+            "corro_sweep_dispatch_total",
+            help_="lane-batched sweep chunk dispatches "
+                  "(corro_sim/sweep/engine.py)",
+        )
+        # metric fetch rides under whatever the host does next
+        # (speculation, bookkeeping) — the PR 4 async-fetch half
+        start_async_fetch(out[1], out[2])
+        return _SlotDispatch(
+            out=out, entries=list(entries),
+            act=np.asarray(act_list, bool),
+            sched_alive=sa, sched_part=sp, width=Wd,
+            pending_depth=len(pending), speculative=speculative,
+            untimed=first_jit,
+        )
+
+    def _entries_now():
+        return [(li, lane_ci[li], lane_base[li]) for li in slots]
+
+    inflight = (
+        _dispatch(state, _entries_now(), slot_active, False)
+        if slots and max_rounds > 0 else None
+    )
+    last_commit = time.perf_counter()
+    compile_pending = 0.0  # dispatch 0's compile predates the clock
+    di = 0
+    while inflight is not None:
+        out = inflight.out
+        # ---- speculate chunk N+1 while N's metrics are in flight:
+        # prediction = "no lane settles this chunk" (slot table and
+        # active mask unchanged, every active cursor one chunk ahead).
+        # Guarded on the round budget: if any active lane's NEXT base
+        # would be out of budget, the real next dispatch differs by
+        # construction — don't waste the speculation.
+        spec = None
+        if pipeline and any(inflight.act) and all(
+            base + chunk < max_rounds
+            for (_, _, base), a in zip(inflight.entries, inflight.act)
+            if a
+        ):
+            spec_entries = [
+                (li, ci + (1 if a else 0), base + (chunk if a else 0))
+                for (li, ci, base), a
+                in zip(inflight.entries, inflight.act)
+            ]
+            spec = _dispatch(out[0], spec_entries,
+                             list(inflight.act), True)
+            spec_dispatched += 1
+            counters.inc(
+                PIPELINE_SPECULATIVE_TOTAL,
+                help_="chunks dispatched before the previous chunk's "
+                      "convergence scalar landed",
+            )
+        # ---- resolve + commit strictly in order
+        m = runner.unpack(np.asarray(out[1]), np.asarray(out[2]))
+        now = time.perf_counter()
+        elapsed = max(now - last_commit - compile_pending, 0.0)
+        last_commit = now
+        compile_pending = 0.0
+        if inflight.untimed:
+            compile_seconds += elapsed
+        else:
+            wall += elapsed
+        dispatches += 1
+        state = out[0]
+        W_d = inflight.width
+        if W_d not in widths_used:
+            widths_used.append(W_d)
+        pre_active = int(inflight.act.sum())
+        pre_pois = sum(poisoned)
+        pre_conv = sum(
+            1 for li in range(L)
+            if converged[li] is not None and not poisoned[li]
+        )
+
+        settled: list[int] = []  # slot indices that settled this chunk
+        for si, ((li, ci_, base), a) in enumerate(
+            zip(inflight.entries, inflight.act)
+        ):
+            if not a:
+                continue
+            lane = lanes[li]
+            lm = {k: np.asarray(v[si]) for k, v in m.items()}
+            lane_metrics[li].append(lm)
+            lane_ci[li] = ci_ + 1
+            lane_base[li] = base + chunk
+            lane_rounds[li] = base + chunk
+            arow = inflight.sched_alive[si]
+            prow = inflight.sched_part[si]
+            lane_state = _lane_slice(state, si)
+            if cards[li] is not None:
+                cards[li].on_chunk(lane_state, lm, arow, prow, base)
+            if checks[li] is not None:
+                checks[li].on_chunk(lane_state, lm, arow, prow, base)
+            if lm["log_wrapped"].any():
+                poisoned[li] = True
+                final_states[li] = lane_state
+                settled.append(si)
+                continue
+            conv = converged_at(lm["gap"], base, chunk, lane.min_rounds)
+            if conv is not None:
+                converged[li] = conv
+                if cards[li] is not None:
+                    cards[li].on_converged(lane_state, arow[-1], prow[-1])
+                if checks[li] is not None:
+                    checks[li].on_converged(lane_state, arow[-1],
+                                            prow[-1])
+                final_states[li] = lane_state
+                settled.append(si)
+            elif lane_base[li] >= max_rounds:
+                # round budget spent unsettled: evict — the serial twin
+                # stops here too; stays "A" (unsettled) fleet-wide
+                final_states[li] = lane_state
+                settled.append(si)
+
+        # ---- occupancy accounting: judged against the BATCH width
+        wasted = (W_d - pre_active) * chunk
+        wasted_total += wasted
+        if wasted:
+            counters.inc(
+                SWEEP_WASTED_LANE_ROUNDS_TOTAL, n=wasted,
+                help_=SWEEP_WASTED_LANE_ROUNDS_HELP,
+            )
+        occupancy.append({
+            "chunk": di,
+            "base": min(
+                (base for (_, _, base), a
+                 in zip(inflight.entries, inflight.act) if a),
+                default=0,
+            ),
+            "rounds": chunk,
+            "lanes_active": pre_active,
+            "lanes_frozen": pre_conv,
+            "lanes_poisoned": pre_pois,
+            "wasted_lane_rounds": wasted,
+            "width": W_d,
+            "pending": inflight.pending_depth,
+            "refills": 0,
+        })
+
+        # ---- boundary: evict settled slots, refill, maybe shrink
+        refill_count = 0
+        if settled and not compact:
+            # fixed width: settled lanes bit-freeze in place (the
+            # lockstep rule) — only the active mask changes
+            for si in settled:
+                slot_active[si] = False
+        elif settled:
+            old_slots = list(slots)
+            new_slots = list(slots)
+            new_active = list(slot_active)
+            for si in settled:
+                new_active[si] = False
+            # refill evicted slots IN PLACE from the pending queue —
+            # the work-stealing move the slot_reuse ledger records
+            admits: dict[int, int] = {}
+            for si in range(len(old_slots)):
+                if new_active[si] or not pending:
+                    continue
+                admits[si] = pending.popleft()
+            if admits:
+                parts = []
+                for si in range(len(old_slots)):
+                    if si in admits:
+                        li = admits[si]
+                        parts.append(_lane_state(plan, lanes[li]))
+                        new_slots[si] = li
+                        new_active[si] = True
+                        refill_count += 1
+                        slot_reuse.append({
+                            "dispatch": di, "slot": si, "admitted": li,
+                            "prev": old_slots[si],
+                        })
+                    else:
+                        parts.append(_lane_slice(state, si))
+                state = _stack(parts)
+            elif not pending:
+                # queue drained: shrink survivors into the smallest
+                # bucket that holds them (the normal tail)
+                live = [
+                    si for si in range(len(old_slots)) if new_active[si]
+                ]
+                nb = _bucket(len(live)) if live else 0
+                if nb and nb < len(old_slots):
+                    shrinks += 1
+                    parts = [_lane_slice(state, si) for si in live]
+                    new_slots = [old_slots[si] for si in live]
+                    new_active = [True] * len(live)
+                    while len(parts) < nb:
+                        parts.append(parts[0])
+                        new_slots.append(new_slots[0])
+                        new_active.append(False)
+                    state = _stack(parts)
+                elif not live:
+                    new_slots, new_active = [], []
+            slots, slot_active = new_slots, new_active
+            refills_total += refill_count
+            occupancy[-1]["refills"] = refill_count
+        max_pending = max(max_pending, len(pending))
+
+        # ---- live fleet telemetry (the lockstep loop's exact gauges)
+        n_pois = sum(poisoned)
+        n_conv = sum(
+            1 for li in range(L)
+            if converged[li] is not None and not poisoned[li]
+        )
+        n_slot_active = sum(slot_active)
+        gauges.set(SWEEP_LANES_ACTIVE, n_slot_active,
+                   help_=SWEEP_LANES_ACTIVE_HELP)
+        gauges.set(SWEEP_LANES_CONVERGED, n_conv,
+                   help_=SWEEP_LANES_CONVERGED_HELP)
+        gauges.set(SWEEP_LANES_POISONED, n_pois,
+                   help_=SWEEP_LANES_POISONED_HELP)
+        pending_set = set(pending)
+        progress = {
+            "chunk": di,
+            "rounds_done": max(lane_rounds, default=0),
+            "lanes_active": n_slot_active,
+            "lanes_queued": len(pending),
+            "lanes_settled": n_conv + n_pois,
+            "lanes_converged": n_conv,
+            "lanes_poisoned": n_pois,
+            "wasted_lane_rounds_total": wasted_total,
+            # one char per PLAN lane: A = racing (or unsettled at
+            # budget), Q = queued, C = converged, P = poisoned
+            "lane_states": "".join(
+                "P" if poisoned[li]
+                else "C" if converged[li] is not None
+                else "Q" if li in pending_set
+                else "A"
+                for li in range(L)
+            ),
+            "chunk_wall_s": round(elapsed, 3),
+            "width": W_d,
+            "pending": len(pending),
+            "refills": refill_count,
+        }
+        publish_sweep_progress({"lanes": L, "dispatches": di + 1,
+                                **progress})
+        if on_chunk is not None:
+            on_chunk(progress)
+        di += 1
+
+        # ---- promote the speculative dispatch, or discard and
+        # re-dispatch from the committed carry (mispredict)
+        fleet_live = any(slot_active)
+        if spec is not None and (settled or not fleet_live):
+            spec_wasted += 1
+            counters.inc(
+                PIPELINE_SPECULATIVE_WASTED,
+                labels='{reason="lane_settled"}',
+                help_="speculative chunk results discarded, by reason",
+            )
+            spec = None
+        if not fleet_live:
+            inflight = None
+        elif spec is not None:
+            inflight = spec
+        else:
+            inflight = _dispatch(state, _entries_now(), slot_active,
+                                 False)
+
+    jax.block_until_ready(jax.tree.leaves(state)[0])
+    histograms.observe(
+        "corro_sweep_wall_seconds", wall,
+        help_="whole-sweep execution wall (compile separate)",
+    )
+
+    rounds_total = max(lane_rounds, default=0)
+    results = []
+    for li, lane in enumerate(lanes):
+        metrics = (
+            {
+                k: np.concatenate([c[k] for c in lane_metrics[li]])
+                for k in lane_metrics[li][0]
+            }
+            if lane_metrics[li] else {}
+        )
+        lane_state = final_states[li]
+        resilience = None
+        if cards[li] is not None and lane_state is not None:
+            resilience = cards[li].finalize(
+                converged_round=(
+                    None if poisoned[li] else converged[li]
+                ),
+                rounds=lane_rounds[li], final_state=lane_state,
+            )
+        heal = lane.scenario.heal_round
+        conv = None if poisoned[li] else converged[li]
+        results.append(LaneResult(
+            index=lane.index, spec=lane.spec, seed=lane.seed,
+            cell=lane.cell,
+            converged_round=conv,
+            rounds=lane_rounds[li],
+            poisoned=poisoned[li],
+            heal_round=heal,
+            recovery_rounds=(
+                conv - heal
+                if conv is not None and heal is not None else None
+            ),
+            metrics=metrics,
+            resilience=resilience,
+            invariants=(
+                checks[li].report() if checks[li] is not None else None
+            ),
+            repro_cmd=lane.repro_cmd(
+                plan.base_cfg, plan.rounds, plan.write_rounds,
+                max_rounds, chunk, fork_path=plan.fork_path,
+            ),
+            state=lane_state,
+        ))
+    for lr in results:
+        if lr.recovery_rounds is not None:
+            histograms.observe(
+                SWEEP_RECOVERY_ROUNDS, float(lr.recovery_rounds),
+                labels=f'{{cell="{lr.cell}"}}',
+                help_=SWEEP_RECOVERY_ROUNDS_HELP,
+                buckets=ROUNDS_BUCKETS,
+            )
+    n_poisoned = sum(poisoned)
+    n_converged = sum(
+        1 for li in range(L)
+        if converged[li] is not None and not poisoned[li]
+    )
+    publish_sweep_result({
+        "lanes": L,
+        "rounds": rounds_total,
+        "dispatches": dispatches,
+        "wall_seconds": round(wall, 3),
+        "compile_seconds": round(compile_seconds, 3),
+        "lanes_converged": n_converged,
+        "lanes_poisoned": n_poisoned,
+        "lanes_unsettled": L - n_converged - n_poisoned,
+        "wasted_lane_rounds_total": wasted_total,
+        "lane_states": "".join(
+            "P" if poisoned[li]
+            else ("C" if converged[li] is not None else "A")
+            for li in range(L)
+        ),
+        "projected": plan.fork is not None,
+        "compact": compact,
+        "pipelined": pipeline,
+        "refills": refills_total,
+    })
+    return SweepResult(
+        lanes=results,
+        rounds=rounds_total,
+        dispatches=dispatches,
+        wall_seconds=wall,
+        compile_seconds=compile_seconds,
+        devices=1,
+        compile_cache=cache_probe.summary(),
+        chunk=chunk,
+        occupancy=occupancy,
+        compaction=(
+            {
+                "widths": widths_used,
+                "refills": refills_total,
+                "shrinks": shrinks,
+                "max_pending": max_pending,
+                "slot_reuse": slot_reuse,
+            } if compact else None
+        ),
+        pipeline=(
+            {
+                "enabled": True,
+                "speculative_dispatched": spec_dispatched,
+                "speculative_wasted": spec_wasted,
+            } if pipeline else None
+        ),
     )

@@ -32,6 +32,12 @@ gate                      knobs
                           (N,) int32 duty cycles (1/1 = full duty)
 ``workload``              ``use_workload`` () bool — schedule-driven
                           vs sampler-driven writes, per lane
+``sim_knobs``             ``write_rate``/``delete_rate`` () float32
+                          thresholds, ``sync_interval``/
+                          ``swim_suspect_rounds`` () int32 cadences —
+                          the SimConfig scalars beyond the link-fault
+                          set (``zipf_alpha`` sweeps with NO knob: it
+                          only shapes the host-built row_cdf plane)
 ========================  =========================================
 
 The *neutral* values (what the builder emits, and what a lane that does
@@ -46,13 +52,28 @@ import numpy as np
 
 from corro_sim.engine.features import FeatureLeaf, register_feature
 
-__all__ = ["SWEEP_KNOB_FIELDS", "lane_knobs", "neutral_knobs"]
+__all__ = [
+    "SIM_KNOB_FIELDS", "SIM_KNOB_LEAF_FIELDS", "SWEEP_KNOB_FIELDS",
+    "lane_knobs", "neutral_knobs",
+]
 
 # the link-fault scalar thresholds a `knob.<field>=...` grid axis may
 # sweep (everything else on FaultConfig changes program structure)
 SWEEP_KNOB_FIELDS = (
     "loss", "dup", "burst_enter", "burst_exit", "burst_loss", "sync_loss",
 )
+
+# SimConfig scalars a grid axis may sweep per lane. The leaf fields
+# ride sweep_knobs as traced operands (sweep.sim_knobs gate);
+# zipf_alpha rides the row_cdf state plane instead — a pure data swap,
+# no gate, no knob. Shape-affecting SimConfig fields (sync_peers,
+# sync_actor_topk, swim_view_size, num_*) can never appear here: they
+# change program structure, so lanes differing in them cannot share
+# one dispatch (plan.parse_grid names them in its rejection).
+SIM_KNOB_LEAF_FIELDS = (
+    "write_rate", "delete_rate", "sync_interval", "swim_suspect_rounds",
+)
+SIM_KNOB_FIELDS = SIM_KNOB_LEAF_FIELDS + ("zipf_alpha",)
 
 
 def neutral_knobs(cfg, seed: int = 0) -> dict:
@@ -83,6 +104,11 @@ def neutral_knobs(cfg, seed: int = 0) -> dict:
         out["straggle_active"] = jnp.ones((n,), jnp.int32)
     if sw.workload:
         out["use_workload"] = jnp.asarray(False)
+    if sw.sim_knobs:
+        out["write_rate"] = jnp.float32(cfg.write_rate)
+        out["delete_rate"] = jnp.float32(cfg.delete_rate)
+        out["sync_interval"] = jnp.int32(cfg.sync_interval)
+        out["swim_suspect_rounds"] = jnp.int32(cfg.swim_suspect_rounds)
     return out
 
 
@@ -159,4 +185,9 @@ def lane_knobs(union_cfg, lane_cfg, use_workload: bool = False) -> dict:
         out["straggle_active"] = active
     if sw.workload:
         out["use_workload"] = np.asarray(bool(use_workload))
+    if sw.sim_knobs:
+        out["write_rate"] = np.float32(lane_cfg.write_rate)
+        out["delete_rate"] = np.float32(lane_cfg.delete_rate)
+        out["sync_interval"] = np.int32(lane_cfg.sync_interval)
+        out["swim_suspect_rounds"] = np.int32(lane_cfg.swim_suspect_rounds)
     return out

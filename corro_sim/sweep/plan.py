@@ -10,9 +10,14 @@ A sweep is specified as a grid of axes (CLI ``corro-sim sweep``)::
   ``crash_amnesia:nodes=3,at=6,lossy:p=0.1`` is two scenarios. ``;`` is
   always a hard separator when the heuristic is unwanted.
 - ``seed`` — ``0..31`` inclusive ranges or comma lists.
-- ``knob.<field>`` — per-lane link-fault threshold overrides
-  (:data:`corro_sim.sweep.knobs.SWEEP_KNOB_FIELDS`); multiple knob axes
-  cross-product.
+- ``knob.<field>`` — per-lane overrides: link-fault thresholds
+  (:data:`corro_sim.sweep.knobs.SWEEP_KNOB_FIELDS`) or SimConfig
+  scalars (:data:`corro_sim.sweep.knobs.SIM_KNOB_FIELDS` —
+  ``write_rate``, ``delete_rate``, ``zipf_alpha``, ``sync_interval``,
+  ``swim_suspect_rounds``); multiple knob axes cross-product.
+  Shape-affecting fields (``sync_peers``, ``sync_actor_topk``,
+  ``swim_view_size``) are refused by name: they change program
+  structure, so lanes differing in them cannot share one dispatch.
 
 The cartesian product of the axes is the lane list; every lane's config
 is the exact config a serial ``run_sim`` of that cell would use (its
@@ -38,7 +43,12 @@ from corro_sim.config import (
     shift_node_faults,
 )
 from corro_sim.faults.scenarios import make_scenario
-from corro_sim.sweep.knobs import SWEEP_KNOB_FIELDS, lane_knobs
+from corro_sim.sweep.knobs import (
+    SIM_KNOB_FIELDS,
+    SIM_KNOB_LEAF_FIELDS,
+    SWEEP_KNOB_FIELDS,
+    lane_knobs,
+)
 
 __all__ = ["SweepLane", "SweepPlan", "build_plan", "parse_grid"]
 
@@ -158,6 +168,17 @@ class SweepPlan:
 
 # ------------------------------------------------------------- grid spec
 
+# SimConfig fields a knob axis must refuse BY NAME: each one shapes an
+# array extent or a traced loop count, so two values mean two programs.
+_SHAPE_AFFECTING = frozenset((
+    "sync_peers", "sync_actor_topk", "swim_view_size", "swim_interval",
+    "num_nodes", "num_rows", "num_cols", "log_capacity",
+))
+
+# the SimConfig int fields a knob axis casts back from the float grid
+_SIM_INT_FIELDS = frozenset(("sync_interval", "swim_suspect_rounds"))
+
+
 def _split_scenarios(value: str) -> list[str]:
     """Scenario-axis splitting: ';' is a hard separator; ',' starts a
     new spec unless the piece is a bare ``k=v`` parameter continuation
@@ -213,10 +234,18 @@ def parse_grid(tokens: list[str]) -> dict:
                 errors.append(f"seed axis {value!r} is not ints/ranges")
         elif key.startswith("knob."):
             field = key[len("knob."):]
-            if field not in SWEEP_KNOB_FIELDS:
+            if field in _SHAPE_AFFECTING:
+                errors.append(
+                    f"knob field {field!r} is shape-affecting — it "
+                    "changes program structure, so lanes differing in "
+                    "it cannot share one dispatch; sweep it as "
+                    "separate runs"
+                )
+                continue
+            if field not in SWEEP_KNOB_FIELDS + SIM_KNOB_FIELDS:
                 errors.append(
                     f"unknown knob field {field!r} (sweepable: "
-                    f"{', '.join(SWEEP_KNOB_FIELDS)})"
+                    f"{', '.join(SWEEP_KNOB_FIELDS + SIM_KNOB_FIELDS)})"
                 )
                 continue
             try:
@@ -334,12 +363,25 @@ def build_plan(
                         )
                     ).validate()
                 if knobs_over:
+                    fault_over = {
+                        k: v for k, v in knobs_over.items()
+                        if k in SWEEP_KNOB_FIELDS
+                    }
+                    sim_over = {
+                        k: (int(v) if k in _SIM_INT_FIELDS else float(v))
+                        for k, v in knobs_over.items()
+                        if k in SIM_KNOB_FIELDS
+                    }
                     try:
-                        cfg = dataclasses.replace(
-                            cfg, faults=dataclasses.replace(
-                                cfg.faults, **knobs_over
+                        if fault_over:
+                            cfg = dataclasses.replace(
+                                cfg, faults=dataclasses.replace(
+                                    cfg.faults, **fault_over
+                                )
                             )
-                        ).validate()
+                        if sim_over:
+                            cfg = dataclasses.replace(cfg, **sim_over)
+                        cfg = cfg.validate()
                     except AssertionError as e:
                         errors.append(f"{cell}: {e}")
                         continue
@@ -412,6 +454,13 @@ def build_plan(
         skew=any(lane.cfg.node_faults.skew for lane in lanes),
         straggle=any(lane.cfg.node_faults.straggle for lane in lanes),
         workload=workload_spec is not None or prebuilt is not None,
+        # arm the sim-knob leaf iff some lane's SimConfig scalar differs
+        # from the base program's baked value — zipf_alpha excluded (it
+        # rides the row_cdf plane, not the leaf)
+        sim_knobs=any(
+            getattr(lane.cfg, f) != getattr(base_cfg, f)
+            for lane in lanes for f in SIM_KNOB_LEAF_FIELDS
+        ),
     )
     union_cfg = dataclasses.replace(
         base_cfg,

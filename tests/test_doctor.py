@@ -260,6 +260,43 @@ def test_rule_occupancy_collapse(tmp_path, golden_report):
     assert not _findings(golden_report, "occupancy_collapse")
 
 
+def test_rule_occupancy_collapse_compaction_semantics(tmp_path):
+    """Fleet-scheduler occupancy semantics (sweep --compact): a frozen
+    slot WHILE the pending queue held lanes is a scheduler bug —
+    critical; the same low occupancy with the queue drained is the
+    normal tail and must never produce a finding."""
+    def entry(active, width, pending):
+        return {"chunk": 0, "base": 0, "rounds": 8,
+                "lanes_active": active, "lanes_frozen": 0,
+                "lanes_poisoned": 0,
+                "wasted_lane_rounds": (width - active) * 8,
+                "width": width, "pending": pending, "refills": 0}
+
+    # injected pathology: 1/8 slots active for 3 dispatches while 10
+    # lanes sat in the queue — the refill machinery plainly broke
+    art = _write(tmp_path, "starved.json", {
+        "lanes_detail": [], "lanes": 16, "ok": True,
+        "occupancy": {"occupancy_ratio": 0.2,
+                      "wasted_frozen_lane_rounds": 168,
+                      "curve": [entry(1, 8, 10)] * 3},
+    })
+    (f,) = _findings(doctor.diagnose([art]), "occupancy_collapse")
+    assert f["severity"] == "critical"
+    assert f["evidence"]["field"] == "occupancy.curve"
+    assert "pending queue held lanes" in f["summary"]
+    # the normal tail: same whole-run ratio, but every low-occupancy
+    # dispatch ran with the queue DRAINED (the last survivors in the
+    # smallest bucket that holds them) — no finding at all
+    tail = _write(tmp_path, "tail.json", {
+        "lanes_detail": [], "lanes": 16, "ok": True,
+        "occupancy": {"occupancy_ratio": 0.2,
+                      "wasted_frozen_lane_rounds": 168,
+                      "curve": [entry(8, 8, 2), entry(1, 2, 0),
+                                entry(1, 2, 0)]},
+    })
+    assert not _findings(doctor.diagnose([tail]), "occupancy_collapse")
+
+
 def test_rule_quarantine_storm(tmp_path, golden_report):
     art = _write(tmp_path, "twin.json", {
         "shadow_delivery": {"p99_ms": 12.0},

@@ -581,13 +581,22 @@ def test_committed_seed_history_passes_its_own_gate():
     bandp = ledger.golden_bands_path()
     assert os.path.exists(led) and os.path.exists(bandp)
     records, bad = ledger.load_ledger(led)
-    assert bad == 0 and len(records) == 13
+    assert bad == 0 and len(records) == 15
     check = ledger.check_bands(records, ledger.load_bands(bandp))
     assert check["ok"], check["breaches"]
     assert check["unmeasured"]  # r05 surfaced
     traj = ledger.build_trajectory(records)
     assert "north_star_wall@axon" in traj["series"]
     assert "north_star_wall@unknown" in traj["series"]
+    # the compact fleet-scheduler A/B: the compacted series is banded
+    # and graded on its own platform; the lockstep capture stays an
+    # un-banded context series (never graded, never skipped-cross-
+    # platform: no other platform bands that config)
+    assert "sweep_compact_throughput@cpu" in traj["series"]
+    assert "sweep_throughput@cpu" in traj["series"]
+    graded = {e["series"] for e in check["checked"]}
+    assert "sweep_compact_throughput@cpu" in graded
+    assert "sweep_throughput@cpu" not in graded
     # committed trajectory artifact matches a fresh build of the ledger
     golden_traj = json.load(open(os.path.join(
         os.path.dirname(led), "perf_trajectory.json")))

@@ -397,3 +397,184 @@ def test_soak_swept_report_matches_serial(tmp_path, capsys):
             for k in ("recovery_rounds", "rows_lost", "resync_rows",
                       "wipes"):
                 assert ra["resilience"][k] == rb["resilience"][k], k
+
+
+# ------------------------------------------- fleet scheduler (compact)
+
+def _compact_twin_check(plan, res):
+    """Every lane vs its serial twin — the shared compact-mode oracle,
+    including the demuxed flight timeline (the re-pack moves must be
+    invisible to the lane observatory)."""
+    from corro_sim.obs.lanes import comparable_timeline, demux_flights
+
+    flights = demux_flights(plan, res)
+    for lane, lr, fl in zip(plan.lanes, res.lanes, flights):
+        serial, inv = _run_twin(lane)
+        _assert_twin(lr, serial, inv)
+        want = comparable_timeline(serial.flight)
+        got = comparable_timeline(fl, metrics=set(want["series"]))
+        for key in ("meta", "diagnostics", "series", "events"):
+            assert got[key] == want[key], (lr.cell, key)
+
+
+@pytest.mark.slow  # ~15-26 s of width-program compiles; t1 runs -m slow explicitly
+def test_compact_refill_lanes_bit_identical_to_serial_twins():
+    """The fleet-scheduler acceptance criterion: lanes race through a
+    width-2 compacted batch — every lane is admitted from the pending
+    queue into a REUSED slot at some re-pack boundary, runs at its own
+    cursor, and still equals its serial run_sim twin bit for bit (state
+    + metrics + scorecard + demuxed flight)."""
+    plan = _wl_plan()
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                    compact=True, width=2)
+    comp = res.compaction
+    assert comp is not None
+    # the queue actually held work and slots were actually reused
+    assert comp["max_pending"] > 0
+    assert comp["refills"] > 0
+    assert comp["slot_reuse"], comp
+    # a freeze-then-refill slot reuse: the admitted lane took a slot
+    # whose previous occupant had settled (converged or poisoned)
+    settled_first = {
+        lr.index for lr in res.lanes
+        if lr.converged_round is not None or lr.poisoned
+    }
+    assert any(
+        e["prev"] in settled_first for e in comp["slot_reuse"]
+    ), comp["slot_reuse"]
+    _compact_twin_check(plan, res)
+
+
+@pytest.mark.slow  # ~15-26 s of width-program compiles; t1 runs -m slow explicitly
+def test_compact_pipelined_mixed_lanes_and_shrink():
+    """Compaction + speculative dispatch together, across a shrink
+    boundary: once the pending queue drains the batch re-packs into a
+    smaller power-of-2 bucket, and committed chunks stay exactly the
+    sequential ones (every lane still twin-identical)."""
+    plan = build_plan(
+        BASE, MIXED_SCENARIOS + ["lossy:p=0.05"], [0, 1],
+        rounds=48, write_rounds=8,
+    )
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                    compact=True, width=4, pipeline=True)
+    comp, pipe = res.compaction, res.pipeline
+    assert comp["refills"] > 0
+    # ragged settle times force at least one smaller bucket
+    assert len(comp["widths"]) > 1 or comp["shrinks"] >= 1 or (
+        comp["widths"] == [4]
+    )
+    assert pipe["speculative_dispatched"] > 0
+    # some speculation must survive (the whole point), some is wasted
+    # at settle boundaries (the mispredict discard)
+    assert pipe["speculative_wasted"] <= pipe["speculative_dispatched"]
+    _compact_twin_check(plan, res)
+
+
+def test_compact_occupancy_accounting():
+    """Width-aware occupancy: executed = Σ width × rounds per dispatch,
+    useful + wasted == executed, and compaction strictly reduces the
+    wasted_frozen_lane_rounds the lockstep dispatch burns on the same
+    ragged grid (the perf number this PR exists for)."""
+    from corro_sim.obs.lanes import fleet_occupancy
+
+    lock = run_sweep(_wl_plan(), max_rounds=MAX_ROUNDS, chunk=CHUNK)
+    comp = run_sweep(_wl_plan(), max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                     compact=True, width=2)
+    o_lock, o_comp = fleet_occupancy(lock), fleet_occupancy(comp)
+    for o in (o_lock, o_comp):
+        assert (
+            o["useful_lane_rounds"] + o["wasted_frozen_lane_rounds"]
+            == o["executed_lane_rounds"]
+        )
+    # identical useful work (same lanes, same serial timelines) ...
+    assert o_comp["useful_lane_rounds"] == o_lock["useful_lane_rounds"]
+    # ... strictly less waste (the ragged grid wastes under lockstep)
+    assert o_lock["wasted_frozen_lane_rounds"] > 0
+    assert (
+        o_comp["wasted_frozen_lane_rounds"]
+        < o_lock["wasted_frozen_lane_rounds"]
+    )
+    # occupancy near 1.0 while the pending queue held work
+    busy = [e for e in o_comp["curve"] if e.get("pending", 0) > 0]
+    if busy:
+        mean = sum(
+            e["lanes_active"] / e["width"] for e in busy
+        ) / len(busy)
+        assert mean >= 0.9, mean
+    # compacted curve entries carry the scheduler fields
+    assert all(
+        "width" in e and "pending" in e and "refills" in e
+        for e in o_comp["curve"]
+    )
+
+
+@pytest.mark.slow  # ~15-26 s of width-program compiles; t1 runs -m slow explicitly
+def test_sim_knob_axis_lanes_bit_identical_to_serial_twins():
+    """The widened grid: SimConfig scalar axes (write_rate f32
+    threshold, sync_interval / swim_suspect_rounds i32 cadences,
+    zipf_alpha row_cdf data swap) ride the sweep_knobs leaf — each lane
+    equals the serial twin that BAKES its value as a constant, under
+    compacted pipelined dispatch."""
+    plan = build_plan(
+        BASE, ["lossy:p=0.1"], [0],
+        knob_combos=[
+            {"write_rate": 0.3},
+            {"sync_interval": 8},
+            {"swim_suspect_rounds": 3},
+            {"zipf_alpha": 1.2},
+            {"write_rate": 0.8, "sync_interval": 2},
+        ],
+        rounds=48, write_rounds=8,
+    )
+    assert plan.union_cfg.sweep.sim_knobs
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                    compact=True, width=2, pipeline=True)
+    _compact_twin_check(plan, res)
+    # the knob lands in the lane's twin config and its repro command
+    by_cell = {lr.cell: lr for lr in res.lanes}
+    wr = next(c for c in by_cell if "write_rate=0.3" in c)
+    assert "--knob write_rate=0.3" in by_cell[wr].repro_cmd
+
+
+def test_sim_knob_grid_rejects_shape_affecting_fields():
+    """Shape-affecting SimConfig fields can never be knob axes — they
+    change program structure, so lanes differing in them cannot share
+    one dispatch. The refusal must name the reason."""
+    with pytest.raises(ValueError, match="shape-affecting"):
+        parse_grid(["scenario=lossy:p=0.1", "knob.sync_peers=2,3"])
+    with pytest.raises(ValueError, match="unknown knob"):
+        parse_grid(["scenario=lossy:p=0.1", "knob.round_ms=5,10"])
+
+
+def test_compact_mesh_refused():
+    """Compaction re-packs the lane axis at runtime — a >1-device mesh
+    cannot follow (sharding.py check_compact_mesh)."""
+    from unittest import mock
+
+    from corro_sim.engine.sharding import check_compact_mesh
+
+    check_compact_mesh(None)  # unsharded: fine
+    fake = mock.Mock(size=4)
+    with pytest.raises(ValueError, match="power-of-2 buckets"):
+        check_compact_mesh(fake)
+
+
+@pytest.mark.slow
+def test_compact_full_ragged_grid_twin_parity():
+    """The t1 chaos-matrix shape at test scale: 4 ragged scenarios × 8
+    seeds, compacted + pipelined at width 8 — all 32 lanes bit-identical
+    to their serial twins across multiple re-pack boundaries."""
+    plan = build_plan(
+        BASE,
+        ["lossy:p=0.1", "crash_amnesia:nodes=3,at=6,down=6",
+         "stale_rejoin:nodes=2,snap=2,at=6,down=4", "clock_skew"],
+        list(range(8)), rounds=48, write_rounds=8,
+    )
+    assert plan.num_lanes == 32
+    res = run_sweep(plan, max_rounds=MAX_ROUNDS, chunk=CHUNK,
+                    compact=True, width=8, pipeline=True)
+    comp = res.compaction
+    assert comp["refills"] > 0 and comp["max_pending"] > 0
+    for lane, lr in zip(plan.lanes, res.lanes):
+        serial, inv = _run_twin(lane)
+        _assert_twin(lr, serial, inv)

@@ -216,7 +216,7 @@ def prime_matrix(chunk: int = 8) -> ProgramRecorder:
     # ISSUE 12: the vmapped fleet-of-clusters sweep programs — the t1
     # chaos-matrix leg's grid and the exact plans tests/test_sweep.py
     # dispatches inside pytest (config literals in lockstep with both).
-    _prime_sweep_matrix(jax, chunk, rec)
+    ci_plan = _prime_sweep_matrix(jax, chunk, rec)
 
     # ISSUE 13: the digital-twin programs — the fixture shadow's
     # per-round inject/step pair, the write-port identity body, the
@@ -224,6 +224,11 @@ def prime_matrix(chunk: int = 8) -> ProgramRecorder:
     # twin leg's 2x4 grid) and every forecast lane's serial run_sim
     # twin (tests/test_twin.py + the t1 twin smoke, in lockstep).
     _prime_twin_matrix(jax, jnp, chunk, rec)
+
+    # the fleet scheduler's bucketed-width family rides LAST — see the
+    # docstring: earlier placement re-keys every program lowered after
+    # it (jax lowering-cache order sensitivity)
+    _prime_sweep_widths(jax, chunk, rec, ci_plan)
     return rec
 
 
@@ -369,16 +374,21 @@ def _prime_sweep_matrix(jax, chunk: int, rec: ProgramRecorder):
     # the t1.yml chaos-matrix leg: 4 scenarios x 8 seeds, zipf+churn
     # workload coupled into every lane (32 lanes, one dispatch; the
     # zipf background keeps every seed's write range across the fault
-    # windows — churn_storm alone leaves sub-window gaps at some seeds)
+    # windows — churn_storm alone leaves sub-window gaps at some seeds).
+    # lossy p=0.3 (not 0.1) since ISSUE 19: the heavy-loss lanes drag
+    # past the rest, making the grid RAGGED at chunk granularity — the
+    # fleet scheduler's whole workload. p is a traced knob value, so
+    # the program (and its cache key) is identical either way.
     ci_base = SimConfig(num_nodes=16, num_rows=32).validate()
-    prime("sweep/ci-matrix", build_plan(
+    ci_plan = build_plan(
         ci_base,
-        ["lossy:p=0.1", "crash_amnesia:nodes=3,at=6,down=6",
+        ["lossy:p=0.3", "crash_amnesia:nodes=3,at=6,down=6",
          "stale_rejoin:nodes=2,snap=2,at=6,down=4", "clock_skew"],
         list(range(8)), rounds=64, write_rounds=8,
         workload_spec="zipf:alpha=1.1,rate=0.5,keys=24"
                       "+churn_storm:waves=2,keys=12",
-    ))
+    )
+    prime("sweep/ci-matrix", ci_plan)
 
     # tests/test_sweep.py: the mixed-scenario plan and the
     # workload-coupled plan (the wltest 12-node shape)
@@ -445,6 +455,33 @@ def _prime_sweep_matrix(jax, chunk: int, rec: ProgramRecorder):
                     f"{'repair' if repair else 'full'}",
                     runner, state, *avals, *wl_avals,
                 )
+    return ci_plan
+
+
+def _prime_sweep_widths(jax, chunk: int, rec: ProgramRecorder, ci_plan):
+    """The compacted fleet scheduler's power-of-2 lane buckets
+    (sweep/engine.py ``_run_compact``): one program per width the t1
+    grid can visit (``--width 16`` admission plus every shrink bucket
+    the 32-lane tail can reach), so every re-pack boundary hits a warm
+    executable instead of a mid-sweep compile stall.
+
+    Deliberately primed LAST: jax's lowering layer reuses cached inner
+    modules process-globally, so lowering one runner at several width
+    avals shifts the StableHLO text — and therefore the cache key — of
+    programs lowered AFTER it in the same process. Appending the width
+    family after every pre-existing program keeps the manifest diff
+    purely additive (the `--check` zero-miss gate depends on tool-order
+    determinism, not on keys being history-free)."""
+    from corro_sim.sweep.engine import sweep_runner, sweep_width_avals
+
+    runner = sweep_runner(
+        ci_plan.union_cfg, workload=ci_plan.union_cfg.sweep.workload
+    )
+    for w in (16, 8, 4, 2, 1):
+        rec.compile(
+            f"sweep/ci-matrix-w{w}", runner,
+            *sweep_width_avals(ci_plan, w, chunk),
+        )
 
 
 def _prime_node_fault_matrix(jax, jnp, chunk: int, rec: ProgramRecorder):
