@@ -405,7 +405,7 @@ def audit(cfg=None) -> dict:
 
 def run_audit(update_golden: bool = False, out: str | None = None,
               as_json: bool = False, diff: bool = False,
-              contracts: bool = False) -> int:
+              contracts: bool = False, keys: bool = False) -> int:
     """The `corro-sim audit` entrypoint: trace, audit, check (or
     rewrite) the golden fingerprint; returns the exit code. Exit 1 on
     any vacuity/hazard problem or golden drift. ``diff`` additionally
@@ -413,7 +413,10 @@ def run_audit(update_golden: bool = False, out: str | None = None,
     printed pass or fail, and embedded in the JSON report).
     ``contracts`` additionally runs the program-contract auditor
     (:mod:`corro_sim.analysis.contracts`) against its own committed
-    manifest — with ``update_golden`` that manifest re-baselines too."""
+    manifest — with ``update_golden`` that manifest re-baselines too.
+    ``keys`` does the same for the key-lineage auditor
+    (:mod:`corro_sim.analysis.keys`) and its
+    ``analysis/golden/key_lineage.json`` manifest."""
     report = audit()
     if update_golden:
         write_golden(report)
@@ -452,6 +455,18 @@ def run_audit(update_golden: bool = False, out: str | None = None,
             crep = _contracts.check()
         report["contracts"] = crep
         report["ok"] = report["ok"] and crep["ok"]
+    if keys:
+        from corro_sim.analysis import keys as _keys
+
+        if update_golden:
+            krep = _keys.build_report()
+            _keys.write_golden(krep)
+            krep["golden_updated"] = _keys.GOLDEN_PATH
+            krep = _keys.check(krep)
+        else:
+            krep = _keys.check()
+        report["keys"] = krep
+        report["ok"] = report["ok"] and krep["ok"]
     if as_json:
         print(json.dumps(report, indent=2))
     else:
@@ -488,6 +503,11 @@ def run_audit(update_golden: bool = False, out: str | None = None,
 
             for line in _contracts.render_text(report["contracts"]):
                 print(line)
+        if keys:
+            from corro_sim.analysis import keys as _keys
+
+            for line in _keys.render_text(report["keys"]):
+                print(line)
         for p in report["problems"] + drift:
             print(f"PROBLEM  {p}")
         if report.get("golden_skipped"):
@@ -500,6 +520,12 @@ def run_audit(update_golden: bool = False, out: str | None = None,
                 )
 
                 print(f"golden   updated: {CONTRACTS_GOLDEN}")
+            if keys:
+                from corro_sim.analysis.keys import (
+                    GOLDEN_PATH as KEYS_GOLDEN,
+                )
+
+                print(f"golden   updated: {KEYS_GOLDEN}")
         print("audit:", "ok" if report["ok"] else "FAILED")
     if out:
         with open(out, "w", encoding="utf-8") as fh:

@@ -41,6 +41,13 @@ perturbs key derivation. These rules encode the hazards that have bitten
                         ranks — the determinism contract's AST-level
                         early warning (analysis/contracts.py pins the
                         same claim at the jaxpr layer).
+  CL109 duplicate-fold-tag two distinct ``fold_in`` call sites deriving
+                        from the same key expression with the same
+                        literal tag — both sites land on the SAME
+                        child stream, a K2 stream collision
+                        (analysis/keys.py proves the same invariant
+                        at the jaxpr layer; this is its AST-level
+                        early warning at the source line).
 
 Trace context is inferred statically: functions decorated with ``jit``
 (including ``functools.partial(jax.jit, ...)``), callbacks handed to
@@ -98,6 +105,9 @@ RULES: dict[str, Rule] = {
         Rule("CL108", "unseeded-shuffle", "warning",
              "sort/argsort without pinned stability feeding "
              "scatter/gather ranks"),
+        Rule("CL109", "duplicate-fold-tag", "error",
+             "same literal fold_in tag folded onto the same key at "
+             "two call sites (stream collision)"),
     )
 }
 
@@ -126,6 +136,20 @@ _STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding"}
 # jax.random callables that DERIVE keys rather than consuming entropy
 _KEY_DERIVERS = {"PRNGKey", "key", "split", "fold_in", "clone",
                  "wrap_key_data", "key_data", "key_impl"}
+# in-tree derivation helpers (engine/driver.py) that wrap fold_in/split
+# compositions — pure derivations, not consumers. The lint trusts the
+# name; analysis/keys.py's K3 prologue audit pins their actual content
+# (and their aliasing from every call site) at the jaxpr layer.
+_TREE_KEY_DERIVERS = {"chunk_keys", "round_key"}
+
+
+def _is_key_deriver(dotted: str | None) -> bool:
+    if dotted is None:
+        return False
+    leaf = dotted.rsplit(".", 1)[-1]
+    if dotted.startswith("jax.random.") and leaf in _KEY_DERIVERS:
+        return True
+    return leaf in _TREE_KEY_DERIVERS
 # mutating method names on a bare closure-captured name (CL105)
 _MUTATORS = {"append", "extend", "update", "add", "insert", "setdefault",
              "pop", "popitem", "remove", "clear", "discard"}
@@ -759,10 +783,7 @@ def _check_prng_reuse(idx: _ModuleIndex, fn: ast.FunctionDef,
 
     def value_is_key(value: ast.AST) -> bool:
         if isinstance(value, ast.Call):
-            d = idx.dotted(value.func)
-            return d is not None and d.startswith("jax.random.") and (
-                d.rsplit(".", 1)[-1] in _KEY_DERIVERS
-            )
+            return _is_key_deriver(idx.dotted(value.func))
         if isinstance(value, ast.Subscript):
             return value_is_key(value.value) or (
                 isinstance(value.value, ast.Name)
@@ -789,13 +810,7 @@ def _check_prng_reuse(idx: _ModuleIndex, fn: ast.FunctionDef,
         out = []
         for n in ast.walk(node):
             if isinstance(n, ast.Call):
-                d = idx.dotted(n.func)
-                is_deriver = (
-                    d is not None
-                    and d.startswith("jax.random.")
-                    and d.rsplit(".", 1)[-1] in _KEY_DERIVERS
-                )
-                if is_deriver:
+                if _is_key_deriver(idx.dotted(n.func)):
                     continue
                 for a in list(n.args) + [k.value for k in n.keywords]:
                     if isinstance(a, ast.Name) and a.id == name:
@@ -1061,6 +1076,80 @@ def _check_unseeded_shuffle(idx: _ModuleIndex, fn: ast.FunctionDef,
                         rank_use(a)
 
 
+def _literal_tag(idx: _ModuleIndex, consts: dict[str, int],
+                 node: ast.AST) -> int | None:
+    """Resolve a fold_in tag expression to a literal int, or None.
+
+    Only two shapes resolve: an int ``ast.Constant`` and a bare
+    ``ast.Name`` bound to a module-level int constant. Loop variables
+    and arithmetic (``BASE + g``) stay unresolved on purpose — a
+    per-iteration tag is exactly the pattern that makes sibling folds
+    distinct, so flagging it would drown the rule in false positives.
+    """
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, int) and not isinstance(v, bool):
+            return v
+        return None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _literal_tag(idx, consts, node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+def _check_duplicate_fold_tag(idx: _ModuleIndex, fn: ast.FunctionDef,
+                              findings: list[Finding]) -> None:
+    """CL109: two ``jax.random.fold_in`` call sites in one function
+    folding the same resolved literal tag onto the same key
+    expression. Both sites derive the SAME child stream — the K2
+    collision analysis/keys.py rejects at the jaxpr layer, caught
+    here at the offending source line. Fires once, at the second
+    (duplicate) site; declared-constant tags resolve through
+    module-level int assignments so ``fold_in(k, GOSSIP_TAG)`` and
+    ``fold_in(k, 7)`` collide when ``GOSSIP_TAG = 7``."""
+    consts: dict[str, int] = {}
+    for st in idx.tree.body:
+        if (isinstance(st, ast.Assign) and len(st.targets) == 1
+                and isinstance(st.targets[0], ast.Name)
+                and isinstance(st.value, ast.Constant)
+                and isinstance(st.value.value, int)
+                and not isinstance(st.value.value, bool)):
+            consts[st.targets[0].id] = st.value.value
+
+    seen: dict[tuple[str, int], ast.Call] = {}
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) or len(node.args) < 2:
+            continue
+        d = idx.dotted(node.func)
+        if d is None or not (d == "jax.random.fold_in"
+                             or d.endswith("random.fold_in")):
+            continue
+        tag = _literal_tag(idx, consts, node.args[1])
+        if tag is None:
+            continue
+        sig = (ast.dump(node.args[0]), tag)
+        first = seen.setdefault(sig, node)
+        if (first.lineno, first.col_offset) == (node.lineno,
+                                                node.col_offset):
+            continue
+        if any(f.rule == "CL109" and f.path == idx.path
+               and f.line == node.lineno and f.col == node.col_offset
+               for f in findings):
+            continue  # already flagged via an enclosing function walk
+        findings.append(Finding(
+            rule="CL109", severity=RULES["CL109"].severity,
+            path=idx.path, line=node.lineno, col=node.col_offset,
+            message=(
+                f"fold_in tag {tag} already folded onto this key at "
+                f"line {first.lineno} — both sites derive the same "
+                "stream (K2 collision); give each draw site its own "
+                "declared tag constant"
+            ),
+        ))
+
+
 # ------------------------------------------------- trace-context graph
 
 def _trace_seeds_and_edges(idx: _ModuleIndex):
@@ -1185,6 +1274,7 @@ def analyze(trees: dict[str, ast.Module]) -> list[Finding]:
             _check_prng_reuse(idx, fn, findings)
             _check_donation_uses(idx, fn, findings)
             _check_unseeded_shuffle(idx, fn, findings)
+            _check_duplicate_fold_tag(idx, fn, findings)
         # module-level statements: PRNG + donation discipline
         pseudo = ast.FunctionDef(
             name="<module>", args=ast.arguments(
@@ -1200,5 +1290,6 @@ def analyze(trees: dict[str, ast.Module]) -> list[Finding]:
         _check_prng_reuse(idx, pseudo, findings)
         _check_donation_uses(idx, pseudo, findings)
         _check_unseeded_shuffle(idx, pseudo, findings)
+        _check_duplicate_fold_tag(idx, pseudo, findings)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings

@@ -1692,12 +1692,16 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     fingerprint (analysis/golden/jaxpr_fingerprint.json). With
     --contracts, also run the program-contract auditor (dataflow
     vacuity proofs, collective budgets, determinism, static peak-HBM —
-    analysis/contracts.py) against its committed manifest."""
-    if args.contracts:
-        # the collective-budget contracts lower against the 8-device
-        # host mesh (the prime_cache/conftest posture) — force it
-        # BEFORE jax initializes; a no-op when the flag is already set
-        # or jax is already up (then the device gate records a skip)
+    analysis/contracts.py) against its committed manifest. With
+    --keys, also run the key-lineage auditor (K1 single-consumption /
+    K2 stream disjointness / K3 lane-fork independence —
+    analysis/keys.py) against analysis/golden/key_lineage.json."""
+    if args.contracts or args.keys:
+        # the collective-budget contracts and the sharded key-lineage
+        # program lower/trace against the 8-device host mesh (the
+        # prime_cache/conftest posture) — force it BEFORE jax
+        # initializes; a no-op when the flag is already set or jax is
+        # already up (then the device gate records a skip)
         import sys as _sys
 
         if "jax" not in _sys.modules:
@@ -1711,6 +1715,7 @@ def _cmd_audit(args: argparse.Namespace) -> int:
     return run_audit(
         update_golden=args.update_golden, out=args.out,
         as_json=args.json, diff=args.diff, contracts=args.contracts,
+        keys=args.keys,
     )
 
 
@@ -2776,6 +2781,15 @@ def build_parser() -> argparse.ArgumentParser:
              "determinism lints, and the static peak-HBM golden "
              "(analysis/golden/program_contracts.json; "
              "doc/static_analysis.md)",
+    )
+    pau.add_argument(
+        "--keys", action="store_true",
+        help="also run the key-lineage auditor: reconstruct every "
+             "program's PRNG derivation forest and prove K1 single-"
+             "consumption, K2 stream disjointness (declared == "
+             "observed fold tags), and K3 lane/fork independence "
+             "(analysis/golden/key_lineage.json; "
+             "doc/static_analysis.md §4)",
     )
     pau.add_argument(
         "--out", help="also write the JSON report to this path"

@@ -175,6 +175,40 @@ class Schedule:
         ]
 
 
+# ---------------------------------------------------------------- keys
+# THE canonical round-key derivations. Every execution engine (serial
+# driver, sweep lanes, twin/replay shadows, live cluster) must derive
+# its per-round keys through these two helpers — the key-lineage
+# auditor (analysis/keys.py, `corro-sim audit --keys`) pins their
+# derivation chains in analysis/golden/key_lineage.json and asserts,
+# via module aliasing + call-site checks, that no engine grows a
+# private variant. That identity IS contract K3 (lane/fork
+# independence): a sweep lane or twin fork differs from its serial
+# twin only by the documented leading fold_in below.
+
+
+def chunk_keys(root, ci, chunk: int):
+    """The ``chunk`` per-round keys for chunk index ``ci``:
+    ``split(fold_in(root, ci), chunk)``. Row r is round
+    ``ci * chunk + r``'s key. Used by the serial chunk loop (both the
+    sequential and pipelined stages) and verbatim per-slot by the sweep
+    engine — which is why a lane's key stream is invariant under slot
+    assignment, batch width and compaction (doc/sweeping.md §5)."""
+    return jax.random.split(jax.random.fold_in(root, ci), chunk)
+
+
+def round_key(root, r):
+    """The single-round key ``fold_in(root, r)`` for engines that step
+    one ABSOLUTE round at a time (twin/replay shadow loops, the live
+    cluster tick and its scan-batched multi_step). ``r`` may be traced.
+
+    NOTE: this is the per-round stream, NOT round r of ``chunk_keys``
+    (which folds the chunk index, then splits) — the two derivations
+    are intentionally disjoint families and the auditor proves neither
+    collapses into the other."""
+    return jax.random.fold_in(root, r)
+
+
 def converged_at(gaps, base: int, chunk: int, min_rounds: int) -> int | None:
     """THE convergence rule, applied to one executed chunk's per-round
     ``gap`` series: the first round strictly past ``min_rounds`` with a
@@ -1041,9 +1075,7 @@ def run_sim(
                 alive, part, we = schedule.slice(rounds, chunk,
                                                  cfg.num_nodes)
                 with _tg_sanctioned("chunk_stage", transfer_guard):
-                    keys = jax.random.split(
-                        jax.random.fold_in(root, ci), chunk
-                    )
+                    keys = chunk_keys(root, ci, chunk)
                     args = (
                         state, keys, jnp.asarray(alive),
                         jnp.asarray(part), jnp.asarray(we),
@@ -1137,9 +1169,7 @@ def run_sim(
                 alive_, part_, we_ = schedule.slice(base_, chunk,
                                                     cfg.num_nodes)
                 with _tg_sanctioned("chunk_stage", transfer_guard):
-                    keys_ = jax.random.split(
-                        jax.random.fold_in(root, ci_), chunk
-                    )
+                    keys_ = chunk_keys(root, ci_, chunk)
                     args_ = (
                         state_in, keys_, jnp.asarray(alive_),
                         jnp.asarray(part_), jnp.asarray(we_),

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from corro_sim.config import SimConfig
+from corro_sim.engine.driver import round_key
 from corro_sim.engine.state import SimState, init_state
 from corro_sim.engine.step import sim_step
 from corro_sim.io.traces import EncodedTrace
@@ -119,7 +120,7 @@ def replay(
     while r < max_rounds:
         if r < trace.rounds:
             state = inject(state, *trace_round_args(trace, cells, r))
-        state, m = step(state, jax.random.fold_in(root, r))
+        state, m = step(state, round_key(root, r))
         r += 1
         if int(m["log_wrapped"]) > 0:
             # ring-wrap tripwire (engine/step.py): state may be silently

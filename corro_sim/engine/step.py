@@ -58,6 +58,26 @@ from corro_sim.membership.swim import swim_step, view_alive  # noqa: F401
 from corro_sim.membership.swim_window import membership_view
 from corro_sim.sync.sync import sync_round
 
+# The step's PRNG stream map — declared contract, checked observed by
+# the key-lineage auditor (analysis/keys.py, `corro-sim audit --keys`):
+# the round key splits exactly once into these lanes, in this order,
+# and child i of that split feeds ONLY the named subsystem. Golden
+# derivation addresses in analysis/golden/key_lineage.json are spelled
+# against these positions (e.g. the broadcast target draw is
+# ``in:key/split9[6]/fold(7)/...``). Reordering or renaming a lane is
+# a stream re-key: every seeded simulation changes.
+STEP_KEY_STREAMS = (
+    "write",   # [0] workload write-commit coin
+    "row",     # [1] write target row
+    "col",     # [2] write target column (randint hi/lo pair)
+    "val",     # [3] written value (randint hi/lo pair)
+    "del",     # [4] delete coin
+    "ncell",   # [5] cells-per-changeset draw (unconsumed by 1-cell cfgs)
+    "bcast",   # [6] gossip broadcast targets (gossip/broadcast.py)
+    "swim",    # [7] SWIM probe/indirect/exchange (membership/swim*.py)
+    "sync",    # [8] anti-entropy partner + payload (sync/sync.py)
+)
+
 
 def make_step(cfg: SimConfig, repair: bool = False, mesh=None):
     """The scan-shaped closure over :func:`sim_step`: ``(state, (key,
@@ -201,7 +221,7 @@ def sim_step(
     cpv = cfg.chunks_per_version
     rows_idx = jnp.arange(n, dtype=jnp.int32)
     (k_write, k_row, k_col, k_val, k_del, k_ncell, k_bcast, k_swim, k_sync) = (
-        jax.random.split(key, 9)
+        jax.random.split(key, len(STEP_KEY_STREAMS))
     )
     reach = _reachable_fn(alive, part)
 
@@ -916,7 +936,7 @@ def _repair_step(
     cpv = cfg.chunks_per_version
     # same 9-way split as the full step — k_swim/k_sync must match
     (_k_write, _k_row, _k_col, _k_val, _k_del, _k_ncell, _k_bcast, k_swim,
-     k_sync) = jax.random.split(key, 9)
+     k_sync) = jax.random.split(key, len(STEP_KEY_STREAMS))
     reach = _reachable_fn(alive, part)
 
     # sweep knob planes: the identical handle the full step holds (the

@@ -43,6 +43,7 @@ import jax
 import numpy as np
 
 from corro_sim.config import SimConfig
+from corro_sim.engine.driver import round_key
 from corro_sim.engine.replay import make_injector, make_shadow_step
 from corro_sim.engine.state import init_state
 from corro_sim.io.traces import (
@@ -389,7 +390,7 @@ def run_twin(
         """One shadow step + the ring-wrap poison tripwire — the ONE
         per-round stanza both the feed loop and the drain loop run."""
         nonlocal rounds, poisoned
-        state, m = step(state, jax.random.fold_in(root, rounds))
+        state, m = step(state, round_key(root, rounds))
         rounds += 1
         m = jax.tree.map(np.asarray, m)
         if int(m["log_wrapped"]) > 0:

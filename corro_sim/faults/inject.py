@@ -42,7 +42,11 @@ register_feature(FeatureLeaf(
 ))
 
 # fold_in tag for the fault key lane (arbitrary constant, fixed forever:
-# changing it changes every seeded fault stream)
+# changing it changes every seeded fault stream). Folded on the ROUND
+# key itself — the fault lane is a sibling of the step's 9-way
+# STEP_KEY_STREAMS split, not a child of it — so the key-lineage
+# auditor (analysis/keys.py, K2) proves it disjoint from every
+# subsystem stream by construction: different parent, distinct tag.
 FAULT_KEY_TAG = 0x0FA17
 
 

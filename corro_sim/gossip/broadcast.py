@@ -39,6 +39,14 @@ from corro_sim.utils.slots import (
 # slot layout of the packed pending ring
 PEND_ACTOR, PEND_VER, PEND_CHUNK, PEND_TX = range(4)
 
+# fold_in tag deriving the per-round broadcast-target key from the
+# step's k_bcast lane (STEP_KEY_STREAMS[6]). Declared contract: the
+# key-lineage auditor (analysis/keys.py) asserts this is the ONLY
+# constant tag folded under the bcast lane, keeping the target stream
+# disjoint from every other subsystem's (K2). Fixed forever — changing
+# it re-keys every seeded gossip fanout draw.
+BROADCAST_TARGET_KEY_TAG = 7
+
 
 @flax.struct.dataclass
 class GossipState:
@@ -239,7 +247,7 @@ def broadcast_step(
     pend_tx = pend_e[..., PEND_TX]
     live = (pend_tx > 0) & sender_alive[:, None]  # (N, E)
 
-    tkey = jax.random.fold_in(key, 7)
+    tkey = jax.random.fold_in(key, BROADCAST_TARGET_KEY_TAG)
     targets = jax.random.randint(
         tkey, (n, e, fanout), 0, n, dtype=jnp.int32
     )

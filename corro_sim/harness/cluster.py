@@ -38,6 +38,7 @@ from corro_sim.api.statements import (
 )
 from corro_sim.config import SimConfig
 from corro_sim.core.crdt import NEG
+from corro_sim.engine.driver import round_key
 from corro_sim.engine.state import SimState, init_state
 from corro_sim.engine.step import sim_step
 from corro_sim.io.values import LiveUniverse
@@ -213,7 +214,7 @@ class LiveCluster:
         def multi_step(state, root_key, start_round, alive, part, writes_k):
             def body(st, inp):
                 r, w = inp
-                key = jax.random.fold_in(root_key, r)
+                key = round_key(root_key, r)
                 return sim_step(
                     cfg, st, key, alive, part, jnp.asarray(False), writes=w
                 )
@@ -991,7 +992,7 @@ class LiveCluster:
                 )
             self._observe_stage("dequeue", time.perf_counter() - t0)
             t0 = time.perf_counter()
-            key = jax.random.fold_in(self._root_key, self._rounds_ticked)
+            key = round_key(self._root_key, self._rounds_ticked)
             self.state, metrics = self._step(
                 self.state,
                 key,

@@ -79,6 +79,18 @@ ALIVE = jnp.int8(0)
 SUSPECT = jnp.int8(1)
 DOWN = jnp.int8(2)
 
+# fold_in tags deriving per-exchange keys from the SWIM k_ex lane
+# (STEP_KEY_STREAMS[7] → split3[2]). Declared contract for the
+# key-lineage auditor (analysis/keys.py, K2 stream disjointness): peer
+# exchange g folds tag ``SWIM_PEER_KEY_TAG_BASE + g`` for g in
+# range(cfg.swim_gossip_peers); the periodic announce folds
+# SWIM_ANNOUNCE_KEY_TAG, which must stay OUTSIDE the peer tag range
+# for every admissible swim_gossip_peers (auditor-enforced ceiling).
+# Both are shared with the windowed automaton (swim_window.py). Fixed
+# forever — changing either re-keys every seeded membership stream.
+SWIM_PEER_KEY_TAG_BASE = 0
+SWIM_ANNOUNCE_KEY_TAG = 997
+
 
 @dataclasses.dataclass(frozen=True)
 class SwimLayout:
@@ -365,7 +377,7 @@ def swim_step(
 
     for g in range(cfg.swim_gossip_peers):
         kg_pull, kg_push, kg_bl1, kg_bl2 = jax.random.split(
-            jax.random.fold_in(k_ex, g), 4
+            jax.random.fold_in(k_ex, SWIM_PEER_KEY_TAG_BASE + g), 4
         )
         peer = jax.random.randint(kg_pull, (n,), 0, n, dtype=jnp.int32)
         can1 = (
@@ -424,7 +436,7 @@ def swim_step(
     # merged view and refutes with a higher incarnation (below), which wins
     # subsequent merges — the standard SWIM heal dance.
     def do_announce(p):
-        ka = jax.random.fold_in(k_ex, 997)
+        ka = jax.random.fold_in(k_ex, SWIM_ANNOUNCE_KEY_TAG)
         perm = jax.random.permutation(ka, n).astype(jnp.int32)
         inv = jnp.argsort(perm, stable=True).astype(jnp.int32)
         for partner in (perm, inv):

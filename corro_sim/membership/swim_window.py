@@ -45,7 +45,11 @@ import jax
 import jax.numpy as jnp
 
 from corro_sim.config import SimConfig
-from corro_sim.membership.swim import belief_dtype, swim_layout
+from corro_sim.membership.swim import (
+    SWIM_PEER_KEY_TAG_BASE,
+    belief_dtype,
+    swim_layout,
+)
 
 
 @flax.struct.dataclass
@@ -278,7 +282,11 @@ def swim_window_step(
 
     # --- pull exchanges with known believed-up members -----------------
     for g in range(cfg.swim_gossip_peers):
-        kg_s, kg_o = jax.random.split(jax.random.fold_in(k_ex, g))
+        # the shared peer-exchange tag family (swim.py, auditor K2) —
+        # the windowed announce needs no fold: it owns the k_ann lane
+        kg_s, kg_o = jax.random.split(
+            jax.random.fold_in(k_ex, SWIM_PEER_KEY_TAG_BASE + g)
+        )
         pslot = jax.random.randint(kg_s, (n,), 1, k, dtype=jnp.int32)
         peer = st.member[rows, pslot]
         pb = st.belief[rows, pslot]

@@ -74,6 +74,7 @@ import numpy as np
 from corro_sim.engine.driver import (
     PIPELINE_SPECULATIVE_TOTAL,
     PIPELINE_SPECULATIVE_WASTED,
+    chunk_keys,
     converged_at,
 )
 from corro_sim.engine.state import _row_cdf, init_state
@@ -284,9 +285,7 @@ def sweep_slot_args(plan, entries, chunk: int, roots) -> tuple:
     wl_cols: list = [[] for _ in range(6)]
     for li, ci, base in entries:
         lane = plan.lanes[li]
-        keys.append(np.asarray(
-            jax.random.split(jax.random.fold_in(roots[li], ci), chunk)
-        ))
+        keys.append(np.asarray(chunk_keys(roots[li], ci, chunk)))
         a, p, w = lane.schedule.slice(base, chunk, n)
         alive.append(a)
         part.append(p)

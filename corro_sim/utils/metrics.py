@@ -182,6 +182,16 @@ LINT_SANCTIONED_TRANSFERS_TOTAL = "corro_lint_sanctioned_transfers_total"
 AUDIT_CONTRACT_CHECKS_TOTAL = "corro_audit_contract_checks_total"
 AUDIT_CONTRACT_VIOLATIONS_TOTAL = "corro_audit_contract_violations_total"
 
+# ---- corro_audit_key_*: the key-lineage auditor (analysis/keys.py,
+# `corro-sim audit --keys`) counts every proven stream-disjointness
+# check and every violation/drift row, labeled by contract family
+# (k1 single-consumption | k2 stream disjointness | k3 lane/fork
+# independence | manifest = structural golden drift):
+#   corro_audit_key_checks_total{family}      lineage checks evaluated
+#   corro_audit_key_violations_total{family}  violations + drift
+AUDIT_KEY_CHECKS_TOTAL = "corro_audit_key_checks_total"
+AUDIT_KEY_VIOLATIONS_TOTAL = "corro_audit_key_violations_total"
+
 # ---- corro_workload_* / corro_sub_latency_*: the production workload
 # engine (corro_sim/workload/, doc/workloads.md). The load harness
 # drives a compiled traffic schedule through a LiveCluster with

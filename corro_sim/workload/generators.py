@@ -49,6 +49,16 @@ import numpy as np
 
 from corro_sim.utils.spec import format_spec, parse_spec
 
+# PRNG domain declaration for the key-lineage auditor (analysis/keys.py,
+# doc/static_analysis.md §4): workload schedule GENERATION draws from a
+# host-side numpy Generator only — it owns zero jax key streams, so the
+# auditor expects no workload-tagged fold_in in any program. On device
+# the schedule rides the step's explicit ``writes=`` port and consumes
+# the step's OWN write-side lanes (STEP_KEY_STREAMS[0..5]); a generator
+# that starts drawing from a jax key must claim a declared tag here and
+# re-baseline key_lineage.json, or `audit --keys` fails K2.
+WORKLOAD_HOST_RNG = "numpy:PCG64"
+
 __all__ = [
     "WORKLOADS",
     "Workload",

@@ -1,0 +1,16 @@
+"""CL109 fixture: same literal tag folded onto one key twice (fires once).
+
+The constant resolves through the module-level assignment, so the
+``GOSSIP_TAG`` site and the bare-literal ``7`` site collide; the third
+site uses a distinct tag and stays clean.
+"""
+import jax
+
+GOSSIP_TAG = 7
+
+
+def derive(key):
+    a = jax.random.fold_in(key, GOSSIP_TAG)
+    b = jax.random.fold_in(key, 7)  # BAD: same stream as line above
+    c = jax.random.fold_in(key, 8)  # distinct tag — fine
+    return a, b, c

@@ -407,5 +407,5 @@ def test_lint_result_shape():
     # one finding per bad fixture, none from the suppressed one
     assert sorted(f.rule for f in res.findings) == sorted(RULES)
     d = res.as_dict()
-    assert d["files_scanned"] == 9
+    assert d["files_scanned"] == 10
     assert sum(d["by_rule"].values()) == len(RULES)

@@ -1,0 +1,285 @@
+"""Key-lineage auditor (ISSUE 20): stream-disjointness proofs.
+
+Four layers, matching the auditor's structure:
+
+- **walker, on toys** — the symbolic derivation forest is exact on
+  programs with known lineage: split children and fold tags get the
+  pinned addresses, scanned key rows become per-round ``[r]`` streams,
+  and exclusive cond branches may share a key without tripping K1;
+- **negatives, adversarially** — a key-reusing program and a
+  tag-colliding program must FAIL the audit with the exact derivation
+  address named: the proofs are falsifiable, not tautologies;
+- **manifest** — the committed golden matches the tree for the cheapest
+  program (jax-version-gated like every jaxpr golden), a synthetic
+  report round-trips through write_golden/check, and every primed
+  cache-key program classifies into a covered key-lineage family
+  (the `prime_cache --check` gate's substrate);
+- **K3 prologues** — every engine derives round keys through the one
+  shared helper, and the helper's traced chain matches the pin.
+"""
+
+import json
+import os
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from corro_sim.analysis import keys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RAW_KEY = jax.ShapeDtypeStruct((2,), jnp.uint32)
+
+
+# ------------------------------------------------------ walker on toys
+
+def test_walker_split_and_fold_addresses_are_exact():
+    def toy(key):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.uniform(k1, (2,))
+        b = jax.random.normal(jax.random.fold_in(k2, 3), (2,))
+        return a, b
+
+    rep = keys.analyze_jaxpr(jax.make_jaxpr(toy)(RAW_KEY), {0: "key"})
+    assert rep["roots"] == ["key"]
+    assert rep["splits"] == ["key/split2"]
+    assert set(rep["draws"]) == {
+        "key/split2[0]", "key/split2[1]/fold(3)"
+    }
+    assert rep["fold_tags"] == {"key/split2[1]": ["3"]}
+    assert rep["k1"]["status"] == "proven"
+    assert rep["k2"]["status"] == "proven"  # cfg=None: no tag registry
+    assert rep["notes"] == {}
+
+
+def test_walker_scanned_key_rows_become_round_streams():
+    def toy(ks):
+        def body(c, k):
+            return c, jax.random.bits(k, (3,), jnp.uint32)
+
+        return jax.lax.scan(body, jnp.float32(0), ks)
+
+    cj = jax.make_jaxpr(toy)(jax.ShapeDtypeStruct((4, 2), jnp.uint32))
+    rep = keys.analyze_jaxpr(cj, {0: "keys"})
+    assert list(rep["draws"]) == ["keys[r]"]
+    assert rep["k1"]["status"] == "proven"
+    assert rep["notes"] == {}
+
+
+def test_exclusive_cond_branches_may_share_a_key():
+    def toy(p, key):
+        return jax.lax.cond(
+            p,
+            lambda k: jax.random.bits(k, (2,), jnp.uint32),
+            lambda k: jax.random.bits(k, (2,), jnp.uint32) + 1,
+            key,
+        )
+
+    cj = jax.make_jaxpr(toy)(
+        jax.ShapeDtypeStruct((), jnp.bool_), RAW_KEY
+    )
+    rep = keys.analyze_jaxpr(cj, {1: "key"})
+    # consumed once per branch, but the branches are exclusive
+    assert rep["k1"]["status"] == "proven"
+    assert list(rep["draws"]) == ["key"]
+
+
+# ------------------------------------------------ negatives (K1 / K2)
+
+def _as_report(name: str, rep: dict) -> dict:
+    return {
+        "programs": {name: dict(rep, family="step")},
+        "prologues": {"k3": {"violations": []}},
+    }
+
+
+def test_key_reuse_fails_the_audit_naming_the_address():
+    """Two draws from one underived key: K1 must fall, and the audit's
+    unconditional budget must name the exact derivation address."""
+
+    def bad(key):
+        a = jax.random.bits(key, (4,), jnp.uint32)
+        b = jax.random.bits(key, (4,), jnp.uint32)
+        return a, b
+
+    rep = keys.analyze_jaxpr(jax.make_jaxpr(bad)(RAW_KEY), {0: "key"})
+    assert rep["k1"]["status"] == "violated"
+    [violation] = rep["k1"]["violations"]
+    assert "'key'" in violation and "2 times" in violation
+
+    problems = keys.budget_problems(_as_report("toy/reuse", rep))
+    assert len(problems) == 1
+    assert "'key'" in problems[0] and "[toy/reuse]" in problems[0]
+
+
+def test_tag_collision_fails_the_audit_naming_the_address():
+    """Two fold_in sites with the same literal tag under one parent:
+    both derive the SAME child stream — K2 must fall and name the
+    colliding parent + tag (the jaxpr face of lint rule CL109)."""
+
+    def bad(key):
+        a = jax.random.uniform(jax.random.fold_in(key, 7), (2,))
+        b = jax.random.normal(jax.random.fold_in(key, 7), (2,))
+        return a, b
+
+    rep = keys.analyze_jaxpr(jax.make_jaxpr(bad)(RAW_KEY), {0: "key"})
+    assert rep["k2"]["status"] == "violated"
+    [violation] = rep["k2"]["violations"]
+    assert "fold(7)" in violation and "'key'" in violation
+    assert "2 sites" in violation
+    # the collapsed child stream is also a K1 double-consumption —
+    # both faces of the same collision land in the audit's problems
+    assert rep["k1"]["status"] == "violated"
+    assert "'key/fold(7)'" in rep["k1"]["violations"][0]
+
+    problems = keys.budget_problems(_as_report("toy/collide", rep))
+    assert len(problems) == 2
+    assert any("fold(7) at 2 sites" in p for p in problems)
+    assert all("[toy/collide]" in p for p in problems)
+
+
+def test_undeclared_tag_fails_under_a_real_config():
+    """With a config in hand the observed-tags side of K2 is live: a
+    literal tag outside the declared registry must be rejected."""
+    from corro_sim.analysis.jaxpr_audit import audit_config
+
+    def bad(key):
+        return jax.random.uniform(jax.random.fold_in(key, 4242), (2,))
+
+    rep = keys.analyze_jaxpr(
+        jax.make_jaxpr(bad)(RAW_KEY), {0: "key"}, cfg=audit_config()
+    )
+    assert rep["k2"]["status"] == "violated"
+    [violation] = rep["k2"]["violations"]
+    assert "undeclared" in violation and "fold(4242)" in violation
+
+
+def test_anonymous_draws_are_an_unconditional_problem():
+    """A draw whose key the walker cannot tie to a tracked root is an
+    audit failure even with every declared stream clean — no stream
+    escapes the proof by being invisible."""
+
+    def sneaky(key):
+        return jax.random.uniform(key, (2,))
+
+    # the key arrives through an input the audit was not told about
+    rep = keys.analyze_jaxpr(jax.make_jaxpr(sneaky)(RAW_KEY), {})
+    assert rep["notes"].get("anonymous_draws", 0) >= 1
+    problems = keys.budget_problems(_as_report("toy/anon", rep))
+    assert any("untracked key root" in p for p in problems)
+
+
+# ------------------------------------------------------------ manifest
+
+def test_declared_tag_registry_is_pinned():
+    assert keys.declared_tags() == {
+        "broadcast_targets": 7,
+        "fault_lane": 64023,  # 0x0FA17
+        "swim_announce": 997,
+        "swim_peer_base": 0,
+    }
+    tags = keys.expected_tags(None)
+    assert tags[64023] == "fault_lane" and 0 not in tags
+
+    class _Cfg:
+        swim_gossip_peers = 3
+
+    with_peers = keys.expected_tags(_Cfg())
+    assert with_peers[0] == "swim_peer[0]"
+    assert with_peers[2] == "swim_peer[2]"
+    assert 3 not in with_peers
+
+
+def test_audit_full_matches_the_committed_manifest():
+    """The pytest face of `audit --keys` for the cheapest program
+    (jax-version-gated like the fingerprint golden)."""
+    from corro_sim.analysis.jaxpr_audit import audit_config
+
+    rep = keys._step_entry(audit_config())
+    assert rep["k1"]["status"] == "proven"
+    assert rep["k2"]["status"] == "proven"
+    assert rep["notes"] == {}
+
+    golden = keys.load_golden()
+    assert golden is not None, (
+        "key_lineage.json not committed — run "
+        "`corro-sim audit --keys --update-golden`"
+    )
+    assert golden.get("waivers", {}) == {}
+    if golden["jax_version"] != jax.__version__:
+        pytest.skip(
+            f"manifest baselined under jax {golden['jax_version']}, "
+            f"running {jax.__version__}"
+        )
+    assert golden["programs"]["audit/full"] == dict(rep, family="step")
+
+
+def test_check_roundtrip_and_drift(monkeypatch, tmp_path):
+    monkeypatch.setattr(
+        keys, "GOLDEN_PATH", str(tmp_path / "key_lineage.json")
+    )
+
+    def toy(key):
+        return jax.random.uniform(jax.random.fold_in(key, 3), (2,))
+
+    rep = keys.analyze_jaxpr(jax.make_jaxpr(toy)(RAW_KEY), {0: "key"})
+    report = {
+        "jax_version": jax.__version__,
+        "device_count": 1,
+        "declared_tags": keys.declared_tags(),
+        "programs": {"toy/one": dict(rep, family="step")},
+        "prologues": {
+            "aliases": {}, "call_sites": {},
+            "chains": {"round": keys.ROUND_PROLOGUE},
+            "k3": {"status": "proven", "violations": []},
+        },
+        "families": dict(keys.KEY_FAMILIES),
+    }
+    assert keys.golden_drift(report, None)  # no manifest -> re-baseline
+    keys.write_golden(report, keys.GOLDEN_PATH)
+    checked = keys.check(json.loads(json.dumps(report)))
+    assert checked["ok"], (checked["problems"], checked["drift"])
+
+    bad = json.loads(json.dumps(keys.load_golden()))
+    bad["programs"]["toy/one"]["fold_tags"] = {"key": ["4"]}
+    drift = keys.golden_drift(report, bad)
+    assert len(drift) == 1 and "fold_tags" in drift[0]
+
+    # another jax version -> comparison skipped, budgets still live
+    stale = json.loads(json.dumps(keys.load_golden()))
+    stale["jax_version"] = "0.0.0"
+    with open(keys.GOLDEN_PATH, "w", encoding="utf-8") as fh:
+        json.dump(stale, fh)
+    rechecked = keys.check(json.loads(json.dumps(report)))
+    assert rechecked["ok"] and "golden_skipped" in rechecked
+
+
+def test_every_primed_program_has_key_lineage_coverage():
+    """The `prime_cache --check` substrate: every program name in the
+    committed cache-key manifest maps onto a key-lineage family the
+    committed manifest covers — no unaudited streams."""
+    with open(os.path.join(
+        REPO, "corro_sim", "analysis", "golden", "cache_keys.json"
+    ), encoding="utf-8") as fh:
+        cache_manifest = json.load(fh)
+    assert keys.coverage_gaps(cache_manifest) == []
+    # and the gate is falsifiable: an unclassifiable name is reported
+    fake = {"programs": dict(cache_manifest["programs"],
+                             **{"mystery/new-shape": {}})}
+    gaps = keys.coverage_gaps(fake)
+    assert gaps == [
+        ("mystery/new-shape", "no key-lineage family classifies it")
+    ]
+
+
+# --------------------------------------------------------- K3 prologues
+
+def test_prologues_alias_the_shared_helper_and_chains_match():
+    rep = keys.prologue_report()
+    assert all(rep["aliases"].values()), rep["aliases"]
+    assert all(rep["call_sites"].values()), rep["call_sites"]
+    assert rep["chains"]["chunk"] == keys.CHUNK_PROLOGUE
+    assert rep["chains"]["round"] == keys.ROUND_PROLOGUE
+    assert rep["k3"] == {"status": "proven", "violations": []}

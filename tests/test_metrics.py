@@ -424,6 +424,42 @@ def test_audit_contract_family_renders_and_validates(cluster):
     _validate_exposition(text)
 
 
+def test_audit_key_family_renders_and_validates(cluster):
+    """ISSUE 20 satellite: the corro_audit_key_* family — the
+    key-lineage auditor's per-family (k1/k2/k3/manifest) check and
+    violation counters (analysis/keys.py export_metrics) — renders
+    through the exposition and the whole thing still validates. Fed a
+    synthetic report (one proven program + one K1 problem + one drift
+    line) so the test costs no trace."""
+    from corro_sim.analysis.keys import export_metrics
+
+    export_metrics({
+        "programs": {
+            "toy/one": {
+                "k1": {"keys_checked": 5, "violations": []},
+                "k2": {"tags_checked": 3, "violations": []},
+            },
+            "toy/skip": {"skipped": "needs 8 devices"},
+        },
+        "prologues": {
+            "aliases": {"a": True, "b": True},
+            "call_sites": {"a": True},
+            "chains": {"round": {}},
+        },
+        "problems": ["K1: key 'key' consumed 2 times [toy/one]"],
+        "drift": ["'toy/one': fold_tags drifted"],
+    })
+    text = render_prometheus(cluster)
+    # presence, not exact values: the counters are process-global, and
+    # any earlier test that ran keys.check() has already fed them
+    assert 'corro_audit_key_checks_total{family="k1"}' in text
+    assert 'corro_audit_key_checks_total{family="k2"}' in text
+    assert 'corro_audit_key_checks_total{family="k3"}' in text
+    assert 'corro_audit_key_violations_total{family="k1"}' in text
+    assert 'corro_audit_key_violations_total{family="manifest"}' in text
+    _validate_exposition(text)
+
+
 def test_workload_and_sub_latency_families_render_and_validate():
     """ISSUE 7 satellite: the corro_workload_* counters and the
     corro_sub_latency_* histograms — recorded by the live load harness

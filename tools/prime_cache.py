@@ -859,6 +859,23 @@ def main(argv: list[str] | None = None) -> int:
                 "`corro-sim audit --contracts --update-golden`"
             )
             rc = 2
+        # ISSUE 20: no unaudited STREAMS either — every primed program
+        # must classify into a key-lineage family the committed
+        # manifest (analysis/golden/key_lineage.json) has analyzed, so
+        # a new program shape cannot ship with unproven PRNG streams
+        from corro_sim.analysis.keys import coverage_gaps as key_gaps
+
+        unkeyed = key_gaps(manifest)
+        for name, reason in unkeyed:
+            print(f"UNAUDITED {name}: {reason}")
+        if unkeyed:
+            print(
+                "CHECK FAILED: primed program(s) without key-lineage "
+                "coverage — extend analysis/keys.py (classify_program "
+                "/ KEY_FAMILIES / key_programs) and re-baseline with "
+                "`corro-sim audit --keys --update-golden`"
+            )
+            rc = 2
     if args.report:
         with open(args.report, "w", encoding="utf-8") as fh:
             json.dump({
